@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (see README / driver
+contract). Must set env before jax initializes."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import tempfile  # noqa: E402
+
+# keep test cache/seed artifacts out of the user's home
+_tmp = tempfile.mkdtemp(prefix="veles_tpu_test_")
+os.environ.setdefault("VELES_TPU_CACHE", _tmp)
+
+from veles_tpu.core.config import root  # noqa: E402
+
+root.common.dirs.cache = os.path.join(_tmp, "cache")
+root.common.dirs.snapshots = os.path.join(_tmp, "snapshots")
+root.common.dirs.events = os.path.join(_tmp, "events")
+root.common.disable.plotting = True

@@ -1,0 +1,205 @@
+"""Foundation tests, mirroring reference test_config.py / test_mutable.py /
+test_random.py coverage."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.core.config import Config, ConfigError, root, validate_kwargs
+from veles_tpu.core.mutable import Bool, link, unlink
+from veles_tpu.core import prng
+from veles_tpu.core.registry import (
+    UnitRegistry, damerau_levenshtein, MappedObjectsRegistry)
+from veles_tpu.core.pickling import Pickleable
+
+
+class TestConfig:
+    def test_materialize_and_get(self):
+        cfg = Config("test")
+        cfg.a.b.c = 42
+        assert cfg.a.b.c == 42
+        assert cfg.a.b.get("c") == 42
+        assert cfg.a.b.get("missing", 7) == 7
+        assert "c" in cfg.a.b
+        assert "missing" not in cfg.a.b
+
+    def test_update_nested(self):
+        cfg = Config("test")
+        cfg.update({"x": {"y": 1, "z": 2}, "w": 3})
+        assert cfg.x.y == 1 and cfg.x.z == 2 and cfg.w == 3
+        cfg.x.update(y=10)
+        assert cfg.x.y == 10 and cfg.x.z == 2
+
+    def test_protect(self):
+        cfg = Config("test")
+        cfg.key = 1
+        cfg.protect("key")
+        with pytest.raises(ConfigError):
+            cfg.key = 2
+
+    def test_validate_kwargs(self):
+        cfg = Config("test")
+        with pytest.raises(ConfigError):
+            validate_kwargs("caller", oops=cfg.not_set_anywhere)
+
+    def test_root_defaults(self):
+        assert root.common.engine.compute_dtype == "bfloat16"
+
+
+class TestBool:
+    def test_leaf(self):
+        b = Bool(False)
+        assert not b
+        b <<= True
+        assert b
+
+    def test_expressions(self):
+        a, b = Bool(True), Bool(False)
+        c = a & b
+        d = a | b
+        e = a ^ b
+        f = ~a
+        assert not c and d and e and not f
+        b <<= True
+        assert c and d and not e
+
+    def test_triggers(self):
+        b = Bool(False)
+        fired = []
+        b.on_true = lambda: fired.append("t")
+        b.on_false = lambda: fired.append("f")
+        b <<= True
+        b <<= True  # no edge
+        b <<= False
+        assert fired == ["t", "f"]
+
+    def test_pickle(self):
+        a, b = Bool(True), Bool(False)
+        c = a | b
+        c2 = pickle.loads(pickle.dumps(c))
+        assert bool(c2) == bool(c)
+
+
+class TestLinks:
+    def test_link_and_unlink(self):
+        class P:
+            pass
+
+        class C:
+            pass
+
+        p, c = P(), C()
+        p.value = 5
+        link(c, "value", p)
+        assert c.value == 5
+        p.value = 6
+        assert c.value == 6
+        unlink(c, "value")
+        p.value = 7
+        assert c.value == 6
+
+    def test_two_way(self):
+        class P:
+            pass
+
+        class C:
+            pass
+
+        p, c = P(), C()
+        p.v = 1
+        link(c, "v", p, two_way=True)
+        c.v = 9
+        assert p.v == 9
+
+
+class TestPrng:
+    def test_reproducible(self):
+        a = prng.RandomGenerator("t1").seed(123)
+        b = prng.RandomGenerator("t2").seed(123)
+        assert numpy.array_equal(a.permutation(100), b.permutation(100))
+        ka, kb = a.next_key(), b.next_key()
+        import jax
+        assert numpy.array_equal(
+            jax.random.normal(ka, (4,)), jax.random.normal(kb, (4,)))
+
+    def test_state_roundtrip(self):
+        a = prng.RandomGenerator("t3").seed(7)
+        a.permutation(10)
+        a.next_key()
+        state = a.__getstate__()
+        b = prng.RandomGenerator.__new__(prng.RandomGenerator)
+        b.__setstate__(state)
+        assert numpy.array_equal(a.permutation(50), b.permutation(50))
+        import jax
+        assert numpy.array_equal(
+            jax.random.key_data(a.next_key()),
+            jax.random.key_data(b.next_key()))
+
+    def test_registry(self):
+        assert prng.get("k") is prng.get("k")
+        assert prng.get("k") is not prng.get("other")
+
+    def test_replay_key(self):
+        rg = prng.RandomGenerator("t4").seed(1)
+        import jax
+        k1 = rg.next_key()
+        assert numpy.array_equal(
+            jax.random.key_data(k1), jax.random.key_data(rg.key_at(1)))
+
+
+class TestRegistry:
+    def test_damerau_levenshtein(self):
+        assert damerau_levenshtein("abc", "abc") == 0
+        assert damerau_levenshtein("abc", "acb") == 1
+        assert damerau_levenshtein("abc", "xyz") == 3
+
+    def test_kwattrs(self):
+        class Base(metaclass=UnitRegistry):
+            def __init__(self, alpha=1, beta=2):
+                pass
+
+        class Child(Base):
+            def __init__(self, gamma=3, **kwargs):
+                super().__init__(**kwargs)
+
+        assert {"alpha", "beta", "gamma"} <= Child.KWATTRS
+
+    def test_mapped_registry(self):
+        class Codec(metaclass=MappedObjectsRegistry):
+            REGISTRY = "test_codecs"
+
+        class GzipCodec(Codec):
+            MAPPING = "gz"
+
+        assert MappedObjectsRegistry.get_mapping("test_codecs")["gz"] \
+            is GzipCodec
+
+
+class _Thing(Pickleable):
+    def init_unpickled(self):
+        super().init_unpickled()
+        self.volatile_ = "rebuilt"
+
+
+class _Holder(Pickleable):
+    pass
+
+
+class TestPickleable:
+    def test_strips_underscored(self):
+        t = _Thing()
+        t.keep = 1
+        t.volatile_ = "live"
+        t2 = pickle.loads(pickle.dumps(t))
+        assert t2.keep == 1
+        assert t2.volatile_ == "rebuilt"
+
+    def test_jax_arrays_to_numpy(self):
+        import jax.numpy as jnp
+
+        h = _Holder()
+        h.weights = jnp.ones((3, 3))
+        h2 = pickle.loads(pickle.dumps(h))
+        assert isinstance(h2.weights, numpy.ndarray)
+        assert numpy.array_equal(h2.weights, numpy.ones((3, 3)))

@@ -1,0 +1,276 @@
+"""Unit/gate/link/workflow tests, mirroring reference test_units.py and
+test_workflow.py coverage."""
+
+import pickle
+
+import pytest
+
+from veles_tpu.core.errors import AttributeMissingError, NoMoreJobsError
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.plumbing import FireStarter, Repeater
+from veles_tpu.core.units import TrivialUnit, Unit
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+
+
+class Recorder(Unit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, log, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.log = log
+
+    def run(self):
+        self.log.append(self.name)
+
+
+class Counter(Unit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.count = 0
+
+    def run(self):
+        self.count += 1
+
+
+def make_chain(wf, log, names):
+    units = [Recorder(wf, log, name=n) for n in names]
+    prev = wf.start_point
+    for u in units:
+        u.link_from(prev)
+        prev = u
+    wf.end_point.link_from(prev)
+    return units
+
+
+class TestControlFlow:
+    def test_linear_chain(self):
+        wf = DummyWorkflow()
+        log = []
+        make_chain(wf, log, ["a", "b", "c"])
+        wf.initialize()
+        wf.run()
+        assert log == ["a", "b", "c"]
+
+    def test_and_gate_fanin(self):
+        """A unit with two incoming links runs only after both fire."""
+        wf = DummyWorkflow()
+        log = []
+        a = Recorder(wf, log, name="a")
+        b = Recorder(wf, log, name="b")
+        c = Recorder(wf, log, name="c")
+        a.link_from(wf.start_point)
+        b.link_from(wf.start_point)
+        c.link_from(a, b)
+        wf.end_point.link_from(c)
+        wf.initialize()
+        wf.run()
+        assert log[-1] == "c"
+        assert sorted(log[:2]) == ["a", "b"]
+        assert len(log) == 3
+
+    def test_gate_block(self):
+        wf = DummyWorkflow()
+        log = []
+        a, b, c = make_chain(wf, log, ["a", "b", "c"])
+        b.gate_block = Bool(True)
+        wf.end_point.unlink_from(c)
+        wf.end_point.link_from(a)  # need another path to finish
+        wf.initialize()
+        wf.run()
+        assert "b" not in log and "c" not in log
+
+    def test_gate_skip(self):
+        wf = DummyWorkflow()
+        log = []
+        a, b, c = make_chain(wf, log, ["a", "b", "c"])
+        b.gate_skip = Bool(True)
+        wf.initialize()
+        wf.run()
+        assert log == ["a", "c"]
+
+    def test_repeater_loop(self):
+        """Repeater closes the epoch loop; a gate opens the exit path."""
+        wf = DummyWorkflow()
+        rep = Repeater(wf)
+        counter = Counter(wf, name="counter")
+        done = Bool(False)
+
+        class Decider(Unit):
+            hide_from_registry = True
+
+            def run(self):
+                if counter.count >= 5:
+                    done.set()
+
+        dec = Decider(wf, name="decider")
+        rep.link_from(wf.start_point)
+        counter.link_from(rep)
+        dec.link_from(counter)
+        rep.link_from(dec)          # cycle
+        wf.end_point.link_from(dec)
+        wf.end_point.gate_block = ~done
+        rep.gate_block = done
+        wf.initialize()
+        wf.run()
+        assert counter.count == 5
+
+    def test_firestarter(self):
+        wf = DummyWorkflow()
+        c = Counter(wf, name="c")
+        c.stopped = True
+        fs = FireStarter(wf, units=[c])
+        fs.link_from(wf.start_point)
+        wf.end_point.link_from(fs)
+        wf.initialize()
+        wf.run()
+        assert c.stopped is True  # run finished sets stopped again
+
+
+class TestDataLinks:
+    def test_link_attrs(self):
+        wf = DummyWorkflow()
+        a = TrivialUnit(wf, name="a")
+        b = TrivialUnit(wf, name="b")
+        a.output = 10
+        b.link_attrs(a, ("input", "output"))
+        assert b.input == 10
+        a.output = 20
+        assert b.input == 20
+
+    def test_demand(self):
+        wf = DummyWorkflow()
+        u = TrivialUnit(wf, name="u")
+        u.demand("needed")
+        with pytest.raises(AttributeMissingError):
+            wf.initialize()
+        u.needed = 5
+        wf.initialize()
+
+
+class TestWorkflow:
+    def test_error_propagates(self):
+        wf = DummyWorkflow()
+
+        class Boom(Unit):
+            hide_from_registry = True
+
+            def run(self):
+                raise RuntimeError("boom")
+
+        a = Boom(wf, name="a")
+        b = Boom(wf, name="b")
+        # two successors forces pool fan-out; error must surface in run()
+        a.link_from(wf.start_point)
+        b.link_from(wf.start_point)
+        wf.end_point.link_from(a, b)
+        wf.initialize()
+        with pytest.raises(RuntimeError):
+            wf.run()
+
+    def test_gather_results(self):
+        wf = DummyWorkflow()
+
+        class Metric(TrivialUnit):
+            hide_from_registry = True
+
+            def get_metric_names(self):
+                return ["accuracy"]
+
+            def get_metric_values(self):
+                return [0.99]
+
+        m = Metric(wf, name="m")
+        m.link_from(wf.start_point)
+        wf.end_point.link_from(m)
+        wf.initialize()
+        wf.run()
+        results = wf.gather_results()
+        assert results["accuracy"] == 0.99
+        assert "run_time" in results
+
+    def test_checksum_stable(self):
+        wf = DummyWorkflow()
+        TrivialUnit(wf, name="x").link_from(wf.start_point)
+        c1 = wf.checksum
+        assert c1 == wf.checksum
+        TrivialUnit(wf, name="y")
+        assert wf.checksum != c1
+
+    def test_graph_dot(self):
+        wf = DummyWorkflow()
+        log = []
+        make_chain(wf, log, ["a", "b"])
+        dot = wf.generate_graph()
+        assert "digraph" in dot and '"a"' in dot.replace("\\n(Recorder)", '"')\
+            or "a" in dot
+        assert "->" in dot
+
+    def test_pickle_roundtrip(self):
+        wf = DummyWorkflow()
+        log = []
+        make_chain(wf, log, ["a", "b", "c"])
+        wf.initialize()
+        wf.run()
+        # detach launcher before pickling (snapshotting does the same)
+        launcher = wf.workflow
+        wf._workflow = None
+        blob = pickle.dumps(wf)
+        wf._workflow = launcher
+        wf2 = pickle.loads(blob)
+        assert [u.name for u in wf2.units[:5]] == \
+            [u.name for u in wf.units[:5]]
+
+
+class TestDistributedAggregation:
+    def _make(self):
+        wf = DummyWorkflow()
+
+        class Worker(Unit):
+            hide_from_registry = True
+            jobs = 0
+
+            def __init__(self, workflow, **kwargs):
+                super().__init__(workflow, **kwargs)
+                self.applied = []
+                self.updates = []
+
+            def generate_data_for_slave(self, slave=None):
+                type(self).jobs += 1
+                if type(self).jobs > 3:
+                    raise NoMoreJobsError()
+                return {"job": type(self).jobs}
+
+            def apply_data_from_master(self, data):
+                self.applied.append(data)
+
+            def generate_data_for_master(self):
+                return {"result": len(self.applied)}
+
+            def apply_data_from_slave(self, data, slave=None):
+                self.updates.append(data)
+
+        w = Worker(wf, name="w")
+        w.link_from(wf.start_point)
+        wf.end_point.link_from(w)
+        wf.initialize()
+        return wf, w
+
+    def test_job_update_cycle(self):
+        Worker_jobs_reset = None
+        wf, w = self._make()
+        type(w).jobs = 0
+        job = wf.generate_data_for_slave("slave1")
+        assert isinstance(job, list)
+        wf.apply_data_from_master(job)
+        assert w.applied == [{"job": 1}]
+        update = wf.generate_data_for_master()
+        wf.apply_data_from_slave(update, "slave1")
+        assert w.updates == [{"result": 1}]
+
+    def test_no_more_jobs(self):
+        wf, w = self._make()
+        type(w).jobs = 3
+        assert wf.generate_data_for_slave("s") is None
+        assert not wf.has_more_jobs()

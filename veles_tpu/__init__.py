@@ -1,0 +1,40 @@
+"""veles_tpu — a TPU-native dataflow machine-learning framework.
+
+A from-scratch re-design of the capabilities of Samsung VELES
+(``gujunli/veles``) for TPUs: models are Workflows — directed graphs of Units
+linked by control and data edges — whose accelerated segments compile into
+fused XLA computations via JAX (jit/pjit), with Pallas kernels for hot ops,
+data/tensor/sequence parallelism over a ``jax.sharding.Mesh`` (ICI
+collectives), an elastic host-orchestrated fleet mode over TCP (DCN),
+whole-workflow snapshot/resume, plotting/web-status/REST services, genetic
+hyperparameter optimization, ensembles, a model hub, and a C++ inference
+runtime for exported workflow packages.
+
+Importable API (reference ``veles/__init__.py:126-189``): the package is
+callable — ``import veles_tpu; veles_tpu("wf.py", config...)`` runs a
+workflow with kwargs mirroring the CLI flags.
+"""
+
+import sys
+
+__version__ = "0.1.0"
+__license__ = "Apache 2.0"
+
+from veles_tpu.core.config import root, Config  # noqa: F401
+from veles_tpu.core.mutable import Bool, LinkableAttribute  # noqa: F401
+from veles_tpu.core import prng  # noqa: F401
+
+
+def __run__(workflow_file, config_file=None, **kwargs):
+    from veles_tpu.cli import run_workflow_file
+    return run_workflow_file(workflow_file, config_file, **kwargs)
+
+
+class _VelesTPUModule(sys.modules[__name__].__class__):
+    """Callable module (reference ``VelesModule``, ``__init__.py:126``)."""
+
+    def __call__(self, workflow_file, config_file=None, **kwargs):
+        return __run__(workflow_file, config_file, **kwargs)
+
+
+sys.modules[__name__].__class__ = _VelesTPUModule

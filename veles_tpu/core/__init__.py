@@ -1,0 +1,1 @@
+"""Core layer: foundation + unit/graph machinery."""

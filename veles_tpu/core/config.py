@@ -1,0 +1,181 @@
+"""Self-materializing dotted configuration tree.
+
+TPU-native re-design of the reference config system (``veles/config.py:52-290``
+and ``veles/site_config.py``): a ``Config`` node materializes child nodes on
+attribute access so workflow config files can write ``root.mnist.learning_rate
+= 0.01`` without declaring intermediate nodes. Supports nested ``update()``,
+``protect()``-ed read-only keys, layered site overrides, and pretty printing.
+
+Unlike the reference, engine defaults here describe the XLA/TPU engine
+(precision/dtype policy, pallas autotune cache, mesh defaults) instead of
+OpenCL/CUDA block sizes.
+"""
+
+import json
+import os
+import pprint
+
+from veles_tpu.core.errors import VelesError
+
+
+class ConfigError(VelesError):
+    pass
+
+
+_PROTECTED = "_protected_"
+_NAME = "_name_"
+
+
+class Config:
+    """A node in the configuration tree (reference ``config.py:52``)."""
+
+    def __init__(self, path):
+        object.__setattr__(self, _NAME, path)
+        object.__setattr__(self, _PROTECTED, set())
+
+    # -- materialization ----------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        child = Config("%s.%s" % (object.__getattribute__(self, _NAME), name))
+        object.__setattr__(self, name, child)
+        return child
+
+    def __setattr__(self, name, value):
+        if name in object.__getattribute__(self, _PROTECTED):
+            raise ConfigError(
+                "Config key %s.%s is protected" % (self.__path__, name))
+        object.__setattr__(self, name, value)
+
+    # -- public API ---------------------------------------------------------
+    @property
+    def __path__(self):
+        return object.__getattribute__(self, _NAME)
+
+    def update(self, value=None, **kwargs):
+        """Deep-merge a nested dict (or kwargs) into this subtree
+        (reference ``config.py:156-176``)."""
+        if value is None:
+            value = kwargs
+        if isinstance(value, Config):
+            value = value.__content__()
+        if not isinstance(value, dict):
+            raise ConfigError(
+                "Can only update %s from a dict, got %r"
+                % (self.__path__, value))
+        for key, val in value.items():
+            if isinstance(val, dict):
+                getattr(self, key).update(val)
+            else:
+                setattr(self, key, val)
+        return self
+
+    def protect(self, *names):
+        """Make keys read-only (reference ``config.py`` protect())."""
+        object.__getattribute__(self, _PROTECTED).update(names)
+
+    def get(self, name, default=None):
+        """Return the value of ``name`` without materializing it."""
+        try:
+            value = object.__getattribute__(self, name)
+        except AttributeError:
+            return default
+        if isinstance(value, Config):
+            return default
+        return value
+
+    def __contains__(self, name):
+        try:
+            return not isinstance(object.__getattribute__(self, name), Config)
+        except AttributeError:
+            return False
+
+    def __content__(self):
+        result = {}
+        for key, value in vars(self).items():
+            if key in (_NAME, _PROTECTED):
+                continue
+            if isinstance(value, Config):
+                result[key] = value.__content__()
+            else:
+                result[key] = value
+        return result
+
+    def print_(self, stream=None):
+        pprint.pprint({self.__path__: self.__content__()}, stream=stream)
+
+    def __repr__(self):
+        return "<Config %s: %s>" % (
+            self.__path__, pprint.pformat(self.__content__()))
+
+
+def validate_kwargs(caller, **kwargs):
+    """Warn about Config nodes leaking in as kwargs values
+    (reference ``config.py:164``): an unset config path materializes as a
+    Config instance rather than a value, which is almost always a typo."""
+    for name, value in kwargs.items():
+        if isinstance(value, Config):
+            raise ConfigError(
+                "%s: keyword %r is an unset config node %s — probably a typo "
+                "in your config file" % (caller, name, value.__path__))
+
+
+#: The global configuration root, like reference ``config.py:151``.
+root = Config("root")
+
+# -- engine defaults (TPU edition of reference config.py:177-290) -----------
+root.common.update({
+    "dirs": {
+        "cache": os.path.expanduser("~/.veles_tpu/cache"),
+        "snapshots": os.path.expanduser("~/.veles_tpu/snapshots"),
+        "datasets": os.path.expanduser("~/.veles_tpu/datasets"),
+        "events": os.path.expanduser("~/.veles_tpu/events"),
+    },
+    "engine": {
+        # compute dtype policy: matmuls/convs run in bfloat16 on the MXU with
+        # float32 accumulation; params kept in float32.
+        "compute_dtype": "bfloat16",
+        "param_dtype": "float32",
+        # precision levels mirror reference config.py:244-247:
+        # 0 - default MXU precision, 1 - float32 inputs ("Kahan" tier),
+        # 2 - highest XLA precision (multi-partial tier).
+        "precision_level": 0,
+        "donate_params": True,
+        # pallas kernel toggles; plain lax fallbacks always exist.
+        "use_pallas": True,
+        "pallas_autotune_cache": os.path.expanduser(
+            "~/.veles_tpu/cache/pallas_tuning.json"),
+    },
+    "mesh": {
+        # default logical mesh axes; sizes are resolved against the actual
+        # device count at Mesh build time (parallel/mesh.py).
+        "axes": {"data": -1, "model": 1, "seq": 1, "expert": 1, "pipe": 1},
+    },
+    "trace": {"run": False},
+    "timings": False,
+    "disable": {"plotting": False, "publishing": False, "snapshotting": False},
+    "web": {"host": "localhost", "port": 8090, "notification_interval": 1.0},
+    "fleet": {
+        "job_timeout": 120.0,
+        "sync_interval": 1.0,
+        "max_reconnect_attempts": 7,
+    },
+    "forge": {"service_name": "forge", "manifest": "manifest.json"},
+})
+
+
+def _apply_site_overrides():
+    """Layered site configuration (reference ``site_config.py`` and
+    ``config.py:292-307``): JSON overrides merged from /etc, $HOME and CWD."""
+    for path in ("/etc/default/veles_tpu.json",
+                 os.path.expanduser("~/.veles_tpu/site_config.json"),
+                 os.path.join(os.getcwd(), "site_config.json")):
+        try:
+            with open(path, "r") as fin:
+                overrides = json.load(fin)
+        except (OSError, ValueError):
+            continue
+        root.update(overrides)
+
+
+_apply_site_overrides()

@@ -1,0 +1,62 @@
+"""Pickling base: trailing-underscore attribute stripping + rebuild hook.
+
+TPU-native equivalent of reference ``veles/distributable.py:48-133``
+(``Pickleable``) and ``veles/pickle2.py``. Attributes whose names end with
+``_`` are volatile (locks, loggers, compiled functions, live jax executables)
+— excluded from pickles and rebuilt in ``init_unpickled()`` after load.
+``stripped_pickle`` mode additionally materializes linked attributes so wire
+payloads (fleet jobs/updates) carry plain values rather than live object
+references.
+
+jax.Arrays are converted to numpy on ``__getstate__`` via ``pickle_jax``
+below, so snapshots are host-portable and device-independent.
+"""
+
+import pickle
+
+import numpy
+
+from veles_tpu.core.logger import Logger
+
+best_protocol = pickle.HIGHEST_PROTOCOL
+
+
+def jax_to_host(value):
+    """Convert jax.Arrays (possibly nested in containers) to numpy."""
+    import jax
+    if isinstance(value, jax.Array):
+        return numpy.asarray(value)
+    if isinstance(value, dict):
+        return {k: jax_to_host(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(jax_to_host(v) for v in value)
+    return value
+
+
+class Pickleable(Logger):
+    """Base with the trailing-underscore pickling contract
+    (reference ``distributable.py:48``)."""
+
+    def __init__(self, **kwargs):
+        self.stripped_pickle = False
+        super().__init__(**kwargs)
+        self.init_unpickled()
+
+    def init_unpickled(self):
+        """Rebuild volatile (``*_``-named) state; called from ``__init__``
+        and after unpickling (reference ``distributable.py:60-67``)."""
+        self.stripped_pickle = False
+
+    def __getstate__(self):
+        state = {}
+        for key, value in self.__dict__.items():
+            if key.endswith("_") and not (key.startswith("__")
+                                          and key.endswith("__")):
+                continue
+            state[key] = jax_to_host(value)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._logger_ = None
+        self.init_unpickled()

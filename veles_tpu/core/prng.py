@@ -1,0 +1,181 @@
+"""Reproducible keyed PRNG streams over JAX counter-based keys.
+
+TPU-native re-design of reference ``veles/prng/random_generator.py``. The
+reference guarantees reproducibility by owning saved numpy RNG states per
+named stream and save/restoring global numpy state around every call
+(``random_generator.py:52-106``), persisting seeds to
+``cache/random_seed_<key>.npy``. JAX's splittable threefry keys make this
+radically simpler and *stronger*: a stream is (seed, counter); any draw is a
+pure function of them, so reproducibility survives resharding, elastic
+slave requeue and snapshot/resume by just recording two integers.
+
+Each named ``RandomGenerator`` owns:
+- a ``jax.random`` key chain for device-side randomness (weight init,
+  dropout, on-device uniform fills — replacing the xorshift1024* kernels in
+  reference ``ocl/random.cl``/``cuda/random.cu``);
+- a numpy ``Generator`` for host-side randomness (index shuffles in loaders),
+  re-seedable and state-capturable for snapshots.
+
+A global keyed registry (``get(key)``) mirrors reference
+``random_generator.py:289``.
+"""
+
+import os
+import threading
+
+import numpy
+import jax
+
+from veles_tpu.core.config import root
+from veles_tpu.core.logger import Logger
+
+
+class RandomGenerator(Logger):
+    """A named reproducible random stream (reference
+    ``prng/random_generator.py:64``)."""
+
+    def __init__(self, key):
+        super().__init__(logger_name="prng.%s" % key)
+        self.key = key
+        self._lock = threading.Lock()
+        self.seed(None)
+
+    # -- seeding ------------------------------------------------------------
+    def seed(self, seed, dtype=None, count=None):
+        """Seed this stream. ``seed`` may be an int, bytes, a numpy array
+        (hashed), a path to a seed file, or None (persisted seed or
+        entropy). ``dtype``/``count`` accepted for CLI parity with the
+        reference's ``file:dtype:count`` seed specs (``__main__.py:483-537``).
+        """
+        if seed is None:
+            seed = self._load_or_create_persisted_seed()
+        elif isinstance(seed, str):
+            with open(seed, "rb") as fin:
+                data = numpy.frombuffer(
+                    fin.read((count or 16) * numpy.dtype(
+                        dtype or numpy.uint8).itemsize),
+                    dtype=dtype or numpy.uint8)
+            seed = self._hash_to_int(data)
+        elif isinstance(seed, (bytes, bytearray)):
+            seed = self._hash_to_int(numpy.frombuffer(seed, numpy.uint8))
+        elif isinstance(seed, numpy.ndarray):
+            seed = self._hash_to_int(seed)
+        self.initial_seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+        self._counter = 0
+        self._jax_key = jax.random.key(
+            numpy.uint64(self.initial_seed).astype(numpy.int64))
+        self._numpy = numpy.random.Generator(
+            numpy.random.PCG64(self.initial_seed))
+        return self
+
+    @staticmethod
+    def _hash_to_int(array):
+        import hashlib
+        return int.from_bytes(
+            hashlib.sha256(array.tobytes()).digest()[:8], "little")
+
+    def _load_or_create_persisted_seed(self):
+        """Reference persists seeds per key under the cache dir
+        (``random_generator.py:106``) so re-runs stay reproducible."""
+        cache = root.common.dirs.cache
+        path = os.path.join(cache, "random_seed_%s.npy" % self.key)
+        try:
+            return int(numpy.load(path))
+        except (OSError, ValueError):
+            seed = int.from_bytes(os.urandom(8), "little")
+            try:
+                os.makedirs(cache, exist_ok=True)
+                numpy.save(path, numpy.uint64(seed))
+            except OSError:
+                pass
+            return seed
+
+    # -- device-side (jax) --------------------------------------------------
+    def next_key(self):
+        """Return a fresh jax PRNG key; advances the stream counter."""
+        with self._lock:
+            self._counter += 1
+            return jax.random.fold_in(self._jax_key, self._counter)
+
+    def key_at(self, counter):
+        """Key for an explicit counter value — used to *replay* randomness,
+        e.g. when a failed minibatch is requeued to another slave
+        (reference ``loader/base.py:679-687`` semantics)."""
+        return jax.random.fold_in(self._jax_key, counter)
+
+    # -- host-side (numpy) --------------------------------------------------
+    @property
+    def numpy_rng(self):
+        return self._numpy
+
+    def shuffle(self, arr):
+        with self._lock:
+            self._numpy.shuffle(arr)
+
+    def permutation(self, n):
+        with self._lock:
+            return self._numpy.permutation(n)
+
+    def randint(self, low, high=None, size=None):
+        with self._lock:
+            return self._numpy.integers(low, high, size)
+
+    def random_sample(self, size=None):
+        with self._lock:
+            return self._numpy.random(size)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        with self._lock:
+            return self._numpy.normal(loc, scale, size)
+
+    def fill(self, arr, vmin=-1.0, vmax=1.0):
+        """Uniformly fill a numpy array in place (reference
+        ``random_generator.py`` fill)."""
+        with self._lock:
+            arr[...] = self._numpy.uniform(vmin, vmax, arr.shape)
+
+    # -- snapshot support ---------------------------------------------------
+    def __getstate__(self):
+        return {
+            "key": self.key,
+            "initial_seed": self.initial_seed,
+            "counter": self._counter,
+            "numpy_state": self._numpy.bit_generator.state,
+        }
+
+    def __setstate__(self, state):
+        Logger.__init__(self, logger_name="prng.%s" % state["key"])
+        self.key = state["key"]
+        self._lock = threading.Lock()
+        self.seed(state["initial_seed"])
+        self._counter = state["counter"]
+        self._numpy.bit_generator.state = state["numpy_state"]
+
+
+_registry = {}
+_registry_lock = threading.Lock()
+
+
+def get(key="default"):
+    """Global keyed stream registry (reference
+    ``random_generator.py:289``)."""
+    with _registry_lock:
+        rg = _registry.get(key)
+        if rg is None:
+            rg = _registry[key] = RandomGenerator(key)
+        return rg
+
+
+def streams_state():
+    """Capture all stream states for whole-workflow snapshots."""
+    with _registry_lock:
+        return {k: v.__getstate__() for k, v in _registry.items()}
+
+
+def restore_streams(state):
+    with _registry_lock:
+        for key, st in state.items():
+            rg = _registry.get(key)
+            if rg is None:
+                rg = _registry[key] = RandomGenerator.__new__(RandomGenerator)
+            rg.__setstate__(st)
