@@ -12,13 +12,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import tempfile  # noqa: E402
 
-# keep test cache/seed artifacts out of the user's home
+# keep ALL framework cache/state artifacts out of the user's home:
+# config.py derives every dir (incl. the pallas autotune cache) from
+# VELES_TPU_HOME, which must be set before veles_tpu imports
 _tmp = tempfile.mkdtemp(prefix="veles_tpu_test_")
-os.environ.setdefault("VELES_TPU_CACHE", _tmp)
+os.environ["VELES_TPU_HOME"] = _tmp
 
 from veles_tpu.core.config import root  # noqa: E402
 
-root.common.dirs.cache = os.path.join(_tmp, "cache")
-root.common.dirs.snapshots = os.path.join(_tmp, "snapshots")
-root.common.dirs.events = os.path.join(_tmp, "events")
 root.common.disable.plotting = True

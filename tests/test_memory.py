@@ -1,0 +1,84 @@
+"""Tests for veles_tpu.memory.Array (mirrors reference test_memory.py)."""
+
+import pickle
+
+import numpy
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu.memory import Array, Watcher, assert_addr
+
+
+def test_empty_array():
+    a = Array()
+    assert not a
+    assert a.shape is None
+    assert a.mem is None
+    assert len(a) == 0
+
+
+def test_reset_and_mem():
+    a = Array(numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+    assert a
+    assert a.shape == (2, 3)
+    assert a.size == 6
+    assert a.sample_size == 3
+    numpy.testing.assert_array_equal(a.mem, numpy.arange(6).reshape(2, 3))
+
+
+def test_device_round_trip():
+    a = Array(numpy.ones((4, 4), numpy.float32))
+    assert not a.on_device
+    a.to_device()
+    assert a.on_device
+    numpy.testing.assert_array_equal(a.mem, numpy.ones((4, 4)))
+    a.to_host()
+    assert not a.on_device
+
+
+def test_map_write_realizes_host():
+    a = Array(jnp.zeros((2, 2)))
+    assert a.on_device
+    a.map_write()
+    assert not a.on_device
+    a.mem[0, 0] = 5.0
+    assert a.mem[0, 0] == 5.0
+
+
+def test_watcher_accounting():
+    Watcher.reset()
+    a = Array(jnp.zeros((8, 8), jnp.float32))
+    assert Watcher.mem_in_use() == 8 * 8 * 4
+    a.reset(None)
+    assert Watcher.mem_in_use() == 0
+    assert Watcher.max_mem_in_use() == 8 * 8 * 4
+
+
+def test_pickle_device_array_becomes_numpy():
+    a = Array(jnp.arange(4.0))
+    b = pickle.loads(pickle.dumps(a))
+    assert isinstance(b.data, numpy.ndarray)
+    numpy.testing.assert_array_equal(b.mem, [0, 1, 2, 3])
+
+
+def test_shallow_pickle_stores_metadata_only():
+    a = Array(numpy.zeros((3, 5), numpy.float32), shallow_pickle=True)
+    b = pickle.loads(pickle.dumps(a))
+    assert b.data is None
+    assert b.__dict__["_shape_hint"] == (3, 5)
+
+
+def test_assert_addr():
+    x = jnp.ones(3)
+    a, b = Array(x), Array(x)
+    assert_addr(a, b)
+    c = Array(jnp.ones(3))
+    with pytest.raises(ValueError):
+        assert_addr(a, c)
+
+
+def test_array_from_array():
+    a = Array(numpy.ones(3))
+    b = Array(a)
+    numpy.testing.assert_array_equal(b.mem, [1, 1, 1])

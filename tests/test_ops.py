@@ -1,0 +1,123 @@
+"""Tests for the veles_tpu.ops library (the Znicz-kernel equivalents)."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu import ops
+from veles_tpu.ops import activations, losses
+from veles_tpu.ops.gemm import matmul, pallas_matmul
+
+
+class TestGemm:
+    def test_matmul_matches_numpy(self):
+        rng = numpy.random.RandomState(0)
+        a = rng.rand(17, 33).astype(numpy.float32)
+        b = rng.rand(33, 9).astype(numpy.float32)
+        out = matmul(jnp.asarray(a), jnp.asarray(b), precision_level=2)
+        numpy.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+    def test_precision_levels_all_close(self):
+        rng = numpy.random.RandomState(1)
+        a = rng.rand(32, 64).astype(numpy.float32)
+        b = rng.rand(64, 16).astype(numpy.float32)
+        ref = a @ b
+        for level in (0, 1, 2):
+            out = matmul(jnp.asarray(a), jnp.asarray(b),
+                         precision_level=level)
+            # level 0 is bf16 passes; level 1 ~ bf16x3 ("Kahan" tier)
+            tol = {0: 2e-2, 1: 1e-3, 2: 1e-5}[level]
+            numpy.testing.assert_allclose(out, ref, rtol=tol)
+
+    def test_pallas_matmul_interpret(self):
+        """Blocked Pallas kernel vs numpy, incl. ragged shapes (padding)."""
+        rng = numpy.random.RandomState(2)
+        for m, k, n in ((128, 128, 128), (130, 70, 50)):
+            a = rng.rand(m, k).astype(numpy.float32)
+            b = rng.rand(k, n).astype(numpy.float32)
+            out = pallas_matmul(jnp.asarray(a), jnp.asarray(b),
+                                out_dtype=jnp.float32,
+                                bm=64, bn=64, bk=64, interpret=True)
+            numpy.testing.assert_allclose(out, a @ b, rtol=1e-4)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", list(activations.ACTIVATIONS))
+    def test_deriv_matches_autodiff(self, name):
+        fwd, deriv = activations.ACTIVATIONS[name]
+        x = jnp.linspace(-2.0, 2.0, 41)
+        if name == "strict_relu":
+            x = x + 0.013  # avoid the kink
+        y = fwd(x)
+        expected = jax.vmap(jax.grad(lambda v: fwd(v)))(x)
+        numpy.testing.assert_allclose(deriv(y), expected,
+                                      rtol=1e-3, atol=1e-4)
+
+
+class TestLosses:
+    def test_softmax_xent_err_matches_autodiff(self):
+        rng = numpy.random.RandomState(3)
+        logits = jnp.asarray(rng.randn(8, 5).astype(numpy.float32))
+        labels = jnp.asarray(rng.randint(0, 5, 8))
+        err, loss, n_err, max_conf = losses.softmax_cross_entropy(
+            logits, labels)
+        grad = jax.grad(
+            lambda lg: losses.softmax_cross_entropy(lg, labels)[1])(logits)
+        numpy.testing.assert_allclose(err, grad, rtol=1e-4, atol=1e-6)
+        assert 0 <= int(n_err) <= 8
+        assert 0.0 < float(max_conf) <= 1.0
+
+    def test_confusion_matrix(self):
+        logits = jnp.asarray([[9.0, 0.0], [0.0, 9.0], [9.0, 0.0]])
+        labels = jnp.asarray([0, 1, 1])
+        cm = losses.confusion_matrix(logits, labels, 2)
+        numpy.testing.assert_array_equal(cm, [[1, 0], [1, 1]])
+
+    def test_mse_err_matches_autodiff(self):
+        rng = numpy.random.RandomState(4)
+        out = jnp.asarray(rng.randn(6, 3).astype(numpy.float32))
+        tgt = jnp.asarray(rng.randn(6, 3).astype(numpy.float32))
+        err, loss, max_err = losses.mse(out, tgt)
+        grad = jax.grad(lambda o: losses.mse(o, tgt)[1])(out)
+        numpy.testing.assert_allclose(err, grad, rtol=1e-4, atol=1e-6)
+
+
+class TestDataOps:
+    def test_gather_minibatch(self):
+        data = jnp.arange(20.0).reshape(10, 2)
+        labels = jnp.arange(10)
+        idx = jnp.asarray([3, 7, 1])
+        batch, lab = ops.gather_minibatch(data, idx, labels)
+        numpy.testing.assert_array_equal(lab, [3, 7, 1])
+        numpy.testing.assert_array_equal(batch[0], [6.0, 7.0])
+
+    def test_gather_with_normalize(self):
+        data = jnp.ones((4, 3))
+        idx = jnp.asarray([0, 1])
+        batch = ops.gather_minibatch(data, idx, scale=2.0, shift=-1.0)
+        numpy.testing.assert_array_equal(batch, numpy.ones((2, 3)))
+
+    def test_mean_disp(self):
+        from veles_tpu.ops.normalize import (compute_mean_disp,
+                                             mean_disp_normalize)
+        rng = numpy.random.RandomState(5)
+        data = jnp.asarray(rng.rand(100, 7).astype(numpy.float32) * 10)
+        mean, rdisp = compute_mean_disp(data)
+        normed = mean_disp_normalize(data, mean, rdisp)
+        assert abs(float(jnp.mean(normed))) < 1e-5
+        assert float(jnp.max(normed)) <= 1.0 + 1e-5
+
+    def test_rng_reproducible(self):
+        key = jax.random.PRNGKey(42)
+        a = ops.uniform(key, (4, 4))
+        b = ops.uniform(key, (4, 4))
+        numpy.testing.assert_array_equal(a, b)
+        assert float(jnp.min(a)) >= -1.0 and float(jnp.max(a)) <= 1.0
+
+    def test_reduce(self):
+        x = jnp.arange(12.0).reshape(3, 4)
+        numpy.testing.assert_array_equal(ops.reduce_sum(x, 0),
+                                         [12.0, 15.0, 18.0, 21.0])
+        assert float(ops.reduce_max(x, None)) == 11.0

@@ -65,7 +65,15 @@ class Config:
                 % (self.__path__, value))
         for key, val in value.items():
             if isinstance(val, dict):
-                getattr(self, key).update(val)
+                try:
+                    node = object.__getattribute__(self, key)
+                except AttributeError:
+                    node = None
+                if not isinstance(node, Config):
+                    # a leaf is being deepened into a subtree: replace it
+                    node = Config("%s.%s" % (self.__path__, key))
+                    setattr(self, key, node)
+                node.update(val)
             else:
                 setattr(self, key, val)
         return self
@@ -123,13 +131,17 @@ def validate_kwargs(caller, **kwargs):
 #: The global configuration root, like reference ``config.py:151``.
 root = Config("root")
 
+#: All framework cache/state dirs live under this; VELES_TPU_HOME relocates
+#: them (tests point it at a tmpdir).
+_home = os.path.expanduser(os.environ.get("VELES_TPU_HOME", "~/.veles_tpu"))
+
 # -- engine defaults (TPU edition of reference config.py:177-290) -----------
 root.common.update({
     "dirs": {
-        "cache": os.path.expanduser("~/.veles_tpu/cache"),
-        "snapshots": os.path.expanduser("~/.veles_tpu/snapshots"),
-        "datasets": os.path.expanduser("~/.veles_tpu/datasets"),
-        "events": os.path.expanduser("~/.veles_tpu/events"),
+        "cache": os.path.join(_home, "cache"),
+        "snapshots": os.path.join(_home, "snapshots"),
+        "datasets": os.path.join(_home, "datasets"),
+        "events": os.path.join(_home, "events"),
     },
     "engine": {
         # compute dtype policy: matmuls/convs run in bfloat16 on the MXU with
@@ -143,8 +155,8 @@ root.common.update({
         "donate_params": True,
         # pallas kernel toggles; plain lax fallbacks always exist.
         "use_pallas": True,
-        "pallas_autotune_cache": os.path.expanduser(
-            "~/.veles_tpu/cache/pallas_tuning.json"),
+        "pallas_autotune_cache": os.path.join(
+            _home, "cache", "pallas_tuning.json"),
     },
     "mesh": {
         # default logical mesh axes; sizes are resolved against the actual
@@ -167,6 +179,7 @@ root.common.update({
 def _apply_site_overrides():
     """Layered site configuration (reference ``site_config.py`` and
     ``config.py:292-307``): JSON overrides merged from /etc, $HOME and CWD."""
+    import sys
     for path in ("/etc/default/veles_tpu.json",
                  os.path.expanduser("~/.veles_tpu/site_config.json"),
                  os.path.join(os.getcwd(), "site_config.json")):
@@ -175,7 +188,12 @@ def _apply_site_overrides():
                 overrides = json.load(fin)
         except (OSError, ValueError):
             continue
-        root.update(overrides)
+        try:
+            root.update(overrides)
+        except Exception as exc:
+            # a malformed override must not break `import veles_tpu`
+            print("veles_tpu: ignoring bad site config %s: %s"
+                  % (path, exc), file=sys.stderr)
 
 
 _apply_site_overrides()

@@ -25,6 +25,7 @@ class ThreadPool(Logger):
         self._paused = threading.Event()
         self._paused.set()  # set == running
         self._shutdown = False
+        self._busy = 0
         self._lock = threading.Lock()
         self.failure_callbacks = []
         self.shutdown_callbacks = []
@@ -43,6 +44,8 @@ class ThreadPool(Logger):
                 return
             self._paused.wait()
             fn, args, kwargs = item
+            with self._lock:
+                self._busy += 1
             try:
                 fn(*args, **kwargs)
             except Exception as exc:  # route into failure callbacks
@@ -53,13 +56,19 @@ class ThreadPool(Logger):
                         cb(exc, tb)
                     except Exception:
                         self.exception("failure callback raised")
+            finally:
+                with self._lock:
+                    self._busy -= 1
 
     def call_in_thread(self, fn, *args, **kwargs):
         with self._lock:
             if self._shutdown:
                 return
-            busy = self._queue.qsize()
-            if busy > 0 and len(self._threads) < self.maxthreads:
+            # spawn when no worker is free for this task: all workers may be
+            # blocked (e.g. a nested Workflow.run waiting on its children),
+            # in which case queued tasks would otherwise starve
+            if (self._busy + self._queue.qsize() >= len(self._threads)
+                    and len(self._threads) < self.maxthreads):
                 self._spawn()
         self._queue.put((fn, args, kwargs))
 

@@ -145,9 +145,14 @@ class LinkableAttribute:
     linked at all, in which case plain attribute storage applies).
     """
 
-    def __init__(self, name):
+    _MISSING = object()
+
+    def __init__(self, name, class_default=_MISSING):
         self.name = name
         self.storage = "_linkable_%s_" % name
+        # the class attribute this descriptor shadowed, if any, so unlinked
+        # instances keep seeing their class-level default
+        self.class_default = class_default
 
     def __get__(self, obj, objtype=None):
         if obj is None:
@@ -157,6 +162,8 @@ class LinkableAttribute:
             try:
                 return obj.__dict__[self.name]
             except KeyError:
+                if self.class_default is not self._MISSING:
+                    return self.class_default
                 raise AttributeError(self.name) from None
         provider, attr = target[:2]
         return getattr(provider, attr)
@@ -188,7 +195,10 @@ def link(consumer, name, provider, provider_attr=None, two_way=False):
             raise VelesError(
                 "Cannot install a link over property %s.%s"
                 % (cls.__name__, name))
-        descr = LinkableAttribute(name)
+        shadowed = getattr(cls, name, LinkableAttribute._MISSING)
+        if isinstance(shadowed, LinkableAttribute):  # inherited descriptor
+            shadowed = shadowed.class_default
+        descr = LinkableAttribute(name, class_default=shadowed)
         setattr(cls, name, descr)
     consumer.__dict__[descr.storage] = (provider, provider_attr, two_way)
 

@@ -103,6 +103,14 @@ class RandomGenerator(Logger):
         (reference ``loader/base.py:679-687`` semantics)."""
         return jax.random.fold_in(self._jax_key, counter)
 
+    def fill_uniform(self, shape, vle, dtype=None):
+        """Device-side symmetric uniform fill U(-vle, vle) — the Znicz
+        weight-init pattern (replaces the xorshift1024* fill kernels)."""
+        import jax.numpy as jnp
+        return jax.random.uniform(
+            self.next_key(), shape, dtype or jnp.float32,
+            minval=-vle, maxval=vle)
+
     # -- host-side (numpy) --------------------------------------------------
     @property
     def numpy_rng(self):

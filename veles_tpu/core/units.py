@@ -69,6 +69,7 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         super().init_unpickled()
         self._gate_lock_ = threading.Lock()
         self._run_lock_ = threading.Lock()
+        self._rerun_pending_ = False
 
     # -- identity -----------------------------------------------------------
     @property
@@ -224,26 +225,37 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         if bool(self.gate_skip):
             self.run_dependent()
             return
-        if not self._run_lock_.acquire(blocking=False):
-            # previous run() still in flight: drop this notification
-            self.debug("%s: dropped re-entrant run notification", self.name)
-            return
-        try:
-            if self.stopped or (self.workflow is not None
-                                and self.workflow.stopped):
+        while True:
+            if not self._run_lock_.acquire(blocking=False):
+                # previous run() still in flight: the gate firing was already
+                # consumed by open_gate(), so record it — the running thread
+                # replays it after its run (losing it would hang the graph)
+                with self._gate_lock_:
+                    self._rerun_pending_ = True
+                self.debug("%s: deferred re-entrant run notification",
+                           self.name)
                 return
-            if root.common.trace.get("run", False):
-                self.debug("-> run (from %s)", src.name if src else "start")
-            timer = self.timers.setdefault("run", Timer())
-            with timer:
-                self.run()
-            self.run_calls += 1
-            if self.timings:
-                self.info("%s run: %.3f ms", self.name,
-                          1000 * timer.total / timer.calls)
-        finally:
-            self._run_lock_.release()
-        self.run_dependent()
+            try:
+                if self.stopped or (self.workflow is not None
+                                    and self.workflow.stopped):
+                    return
+                if root.common.trace.get("run", False):
+                    self.debug("-> run (from %s)",
+                               src.name if src else "start")
+                timer = self.timers.setdefault("run", Timer())
+                with timer:
+                    self.run()
+                self.run_calls += 1
+                if self.timings:
+                    self.info("%s run: %.3f ms", self.name,
+                              1000 * timer.total / timer.calls)
+            finally:
+                self._run_lock_.release()
+            self.run_dependent()
+            with self._gate_lock_:
+                if not self._rerun_pending_:
+                    return
+                self._rerun_pending_ = False
 
     def run_dependent(self):
         """Notify successors; fan out on the pool, single successor inline

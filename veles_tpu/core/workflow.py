@@ -250,6 +250,10 @@ class Workflow(Container):
 
     def apply_data_from_slave(self, data, slave=None):
         order = [u for u in self.units_in_dependency_order() if u is not self]
+        if len(data) != len(order):
+            raise VelesError(
+                "Update payload has %d entries for %d units — master/slave "
+                "workflow mismatch" % (len(data), len(order)))
         for unit, payload in zip(order, data):
             if payload is not None:
                 unit.lock_data()

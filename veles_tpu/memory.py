@@ -1,0 +1,269 @@
+"""Array: the framework's device-backed tensor.
+
+TPU-native re-design of reference ``veles/memory.py``. The reference Array
+pairs a numpy buffer with a lazy OpenCL/CUDA buffer and a manual
+map_read/map_write/unmap coherency protocol (``memory.py:110-511``). On TPU
+under JAX that whole protocol degenerates: a ``jax.Array`` *is* the device
+buffer, transfers are ``jax.device_put``/``np.asarray``, and XLA manages
+memory. What survives:
+
+- ``mem`` — host-visible numpy view (reference ``Array.mem``); assigning to
+  it (or calling ``map_write``-style mutators) invalidates the device copy;
+- lazy device residency: an Array can live host-side (numpy) until first
+  device use;
+- ``Watcher``-style accounting of the global device-memory high-water mark
+  (reference ``memory.py:56-107``);
+- shallow pickling that stores only shape+dtype when requested (reference
+  ``shallow_pickle``).
+
+Mutation model: jax.Arrays are immutable, so "writing" replaces the backing
+value. Units therefore treat Array as a *slot*: producers assign ``.data``
+(device value) each tick, consumers read it. The map/unmap methods are kept
+as cheap no-ops/synonyms so unit code written against the reference API
+shape still reads naturally.
+"""
+
+import threading
+
+import numpy
+
+try:
+    import jax
+    import jax.numpy as jnp
+    _HAVE_JAX = True
+except ImportError:  # pragma: no cover - jax is baked into the image
+    _HAVE_JAX = False
+
+from veles_tpu.core.logger import Logger
+from veles_tpu.core.pickling import Pickleable
+
+
+class Watcher:
+    """Tracks the global high-water mark of device bytes held by live Arrays
+    (reference ``memory.py:56-107`` tracked the same via a metaclass)."""
+
+    _lock = threading.Lock()
+    _current = 0
+    _peak = 0
+
+    @classmethod
+    def add(cls, nbytes):
+        with cls._lock:
+            cls._current += nbytes
+            cls._peak = max(cls._peak, cls._current)
+
+    @classmethod
+    def remove(cls, nbytes):
+        with cls._lock:
+            cls._current -= nbytes
+
+    @classmethod
+    def max_mem_in_use(cls):
+        return cls._peak
+
+    @classmethod
+    def mem_in_use(cls):
+        return cls._current
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._current = 0
+            cls._peak = 0
+
+
+class Array(Pickleable):
+    """Host+device tensor slot (reference ``memory.py:110``)."""
+
+    def __init__(self, value=None, dtype=None, shallow_pickle=False):
+        super().__init__()
+        self._device_bytes_ = 0
+        self._data = None
+        self.shallow_pickle = shallow_pickle
+        if value is not None:
+            self.reset(value, dtype=dtype)
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._lock_ = threading.RLock()
+        self._device_bytes_ = 0
+
+    # -- value access ---------------------------------------------------------
+    @property
+    def data(self):
+        """The current backing value (numpy or jax.Array)."""
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        with self._lock_:
+            self._account(value)
+            self._data = value
+
+    @property
+    def mem(self):
+        """Host-visible numpy view of the value (reference ``Array.mem``).
+        For device-resident values this synchronizes and copies to host."""
+        if self._data is None:
+            return None
+        if isinstance(self._data, numpy.ndarray):
+            return self._data
+        return numpy.asarray(self._data)
+
+    @mem.setter
+    def mem(self, value):
+        self.reset(value)
+
+    def __bool__(self):
+        return self._data is not None
+
+    def reset(self, value=None, dtype=None):
+        """Replace the backing value (reference ``Array.reset``)."""
+        with self._lock_:
+            if value is None:
+                self._account(None)
+                self._data = None
+                return self
+            if isinstance(value, Array):
+                value = value.data
+            if dtype is not None and not _is_jax(value):
+                value = numpy.asarray(value, dtype=dtype)
+            elif not _is_jax(value) and not isinstance(value, numpy.ndarray):
+                value = numpy.asarray(value)
+            self._account(value)
+            self._data = value
+            return self
+
+    # -- shape/dtype ----------------------------------------------------------
+    @property
+    def shape(self):
+        return None if self._data is None else self._data.shape
+
+    @property
+    def dtype(self):
+        return None if self._data is None else self._data.dtype
+
+    @property
+    def size(self):
+        return 0 if self._data is None else int(numpy.prod(self._data.shape))
+
+    @property
+    def nbytes(self):
+        if self._data is None:
+            return 0
+        return self.size * self._data.dtype.itemsize
+
+    @property
+    def sample_size(self):
+        """Elements per leading-axis sample (reference ``memory.py``)."""
+        if self._data is None or not len(self._data.shape):
+            return 0
+        return self.size // self._data.shape[0] if self._data.shape[0] else 0
+
+    def __len__(self):
+        return 0 if self._data is None else self._data.shape[0]
+
+    def __getitem__(self, key):
+        return self._data[key]
+
+    def __repr__(self):
+        if self._data is None:
+            return "<Array (empty)>"
+        return "<Array %s %s %s>" % (
+            self.shape, self.dtype, "device" if self.on_device else "host")
+
+    # -- device residency -----------------------------------------------------
+    @property
+    def on_device(self):
+        return _is_jax(self._data)
+
+    def to_device(self, device=None, sharding=None):
+        """Move to device (reference ``map_invalidate``+``unmap`` round trip
+        collapses into one transfer)."""
+        if not _HAVE_JAX or self._data is None:
+            return self
+        with self._lock_:
+            target = sharding if sharding is not None else device
+            if target is not None:
+                value = jax.device_put(self._data, target)
+            elif not _is_jax(self._data):
+                value = jnp.asarray(self._data)
+            else:
+                return self
+            self._account(value)
+            self._data = value
+        return self
+
+    def to_host(self):
+        if self._data is None or isinstance(self._data, numpy.ndarray):
+            return self
+        with self._lock_:
+            # numpy.array (not asarray): jax buffers give read-only views,
+            # but host-side code mutates .mem in place
+            value = numpy.array(self._data)
+            self._account(value)
+            self._data = value
+        return self
+
+    # Reference map/unmap protocol — coherency is XLA's job now; these
+    # remain so unit code keeps the familiar call sites (memory.py:371-475).
+    def map_read(self):
+        return self
+
+    def map_write(self):
+        """Writing implies the next device use must re-upload; we realize
+        the value on host so numpy-style in-place mutation works."""
+        return self.to_host()
+
+    def map_invalidate(self):
+        return self.to_host()
+
+    def unmap(self):
+        return self
+
+    # -- accounting -----------------------------------------------------------
+    def _account(self, new_value):
+        new_bytes = 0
+        if _is_jax(new_value):
+            new_bytes = int(numpy.prod(new_value.shape)) * \
+                new_value.dtype.itemsize
+        if new_bytes != self._device_bytes_:
+            if self._device_bytes_:
+                Watcher.remove(self._device_bytes_)
+            if new_bytes:
+                Watcher.add(new_bytes)
+            self._device_bytes_ = new_bytes
+
+    def __del__(self):
+        try:
+            if self._device_bytes_:
+                Watcher.remove(self._device_bytes_)
+        except Exception:
+            pass
+
+    # -- pickling -------------------------------------------------------------
+    def __getstate__(self):
+        state = super().__getstate__()
+        if self.shallow_pickle:
+            # store only metadata (reference shallow_pickle)
+            state["_data"] = None
+            state["_shape_hint"] = self.shape
+            state["_dtype_hint"] = (
+                None if self.dtype is None else numpy.dtype(self.dtype).str)
+        elif _is_jax(self._data):
+            state["_data"] = numpy.asarray(self._data)
+        return state
+
+
+def _is_jax(value):
+    return _HAVE_JAX and isinstance(value, jax.Array) \
+        and not isinstance(value, numpy.ndarray)
+
+
+def assert_addr(*arrays):
+    """Reference ``memory.py`` helper: assert arrays share a buffer. With
+    immutable jax values identity is the closest analogue."""
+    first = arrays[0]
+    for a in arrays[1:]:
+        if a.data is not first.data:
+            raise ValueError("Arrays do not share the same backing value")

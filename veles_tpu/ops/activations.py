@@ -1,0 +1,75 @@
+"""Activation functions and their output-form derivatives.
+
+The Znicz forward units (All2AllTanh/Sigmoid/RELU/StrictRELU/Softmax —
+named in ``BASELINE.json`` and the reference docs) apply these after the
+GEMM. Derivatives are expressed **in terms of the activation output** so the
+backward units need only the forward result, matching the reference backprop
+unit contract (gradient units receive ``output`` + ``err_output``).
+
+The reference scales tanh as ``1.7159 * tanh(0.6666 * x)`` (LeCun's
+recommendation, used throughout Znicz); we keep those constants for accuracy
+parity with the published MNIST numbers.
+"""
+
+import jax.numpy as jnp
+from jax import nn as jnn
+
+TANH_A = 1.7159
+TANH_B = 0.6666
+
+
+def linear(x):
+    return x
+
+
+def linear_deriv(y):
+    return jnp.ones_like(y)
+
+
+def tanh(x):
+    """Scaled tanh: ``1.7159 * tanh(0.6666 x)`` (Znicz All2AllTanh)."""
+    return TANH_A * jnp.tanh(TANH_B * x)
+
+
+def tanh_deriv(y):
+    # d/dx A*tanh(Bx) = A*B*(1 - tanh^2) = B/A * (A^2 - y^2)
+    return (y * y - TANH_A * TANH_A) * (-TANH_B / TANH_A)
+
+
+def sigmoid(x):
+    return jnn.sigmoid(x)
+
+
+def sigmoid_deriv(y):
+    return y * (1.0 - y)
+
+
+def relu(x):
+    """Znicz RELU is the smooth variant ``log(1 + exp(x))`` (softplus)."""
+    return jnn.softplus(x)
+
+
+def relu_deriv(y):
+    # y = log(1+e^x) ⇒ dy/dx = 1 - e^-y
+    return 1.0 - jnp.exp(-y)
+
+
+def strict_relu(x):
+    return jnn.relu(x)
+
+
+def strict_relu_deriv(y):
+    return (y > 0).astype(y.dtype)
+
+
+def softmax(x):
+    return jnn.softmax(x, axis=-1)
+
+
+ACTIVATIONS = {
+    "linear": (linear, linear_deriv),
+    "tanh": (tanh, tanh_deriv),
+    "sigmoid": (sigmoid, sigmoid_deriv),
+    "relu": (relu, relu_deriv),
+    "strict_relu": (strict_relu, strict_relu_deriv),
+}

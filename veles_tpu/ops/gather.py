@@ -1,0 +1,29 @@
+"""Minibatch gather from a device-resident full-batch dataset.
+
+Replaces ``cuda/fullbatch_loader.cu`` / ``ocl/fullbatch_loader.cl``
+(``fill_minibatch_data_labels``): the reference keeps the entire dataset on
+device and gathers shuffled minibatch samples + labels by index. On TPU this
+is a ``jnp.take`` along axis 0 — XLA emits an efficient dynamic-gather — and
+it composes into the jitted train tick so data never round-trips to host.
+
+Normalization (the kernel fused a scale/shift) is applied in the same traced
+function so XLA fuses it into the gather's consumer.
+"""
+
+import jax.numpy as jnp
+
+
+def gather_minibatch(data, indices, labels=None, scale=None, shift=None):
+    """Gather ``data[indices]`` (+ labels), with optional affine normalize.
+
+    Returns (batch,) or (batch, labels) tuple mirroring the reference
+    kernel's dual outputs.
+    """
+    batch = jnp.take(data, indices, axis=0)
+    if scale is not None:
+        batch = batch * scale
+    if shift is not None:
+        batch = batch + shift
+    if labels is None:
+        return batch
+    return batch, jnp.take(labels, indices, axis=0)

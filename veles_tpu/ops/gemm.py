@@ -1,0 +1,217 @@
+"""Matrix multiplication for the MXU, with reference precision levels.
+
+Replaces the reference's hand-tuned OpenCL/CUDA GEMM family
+(``ocl/matrix_multiplication_precise.cl``, ``ocl/gemm.cl``) and its
+per-device block-size autotuner (``backends.py:623-731`` +
+``devices/device_infos.json``). On TPU the design inverts: XLA's
+``dot_general`` already emits optimal MXU schedules for standard shapes, so
+that is the default path; the Pallas kernel below exists for the fused /
+blocked cases XLA can't express (and as the substrate for later fused
+epilogues), with a tiny autotune cache mirroring ``device_infos.json``.
+
+Precision levels (reference ``config.py:244-247`` documented plain sum /
+Kahan (+9%) / multi-partial (+90%) summation tiers):
+
+- 0 → bfloat16 MXU passes, float32 accumulation (fast path),
+- 1 → float32 operands, ``Precision.HIGH`` (≈ the Kahan tier),
+- 2 → float32 operands, ``Precision.HIGHEST`` (≈ the multi-partial tier).
+"""
+
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles_tpu.core.config import root
+
+_PRECISIONS = {
+    0: lax.Precision.DEFAULT,
+    1: lax.Precision.HIGH,
+    2: lax.Precision.HIGHEST,
+}
+
+
+def matmul(a, b, precision_level=None, out_dtype=None, use_pallas=None):
+    """``a @ b`` tuned for the MXU.
+
+    precision_level mirrors the reference's GEMM summation tiers (see module
+    docstring); ``None`` reads ``root.common.engine.precision_level``.
+    """
+    if precision_level is None:
+        precision_level = root.common.engine.get("precision_level", 0)
+    if out_dtype is None:
+        out_dtype = a.dtype
+    if use_pallas is None:
+        use_pallas = root.common.engine.get("use_pallas", True)
+    if precision_level == 0:
+        compute_dtype = jnp.dtype(
+            root.common.engine.get("compute_dtype", "bfloat16"))
+    else:
+        compute_dtype = jnp.float32
+    a = a.astype(compute_dtype)
+    b = b.astype(compute_dtype)
+    if use_pallas and _pallas_eligible(a, b):
+        return pallas_matmul(a, b, out_dtype=out_dtype)
+    return lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        precision=_PRECISIONS[precision_level],
+        preferred_element_type=jnp.float32,
+    ).astype(out_dtype)
+
+
+def _pallas_eligible(a, b):
+    """Pallas pays off for large 2-D matmuls on a real TPU backend; small or
+    ragged shapes go to XLA which handles padding better."""
+    if a.ndim != 2 or b.ndim != 2:
+        return False
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    m, k = a.shape
+    _, n = b.shape
+    return m >= 512 and n >= 512 and k >= 512
+
+
+# -- Pallas blocked matmul ---------------------------------------------------
+
+def _mm_kernel(a_ref, b_ref, o_ref, acc_ref):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # f32 operands need HIGHEST or the dot truncates to bf16 passes; bf16
+    # operands must keep DEFAULT (Mosaic rejects fp32 contract precision on
+    # a bf16 lhs) and already accumulate in f32 on the MXU
+    precision = (lax.Precision.HIGHEST if a_ref.dtype == jnp.float32
+                 else lax.Precision.DEFAULT)
+    acc_ref[...] += jnp.dot(a_ref[...], b_ref[...],
+                            preferred_element_type=jnp.float32,
+                            precision=precision)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("out_dtype", "bm", "bn", "bk",
+                                    "interpret"))
+def pallas_matmul(a, b, out_dtype=jnp.float32, bm=None, bn=None, bk=None,
+                  interpret=False):
+    """Blocked MXU matmul: grid (M/bm, N/bn, K/bk), float32 VMEM accumulator,
+    K innermost so each (i, j) output tile is revisited sequentially
+    (``dimension_semantics``: parallel, parallel, arbitrary)."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    if bm is None or bn is None or bk is None:
+        bm, bn, bk = _tuned_blocks(m, n, k, str(jnp.dtype(a.dtype)))
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    # pad to block multiples; zero padding is sum-neutral
+    pm, pn, pk = (-m) % bm, (-n) % bn, (-k) % bk
+    if pm or pk:
+        a = jnp.pad(a, ((0, pm), (0, pk)))
+    if pk or pn:
+        b = jnp.pad(b, ((0, pk), (0, pn)))
+    mm, nn, kk = m + pm, n + pn, k + pk
+    out = pl.pallas_call(
+        _mm_kernel,
+        grid=(mm // bm, nn // bn, kk // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, s: (i, s)),
+            pl.BlockSpec((bk, bn), lambda i, j, s: (s, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, s: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mm, nn), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, b)
+    if pm or pn:
+        out = out[:m, :n]
+    return out
+
+
+# -- autotune cache (the device_infos.json descendant) ------------------------
+
+_DEFAULT_BLOCKS = (256, 256, 512)
+_CANDIDATES = ((128, 128, 512), (256, 256, 512), (512, 512, 512),
+               (256, 512, 512), (512, 256, 512), (256, 256, 1024))
+_tuning_cache = None
+
+
+def _cache_path():
+    return root.common.engine.get(
+        "pallas_autotune_cache",
+        os.path.expanduser("~/.veles_tpu/cache/pallas_tuning.json"))
+
+
+def _load_cache():
+    global _tuning_cache
+    if _tuning_cache is None:
+        try:
+            with open(_cache_path(), "r") as fin:
+                _tuning_cache = json.load(fin)
+        except (OSError, ValueError):
+            _tuning_cache = {}
+    return _tuning_cache
+
+
+def _tuned_blocks(m, n, k, dtype):
+    key = "%s:%d" % (dtype, _size_bucket(m, n, k))
+    entry = _load_cache().get(key)
+    if entry:
+        return tuple(entry["blocks"])
+    return _DEFAULT_BLOCKS
+
+
+def _size_bucket(m, n, k):
+    size = m * n * k
+    bucket = 0
+    while size > 1:
+        size >>= 3  # buckets by order of magnitude in each dim
+        bucket += 1
+    return bucket
+
+
+def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=3):
+    """Benchmark candidate block sizes for this shape bucket and persist the
+    winner (reference ``backends.py:623-731`` per-device GEMM autotune)."""
+    import time
+    a = jnp.ones((m, k), dtype)
+    b = jnp.ones((k, n), dtype)
+    best, best_dt = None, float("inf")
+    for bm, bn, bk in _CANDIDATES:
+        if bm > m or bn > n or bk > k:
+            continue
+        try:
+            fn = lambda: pallas_matmul(  # noqa: E731
+                a, b, out_dtype=jnp.float32, bm=bm, bn=bn, bk=bk)
+            fn().block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn()
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue
+        if dt < best_dt:
+            best, best_dt = (bm, bn, bk), dt
+    if best is None:
+        return _DEFAULT_BLOCKS
+    cache = _load_cache()
+    cache["%s:%d" % (str(jnp.dtype(dtype)), _size_bucket(m, n, k))] = {
+        "blocks": list(best), "seconds": best_dt}
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fout:
+            json.dump(cache, fout, indent=1)
+    except OSError:
+        pass
+    return best

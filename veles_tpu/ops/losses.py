@@ -1,0 +1,50 @@
+"""Evaluator math: losses, error signals, and classification metrics.
+
+The Znicz EvaluatorSoftmax / EvaluatorMSE units compute the training error
+signal fed to the gradient-descent chain plus host-visible metrics
+(n_err, confusion matrix, max error). Here each is one pure function
+designed to live inside the jitted tick: metrics come back as device scalars
+/ small arrays and are read on host only at epoch boundaries.
+"""
+
+import jax.numpy as jnp
+from jax import nn as jnn
+
+
+def softmax_cross_entropy(logits, labels, n_classes=None):
+    """Returns (err_logits, loss, n_err, max_confidence).
+
+    ``err_logits`` is d(mean xent)/d(logits) = (softmax - onehot)/batch —
+    exactly the signal Znicz's EvaluatorSoftmax emits to the GD chain.
+    """
+    if n_classes is None:
+        n_classes = logits.shape[-1]
+    batch = logits.shape[0]
+    probs = jnn.softmax(logits, axis=-1)
+    onehot = jnn.one_hot(labels, n_classes, dtype=logits.dtype)
+    logp = jnn.log_softmax(logits, axis=-1)
+    loss = -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+    err = (probs - onehot) / batch
+    pred = jnp.argmax(logits, axis=-1)
+    n_err = jnp.sum((pred != labels).astype(jnp.int32))
+    max_conf = jnp.max(probs)
+    return err, loss, n_err, max_conf
+
+
+def confusion_matrix(logits, labels, n_classes):
+    """Dense confusion-matrix increment (Znicz evaluator option)."""
+    pred = jnp.argmax(logits, axis=-1)
+    idx = labels * n_classes + pred
+    flat = jnp.zeros((n_classes * n_classes,), jnp.int32).at[idx].add(1)
+    return flat.reshape(n_classes, n_classes)
+
+
+def mse(output, target):
+    """Returns (err_output, loss, max_err) — Znicz EvaluatorMSE contract."""
+    batch = output.shape[0]
+    diff = output - target
+    loss = jnp.mean(jnp.sum(
+        diff.reshape(batch, -1) ** 2, axis=-1))
+    err = diff * (2.0 / batch)
+    max_err = jnp.max(jnp.abs(diff))
+    return err, loss, max_err

@@ -1,0 +1,22 @@
+"""Mean/dispersion normalization op.
+
+Replaces ``ocl/mean_disp_normalizer.cl`` / ``cuda/mean_disp_normalizer.cu``:
+``out = (in - mean) * rdisp`` applied per feature. Pure elementwise — XLA
+fuses it into whatever consumes it, so the right TPU design is a plain
+traced function, not a kernel.
+"""
+
+
+def mean_disp_normalize(x, mean, rdisp):
+    """(x - mean) * rdisp, broadcasting stats over the batch axis."""
+    return (x - mean) * rdisp
+
+
+def compute_mean_disp(data, eps=1e-8):
+    """Training-set statistics: mean and reciprocal dispersion
+    (max-min based, as the reference MeanDispNormalizer defines it)."""
+    import jax.numpy as jnp
+    mean = jnp.mean(data, axis=0)
+    disp = jnp.max(data, axis=0) - jnp.min(data, axis=0)
+    rdisp = 1.0 / jnp.maximum(disp, eps)
+    return mean, rdisp

@@ -1,0 +1,28 @@
+"""Matrix reductions.
+
+Replaces the reference's generic reduction scaffold
+(``ocl/matrix_reduce.cl``, ``cuda/matrix_reduce.cu``) which Znicz used for
+bias gradients, normalization statistics and Kohonen winner search. On TPU
+these lower directly to VPU reduction trees via lax; no hand scheduling is
+needed or beneficial. Kept as named entry points so unit code expresses
+intent (and so a Pallas fused variant can slot in later).
+"""
+
+import jax.numpy as jnp
+
+
+def reduce_sum(x, axis=0):
+    return jnp.sum(x, axis=axis)
+
+
+def reduce_mean(x, axis=0):
+    return jnp.mean(x, axis=axis)
+
+
+def reduce_max(x, axis=0):
+    return jnp.max(x, axis=axis)
+
+
+def argmin_rows(x):
+    """Winner search across rows (Kohonen SOM uses this shape)."""
+    return jnp.argmin(x, axis=-1)

@@ -1,0 +1,26 @@
+"""Device random number generation.
+
+Replaces the reference's xorshift1024* device kernels (``ocl/random.cl``,
+``cuda/random.cu``) and the ``Uniform`` unit's device-resident state. JAX's
+counter-based threefry keys are the TPU-native equivalent — splittable,
+reproducible across shardings, and jit-safe — so there is no mutable device
+state to manage; units carry a key and split per use (see
+``veles_tpu.core.prng.RandomGenerator`` for the host-side keyed registry).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def uniform(key, shape, dtype=jnp.float32, low=-1.0, high=1.0):
+    return jax.random.uniform(key, shape, dtype, minval=low, maxval=high)
+
+
+def normal(key, shape, dtype=jnp.float32, mean=0.0, stddev=1.0):
+    return mean + stddev * jax.random.normal(key, shape, dtype)
+
+
+def fill_uniform(key, shape, vle, dtype=jnp.float32):
+    """Znicz-style symmetric init: U(-vle, vle) (the reference fills weight
+    matrices this way with magnitude ``1/sqrt(fan_in)``-ish constants)."""
+    return jax.random.uniform(key, shape, dtype, minval=-vle, maxval=vle)
