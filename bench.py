@@ -1,0 +1,74 @@
+"""Benchmark harness: MNIST784-topology training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline context (BASELINE.md): the reference publishes no absolute
+images/sec; the driver-set target is ≥2× a K40-era chip. The GTX-TITAN GEMM
+autotune row (3001² matmul in 0.1642 s ⇒ ~329 GFLOP/s sustained) is the
+only hard GPU-era number, so ``vs_baseline`` reports our measured
+training-step FLOP throughput against that 329 GFLOP/s anchor.
+"""
+
+import json
+import time
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from veles_tpu.parallel.step import build_train_step
+
+    batch = 4096
+    in_features, hidden, classes = 784, 4096, 10
+    spec = [
+        dict(activation="tanh", learning_rate=0.03, learning_rate_bias=0.03,
+             weights_decay=0.0, l1_vs_l2=0.0, gradient_moment=0.9),
+        dict(activation="linear", learning_rate=0.03,
+             learning_rate_bias=0.03, weights_decay=0.0, l1_vs_l2=0.0,
+             gradient_moment=0.9),
+    ]
+    rng = numpy.random.RandomState(0)
+    params = {"w": [], "b": [], "vw": [], "vb": []}
+    fan_in = in_features
+    for width in (hidden, classes):
+        params["w"].append(jnp.asarray(
+            rng.randn(fan_in, width).astype(numpy.float32) * 0.05))
+        params["b"].append(jnp.zeros(width, jnp.float32))
+        params["vw"].append(jnp.zeros((fan_in, width), jnp.float32))
+        params["vb"].append(jnp.zeros(width, jnp.float32))
+        fan_in = width
+    data = jnp.asarray(rng.rand(batch, in_features).astype(numpy.float32))
+    labels = jnp.asarray(rng.randint(0, classes, batch))
+    mask = jnp.ones(batch, jnp.float32)
+
+    step = build_train_step(spec, donate=True)
+    # warmup/compile (the host read drains the dispatch pipeline — plain
+    # block_until_ready resolves early through the axon tunnel)
+    params, metrics = step(params, data, labels, mask)
+    float(metrics[0])
+
+    iters = 100
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, metrics = step(params, data, labels, mask)
+    float(metrics[0])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    # fwd+bwd FLOPs: 3 GEMM passes per layer ≈ 6·B·Σ(in·out)
+    flops_per_image = 6 * (in_features * hidden + hidden * classes)
+    gflops = images_per_sec * flops_per_image / 1e9
+    titan_gflops = 2 * 3001 ** 3 / 0.1642 / 1e9  # reference GEMM anchor
+    print(json.dumps({
+        "metric": "mnist784_mlp_train_throughput",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(gflops / titan_gflops, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

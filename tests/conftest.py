@@ -4,11 +4,18 @@ contract). Must set env before jax initializes."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the axon sitecustomize force-registers the TPU backend and overrides
+# JAX_PLATFORMS from the environment, so pin the platform via jax.config
+# (wins as long as no backend has initialized yet)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import tempfile  # noqa: E402
 

@@ -1,0 +1,116 @@
+"""Tests for the loader layer (mirrors reference test_loader.py)."""
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+def make_loader(minibatch_size=10, lengths=(0, 20, 50), **kwargs):
+    n = sum(lengths)
+    data = numpy.arange(n * 3, dtype=numpy.float32).reshape(n, 3)
+    labels = numpy.arange(n, dtype=numpy.int32) % 7
+    loader = FullBatchLoader(
+        DummyWorkflow(), data=data, labels=labels,
+        class_lengths=list(lengths), minibatch_size=minibatch_size,
+        **kwargs)
+    loader.initialize()
+    return loader
+
+
+class TestServing:
+    def test_class_order_and_epoch_flags(self):
+        loader = make_loader()
+        classes, ends = [], []
+        for _ in range(7):  # 2 valid + 5 train minibatches
+            loader.run()
+            classes.append(loader.minibatch_class)
+            ends.append(bool(loader.epoch_ended))
+        assert classes == [VALID] * 2 + [TRAIN] * 5
+        assert ends == [False] * 6 + [True]
+        assert loader.epoch_number == 0
+        loader.run()  # first minibatch of next epoch
+        assert loader.epoch_number == 1
+        assert loader.minibatch_class == VALID
+
+    def test_short_final_minibatch_mask(self):
+        loader = make_loader(minibatch_size=8, lengths=(0, 0, 20))
+        for _ in range(3):
+            loader.run()
+        # 20 = 8 + 8 + 4: final minibatch half-valid
+        assert loader.minibatch_valid_size == 4
+        mask = numpy.asarray(loader.sample_mask.mem)
+        numpy.testing.assert_array_equal(mask, [1, 1, 1, 1, 0, 0, 0, 0])
+        assert loader.minibatch_data.shape == (8, 3)  # static shape
+
+    def test_minibatch_contents_match_indices(self):
+        loader = make_loader()
+        loader.run()
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        valid = loader.minibatch_valid_size
+        expected = numpy.arange(150, dtype=numpy.float32).reshape(50, 3)[idx]
+        numpy.testing.assert_array_equal(
+            numpy.asarray(loader.minibatch_data.mem)[:valid],
+            expected[:valid])
+
+    def test_train_shuffled_between_epochs(self):
+        loader = make_loader(lengths=(0, 0, 50), minibatch_size=50)
+        loader.run()
+        first = numpy.asarray(loader.minibatch_indices.mem).copy()
+        loader.run()
+        second = numpy.asarray(loader.minibatch_indices.mem)
+        assert not numpy.array_equal(first, second)
+        assert set(first) == set(second) == set(range(50))
+
+    def test_validation_not_shuffled(self):
+        loader = make_loader()
+        loader.run()
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        numpy.testing.assert_array_equal(
+            idx[:loader.minibatch_valid_size], numpy.arange(10))
+
+    def test_train_ratio(self):
+        loader = make_loader(train_ratio=0.5, lengths=(0, 0, 40),
+                             minibatch_size=10)
+        served = 0
+        loader.run()
+        while not loader.epoch_ended:
+            served += loader.minibatch_valid_size
+            loader.run()
+        served += loader.minibatch_valid_size
+        assert served == 20  # half of train
+
+    def test_normalization_linear(self):
+        loader = make_loader(normalization_type="linear")
+        loader.run()
+        assert float(numpy.abs(loader.minibatch_data.mem).max()) <= 1.0
+
+
+class TestDistribution:
+    def test_master_serves_indices_slave_fills(self):
+        master = make_loader()
+        slave = make_loader()
+        job = master.generate_data_for_slave("slave-1")
+        slave.apply_data_from_master(job)
+        assert slave.minibatch_class == job[0]
+        assert master.pending_minibatches_["slave-1"]
+        master.apply_data_from_slave({}, "slave-1")
+        assert not master.pending_minibatches_["slave-1"]
+
+    def test_drop_slave_requeues(self):
+        master = make_loader()
+        job = master.generate_data_for_slave("slave-1")
+        master.drop_slave("slave-1")
+        assert len(master.failed_minibatches) == 1
+        # requeued minibatch served again, to another slave
+        job2 = master.generate_data_for_slave("slave-2")
+        numpy.testing.assert_array_equal(job[1], job2[1])
+
+
+class TestResplit:
+    def test_validation_ratio(self):
+        loader = make_loader(lengths=(0, 0, 50), validation_ratio=0.2)
+        assert loader.class_lengths == [0, 10, 40]
+        assert loader.total_samples == 50

@@ -42,10 +42,12 @@ class ThreadPool(Logger):
             item = self._queue.get()
             if item is None:
                 return
-            self._paused.wait()
+            # mark busy immediately on dequeue, before pause-wait or fn, so
+            # the spawn heuristic can't undercount while this worker blocks
             fn, args, kwargs = item
             with self._lock:
                 self._busy += 1
+            self._paused.wait()
             try:
                 fn(*args, **kwargs)
             except Exception as exc:  # route into failure callbacks

@@ -107,9 +107,9 @@ class RandomGenerator(Logger):
         """Device-side symmetric uniform fill U(-vle, vle) — the Znicz
         weight-init pattern (replaces the xorshift1024* fill kernels)."""
         import jax.numpy as jnp
-        return jax.random.uniform(
-            self.next_key(), shape, dtype or jnp.float32,
-            minval=-vle, maxval=vle)
+        from veles_tpu.ops.rng import fill_uniform
+        return fill_uniform(self.next_key(), shape, vle,
+                            dtype or jnp.float32)
 
     # -- host-side (numpy) --------------------------------------------------
     @property

@@ -69,7 +69,7 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         super().init_unpickled()
         self._gate_lock_ = threading.Lock()
         self._run_lock_ = threading.Lock()
-        self._rerun_pending_ = False
+        self._pending_runs_ = 0
 
     # -- identity -----------------------------------------------------------
     @property
@@ -225,17 +225,23 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         if bool(self.gate_skip):
             self.run_dependent()
             return
+        # Each opened gate is one run token. Tokens, not a flag, so that the
+        # holder/deferrer handoff cannot lose a firing (a notification that
+        # arrives while run() is in flight must cause exactly one more run —
+        # losing it would hang the graph, double-consuming would over-run).
+        with self._gate_lock_:
+            self._pending_runs_ += 1
         while True:
             if not self._run_lock_.acquire(blocking=False):
-                # previous run() still in flight: the gate firing was already
-                # consumed by open_gate(), so record it — the running thread
-                # replays it after its run (losing it would hang the graph)
-                with self._gate_lock_:
-                    self._rerun_pending_ = True
-                self.debug("%s: deferred re-entrant run notification",
-                           self.name)
+                # the current holder re-checks the token count after its
+                # run, so our token will be consumed by it (or by whoever
+                # acquires next)
                 return
             try:
+                with self._gate_lock_:
+                    if not self._pending_runs_:
+                        return  # tokens already consumed by another thread
+                    self._pending_runs_ -= 1
                 if self.stopped or (self.workflow is not None
                                     and self.workflow.stopped):
                     return
@@ -253,25 +259,42 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
                 self._run_lock_.release()
             self.run_dependent()
             with self._gate_lock_:
-                if not self._rerun_pending_:
+                if not self._pending_runs_:
                     return
-                self._rerun_pending_ = False
+            # more tokens arrived while we ran: loop to consume them
+
+    _dispatch_local_ = threading.local()
 
     def run_dependent(self):
         """Notify successors; fan out on the pool, single successor inline
-        (reference ``units.py:485-505``)."""
+        (reference ``units.py:485-505``). Inline dispatch runs through a
+        per-thread trampoline queue, not recursion — a Repeater cycle makes
+        the tick chain arbitrarily long and would blow the stack."""
         consumers = [u for u in self.links_to
                      if not bool(u.gate_block)]
         if not consumers:
             return
         pool = self.workflow.thread_pool if self.workflow else None
-        if len(consumers) == 1 or pool is None:
-            for consumer in consumers:
-                consumer._check_gate_and_run(self)
-        else:
+        if pool is not None and len(consumers) > 1:
             for consumer in consumers[1:]:
                 pool.call_in_thread(consumer._check_gate_and_run, self)
-            consumers[0]._check_gate_and_run(self)
+            inline = consumers[:1]
+        else:
+            inline = consumers  # no pool: every consumer runs inline
+        local = Unit._dispatch_local_
+        queue = getattr(local, "queue", None)
+        if queue is not None:
+            # already inside this thread's dispatch loop: enqueue and let
+            # the outermost frame process it iteratively
+            queue.extend((c, self) for c in inline)
+            return
+        local.queue = queue = [(c, self) for c in inline]
+        try:
+            while queue:
+                consumer, src = queue.pop(0)
+                consumer._check_gate_and_run(src)
+        finally:
+            local.queue = None
 
     # -- introspection -------------------------------------------------------
     def describe(self):

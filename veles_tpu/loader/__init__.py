@@ -1,0 +1,5 @@
+"""veles_tpu.loader: the data layer (reference ``veles/loader/``)."""
+
+from veles_tpu.loader.base import (  # noqa: F401
+    Loader, TEST, VALID, TRAIN, CLASS_NAMES)
+from veles_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401

@@ -1,0 +1,266 @@
+"""Loader base: the minibatch server.
+
+TPU-native re-design of reference ``veles/loader/base.py`` (1181 LoC). Kept
+semantics:
+
+- three sample classes TEST(0)/VALID(1)/TRAIN(2) with per-class lengths and
+  a fixed serving order TEST → VALID → TRAIN inside each epoch
+  (``loader/base.py:72-80``);
+- train-set reshuffling each epoch from the named "loader" PRNG stream,
+  bounded by ``shuffle_limit`` (``loader/base.py:711-724``);
+- epoch flags consumed by Decision/GD gating: ``minibatch_class``,
+  ``last_minibatch``, ``epoch_ended_for_class``, ``epoch_ended``,
+  ``epoch_number``;
+- fleet-mode distribution: the master serves only (indices, class, epoch)
+  payloads; slaves fill data locally; un-acked minibatches are requeued on
+  slave drop (``loader/base.py:631-687``) — index payloads are tiny, so DCN
+  traffic stays negligible;
+- ``--train-ratio`` partial-train support and validation resplit hooks.
+
+TPU deltas: minibatch tensors have **static shapes** (jit requirement) — a
+short final minibatch keeps ``max_minibatch_size`` rows and exposes
+``minibatch_valid_size`` + a 0/1 ``sample_mask`` that the evaluator folds
+into loss/metrics (the reference instead re-served tail rows). Filling
+happens on device (see FullBatchLoader) so the gather fuses into the tick.
+"""
+
+import collections
+
+import numpy
+
+from veles_tpu.core import prng
+from veles_tpu.core.errors import NoMoreJobsError
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.units import Unit
+from veles_tpu.memory import Array
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+#: Name → loader-class map (reference ``loader/base.py:83``
+#: UserLoaderRegistry); populated by the @register_loader decorator.
+loader_registry = {}
+
+
+def register_loader(name):
+    def wrap(cls):
+        loader_registry[name] = cls
+        return cls
+    return wrap
+
+
+class Loader(Unit):
+    """Minibatch server base (reference ``loader/base.py:120``)."""
+
+    hide_from_registry = True
+    VIEW_GROUP = "LOADER"
+
+    def __init__(self, workflow, **kwargs):
+        self.minibatch_size = kwargs.pop("minibatch_size", 100)
+        self.train_ratio = kwargs.pop("train_ratio", 1.0)
+        self.shuffle_limit = kwargs.pop("shuffle_limit", None)
+        self.prng_key = kwargs.pop("prng_key", "loader")
+        super().__init__(workflow, **kwargs)
+        self.class_lengths = [0, 0, 0]
+        self.epoch_number = 0
+        self.samples_served = 0
+        self.minibatch_class = TRAIN
+        self.minibatch_valid_size = 0
+        self.minibatch_offset = 0
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.epoch_ended_for_class = Bool(False)
+        self.complete = Bool(False)
+        # served tensors (static-shape device slots):
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_indices = Array()
+        self.sample_mask = Array()
+        self.shuffled_indices = [None, None, None]
+        self._position = [0, 0, 0]
+        self._served_this_epoch = 0
+        # fleet mode: minibatches handed to slaves but not yet acked, and
+        # dropped slaves' work queued for re-serving
+        self.pending_minibatches_ = collections.defaultdict(list)
+        self.failed_minibatches = []
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self.pending_minibatches_ = collections.defaultdict(list)
+
+    # -- the ILoader contract (reference loader/base.py:100-115) -------------
+    def load_data(self):
+        """Populate class_lengths and dataset storage. Abstract."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Allocate the static-shape minibatch slots. Abstract."""
+        raise NotImplementedError
+
+    def fill_minibatch(self, indices, valid):
+        """Fill minibatch slots for ``indices`` (global sample ids);
+        entries beyond ``valid`` are padding. Abstract."""
+        raise NotImplementedError
+
+    # -- derived sizes --------------------------------------------------------
+    @property
+    def total_samples(self):
+        return int(sum(self.class_lengths))
+
+    @property
+    def max_minibatch_size(self):
+        return self.minibatch_size
+
+    def class_offset(self, klass):
+        return int(sum(self.class_lengths[:klass]))
+
+    @property
+    def effective_class_lengths(self):
+        """class_lengths with --train-ratio applied to TRAIN."""
+        lengths = list(self.class_lengths)
+        if self.train_ratio < 1.0:
+            lengths[TRAIN] = max(1, int(lengths[TRAIN] * self.train_ratio))
+        return lengths
+
+    # -- lifecycle ------------------------------------------------------------
+    def initialize(self, **kwargs):
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s loaded an empty dataset" % self.name)
+        self.info("dataset: test=%d validation=%d train=%d",
+                  *self.class_lengths)
+        if not self.restored_from_snapshot():
+            for klass in (TEST, VALID, TRAIN):
+                length = self.class_lengths[klass]
+                self.shuffled_indices[klass] = (
+                    numpy.arange(length, dtype=numpy.int64)
+                    + self.class_offset(klass))
+            self._shuffle_train()
+        self.create_minibatch_data()
+
+    def restored_from_snapshot(self):
+        wf = self.workflow
+        return bool(getattr(wf, "restored_from_snapshot", False)) \
+            and self.shuffled_indices[TRAIN] is not None
+
+    def _shuffle_train(self):
+        if self.shuffle_limit is not None \
+                and self.epoch_number >= self.shuffle_limit:
+            return
+        prng.get(self.prng_key).shuffle(self.shuffled_indices[TRAIN])
+
+    # -- serving --------------------------------------------------------------
+    def _next_block(self):
+        """Compute the next (class, start, size) to serve, advancing epoch
+        state. Returns None when a full epoch just completed."""
+        lengths = self.effective_class_lengths
+        for klass in (TEST, VALID, TRAIN):
+            pos = self._position[klass]
+            if pos < lengths[klass]:
+                size = min(self.max_minibatch_size, lengths[klass] - pos)
+                self._position[klass] = pos + size
+                return klass, pos, size
+        return None
+
+    def _roll_epoch(self):
+        self.epoch_number += 1
+        self._position = [0, 0, 0]
+        self._shuffle_train()
+
+    def serve_next_minibatch(self, slave_id=None):
+        """Pick the next minibatch (failed ones first — reference
+        ``loader/base.py:726-753``), record it pending for the slave, and
+        return (klass, indices, valid_size, flags)."""
+        if self.failed_minibatches:
+            klass, indices, valid = self.failed_minibatches.pop()
+            requeued = True
+        else:
+            block = self._next_block()
+            if block is None:
+                self._roll_epoch()
+                block = self._next_block()
+            klass, pos, valid = block
+            indices = self.shuffled_indices[klass][pos:pos + valid]
+            requeued = False
+        if slave_id is not None:
+            self.pending_minibatches_[slave_id].append(
+                (klass, indices, valid))
+        lengths = self.effective_class_lengths
+        last_of_class = (not requeued
+                         and self._position[klass] >= lengths[klass])
+        last_of_epoch = last_of_class and all(
+            self._position[k] >= lengths[k] or lengths[k] == 0
+            for k in (TEST, VALID, TRAIN))
+        return klass, indices, valid, last_of_class, last_of_epoch
+
+    def run(self):
+        """Standalone/slave-local serving: pick indices and fill on device."""
+        (klass, indices, valid, last_of_class,
+         last_of_epoch) = self.serve_next_minibatch()
+        self._apply_minibatch(klass, indices, valid, last_of_class,
+                              last_of_epoch)
+
+    def _apply_minibatch(self, klass, indices, valid, last_of_class,
+                         last_of_epoch):
+        self.minibatch_class = klass
+        self.minibatch_valid_size = valid
+        self.minibatch_offset = int(indices[0]) if len(indices) else 0
+        self.last_minibatch.set(last_of_class)
+        self.epoch_ended_for_class.set(last_of_class)
+        self.epoch_ended.set(last_of_epoch)
+        padded = self._pad_indices(indices)
+        self.fill_minibatch(padded, valid)
+        self.samples_served += valid
+        self._served_this_epoch += valid
+        if last_of_epoch:
+            self.event("epoch", "single", number=self.epoch_number)
+            self._served_this_epoch = 0
+
+    def _pad_indices(self, indices):
+        """Static shapes: pad short index blocks by repeating index 0; the
+        mask zeroes their contribution."""
+        size = self.max_minibatch_size
+        padded = numpy.zeros(size, dtype=numpy.int64)
+        padded[:len(indices)] = indices
+        return padded
+
+    # -- fleet-mode distribution (reference loader/base.py:631-687) ----------
+    def generate_data_for_slave(self, slave=None):
+        slave_id = getattr(slave, "id", slave)
+        if self.complete:
+            raise NoMoreJobsError()
+        return self.serve_next_minibatch(slave_id)
+
+    def apply_data_from_master(self, data):
+        klass, indices, valid, last_of_class, last_of_epoch = data
+        self._apply_minibatch(klass, numpy.asarray(indices), valid,
+                              last_of_class, last_of_epoch)
+
+    def generate_data_for_master(self):
+        return {"samples_served": self.samples_served}
+
+    def apply_data_from_slave(self, data, slave=None):
+        slave_id = getattr(slave, "id", slave)
+        if self.pending_minibatches_.get(slave_id):
+            self.pending_minibatches_[slave_id].pop(0)
+
+    def drop_slave(self, slave=None):
+        """Requeue the dropped slave's un-acked minibatches so no sample is
+        lost (reference ``loader/base.py:679-687``)."""
+        slave_id = getattr(slave, "id", slave)
+        pending = self.pending_minibatches_.pop(slave_id, [])
+        self.failed_minibatches.extend(pending)
+        if pending:
+            self.warning("requeued %d minibatches from dropped slave %s",
+                         len(pending), slave_id)
+
+    @property
+    def has_data_for_slave(self):
+        return not self.complete
+
+    # -- results --------------------------------------------------------------
+    def get_metric_names(self):
+        return ["epochs", "total_samples"]
+
+    def get_metric_values(self):
+        return [self.epoch_number, self.total_samples]

@@ -1,0 +1,173 @@
+"""FullBatchLoader: whole dataset resident on device, minibatch by gather.
+
+Reference ``veles/loader/fullbatch.py``: the dataset lives in
+``original_data``/``original_labels`` Arrays, optionally device-resident,
+and minibatches are gathered by the ``fill_minibatch_data_labels`` kernel
+(``cuda/fullbatch_loader.cu``). TPU design: the originals are jax.Arrays in
+HBM and the fill is one jitted gather+normalize (``ops.gather_minibatch``) —
+for MNIST-scale sets this keeps the whole data path on device; the
+graceful OOM fallback (reference ``fullbatch.py:170-242``) keeps originals
+in host numpy and gathers there instead.
+
+Subclasses (or callers via ``data=``/``labels=`` kwargs) provide the actual
+dataset; class splits come from ``class_lengths`` or the
+``validation_ratio`` resplit.
+"""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.loader.base import Loader, TRAIN, VALID, register_loader
+from veles_tpu.memory import Array
+from veles_tpu.ops.gather import gather_minibatch
+from veles_tpu.ops.normalize import compute_mean_disp, mean_disp_normalize
+
+
+@register_loader("full_batch")
+class FullBatchLoader(Loader):
+    """Device-resident full-batch loader (reference ``fullbatch.py:79``)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.on_device = kwargs.pop("on_device", True)
+        self.normalization_type = kwargs.pop("normalization_type", "none")
+        self.validation_ratio = kwargs.pop("validation_ratio", None)
+        data = kwargs.pop("data", None)
+        labels = kwargs.pop("labels", None)
+        lengths = kwargs.pop("class_lengths", None)
+        super().__init__(workflow, **kwargs)
+        self.original_data = Array()
+        self.original_labels = Array()
+        self._provided_data = data
+        self._provided_labels = labels
+        self._provided_lengths = lengths
+        self.normalizer_state = None
+
+    # -- ILoader --------------------------------------------------------------
+    def load_data(self):
+        if self._provided_data is None:
+            raise NotImplementedError(
+                "%s: override load_data() or pass data=" % self.name)
+        data = numpy.asarray(self._provided_data, numpy.float32)
+        self.original_data.reset(data)
+        if self._provided_labels is not None:
+            self.original_labels.reset(
+                numpy.asarray(self._provided_labels, numpy.int32))
+        if self._provided_lengths is not None:
+            self.class_lengths = list(self._provided_lengths)
+        else:
+            self.class_lengths = [0, 0, len(data)]
+        if self.validation_ratio:
+            self._resplit_validation()
+        self._analyze_normalization()
+        if self.on_device:
+            try:
+                self.original_data.to_device()
+                if self.original_labels:
+                    self.original_labels.to_device()
+            except Exception as exc:
+                # graceful fallback to host gather (reference OOM path)
+                self.warning("keeping dataset on host: %s", exc)
+                self.on_device = False
+
+    def _resplit_validation(self):
+        """Move the tail of TRAIN into VALID (reference
+        ``validation_ratio`` resplit)."""
+        n_valid = int(self.class_lengths[TRAIN] * self.validation_ratio)
+        # layout is [test | valid | train]; splice the LAST n_valid train
+        # rows in after the existing valid block so all three stay contiguous
+        valid_end = self.class_offset(TRAIN)
+        self.class_lengths[VALID] += n_valid
+        self.class_lengths[TRAIN] -= n_valid
+
+        def splice(arr):
+            return numpy.concatenate([
+                arr[:valid_end], arr[len(arr) - n_valid:],
+                arr[valid_end:len(arr) - n_valid]])
+
+        self.original_data.reset(splice(self.original_data.mem))
+        if self.original_labels:
+            self.original_labels.reset(splice(self.original_labels.mem))
+
+    def _analyze_normalization(self):
+        """One pass over the train set for normalizer statistics
+        (reference ``loader/base.py:755-802``)."""
+        if self.normalization_type == "none":
+            return
+        start = self.class_offset(TRAIN)
+        train = self.original_data.mem[
+            start:start + self.class_lengths[TRAIN]]
+        if not len(train):  # no train split (e.g. pure evaluation runs)
+            train = self.original_data.mem
+        if self.normalization_type == "mean_disp":
+            mean, rdisp = compute_mean_disp(jnp.asarray(train))
+            self.normalizer_state = {"mean": mean, "rdisp": rdisp}
+        elif self.normalization_type == "linear":
+            vmax = float(numpy.max(numpy.abs(train))) or 1.0
+            self.normalizer_state = {"scale": 1.0 / vmax}
+        else:
+            raise ValueError("unknown normalization_type %r"
+                             % self.normalization_type)
+
+    def create_minibatch_data(self):
+        size = self.max_minibatch_size
+        sample_shape = self.original_data.shape[1:]
+        self.minibatch_data.reset(
+            numpy.zeros((size,) + sample_shape, numpy.float32))
+        if self.original_labels:
+            self.minibatch_labels.reset(numpy.zeros(size, numpy.int32))
+        self.minibatch_indices.reset(numpy.zeros(size, numpy.int64))
+        self.sample_mask.reset(numpy.zeros(size, numpy.float32))
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._fill_jit_ = None
+
+    @property
+    def _fill_jit(self):
+        if self._fill_jit_ is None:
+            norm = self.normalizer_state or {}
+            norm_type = self.normalization_type
+
+            @jax.jit
+            def fill(data, labels, indices, valid):
+                batch, lab = gather_minibatch(data, indices, labels)
+                if norm_type == "mean_disp":
+                    batch = mean_disp_normalize(
+                        batch, norm["mean"], norm["rdisp"])
+                elif norm_type == "linear":
+                    batch = batch * norm["scale"]
+                mask = (jnp.arange(indices.shape[0]) < valid).astype(
+                    jnp.float32)
+                return batch, lab, mask
+
+            self._fill_jit_ = fill
+        return self._fill_jit_
+
+    def fill_minibatch(self, indices, valid):
+        idx = jnp.asarray(indices)
+        data = self.original_data.data
+        labels = (self.original_labels.data if self.original_labels
+                  else jnp.zeros(len(self.original_data), jnp.int32))
+        if not self.on_device and not isinstance(data, jax.Array):
+            # host gather path
+            batch = numpy.take(numpy.asarray(data), indices, axis=0)
+            lab = numpy.take(numpy.asarray(labels), indices, axis=0)
+            mask = (numpy.arange(len(indices)) < valid).astype(numpy.float32)
+            if self.normalization_type == "mean_disp":
+                batch = (batch - numpy.asarray(
+                    self.normalizer_state["mean"])) * numpy.asarray(
+                    self.normalizer_state["rdisp"])
+            elif self.normalization_type == "linear":
+                batch = batch * self.normalizer_state["scale"]
+            self.minibatch_data.data = jnp.asarray(batch)
+            self.minibatch_labels.data = jnp.asarray(lab)
+            self.sample_mask.data = jnp.asarray(mask)
+        else:
+            batch, lab, mask = self._fill_jit(data, labels, idx,
+                                              jnp.int32(valid))
+            self.minibatch_data.data = batch
+            self.minibatch_labels.data = lab
+            self.sample_mask.data = mask
+        self.minibatch_indices.data = idx

@@ -1,0 +1,11 @@
+"""veles_tpu.models: reference model workflows (the Znicz model zoo tier).
+
+Each module assembles a Workflow from nn/loader units the way reference
+Znicz models did (MNIST784, MNIST-conv, CIFAR, AlexNet, Kohonen...), with
+the standard control topology:
+
+    start → repeater → loader → forwards… → evaluator → decision
+          → gds… (train only) → repeater ; decision → end (on complete)
+"""
+
+from veles_tpu.models.mlp import MLPWorkflow, create_mnist784  # noqa: F401

@@ -1,0 +1,19 @@
+"""veles_tpu.nn: neural-network units (the Znicz plugin equivalent).
+
+The reference keeps its NN op units in the Znicz submodule (absent from the
+snapshot; unit families named in ``BASELINE.json`` and the docs:
+All2All*/Conv/Pooling/GradientDescent*/Evaluator*/Decision). Here they are
+first-class: each unit is a :class:`veles_tpu.nn.jit_unit.JitUnit` whose
+``compute`` is a pure jax function compiled once per shape, with parameters
+held in shared :class:`veles_tpu.memory.Array` slots so forward and
+gradient units see the same weights without copies.
+"""
+
+from veles_tpu.nn.jit_unit import JitUnit, ForwardUnit  # noqa: F401
+from veles_tpu.nn.all2all import (  # noqa: F401
+    All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU, All2AllSigmoid,
+    All2AllSoftmax)
+from veles_tpu.nn.evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa: F401
+from veles_tpu.nn.gd import (  # noqa: F401
+    GradientDescent, GDTanh, GDRELU, GDStrictRELU, GDSigmoid, GDSoftmax)
+from veles_tpu.nn.decision import DecisionGD  # noqa: F401

@@ -1,0 +1,121 @@
+"""All2All: fully-connected forward units.
+
+The Znicz All2All family (named in ``BASELINE.json``; reference GPU path was
+the tiled GEMM kernels of ``ocl/matrix_multiplication*.cl`` driven by
+``accelerated_units.py``). TPU design: one jitted ``act(x @ W + b)`` over
+``ops.gemm.matmul`` (MXU, bf16 passes + f32 accumulation by default).
+
+Weight layout is (in_features, out_features) — natural for row-major
+activations on the MXU; the reference stored (out, in) and transposed inside
+its GEMM kernel.
+
+Weights are initialized U(-stddev, stddev) from the unit's named
+reproducible PRNG stream (reference Znicz used the same symmetric fill via
+``prng``), with the Znicz default magnitude ``1/sqrt(fan_in)``-scaled unless
+``weights_stddev`` is given.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from veles_tpu.core.prng import get as get_rng
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import ForwardUnit
+from veles_tpu.ops import activations
+from veles_tpu.ops.gemm import matmul
+
+
+class All2All(ForwardUnit):
+    """Linear layer: output = act(input @ weights + bias)."""
+
+    ACTIVATION = "linear"
+
+    INPUTS = ("input", "weights", "bias")
+    OUTPUTS = ("output",)
+
+    def __init__(self, workflow, output_sample_shape=None, **kwargs):
+        self.weights_stddev = kwargs.pop("weights_stddev", None)
+        self.bias_stddev = kwargs.pop("bias_stddev", None)
+        self.include_bias = kwargs.pop("include_bias", True)
+        self.prng_key = kwargs.pop("prng_key", "default")
+        super().__init__(workflow, **kwargs)
+        if output_sample_shape is None:
+            raise ValueError("%s needs output_sample_shape" % self.name)
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.output_sample_shape = tuple(output_sample_shape)
+        self.weights = Array()
+        self.bias = Array()
+        self.input = None
+
+    @property
+    def neurons_number(self):
+        return int(math.prod(self.output_sample_shape))
+
+    def initialize(self, **kwargs):
+        if self.input is None or (isinstance(self.input, Array)
+                                  and self.input.data is None):
+            return True  # retry after the provider initializes
+        in_features = int(math.prod(self.input.shape[1:]))
+        out_features = self.neurons_number
+        if self.weights.data is None:
+            stddev = self.weights_stddev or 1.0 / math.sqrt(in_features)
+            rng = get_rng(self.prng_key)
+            self.weights.data = jnp.asarray(rng.fill_uniform(
+                (in_features, out_features), stddev), jnp.float32)
+            bias_std = self.bias_stddev or stddev
+            self.bias.data = jnp.asarray(rng.fill_uniform(
+                (out_features,), bias_std), jnp.float32) \
+                if self.include_bias else jnp.zeros(
+                    (out_features,), jnp.float32)
+        if self.output.data is None:
+            # allocate the output slot so downstream units can initialize
+            # against its shape before the first tick (reference
+            # AcceleratedUnit allocated output buffers at init)
+            batch = self.input.shape[0]
+            self.output.data = jnp.zeros(
+                (batch,) + self.output_sample_shape, jnp.float32)
+
+    def compute(self, x, weights, bias):
+        x = x.reshape(x.shape[0], -1)
+        pre = matmul(x, weights, out_dtype=jnp.float32) + bias
+        act, _ = activations.ACTIVATIONS[self.ACTIVATION]
+        out = act(pre)
+        if len(self.output_sample_shape) > 1:
+            out = out.reshape((x.shape[0],) + self.output_sample_shape)
+        return out
+
+
+class All2AllTanh(All2All):
+    """Scaled-tanh dense layer (Znicz All2AllTanh, 1.7159·tanh(0.6666x))."""
+    ACTIVATION = "tanh"
+
+
+class All2AllRELU(All2All):
+    """Softplus dense layer (Znicz All2AllRELU is log(1+e^x))."""
+    ACTIVATION = "relu"
+
+
+class All2AllStrictRELU(All2All):
+    """max(0, x) dense layer."""
+    ACTIVATION = "strict_relu"
+
+
+class All2AllSigmoid(All2All):
+    ACTIVATION = "sigmoid"
+
+
+class All2AllSoftmax(All2All):
+    """Classifier head. Emits **logits** in ``output`` plus the argmax in
+    ``max_idx``; the softmax itself lives fused inside EvaluatorSoftmax's
+    cross-entropy (numerically stabler and one less HBM round trip than the
+    reference's explicit softmax kernel). Consumers needing probabilities
+    use ``jax.nn.softmax(output.data)``."""
+
+    ACTIVATION = "linear"
+    OUTPUTS = ("output", "max_idx")
+
+    def compute(self, x, weights, bias):
+        logits = super().compute(x, weights, bias)
+        return logits, jnp.argmax(logits, axis=-1)

@@ -1,0 +1,141 @@
+"""DecisionGD: epoch accounting and stop decisions.
+
+The Znicz Decision unit watches the loader's epoch flags and the evaluator's
+metrics, accumulates per-class error counts, decides whether the validation
+error improved, remembers the best snapshot point, and raises
+``complete`` when training should stop (max epochs reached or no
+improvement for ``fail_iterations`` epochs).
+
+Host-side by design: it runs once per minibatch but does only flag checks;
+device metric reads happen at epoch boundaries (one small transfer per
+epoch). Its ``improved``/``snapshot_suffix``/``complete`` outputs gate the
+Snapshotter and the Repeater loop exactly as in the reference workflows.
+"""
+
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.units import Unit
+from veles_tpu.loader.base import CLASS_NAMES, TEST, TRAIN, VALID
+
+
+class DecisionGD(Unit):
+    """Training-loop decision unit (the Znicz Decision contract)."""
+
+    VIEW_GROUP = "TRAINER"
+
+    def __init__(self, workflow, **kwargs):
+        self.max_epochs = kwargs.pop("max_epochs", None)
+        self.fail_iterations = kwargs.pop("fail_iterations", 100)
+        super().__init__(workflow, **kwargs)
+        # linked from the loader:
+        self.loader = None
+        # linked from the evaluator (device scalars, read at epoch end):
+        self.evaluator = None
+        self.demand("loader", "evaluator")
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.train_ended = Bool(False)
+        self.epoch_ended = Bool(False)
+        # gate for the GD chain: True on non-train minibatches so the
+        # backward units gate_skip (run nothing, still propagate the tick)
+        self.gd_skipped = Bool(False)
+        # accumulated per-class stats, indexed TEST/VALID/TRAIN:
+        self.epoch_n_err = [0, 0, 0]
+        self.epoch_samples = [0, 0, 0]
+        self.epoch_loss = [0.0, 0.0, 0.0]
+        self.best_n_err = [None, None, None]
+        self.best_epoch = 0
+        self.snapshot_suffix = ""
+        self._epochs_without_improvement = 0
+
+    def link_from_workflow(self, loader, evaluator):
+        self.loader = loader
+        self.evaluator = evaluator
+        return self
+
+    def initialize(self, **kwargs):
+        if self.loader is None or self.evaluator is None:
+            return True
+
+    def run(self):
+        self.improved.unset()
+        self.epoch_ended.unset()
+        klass = self.loader.minibatch_class
+        self.gd_skipped.set(klass != TRAIN)
+        # accumulate metrics as LAZY device scalars — a host read here would
+        # block the async XLA dispatch pipeline every minibatch; conversion
+        # to Python numbers happens only at class/epoch boundaries
+        size = int(self.loader.minibatch_valid_size)
+        self.epoch_n_err[klass] = (self.epoch_n_err[klass]
+                                   + self.evaluator.n_err.data)
+        self.epoch_samples[klass] += size
+        self.epoch_loss[klass] = (self.epoch_loss[klass]
+                                  + self.evaluator.loss.data * size)
+        if not self.loader.epoch_ended_for_class:
+            return
+        # one sample-class sweep finished: sync its accumulators to host
+        self.epoch_n_err[klass] = int(self.epoch_n_err[klass])
+        self.epoch_loss[klass] = float(self.epoch_loss[klass])
+        self._on_class_ended(klass)
+        if self.loader.epoch_ended:
+            self._on_epoch_ended()
+
+    # -- epoch boundary logic -------------------------------------------------
+    def _on_class_ended(self, klass):
+        samples = max(self.epoch_samples[klass], 1)
+        error_pct = 100.0 * self.epoch_n_err[klass] / samples
+        self.info(
+            "epoch %d %s: errors %d/%d (%.2f%%) avg loss %.6f",
+            self.loader.epoch_number, CLASS_NAMES[klass],
+            self.epoch_n_err[klass], samples, error_pct,
+            self.epoch_loss[klass] / samples)
+        if klass == VALID:
+            best = self.best_n_err[VALID]
+            if best is None or self.epoch_n_err[VALID] < best:
+                self.best_n_err[VALID] = self.epoch_n_err[VALID]
+                self.best_epoch = self.loader.epoch_number
+                self.improved.set()
+                self._epochs_without_improvement = 0
+                self.snapshot_suffix = "validation_%.2fpt" % error_pct
+            else:
+                self._epochs_without_improvement += 1
+
+    def _on_epoch_ended(self):
+        self.epoch_ended.set()
+        # when there is no validation set, improvement tracks train error
+        if self.epoch_samples[VALID] == 0 and self.epoch_samples[TRAIN] > 0:
+            best = self.best_n_err[TRAIN]
+            if best is None or self.epoch_n_err[TRAIN] < best:
+                self.best_n_err[TRAIN] = self.epoch_n_err[TRAIN]
+                self.best_epoch = self.loader.epoch_number
+                self.improved.set()
+                self._epochs_without_improvement = 0
+                samples = max(self.epoch_samples[TRAIN], 1)
+                self.snapshot_suffix = "train_%.2fpt" % (
+                    100.0 * self.epoch_n_err[TRAIN] / samples)
+            else:
+                self._epochs_without_improvement += 1
+        stop = False
+        if self.max_epochs is not None \
+                and self.loader.epoch_number >= self.max_epochs:
+            self.info("stopping: reached max_epochs=%d", self.max_epochs)
+            stop = True
+        if self._epochs_without_improvement >= self.fail_iterations:
+            self.info("stopping: no improvement for %d epochs",
+                      self.fail_iterations)
+            stop = True
+        if stop:
+            self.complete.set()
+            self.train_ended.set()
+        for klass in (TEST, VALID, TRAIN):
+            self.epoch_n_err[klass] = 0
+            self.epoch_samples[klass] = 0
+            self.epoch_loss[klass] = 0.0
+
+    # -- results (IResultProvider) -------------------------------------------
+    def get_metric_names(self):
+        return ["best_validation_errors", "best_epoch", "epochs"]
+
+    def get_metric_values(self):
+        return [self.best_n_err[VALID] if self.best_n_err[VALID] is not None
+                else self.best_n_err[TRAIN],
+                self.best_epoch, self.loader.epoch_number]

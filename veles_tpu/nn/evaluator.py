@@ -1,0 +1,78 @@
+"""Evaluator units: loss, error signal, and metrics.
+
+The Znicz EvaluatorSoftmax/EvaluatorMSE contract: consume the last forward
+unit's ``output`` plus the loader's ``minibatch_labels``/``targets``, emit
+``err_output`` for the gradient chain and metric accumulators the Decision
+unit reads at epoch boundaries.
+
+TPU design notes:
+
+- the softmax + cross-entropy + gradient are one fused jitted computation
+  over logits (All2AllSoftmax emits logits — see its docstring);
+- a 0/1 ``sample_mask`` handles short final minibatches under jit's static
+  shapes (the reference instead re-filled the tail with previous samples);
+- metric values stay on device; ``n_err`` etc. are read to host only when
+  the Decision unit asks at epoch end.
+"""
+
+import jax.numpy as jnp
+
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import JitUnit
+from veles_tpu.ops import losses
+
+
+class EvaluatorBase(JitUnit):
+
+    hide_from_registry = True
+    VIEW_GROUP = "EVALUATOR"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None          # forward output (linked)
+        self.sample_mask = None    # loader-provided validity mask (linked)
+        self.demand("input")
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Softmax cross-entropy evaluator (Znicz EvaluatorSoftmax)."""
+
+    INPUTS = ("input", "labels", "sample_mask")
+    OUTPUTS = ("err_output", "loss", "n_err", "max_err_output_sum",
+               "confusion_matrix")
+
+    def __init__(self, workflow, **kwargs):
+        self.compute_confusion = kwargs.pop("compute_confusion", True)
+        super().__init__(workflow, **kwargs)
+        self.labels = None  # linked from loader.minibatch_labels
+        self.demand("labels")
+
+    def compute(self, logits, labels, mask):
+        n_classes = logits.shape[-1]
+        valid = jnp.maximum(jnp.sum(mask), 1.0)
+        err, loss_sum, n_err, _ = losses.masked_softmax_xent(
+            logits, labels, mask, valid)
+        max_err = jnp.max(jnp.abs(err))
+        if self.compute_confusion:
+            cm = losses.confusion_matrix(logits, labels, n_classes, mask)
+        else:
+            cm = jnp.zeros((n_classes, n_classes), jnp.int32)
+        return err, loss_sum / valid, n_err, max_err, cm
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error evaluator (Znicz EvaluatorMSE)."""
+
+    INPUTS = ("input", "target", "sample_mask")
+    OUTPUTS = ("err_output", "loss", "max_err")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.target = None  # linked from loader.minibatch_targets
+        self.demand("target")
+
+    def compute(self, output, target, mask):
+        valid = jnp.maximum(jnp.sum(mask), 1.0)
+        err, loss_sum, max_err = losses.masked_mse(output, target, mask,
+                                                   valid)
+        return err, loss_sum / valid, max_err

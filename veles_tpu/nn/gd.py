@@ -1,0 +1,148 @@
+"""Gradient-descent units: the backward chain.
+
+The Znicz GradientDescent* family (named in ``BASELINE.json``): each GD unit
+mirrors one forward unit, consuming ``err_output`` (dL/d output) and the
+forward unit's saved ``input``/``output``, producing ``err_input`` for the
+next unit down and updating the **shared** weights/bias Array slots in
+place. The whole backward step for a layer — activation derivative, weight
+gradient GEMM, error back-GEMM, momentum + weight-decay update — is one
+jitted computation (the reference launched four separate kernels:
+err_y_update, weights_update, bias_update, err_h_update).
+
+Update rule (Znicz GD semantics):
+
+    v    ← μ·v − λ·(∇W + Λ₂·W + Λ₁·sign(W))
+    W    ← W + v
+
+with learning_rate λ, gradient_moment μ, l2 Λ₂ (``weights_decay``), l1 Λ₁.
+Hyperparameters are passed into the jitted function as arrays so they can be
+annealed per epoch without retracing.
+"""
+
+import jax.numpy as jnp
+
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import JitUnit
+from veles_tpu.ops import activations
+from veles_tpu.ops.gemm import matmul
+
+
+class GradientDescent(JitUnit):
+    """Backward unit for All2All (linear activation)."""
+
+    ACTIVATION = "linear"
+    VIEW_GROUP = "TRAINER"
+
+    INPUTS = ("err_output", "input", "output", "weights", "bias",
+              "_velocity_w", "_velocity_b", "_hyper")
+    OUTPUTS = ("err_input", "weights", "bias", "_velocity_w", "_velocity_b")
+
+    def __init__(self, workflow, **kwargs):
+        self.learning_rate = kwargs.pop("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.pop("learning_rate_bias", None)
+        self.weights_decay = kwargs.pop("weights_decay", 0.0)
+        self.l1_vs_l2 = kwargs.pop("l1_vs_l2", 0.0)
+        self.gradient_moment = kwargs.pop("gradient_moment", 0.0)
+        self.include_bias = kwargs.pop("include_bias", True)
+        super().__init__(workflow, **kwargs)
+        # linked from the paired forward unit:
+        self.input = None
+        self.output = None
+        self.weights = None
+        self.bias = None
+        # linked from the next unit up (evaluator or deeper GD):
+        self.err_output = None
+        self.demand("err_output", "input", "output", "weights", "bias")
+        self._velocity_w = Array()
+        self._velocity_b = Array()
+        self._hyper = Array()
+
+    def link_forward(self, forward_unit, err_source):
+        """Wire this GD unit to its forward twin + the error source
+        (convenience mirroring how Znicz models assemble the chain)."""
+        self.link_attrs(forward_unit, "input", "output", "weights", "bias")
+        self.link_attrs(err_source, ("err_output", "err_input")
+                        if isinstance(err_source, GradientDescent)
+                        else ("err_output", "err_output"))
+        return self
+
+    def initialize(self, **kwargs):
+        if self.weights is None or self.weights.data is None:
+            return True
+        if self._velocity_w.data is None:
+            self._velocity_w.data = jnp.zeros_like(self.weights.data)
+            self._velocity_b.data = jnp.zeros_like(self.bias.data)
+        self._refresh_hyper()
+
+    def _refresh_hyper(self):
+        lr_bias = (self.learning_rate_bias
+                   if self.learning_rate_bias is not None
+                   else self.learning_rate)
+        self._hyper.data = jnp.asarray(
+            [self.learning_rate, lr_bias, self.weights_decay,
+             self.l1_vs_l2, self.gradient_moment], jnp.float32)
+
+    def set_learning_rate(self, value):
+        """Anneal without retracing (hyper is a traced input)."""
+        self.learning_rate = value
+        self._refresh_hyper()
+
+    def compute(self, err_output, x, y, weights, bias, vel_w, vel_b, hyper):
+        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
+                                    hyper[4])
+        _, deriv = activations.ACTIVATIONS[self.ACTIVATION]
+        err_pre = (err_output.reshape(err_output.shape[0], -1)
+                   * deriv(y.reshape(y.shape[0], -1)))
+        x2 = x.reshape(x.shape[0], -1)
+        grad_w = matmul(x2.T, err_pre, out_dtype=jnp.float32)
+        grad_w = grad_w + l2 * weights + l1 * jnp.sign(weights)
+        err_input = matmul(err_pre, weights.T,
+                           out_dtype=jnp.float32).reshape(x.shape)
+        new_vel_w = moment * vel_w - lr * grad_w
+        new_w = weights + new_vel_w
+        grad_b = jnp.sum(err_pre, axis=0)
+        new_vel_b = moment * vel_b - lr_b * grad_b
+        new_b = bias + new_vel_b
+        return err_input, new_w, new_b, new_vel_w, new_vel_b
+
+    # fleet-mode DP: slaves ship their weight deltas; the master merges.
+    # (Pod-mode DP instead all-reduces gradients inside the tick — see
+    # veles_tpu/parallel/.)
+    def generate_data_for_master(self):
+        return {"weights": self.weights.mem, "bias": self.bias.mem}
+
+    def apply_data_from_slave(self, data, slave=None):
+        # reference Znicz GD units overwrite master state with the slave's
+        # result (asynchronous DP: last-writer-wins, stale updates accepted)
+        self.weights.data = jnp.asarray(data["weights"])
+        self.bias.data = jnp.asarray(data["bias"])
+
+    def generate_data_for_slave(self, slave=None):
+        return {"weights": self.weights.mem, "bias": self.bias.mem}
+
+    def apply_data_from_master(self, data):
+        self.weights.data = jnp.asarray(data["weights"])
+        self.bias.data = jnp.asarray(data["bias"])
+
+
+class GDTanh(GradientDescent):
+    ACTIVATION = "tanh"
+
+
+class GDRELU(GradientDescent):
+    ACTIVATION = "relu"
+
+
+class GDStrictRELU(GradientDescent):
+    ACTIVATION = "strict_relu"
+
+
+class GDSigmoid(GradientDescent):
+    ACTIVATION = "sigmoid"
+
+
+class GDSoftmax(GradientDescent):
+    """Backward for All2AllSoftmax: the evaluator's err_output is already
+    d(loss)/d(logits) (softmax folded into the cross-entropy gradient), so
+    the activation derivative is identity."""
+    ACTIVATION = "linear"

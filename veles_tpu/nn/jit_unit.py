@@ -1,0 +1,103 @@
+"""JitUnit: the TPU-era AcceleratedUnit.
+
+The reference AcceleratedUnit (``accelerated_units.py:130-673``) dispatches
+per backend (numpy_run/ocl_run/cuda_run) and hand-builds kernels through a
+jinja2 + compile + binary-cache pipeline. Under XLA that entire machinery is
+``jax.jit``: a JitUnit subclass writes one pure ``compute(*arrays)`` and the
+framework traces/compiles/caches it per shape signature. The reference's
+``--force-numpy`` escape hatch survives as ``root.common.engine.force_cpu``
+(jit on the CPU backend); its kernel binary cache is XLA's own compilation
+cache.
+
+Contract:
+
+- ``INPUTS``/``OUTPUTS`` name Array-slot attributes on the unit;
+- ``compute(*tensors)`` is pure (no self-state reads that change between
+  calls — changing hyperparameters must be passed as tensors, e.g. via
+  ``PARAMS`` slots);
+- ``run()`` gathers INPUT slots' device values, invokes the jitted compute,
+  and stores results back into OUTPUT slots (mutable Array containers shared
+  with consumers by ``link_attrs``), so downstream units — and the fused
+  tick, later — see new values without host round-trips.
+"""
+
+import jax
+
+from veles_tpu.core.units import Unit
+from veles_tpu.memory import Array
+
+
+class JitUnit(Unit):
+    """Base for units whose run() is one jitted computation."""
+
+    hide_from_registry = True
+
+    INPUTS = ()
+    OUTPUTS = ()
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        for name in self.OUTPUTS:
+            if getattr(self, name, None) is None:
+                setattr(self, name, Array())
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._jitted_ = None
+
+    # -- the pure computation -------------------------------------------------
+    def compute(self, *tensors):
+        """Pure function of the INPUT tensors; returns one tensor per OUTPUT
+        (or a single tensor when there is one OUTPUT)."""
+        raise NotImplementedError
+
+    @property
+    def jitted(self):
+        if self._jitted_ is None:
+            backend = None
+            from veles_tpu.core.config import root
+            if root.common.engine.get("force_cpu", False):
+                backend = "cpu"
+            self._jitted_ = jax.jit(self.compute, backend=backend)
+        return self._jitted_
+
+    # -- slot plumbing --------------------------------------------------------
+    def gather_inputs(self):
+        values = []
+        for name in self.INPUTS:
+            slot = getattr(self, name)
+            if isinstance(slot, Array):
+                if slot.data is None:
+                    raise ValueError(
+                        "%s: input slot %r is empty" % (self.name, name))
+                values.append(slot.data)
+            else:
+                values.append(slot)
+        return values
+
+    def scatter_outputs(self, results):
+        if len(self.OUTPUTS) == 1:
+            results = (results,)
+        for name, value in zip(self.OUTPUTS, results):
+            slot = getattr(self, name)
+            if isinstance(slot, Array):
+                slot.data = value
+            else:
+                setattr(self, name, value)
+
+    def run(self):
+        self.scatter_outputs(self.jitted(*self.gather_inputs()))
+
+
+class ForwardUnit(JitUnit):
+    """Marker base for forward-propagation units (the Znicz ``Forward``
+    contract: ``input``/``output`` + ``weights``/``bias`` slots). The tick
+    compiler and the model exporter recognize these."""
+
+    hide_from_registry = True
+
+    VIEW_GROUP = "WORKER"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.demand("input")

@@ -2,49 +2,76 @@
 
 The Znicz EvaluatorSoftmax / EvaluatorMSE units compute the training error
 signal fed to the gradient-descent chain plus host-visible metrics
-(n_err, confusion matrix, max error). Here each is one pure function
-designed to live inside the jitted tick: metrics come back as device scalars
-/ small arrays and are read on host only at epoch boundaries.
+(n_err, confusion matrix, max error). The masked variants are the single
+source of truth — EvaluatorSoftmax (graph mode) and the fused train step
+(``parallel/step.py``) both call them, which is what keeps the two modes
+numerically identical. ``mask`` handles short final minibatches under jit's
+static shapes; ``valid`` is passed in so a data-parallel caller can supply
+the *global* valid count (psum over the mesh) and get exact full-batch
+gradients.
 """
 
 import jax.numpy as jnp
 from jax import nn as jnn
 
 
-def softmax_cross_entropy(logits, labels, n_classes=None):
-    """Returns (err_logits, loss, n_err, max_confidence).
+def masked_softmax_xent(logits, labels, mask, valid):
+    """Fused masked softmax cross-entropy.
 
-    ``err_logits`` is d(mean xent)/d(logits) = (softmax - onehot)/batch —
-    exactly the signal Znicz's EvaluatorSoftmax emits to the GD chain.
+    Returns ``(err, loss_sum, n_err, pred)`` where ``err`` is
+    d(sum xent / valid)/d(logits) = (softmax - onehot)·mask/valid — the
+    signal Znicz's EvaluatorSoftmax emits to the GD chain — and
+    ``loss_sum`` is the *unnormalized* masked xent sum so distributed
+    callers can psum it before dividing by the global ``valid``.
     """
-    if n_classes is None:
-        n_classes = logits.shape[-1]
-    batch = logits.shape[0]
-    probs = jnn.softmax(logits, axis=-1)
-    onehot = jnn.one_hot(labels, n_classes, dtype=logits.dtype)
-    logp = jnn.log_softmax(logits, axis=-1)
-    loss = -jnp.mean(jnp.sum(onehot * logp, axis=-1))
-    err = (probs - onehot) / batch
+    n_classes = logits.shape[-1]
+    onehot = jnp.eye(n_classes, dtype=logits.dtype)[labels]
+    logp = logits - jnp.max(logits, axis=-1, keepdims=True)
+    logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+    loss_sum = -jnp.sum(jnp.sum(onehot * logp, axis=-1) * mask)
+    err = (jnp.exp(logp) - onehot) * (mask / valid)[:, None]
     pred = jnp.argmax(logits, axis=-1)
-    n_err = jnp.sum((pred != labels).astype(jnp.int32))
-    max_conf = jnp.max(probs)
-    return err, loss, n_err, max_conf
+    n_err = jnp.sum(((pred != labels) & (mask > 0)).astype(jnp.int32))
+    return err, loss_sum, n_err, pred
 
 
-def confusion_matrix(logits, labels, n_classes):
+def softmax_cross_entropy(logits, labels, n_classes=None, mask=None):
+    """Single-host convenience wrapper: returns
+    (err_logits, loss, n_err, max_confidence)."""
+    if mask is None:
+        mask = jnp.ones(logits.shape[0], logits.dtype)
+    valid = jnp.maximum(jnp.sum(mask), 1.0)
+    err, loss_sum, n_err, _ = masked_softmax_xent(logits, labels, mask,
+                                                  valid)
+    max_conf = jnp.max(jnn.softmax(logits, axis=-1))
+    return err, loss_sum / valid, n_err, max_conf
+
+
+def confusion_matrix(logits, labels, n_classes, mask=None):
     """Dense confusion-matrix increment (Znicz evaluator option)."""
     pred = jnp.argmax(logits, axis=-1)
     idx = labels * n_classes + pred
-    flat = jnp.zeros((n_classes * n_classes,), jnp.int32).at[idx].add(1)
+    weights = (jnp.ones_like(labels, dtype=jnp.int32) if mask is None
+               else mask.astype(jnp.int32))
+    flat = jnp.zeros((n_classes * n_classes,), jnp.int32).at[idx].add(
+        weights)
     return flat.reshape(n_classes, n_classes)
+
+
+def masked_mse(output, target, mask, valid):
+    """Masked MSE: returns (err_output, loss_sum, max_err); ``loss_sum``
+    unnormalized for the same distributed reason as masked_softmax_xent."""
+    diff = (output - target) * mask.reshape(
+        (-1,) + (1,) * (output.ndim - 1))
+    loss_sum = jnp.sum(diff.reshape(diff.shape[0], -1) ** 2)
+    err = diff * (2.0 / valid)
+    return err, loss_sum, jnp.max(jnp.abs(diff))
 
 
 def mse(output, target):
     """Returns (err_output, loss, max_err) — Znicz EvaluatorMSE contract."""
     batch = output.shape[0]
-    diff = output - target
-    loss = jnp.mean(jnp.sum(
-        diff.reshape(batch, -1) ** 2, axis=-1))
-    err = diff * (2.0 / batch)
-    max_err = jnp.max(jnp.abs(diff))
-    return err, loss, max_err
+    mask = jnp.ones(batch, output.dtype)
+    err, loss_sum, max_err = masked_mse(output, target, mask,
+                                        jnp.asarray(float(batch)))
+    return err, loss_sum / batch, max_err

@@ -1,0 +1,17 @@
+"""veles_tpu.parallel: device meshes and distributed execution.
+
+The reference's only intra-model distribution is master/slave data
+parallelism over Twisted/ZeroMQ (SURVEY §2.5). The TPU design has two
+tiers:
+
+- **pod mode** (this package): synchronous SPMD over a ``jax.sharding.Mesh``
+  — data/tensor parallel shardings of one fused train step, gradient merge
+  as ``psum`` over ICI. The idiomatic path for any fixed pod slice.
+- **fleet mode** (``veles_tpu.fleet``): host-level elastic master/slave
+  orchestration preserving the reference's job/update, drop/requeue,
+  respawn semantics over DCN, used for dynamic clusters, genetics and
+  ensembles.
+"""
+
+from veles_tpu.parallel.mesh import build_mesh, mesh_axes  # noqa: F401
+from veles_tpu.parallel.step import build_train_step  # noqa: F401

@@ -1,0 +1,59 @@
+"""Logical device mesh construction.
+
+Axes (sized from ``root.common.mesh.axes``, -1 = absorb remaining devices):
+
+- ``data``  — batch (DP); gradient psum rides ICI
+- ``model`` — tensor parallel (TP): weight column/row shards
+- ``seq``   — sequence/context parallel (ring attention neighborhoods)
+- ``pipe``  — pipeline stages
+- ``expert``— MoE expert parallel
+
+The reference has no analogue (its DP is host-level); this is the
+scaling-book-style mesh the whole pod-mode design hangs off.
+"""
+
+import numpy
+
+import jax
+from jax.sharding import Mesh
+
+from veles_tpu.core.config import root
+
+AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+
+
+def mesh_axes():
+    cfg = root.common.mesh.axes
+    if hasattr(cfg, "__content__"):
+        cfg = cfg.__content__()
+    return {name: int(cfg.get(name, 1)) for name in AXIS_ORDER}
+
+
+def build_mesh(devices=None, **overrides):
+    """Build a Mesh over ``devices`` with configured axis sizes.
+
+    Axis sizes multiply to the device count; a single -1 axis absorbs the
+    remainder (like a reshape). Axes of size 1 are kept (they cost nothing
+    and make in/out specs uniform).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = mesh_axes()
+    sizes.update({k: int(v) for k, v in overrides.items()})
+    wildcard = [k for k, v in sizes.items() if v == -1]
+    fixed = int(numpy.prod([v for v in sizes.values() if v != -1]))
+    if len(wildcard) > 1:
+        raise ValueError("only one mesh axis may be -1, got %s" % wildcard)
+    if wildcard:
+        if n % fixed:
+            raise ValueError(
+                "%d devices not divisible by fixed axes %s" % (n, sizes))
+        sizes[wildcard[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(
+            "mesh axes %s multiply to %d but %d devices present"
+            % (sizes, fixed, n))
+    shape = tuple(sizes[name] for name in AXIS_ORDER)
+    dev_array = numpy.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
