@@ -1,0 +1,117 @@
+"""Conv/pooling unit tests + convnet functional regression."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.memory import Array
+from veles_tpu.models.standard import StandardWorkflow
+from veles_tpu.nn.conv import Conv, GDConv
+from veles_tpu.nn.pooling import AvgPooling, GDPooling, MaxPooling
+
+
+def test_conv_forward_shape_and_math():
+    wf = DummyWorkflow()
+    unit = Conv(wf, n_kernels=4, kx=3, ky=3, padding="SAME")
+    x = numpy.random.RandomState(0).rand(2, 8, 8, 1).astype(numpy.float32)
+    unit.input = Array(x)
+    unit.initialize()
+    unit.run()
+    assert unit.output.shape == (2, 8, 8, 4)
+    # identity-kernel check: 1x1 conv with unit weight reproduces input
+    unit2 = Conv(wf, n_kernels=1, kx=1, ky=1, padding="SAME")
+    unit2.input = Array(x)
+    unit2.initialize()
+    unit2.weights.data = jnp.ones((1, 1, 1, 1), jnp.float32)
+    unit2.bias.data = jnp.zeros(1, jnp.float32)
+    unit2.run()
+    numpy.testing.assert_allclose(
+        numpy.asarray(unit2.output.mem), x, rtol=1e-2, atol=1e-3)
+
+
+def test_gdconv_matches_autodiff():
+    rng = numpy.random.RandomState(1)
+    x = rng.rand(2, 6, 6, 2).astype(numpy.float32)
+    wf = DummyWorkflow()
+    fwd = Conv(wf, n_kernels=3, kx=3, ky=3, padding="SAME")
+    fwd.input = Array(x)
+    fwd.initialize()
+    w0 = numpy.asarray(fwd.weights.mem).copy()
+    fwd.run()
+    err = rng.rand(2, 6, 6, 3).astype(numpy.float32)
+
+    gd = GDConv(wf, learning_rate=1.0)
+    gd.link_conv(fwd, type("E", (), {"err_output": Array(err)})())
+    gd.initialize()
+    gd.run()
+
+    def loss(w):
+        out = fwd._pre_activation(jnp.asarray(x), w,
+                                  jnp.zeros(3, jnp.float32))
+        return jnp.sum(out * jnp.asarray(err))
+
+    grad_w = jax.grad(loss)(jnp.asarray(w0))
+    numpy.testing.assert_allclose(
+        numpy.asarray(fwd.weights.mem), w0 - numpy.asarray(grad_w),
+        rtol=1e-2, atol=1e-3)
+    assert gd.err_input.shape == x.shape
+
+
+def test_pooling_forward_and_backward():
+    x = numpy.arange(16, dtype=numpy.float32).reshape(1, 4, 4, 1)
+    wf = DummyWorkflow()
+    pool = MaxPooling(wf, kx=2, ky=2)
+    pool.input = Array(x)
+    pool.initialize()
+    pool.run()
+    numpy.testing.assert_array_equal(
+        numpy.asarray(pool.output.mem).reshape(2, 2),
+        [[5, 7], [13, 15]])
+    gd = GDPooling(wf)
+    gd.link_pooling(pool, type("E", (), {
+        "err_output": Array(numpy.ones((1, 2, 2, 1), numpy.float32))})())
+    gd.run()
+    err_in = numpy.asarray(gd.err_input.mem).reshape(4, 4)
+    assert err_in.sum() == 4.0  # gradient routed only to the 4 winners
+    assert err_in[1, 1] == 1.0 and err_in[0, 0] == 0.0
+
+
+def test_avg_pooling():
+    x = numpy.ones((1, 4, 4, 1), numpy.float32)
+    wf = DummyWorkflow()
+    pool = AvgPooling(wf, kx=2, ky=2)
+    pool.input = Array(x)
+    pool.initialize()
+    pool.run()
+    numpy.testing.assert_allclose(numpy.asarray(pool.output.mem),
+                                  numpy.ones((1, 2, 2, 1)), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_convnet_learns_digits():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.images.astype(numpy.float32) / 16.0)[..., None]
+    y = d.target.astype(numpy.int32)
+    perm = numpy.random.RandomState(0).permutation(len(X))
+    X, y = X[perm], y[perm]
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        layers=[
+            {"type": "conv_strict_relu", "n_kernels": 8, "kx": 3, "ky": 3},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "all2all_tanh", "output_sample_shape": 32},
+            {"type": "softmax", "output_sample_shape": 10},
+        ],
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 297, 1500],
+                           minibatch_size=100),
+        learning_rate=0.1, gradient_moment=0.9,
+        decision_kwargs=dict(max_epochs=6), name="digits-conv-test")
+    wf.initialize()
+    wf.run()
+    best = wf.decision.best_n_err[1]
+    assert best is not None and best < 45, \
+        "convnet at %s/297 validation errors" % best

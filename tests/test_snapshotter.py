@@ -1,0 +1,104 @@
+"""Snapshot/resume tests (mirror reference test_workflow.py:69-278
+snapshot-restore coverage)."""
+
+import glob
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.core.config import root
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.base import VALID
+from veles_tpu.models.mlp import MLPWorkflow
+from veles_tpu.snapshotter import Snapshotter, SnapshotterToFile
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = d.data.astype(numpy.float32)
+    y = d.target.astype(numpy.int32)
+    perm = numpy.random.RandomState(0).permutation(len(X))
+    return X[perm], y[perm]
+
+
+def make_wf(max_epochs):
+    X, y = _digits()
+    return MLPWorkflow(
+        DummyLauncher(), layers=(16, 10),
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 297, 1500],
+                           minibatch_size=300,
+                           normalization_type="linear"),
+        learning_rate=0.1, max_epochs=max_epochs, name="snap-test")
+
+
+@pytest.mark.slow
+def test_snapshot_resume_roundtrip(tmp_path):
+    wf = make_wf(max_epochs=2)
+    snap = Snapshotter(wf, directory=str(tmp_path), prefix="digits",
+                       interval=1, time_interval=0)
+    snap.link_from(wf.decision)
+    snap.gate_block = ~wf.decision.improved
+    # snapshotter must not hold up the repeater loop: it has no consumers
+    wf.initialize()
+    wf.run()
+    files = glob.glob(os.path.join(str(tmp_path), "digits_*.pickle*"))
+    files = [f for f in files if not f.endswith(".lnk")]
+    assert files, "no snapshot written"
+    err_before = wf.decision.best_n_err[VALID]
+
+    restored = SnapshotterToFile.import_(snap.destination)
+    assert restored.restored_from_snapshot
+    # re-parent onto a fresh launcher (the snapshot never carries one)
+    restored.workflow = DummyLauncher()
+    # links survived: evaluator still reads the last forward's output slot
+    assert restored.evaluator.input is restored.forwards[-1].output
+    w_a = numpy.asarray(restored.forwards[0].weights.mem)
+    w_b = numpy.asarray(wf.forwards[0].weights.mem)
+    # restored weights are a *snapshot* of some improved epoch
+    assert w_a.shape == w_b.shape
+
+    # resume training for more epochs: must run and not regress wildly
+    restored.decision.max_epochs = 4
+    restored.decision.complete.unset()
+    restored.decision.train_ended.unset()
+    restored.initialize()
+    restored.run()
+    err_after = restored.decision.best_n_err[VALID]
+    assert err_after is not None and err_before is not None
+    assert err_after <= err_before * 2 + 10
+
+
+def test_weights_export(tmp_path):
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="w")
+    path = snap.export_weights()
+    arrays = numpy.load(path)
+    assert "fwd0_weights" in arrays and "fwd1_bias" in arrays
+    assert arrays["fwd0_weights"].shape == (64, 16)
+
+
+def test_interval_and_time_gating(tmp_path):
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="gate",
+                             interval=3, time_interval=0)
+    snap.initialize()
+    snap.run()
+    snap.run()
+    assert snap.destination is None  # interval not reached
+    snap.run()
+    assert snap.destination is not None
+
+
+def test_skip_bool(tmp_path):
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="skip",
+                             interval=1, time_interval=0)
+    snap.initialize()
+    snap.skip.set()
+    snap.run()
+    assert snap.destination is None

@@ -56,11 +56,13 @@ class Logger:
     @property
     def logger(self):
         try:
-            return self._logger_
+            if self._logger_ is not None:
+                return self._logger_
         except AttributeError:
-            # objects restored from pickle before init_unpickled
-            self._logger_ = logging.getLogger(type(self).__name__)
-            return self._logger_
+            pass
+        # objects restored from pickle rebuild their logger lazily
+        self._logger_ = logging.getLogger(type(self).__name__)
+        return self._logger_
 
     @logger.setter
     def logger(self, value):

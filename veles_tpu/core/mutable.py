@@ -149,7 +149,10 @@ class LinkableAttribute:
 
     def __init__(self, name, class_default=_MISSING):
         self.name = name
-        self.storage = "_linkable_%s_" % name
+        # no trailing underscore: link targets must SURVIVE pickling (the
+        # provider is part of the same pickled workflow graph), or resumed
+        # snapshots would silently lose every data link
+        self.storage = "_linkable_%s" % name
         # the class attribute this descriptor shadowed, if any, so unlinked
         # instances keep seeing their class-level default
         self.class_default = class_default

@@ -70,6 +70,13 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         self._gate_lock_ = threading.Lock()
         self._run_lock_ = threading.Lock()
         self._pending_runs_ = 0
+        # a snapshot loaded in a fresh process carries link targets in the
+        # instance dict, but the LinkableAttribute descriptors live on the
+        # CLASS and were installed dynamically — reinstall them
+        for key, value in list(self.__dict__.items()):
+            if key.startswith("_linkable_") and isinstance(value, tuple):
+                link_attr(self, key[len("_linkable_"):], value[0], value[1],
+                          two_way=value[2])
 
     # -- identity -----------------------------------------------------------
     @property
@@ -173,7 +180,7 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
             # a live data link satisfies the demand even before the provider
             # has produced a value (reference units.py:682-699 checks
             # linkage, not current value)
-            if self.__dict__.get("_linkable_%s_" % attr) is not None:
+            if self.__dict__.get("_linkable_%s" % attr) is not None:
                 continue
             if not hasattr(self, attr) or getattr(self, attr) is None:
                 missing.append(attr)
@@ -231,6 +238,12 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         # losing it would hang the graph, double-consuming would over-run).
         with self._gate_lock_:
             self._pending_runs_ += 1
+        self._drain_run_tokens(src)
+
+    def _drain_run_tokens(self, src=None):
+        """Consume pending run tokens while the run lock can be taken.
+        Callers that held ``_run_lock_`` directly (snapshot quiesce) call
+        this after releasing so deferred firings aren't stranded."""
         while True:
             if not self._run_lock_.acquire(blocking=False):
                 # the current holder re-checks the token count after its

@@ -52,6 +52,16 @@ class Workflow(Container):
         self._job_callback_ = None
         self._restored_from_snapshot_ = False
 
+    def __getstate__(self):
+        state = super().__getstate__()
+        if not isinstance(self._workflow, Unit):
+            # the top-level workflow's parent is the launcher (live threads,
+            # sockets) — snapshots never carry it; the resume path
+            # re-parents via ``workflow.workflow = launcher``
+            # (reference __main__.py:616)
+            state["_workflow"] = None
+        return state
+
     # -- containment ---------------------------------------------------------
     def add_ref(self, unit):
         if unit is not self and unit not in self._units:
@@ -162,11 +172,20 @@ class Workflow(Container):
         self.thread_pool  # ensure failure routing is wired
         for unit in self._units:
             unit.stopped = False
+            unit._pending_runs_ = 0  # stale tokens from a previous run
         self.stopped = False
         self._run_start = time.perf_counter()
         self.event("workflow run", "begin", workflow=self.name)
         self.start_point.run_dependent()
         self._sync_event_.wait()
+        # quiesce: finish is signalled by the EndPoint, but sibling units
+        # (snapshotter, plotters) may still be running on pool threads —
+        # don't return to the caller until every run() is out of flight
+        for unit in self._units:
+            lock = getattr(unit, "_run_lock_", None)
+            if lock is not None:
+                with lock:
+                    pass
         self.event("workflow run", "end", workflow=self.name)
         if self._sync_error_ is not None:
             exc, tb = self._sync_error_
@@ -284,6 +303,7 @@ class Workflow(Container):
         self._job_callback_ = callback
         for unit in self._units:
             unit.stopped = False
+            unit._pending_runs_ = 0
         self.stopped = False
         self._finished = False
         self._sync_event_.clear()

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from veles_tpu.loader.base import Loader, TRAIN, VALID, register_loader
 from veles_tpu.memory import Array
 from veles_tpu.ops.gather import gather_minibatch
-from veles_tpu.ops.normalize import compute_mean_disp, mean_disp_normalize
+from veles_tpu.ops.normalize import mean_disp_normalize
 
 
 @register_loader("full_batch")
@@ -101,7 +101,11 @@ class FullBatchLoader(Loader):
         if not len(train):  # no train split (e.g. pure evaluation runs)
             train = self.original_data.mem
         if self.normalization_type == "mean_disp":
-            mean, rdisp = compute_mean_disp(jnp.asarray(train))
+            # host-side numpy: a device transfer of the whole train split
+            # here would defeat the OOM fallback below
+            mean = train.mean(axis=0)
+            disp = train.max(axis=0) - train.min(axis=0)
+            rdisp = 1.0 / numpy.maximum(disp, 1e-8)
             self.normalizer_state = {"mean": mean, "rdisp": rdisp}
         elif self.normalization_type == "linear":
             vmax = float(numpy.max(numpy.abs(train))) or 1.0

@@ -2,27 +2,15 @@
 
 Reference MNIST784: 784→100(tanh)→10(softmax), SGD, published validation
 error 1.92% (``docs/source/manualrst_veles_example.rst:55,62``). The
-topology, unit wiring and gating reproduce the reference control graph; the
-compute path is the TPU one (jitted units over MXU matmuls, device-resident
-full-batch gather).
-
-Wiring (one tick = one minibatch):
-
-    start → repeater → loader → fwd₀ → … → fwdₙ → evaluator → decision
-    decision → gdₙ → … → gd₀ → repeater        (skipped unless TRAIN batch)
-    decision → end_point                        (blocked until complete)
+topology, unit wiring and gating reproduce the reference control graph via
+StandardWorkflow; the compute path is the TPU one (jitted units over MXU
+matmuls, device-resident full-batch gather).
 """
 
-from veles_tpu.core.workflow import Workflow
-from veles_tpu.core.plumbing import Repeater
-from veles_tpu.loader.fullbatch import FullBatchLoader
-from veles_tpu.nn.all2all import (All2AllSoftmax, All2AllTanh)
-from veles_tpu.nn.decision import DecisionGD
-from veles_tpu.nn.evaluator import EvaluatorSoftmax
-from veles_tpu.nn.gd import GDSoftmax, GDTanh
+from veles_tpu.models.standard import StandardWorkflow
 
 
-class MLPWorkflow(Workflow):
+class MLPWorkflow(StandardWorkflow):
     """An N-layer tanh MLP with a softmax head (reference MNIST784 when
     ``layers=[100, 10]`` over 784-feature input)."""
 
@@ -30,70 +18,17 @@ class MLPWorkflow(Workflow):
                  learning_rate=0.03, weights_decay=0.0, gradient_moment=0.0,
                  max_epochs=None, fail_iterations=50, loader_cls=None,
                  **kwargs):
-        super().__init__(workflow, **kwargs)
-        loader_cls = loader_cls or FullBatchLoader
-        self.repeater = Repeater(self)
-        self.repeater.link_from(self.start_point)
-
-        self.loader = loader_cls(self, **(loader_kwargs or {}))
-        self.loader.link_from(self.repeater)
-
-        # forward chain
-        self.forwards = []
-        src = self.loader
-        for i, width in enumerate(layers):
-            cls = All2AllSoftmax if i == len(layers) - 1 else All2AllTanh
-            fwd = cls(self, output_sample_shape=(width,),
-                      name="fwd%d" % i)
-            fwd.link_from(src)
-            if i == 0:
-                fwd.link_attrs(self.loader, ("input", "minibatch_data"))
-            else:
-                fwd.link_attrs(self.forwards[-1], ("input", "output"))
-            self.forwards.append(fwd)
-            src = fwd
-
-        self.evaluator = EvaluatorSoftmax(self)
-        self.evaluator.link_from(self.forwards[-1])
-        self.evaluator.link_attrs(self.forwards[-1], ("input", "output"))
-        self.evaluator.link_attrs(self.loader,
-                                  ("labels", "minibatch_labels"),
-                                  "sample_mask")
-
-        self.decision = DecisionGD(self, max_epochs=max_epochs,
-                                   fail_iterations=fail_iterations)
-        self.decision.link_from(self.evaluator)
-        self.decision.loader = self.loader
-        self.decision.evaluator = self.evaluator
-
-        # backward chain, deepest first
-        self.gds = [None] * len(self.forwards)
-        err_src = self.evaluator
-        prev = self.decision
-        for i in reversed(range(len(self.forwards))):
-            cls = GDSoftmax if i == len(self.forwards) - 1 else GDTanh
-            gd = cls(self, learning_rate=learning_rate,
-                     weights_decay=weights_decay,
-                     gradient_moment=gradient_moment, name="gd%d" % i)
-            gd.link_from(prev)
-            gd.link_attrs(self.forwards[i], "input", "output", "weights",
-                          "bias")
-            if err_src is self.evaluator:
-                gd.link_attrs(err_src, "err_output")
-            else:
-                gd.link_attrs(err_src, ("err_output", "err_input"))
-            gd.gate_skip = self.decision.gd_skipped
-            gd.gate_block = self.decision.complete
-            self.gds[i] = gd
-            err_src = gd
-            prev = gd
-
-        self.repeater.link_from(self.gds[0])
-        self.end_point.link_from(self.decision)
-        self.end_point.gate_block = ~self.decision.complete
-
-    def initialize(self, **kwargs):
-        return super().initialize(**kwargs)
+        specs = [{"type": "all2all_tanh", "output_sample_shape": (w,)}
+                 for w in layers[:-1]]
+        specs.append({"type": "softmax",
+                      "output_sample_shape": (layers[-1],)})
+        super().__init__(
+            workflow, layers=specs, loader_kwargs=loader_kwargs,
+            loader_cls=loader_cls, learning_rate=learning_rate,
+            weights_decay=weights_decay, gradient_moment=gradient_moment,
+            decision_kwargs=dict(max_epochs=max_epochs,
+                                 fail_iterations=fail_iterations),
+            **kwargs)
 
 
 def create_mnist784(launcher, data, labels, class_lengths,

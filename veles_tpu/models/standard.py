@@ -1,0 +1,128 @@
+"""StandardWorkflow: declarative model assembly from a layer-spec list.
+
+The Znicz StandardWorkflow pattern: reference model configs declare
+topologies as lists of layer dicts and the workflow wires
+loader → forwards → evaluator → decision → gds automatically. Layer types:
+
+    {"type": "all2all_tanh", "output_sample_shape": 100, ...}
+    {"type": "conv_relu", "n_kernels": 32, "kx": 3, "ky": 3, ...}
+    {"type": "max_pooling", "kx": 2, "ky": 2}
+    {"type": "softmax", "output_sample_shape": 10}
+
+Per-layer trainer kwargs (learning_rate, weights_decay, gradient_moment,
+l1_vs_l2) may be embedded in each spec under "trainer"; workflow-level
+defaults apply otherwise.
+"""
+
+from veles_tpu.core.workflow import Workflow
+from veles_tpu.core.plumbing import Repeater
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.nn.all2all import (
+    All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
+    All2AllStrictRELU, All2AllTanh)
+from veles_tpu.nn.conv import (
+    Conv, ConvRELU, ConvStrictRELU, ConvTanh, GDConv, GDConvRELU,
+    GDConvStrictRELU, GDConvTanh)
+from veles_tpu.nn.decision import DecisionGD
+from veles_tpu.nn.evaluator import EvaluatorSoftmax
+from veles_tpu.nn.gd import (
+    GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh, GradientDescent,
+    link_err_output)
+from veles_tpu.nn.pooling import (
+    AvgPooling, GDPooling, MaxAbsPooling, MaxPooling)
+
+FORWARD_TYPES = {
+    "all2all": (All2All, GradientDescent),
+    "all2all_tanh": (All2AllTanh, GDTanh),
+    "all2all_relu": (All2AllRELU, GDRELU),
+    "all2all_strict_relu": (All2AllStrictRELU, GDStrictRELU),
+    "all2all_sigmoid": (All2AllSigmoid, GDSigmoid),
+    "softmax": (All2AllSoftmax, GDSoftmax),
+    "conv": (Conv, GDConv),
+    "conv_tanh": (ConvTanh, GDConvTanh),
+    "conv_relu": (ConvRELU, GDConvRELU),
+    "conv_strict_relu": (ConvStrictRELU, GDConvStrictRELU),
+    "max_pooling": (MaxPooling, GDPooling),
+    "maxabs_pooling": (MaxAbsPooling, GDPooling),
+    "avg_pooling": (AvgPooling, GDPooling),
+}
+
+TRAINER_KEYS = ("learning_rate", "learning_rate_bias", "weights_decay",
+                "l1_vs_l2", "gradient_moment")
+
+
+class StandardWorkflow(Workflow):
+    """Declarative topology workflow (the Znicz StandardWorkflow role)."""
+
+    def __init__(self, workflow, layers=(), loader_kwargs=None,
+                 loader_cls=None, decision_kwargs=None, **kwargs):
+        self.layer_defaults = {k: kwargs.pop(k) for k in TRAINER_KEYS
+                               if k in kwargs}
+        super().__init__(workflow, **kwargs)
+        loader_cls = loader_cls or FullBatchLoader
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+        self.loader = loader_cls(self, **(loader_kwargs or {}))
+        self.loader.link_from(self.repeater)
+        self.forwards = []
+        self.gds = []
+        self._specs = [dict(spec) for spec in layers]
+        self._build_forwards()
+        self._build_evaluator_and_decision(decision_kwargs or {})
+        self._build_gds()
+        self.repeater.link_from(self.gds[0])
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def _build_forwards(self):
+        src = self.loader
+        for i, spec in enumerate(self._specs):
+            spec = dict(spec)
+            ltype = spec.pop("type")
+            spec.pop("trainer", None)
+            fwd_cls, _ = FORWARD_TYPES[ltype]
+            fwd = fwd_cls(self, name="fwd%d" % i, **spec)
+            fwd.link_from(src)
+            if i == 0:
+                fwd.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                fwd.link_attrs(self.forwards[-1], ("input", "output"))
+            self.forwards.append(fwd)
+            src = fwd
+
+    def _build_evaluator_and_decision(self, decision_kwargs):
+        self.evaluator = EvaluatorSoftmax(self)
+        self.evaluator.link_from(self.forwards[-1])
+        self.evaluator.link_attrs(self.forwards[-1], ("input", "output"))
+        self.evaluator.link_attrs(self.loader,
+                                  ("labels", "minibatch_labels"),
+                                  "sample_mask")
+        self.decision = DecisionGD(self, **decision_kwargs)
+        self.decision.link_from(self.evaluator)
+        self.decision.loader = self.loader
+        self.decision.evaluator = self.evaluator
+
+    def _build_gds(self):
+        self.gds = [None] * len(self.forwards)
+        err_src = self.evaluator
+        prev = self.decision
+        for i in reversed(range(len(self.forwards))):
+            spec = self._specs[i]
+            _, gd_cls = FORWARD_TYPES[spec["type"]]
+            trainer = dict(self.layer_defaults)
+            trainer.update(spec.get("trainer", {}))
+            if gd_cls is GDPooling:
+                gd = GDPooling(self, name="gd%d" % i)
+                gd.link_pooling(self.forwards[i], err_src)
+            elif issubclass(gd_cls, GDConv):
+                gd = gd_cls(self, name="gd%d" % i, **trainer)
+                gd.link_conv(self.forwards[i], err_src)
+            else:
+                gd = gd_cls(self, name="gd%d" % i, **trainer)
+                gd.link_forward(self.forwards[i], err_src)
+            gd.link_from(prev)
+            gd.gate_skip = self.decision.gd_skipped
+            gd.gate_block = self.decision.complete
+            self.gds[i] = gd
+            err_src = gd
+            prev = gd

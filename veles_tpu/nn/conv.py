@@ -1,0 +1,145 @@
+"""Convolutional units (Znicz Conv/GradientDescentConv equivalents).
+
+Forward: NHWC activations × HWIO weights through ``lax.conv_general_dilated``
+— the layout XLA maps straight onto the MXU (the reference hand-tiled
+OpenCL/CUDA conv kernels in libZnicz; on TPU the compiler's conv emitter is
+the fast path, in bf16 with f32 accumulation per the engine dtype policy).
+
+Backward: ``jax.vjp`` of the pre-activation forward *inside the jitted
+compute* — exact gradients with zero hand-derived transpose-conv code, fully
+fused by XLA. This is the pattern for every structured op whose manual
+adjoint the reference maintained by hand.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from veles_tpu.core.prng import get as get_rng
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import ForwardUnit
+from veles_tpu.nn.gd import GradientDescent
+from veles_tpu.ops import activations
+
+DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+class Conv(ForwardUnit):
+    """2-D convolution + activation."""
+
+    ACTIVATION = "linear"
+
+    INPUTS = ("input", "weights", "bias")
+    OUTPUTS = ("output",)
+
+    def __init__(self, workflow, n_kernels=None, kx=3, ky=3,
+                 sliding=(1, 1), padding="SAME", **kwargs):
+        self.weights_stddev = kwargs.pop("weights_stddev", None)
+        self.prng_key = kwargs.pop("prng_key", "default")
+        super().__init__(workflow, **kwargs)
+        if n_kernels is None:
+            raise ValueError("%s needs n_kernels" % self.name)
+        self.n_kernels = n_kernels
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(sliding)
+        self.padding = padding
+        self.weights = Array()
+        self.bias = Array()
+        self.input = None
+
+    def initialize(self, **kwargs):
+        if self.input is None or (isinstance(self.input, Array)
+                                  and self.input.data is None):
+            return True
+        in_shape = self.input.shape  # (N, H, W, C)
+        if len(in_shape) != 4:
+            raise ValueError(
+                "%s expects NHWC input, got %s" % (self.name, (in_shape,)))
+        channels = in_shape[3]
+        if self.weights.data is None:
+            fan_in = self.kx * self.ky * channels
+            stddev = self.weights_stddev or 1.0 / math.sqrt(fan_in)
+            rng = get_rng(self.prng_key)
+            self.weights.data = jnp.asarray(rng.fill_uniform(
+                (self.ky, self.kx, channels, self.n_kernels), stddev),
+                jnp.float32)
+            self.bias.data = jnp.zeros((self.n_kernels,), jnp.float32)
+        if self.output.data is None:
+            shape = jax.eval_shape(
+                lambda x, w, b: self._pre_activation(x, w, b),
+                jax.ShapeDtypeStruct(in_shape, jnp.float32),
+                jax.ShapeDtypeStruct(self.weights.shape, jnp.float32),
+                jax.ShapeDtypeStruct(self.bias.shape, jnp.float32)).shape
+            self.output.data = jnp.zeros(shape, jnp.float32)
+
+    def _pre_activation(self, x, weights, bias):
+        # f32 operands with DEFAULT precision: XLA emits bf16 MXU passes on
+        # TPU (explicit bf16 casts here would break the conv transpose rule
+        # under jax.vjp, which requires uniform dtypes)
+        out = lax.conv_general_dilated(
+            x, weights, window_strides=self.sliding, padding=self.padding,
+            dimension_numbers=DIMENSION_NUMBERS,
+            precision=lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
+        return out + bias
+
+    def compute(self, x, weights, bias):
+        act, _ = activations.ACTIVATIONS[self.ACTIVATION]
+        return act(self._pre_activation(x, weights, bias))
+
+
+class ConvTanh(Conv):
+    ACTIVATION = "tanh"
+
+
+class ConvRELU(Conv):
+    ACTIVATION = "relu"
+
+
+class ConvStrictRELU(Conv):
+    ACTIVATION = "strict_relu"
+
+
+class GDConv(GradientDescent):
+    """Backward unit for Conv: exact adjoint via jax.vjp of the paired
+    forward's pre-activation, fused into one jitted computation."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward_unit = None  # set by link_conv
+
+    def link_conv(self, conv_unit, err_source):
+        from veles_tpu.nn.gd import link_err_output
+        self.forward_unit = conv_unit
+        self.link_attrs(conv_unit, "input", "output", "weights", "bias")
+        link_err_output(self, err_source)
+        return self
+
+    def compute(self, err_output, x, y, weights, bias, vel_w, vel_b, hyper):
+        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
+                                    hyper[4])
+        _, deriv = activations.ACTIVATIONS[self.ACTIVATION]
+        err_pre = err_output * deriv(y)
+        _, vjp = jax.vjp(self.forward_unit._pre_activation, x, weights, bias)
+        err_input, grad_w, grad_b = vjp(err_pre)
+        grad_w = grad_w + l2 * weights + l1 * jnp.sign(weights)
+        new_vel_w = moment * vel_w - lr * grad_w
+        new_vel_b = moment * vel_b - lr_b * grad_b
+        return (err_input, weights + new_vel_w, bias + new_vel_b,
+                new_vel_w, new_vel_b)
+
+
+class GDConvTanh(GDConv):
+    ACTIVATION = "tanh"
+
+
+class GDConvRELU(GDConv):
+    ACTIVATION = "relu"
+
+
+class GDConvStrictRELU(GDConv):
+    ACTIVATION = "strict_relu"

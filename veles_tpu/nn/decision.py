@@ -115,8 +115,10 @@ class DecisionGD(Unit):
             else:
                 self._epochs_without_improvement += 1
         stop = False
+        # epoch_number is 0-based and only increments when the NEXT epoch
+        # starts serving, so at the end of epoch N it still reads N
         if self.max_epochs is not None \
-                and self.loader.epoch_number >= self.max_epochs:
+                and self.loader.epoch_number + 1 >= self.max_epochs:
             self.info("stopping: reached max_epochs=%d", self.max_epochs)
             stop = True
         if self._epochs_without_improvement >= self.fail_iterations:

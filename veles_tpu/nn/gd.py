@@ -61,9 +61,7 @@ class GradientDescent(JitUnit):
         """Wire this GD unit to its forward twin + the error source
         (convenience mirroring how Znicz models assemble the chain)."""
         self.link_attrs(forward_unit, "input", "output", "weights", "bias")
-        self.link_attrs(err_source, ("err_output", "err_input")
-                        if isinstance(err_source, GradientDescent)
-                        else ("err_output", "err_output"))
+        link_err_output(self, err_source)
         return self
 
     def initialize(self, **kwargs):
@@ -123,6 +121,16 @@ class GradientDescent(JitUnit):
     def apply_data_from_master(self, data):
         self.weights.data = jnp.asarray(data["weights"])
         self.bias.data = jnp.asarray(data["bias"])
+
+
+def link_err_output(gd_unit, err_source):
+    """Wire ``gd_unit.err_output`` to the upstream error: a backward unit
+    exposes ``err_input``, an evaluator exposes ``err_output``."""
+    if hasattr(err_source, "err_input"):
+        gd_unit.link_attrs(err_source, ("err_output", "err_input"))
+    else:
+        gd_unit.link_attrs(err_source, "err_output")
+    return gd_unit
 
 
 class GDTanh(GradientDescent):

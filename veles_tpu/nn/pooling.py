@@ -1,0 +1,108 @@
+"""Pooling units (Znicz MaxPooling/AvgPooling + their GD twins).
+
+``lax.reduce_window`` forward; ``jax.vjp`` backward (max-pooling's adjoint
+is the winner-scatter the reference implemented as a dedicated kernel with
+an offset buffer — vjp recovers exactly that, fused).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import ForwardUnit
+from veles_tpu.core.units import Unit
+
+
+class Pooling(ForwardUnit):
+    """Base pooling over NHWC, window (ky, kx), stride = sliding."""
+
+    INPUTS = ("input",)
+    OUTPUTS = ("output",)
+
+    def __init__(self, workflow, kx=2, ky=2, sliding=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(sliding) if sliding else (ky, kx)
+        self.input = None
+
+    def initialize(self, **kwargs):
+        if self.input is None or (isinstance(self.input, Array)
+                                  and self.input.data is None):
+            return True
+        if self.output.data is None:
+            shape = jax.eval_shape(
+                self._pool, jax.ShapeDtypeStruct(self.input.shape,
+                                                 jnp.float32)).shape
+            self.output.data = jnp.zeros(shape, jnp.float32)
+
+    def _window(self):
+        return ((1, self.ky, self.kx, 1), (1,) + self.sliding + (1,))
+
+    def _pool(self, x):
+        raise NotImplementedError
+
+    def compute(self, x):
+        return self._pool(x)
+
+
+class MaxPooling(Pooling):
+    def _pool(self, x):
+        window, strides = self._window()
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                 "VALID")
+
+
+class AvgPooling(Pooling):
+    def _pool(self, x):
+        window, strides = self._window()
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                   "VALID")
+        return summed / (self.kx * self.ky)
+
+
+class MaxAbsPooling(Pooling):
+    """Znicz's max-by-absolute-value pooling variant."""
+
+    def _pool(self, x):
+        window, strides = self._window()
+
+        def absmax(a, b):
+            return lax.select(lax.abs(a) > lax.abs(b), a, b)
+
+        return lax.reduce_window(x, 0.0, absmax, window, strides, "VALID")
+
+
+class GDPooling(Unit):
+    """Backward for any Pooling: routes err_output back through the
+    pooling's vjp. No parameters — just error propagation."""
+
+    VIEW_GROUP = "TRAINER"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward_unit = None
+        self.err_output = None
+        self.input = None
+        self.err_input = Array()
+        self.demand("err_output", "input")
+
+    def link_pooling(self, pooling_unit, err_source):
+        from veles_tpu.nn.gd import link_err_output
+        self.forward_unit = pooling_unit
+        self.link_attrs(pooling_unit, "input")
+        link_err_output(self, err_source)
+        return self
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._jitted_ = None
+
+    def run(self):
+        if self._jitted_ is None:
+            def backward(x, err_out):
+                _, vjp = jax.vjp(self.forward_unit._pool, x)
+                return vjp(err_out)[0]
+            self._jitted_ = jax.jit(backward)
+        self.err_input.data = self._jitted_(self.input.data,
+                                            self.err_output.data)
