@@ -1,0 +1,186 @@
+"""Fleet-mode tests: master+slave in one process over loopback (the
+reference's key distributed-test pattern, ``test_network.py:111-137`` /
+``test_launcher.py:91-118``)."""
+
+import threading
+
+import numpy
+import pytest
+
+from veles_tpu.core import prng
+from veles_tpu.fleet.protocol import encode_frame, machine_id
+from veles_tpu.launcher import Launcher
+from veles_tpu.loader.base import VALID
+from veles_tpu.models.mlp import MLPWorkflow
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    return (d.data.astype(numpy.float32),
+            d.target.astype(numpy.int32))
+
+
+def _kw(max_epochs=2, minibatch=300):
+    X, y = _digits()
+    return dict(
+        layers=(16, 10),
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 297, 1500],
+                           minibatch_size=minibatch,
+                           normalization_type="linear"),
+        learning_rate=0.5, max_epochs=max_epochs)
+
+
+def _seed():
+    prng.get("default").seed(42)
+    prng.get("loader").seed(43)
+
+
+def _run_master(kw):
+    _seed()
+    master = Launcher(listen_address="127.0.0.1:0")
+    wf = MLPWorkflow(master, name="fleet-t", **kw)
+    master.initialize()
+    thread = threading.Thread(target=master.run, daemon=True)
+    thread.start()
+    return master, wf, thread
+
+
+def _run_slave(port, kw, **slave_kw):
+    _seed()
+    slave = Launcher(master_address="127.0.0.1:%d" % port, **slave_kw)
+    MLPWorkflow(slave, name="fleet-t", **kw)
+    slave.initialize()
+    return slave
+
+
+class TestProtocol:
+    def test_frame_roundtrip(self):
+        import asyncio
+        import io
+
+        msg = {"type": "job", "job": [numpy.arange(5), {"a": 1}]}
+        frame = encode_frame(msg)
+
+        class FakeReader:
+            def __init__(self, data):
+                self.buf = io.BytesIO(data)
+
+            async def readexactly(self, n):
+                return self.buf.read(n)
+
+        from veles_tpu.fleet.protocol import read_frame
+        out = asyncio.get_event_loop().run_until_complete(
+            read_frame(FakeReader(frame)))
+        assert out["type"] == "job"
+        numpy.testing.assert_array_equal(out["job"][0], numpy.arange(5))
+
+    def test_big_frame_compressed(self):
+        big = {"data": numpy.zeros(1024 * 1024, numpy.float32)}
+        frame = encode_frame(big)
+        assert len(frame) < 1024 * 1024  # gzip kicked in
+
+    def test_machine_id_stable(self):
+        assert machine_id() == machine_id()
+
+
+@pytest.mark.slow
+class TestLoopback:
+    def test_sync_training_and_parity(self):
+        """One master + one sync slave must produce the SAME result as a
+        standalone run (sequential SGD equivalence)."""
+        kw = _kw()
+        _seed()
+        lau = Launcher()
+        wf_sa = MLPWorkflow(lau, name="fleet-t", **kw)
+        lau.initialize()
+        lau.run()
+        expected = wf_sa.decision.best_n_err[VALID]
+
+        master, wf_m, thread = _run_master(kw)
+        slave = _run_slave(master.agent.port, kw)
+        slave.run()
+        thread.join(60)
+        assert not thread.is_alive(), "master did not finish"
+        assert wf_m.decision.best_n_err[VALID] == expected
+        assert slave.agent.jobs_done == 12  # 2 epochs x (1 valid + 5 train)
+        master.stop()
+        slave.stop()
+
+    def test_two_slaves_share_the_epoch(self):
+        kw = _kw(max_epochs=2)
+        master, wf_m, thread = _run_master(kw)
+        s1 = _run_slave(master.agent.port, kw)
+        s2 = _run_slave(master.agent.port, kw)
+        t1 = threading.Thread(target=s1.run, daemon=True)
+        t1.start()
+        s2.run()
+        t1.join(60)
+        thread.join(60)
+        assert not thread.is_alive()
+        total = s1.agent.jobs_done + s2.agent.jobs_done
+        # the job stream is asynchronous: with 2 slaves the master may hand
+        # out a couple of next-epoch jobs before the stop decision lands,
+        # so the total can overshoot the 12-minibatch epoch slightly
+        assert total >= 12, "jobs split %d+%d < 12" % (
+            s1.agent.jobs_done, s2.agent.jobs_done)
+        assert s1.agent.jobs_done > 0 and s2.agent.jobs_done > 0
+        assert wf_m.decision.best_n_err[VALID] is not None
+        master.stop()
+        s1.stop()
+        s2.stop()
+
+    def test_async_slave_mode(self):
+        kw = _kw(max_epochs=2)
+        master, wf_m, thread = _run_master(kw)
+        slave = _run_slave(master.agent.port, kw, async_slave=True)
+        slave.run()
+        thread.join(60)
+        assert not thread.is_alive()
+        assert wf_m.decision.best_n_err[VALID] is not None
+        master.stop()
+        slave.stop()
+
+    def test_drop_slave_requeues_minibatches(self):
+        """A disconnected slave's pending work must be requeued and the
+        epoch still complete exactly (reference drop_slave semantics)."""
+        kw = _kw(max_epochs=1)
+        master, wf_m, thread = _run_master(kw)
+        loader = wf_m.loader
+        # simulate: serve a job to a fake slave, then drop it
+        class FakeSlave:
+            id = "fake-1"
+        job = loader.generate_data_for_slave(FakeSlave())
+        assert loader.pending_minibatches_["fake-1"]
+        loader.drop_slave(FakeSlave())
+        assert len(loader.failed_minibatches) == 1
+        # a real slave now runs everything, including the requeued batch
+        slave = _run_slave(master.agent.port, kw)
+        slave.run()
+        thread.join(60)
+        assert not thread.is_alive()
+        # requeued minibatch was re-served: total samples == 1 full epoch
+        # + the duplicated minibatch
+        assert wf_m.decision.best_n_err[VALID] is not None
+        master.stop()
+        slave.stop()
+
+
+class TestChecksum:
+    def test_checksum_mismatch_rejected(self):
+        import types
+
+        kw = _kw(max_epochs=1)
+        master, wf_m, thread = _run_master(kw)
+        slave = _run_slave(master.agent.port, kw)
+        # a class-level checksum patch would hit the master too (same class
+        # in-process), so swap the CLIENT's workflow for a bogus-checksum
+        # stand-in instead
+        slave.agent.workflow = types.SimpleNamespace(checksum="bogus")
+        try:
+            slave.run()
+            assert slave.agent.jobs_done == 0
+        finally:
+            master.stop()
+            slave.stop()
+            thread.join(1)

@@ -235,13 +235,20 @@ class Workflow(Container):
     def has_more_jobs(self):
         return not self._no_more_jobs
 
+    def distribution_order(self):
+        """Unit order for job/update payload lists: CONSTRUCTION order, not
+        link order — the slave rewires its control links (one-tick graph),
+        but both sides build units in the same sequence, so indices align.
+        """
+        return list(self._units)
+
     def generate_data_for_slave(self, slave=None):
-        """Collect per-unit job payloads in dependency order. Returns the
-        payload list, ``False`` if some unit isn't ready (backpressure), or
-        ``None`` when there are no more jobs."""
+        """Collect per-unit job payloads. Returns the payload list,
+        ``False`` if some unit isn't ready (backpressure), or ``None`` when
+        there are no more jobs."""
         if self._no_more_jobs:
             return None
-        order = [u for u in self.units_in_dependency_order() if u is not self]
+        order = self.distribution_order()
         if not all(u.has_data_for_slave for u in order):
             return False
         data = []
@@ -254,7 +261,7 @@ class Workflow(Container):
         return data
 
     def apply_data_from_master(self, data):
-        order = [u for u in self.units_in_dependency_order() if u is not self]
+        order = self.distribution_order()
         if len(data) != len(order):
             raise VelesError(
                 "Job payload has %d entries for %d units — master/slave "
@@ -265,10 +272,10 @@ class Workflow(Container):
 
     def generate_data_for_master(self):
         return [u.generate_data_for_master()
-                for u in self.units_in_dependency_order() if u is not self]
+                for u in self.distribution_order()]
 
     def apply_data_from_slave(self, data, slave=None):
-        order = [u for u in self.units_in_dependency_order() if u is not self]
+        order = self.distribution_order()
         if len(data) != len(order):
             raise VelesError(
                 "Update payload has %d entries for %d units — master/slave "

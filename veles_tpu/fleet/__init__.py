@@ -1,0 +1,21 @@
+"""veles_tpu.fleet: elastic host-level distribution (master/slave).
+
+The reference's distributed runtime (SURVEY §2.5) is a master/slave
+data-parallel protocol: the master owns canonical state and serves *jobs*
+(per-unit payloads — for the Loader just minibatch indices); each slave
+runs the whole workflow on its job and returns an *update*, merged into
+master state. Asynchronous by default (stale updates accepted), elastic
+(slaves join/leave any time, their pending work is requeued), with hang
+detection and fault injection.
+
+TPU translation: inside one pod slice, synchronous SPMD (``parallel/``) is
+the idiomatic path. Fleet mode exists for what collectives can't do —
+dynamic/heterogeneous clusters over DCN, genetics/ensemble population
+parallelism, and parity with the reference's elasticity semantics. The
+transport is asyncio TCP with length-prefixed pickled frames (the modern
+stdlib equivalent of the reference's Twisted control plane + ZeroMQ
+streaming-pickle data plane, reference ``txzmq/connection.py:395-562``).
+"""
+
+from veles_tpu.fleet.server import Server  # noqa: F401
+from veles_tpu.fleet.client import Client  # noqa: F401

@@ -1,0 +1,158 @@
+"""Slave: connects to the master, runs jobs, ships updates.
+
+Reference ``veles/client.py``. Kept semantics:
+
+- handshake uploads computing power, machine id, pid, backend and the
+  workflow checksum (``client.py:362-373``);
+- job loop: job_received → do_job (on the workflow's thread pool) →
+  update → ack → next request (``client.py:278-354``);
+- ``--async-slave`` pipelining: request the next job before the update ack
+  (``client.py:294-341``);
+- auto-reconnect with an attempt budget, then exit
+  (``client.py:488-508``);
+- fault injection ``death_probability`` — the slave kills itself mid-job
+  with the given probability, exercising the master's requeue path
+  (``client.py:438-442``).
+"""
+
+import asyncio
+import os
+import random
+import threading
+
+from veles_tpu.core.logger import Logger
+from veles_tpu.fleet.protocol import (machine_id, read_frame, write_frame)
+
+
+class Client(Logger):
+    """The fleet slave (reference ``client.py:405``)."""
+
+    def __init__(self, address, workflow, power=1.0, async_mode=False,
+                 death_probability=0.0, max_reconnect_attempts=7):
+        super().__init__(logger_name="fleet.Client")
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.workflow = workflow
+        self.power = power
+        self.async_mode = async_mode
+        self.death_probability = death_probability
+        self.max_reconnect_attempts = max_reconnect_attempts
+        self.sid = None
+        self.jobs_done = 0
+        self._loop = None
+        self._thread = None
+        self._stopped = threading.Event()
+        self.on_finished = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-client")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._session())
+        finally:
+            self._loop.close()
+        if self.on_finished is not None:
+            self.on_finished()
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def join(self, timeout=None):
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    # -- session with reconnect budget ---------------------------------------
+    async def _session(self):
+        attempts = 0
+        while not self._stopped.is_set():
+            try:
+                reader, writer = await asyncio.open_connection(
+                    self.host, self.port)
+            except OSError:
+                attempts += 1
+                if attempts > self.max_reconnect_attempts:
+                    self.error("gave up reconnecting after %d attempts",
+                               attempts - 1)
+                    return
+                await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
+                continue
+            attempts = 0
+            try:
+                done = await self._work(reader, writer)
+                if done:
+                    return
+            except (asyncio.IncompleteReadError, ConnectionError):
+                self.warning("connection to master lost; reconnecting")
+            finally:
+                writer.close()
+
+    async def _work(self, reader, writer):
+        await write_frame(writer, {
+            "type": "hello", "power": self.power, "mid": machine_id(),
+            "pid": os.getpid(), "backend": "tpu",
+            "checksum": getattr(self.workflow, "checksum", None)})
+        welcome = await read_frame(reader)
+        if welcome.get("type") == "error":
+            self.error("master refused: %s", welcome.get("error"))
+            return True
+        self.sid = welcome["id"]
+        initial = welcome.get("initial")
+        if initial:
+            self.workflow.apply_initial_data_from_master(initial)
+        self.info("connected as %s", self.sid)
+        await write_frame(writer, {"type": "job_request"})
+        while not self._stopped.is_set():
+            msg = await read_frame(reader)
+            mtype = msg.get("type")
+            if mtype == "job":
+                if msg.get("paused"):
+                    await asyncio.sleep(0.5)
+                    await write_frame(writer, {"type": "job_request"})
+                    continue
+                if msg.get("job") is None:
+                    self.info("no more jobs; exiting")
+                    return True
+                update = await self._do_job(msg["job"])
+                if self.death_probability > 0 \
+                        and random.random() < self.death_probability:
+                    self.warning("fault injection: dying mid-job")
+                    os._exit(1)
+                if self.async_mode:
+                    # pipelined: next request goes out with the update
+                    await write_frame(writer, {"type": "update",
+                                               "update": update})
+                    await write_frame(writer, {"type": "job_request"})
+                else:
+                    await write_frame(writer, {"type": "update",
+                                               "update": update})
+            elif mtype == "update_ack":
+                if not self.async_mode:
+                    await write_frame(writer, {"type": "job_request"})
+        return False
+
+    async def _do_job(self, job):
+        """Run the whole workflow locally on the job (reference
+        ``workflow.py:554-569``), off the event loop."""
+        loop = asyncio.get_event_loop()
+        future = loop.create_future()
+
+        def callback(update):
+            loop.call_soon_threadsafe(future.set_result, update)
+
+        def launch():
+            self.workflow.do_job(job, callback)
+
+        await loop.run_in_executor(None, launch)
+        update = await future
+        self.jobs_done += 1
+        return update

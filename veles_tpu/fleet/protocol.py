@@ -1,0 +1,65 @@
+"""Wire protocol: length-prefixed pickled frames over asyncio TCP.
+
+Replaces the reference's two-plane fabric (Twisted JSON-lines control +
+ZeroMQ streaming-pickle data, ``network_common.py`` + ``txzmq/``) with one
+asyncio stream. Frames:
+
+    [4-byte big-endian length][1-byte codec][payload]
+
+codec 0 = raw pickle, 1 = gzip pickle (auto-chosen by size, mirroring the
+reference's pluggable chunk compression). Messages are dicts with a "type"
+key; job/update payloads ride inside them as pickled python objects (the
+units' generate/apply contracts define their content).
+"""
+
+import asyncio
+import gzip
+import hashlib
+import os
+import pickle
+import struct
+import uuid
+
+COMPRESS_THRESHOLD = 64 * 1024
+
+_HEADER = struct.Struct(">IB")
+
+
+def encode_frame(message):
+    payload = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+    codec = 0
+    if len(payload) >= COMPRESS_THRESHOLD:
+        compressed = gzip.compress(payload, compresslevel=1)
+        if len(compressed) < len(payload):
+            payload, codec = compressed, 1
+    return _HEADER.pack(len(payload), codec) + payload
+
+
+async def read_frame(reader):
+    header = await reader.readexactly(_HEADER.size)
+    length, codec = _HEADER.unpack(header)
+    payload = await reader.readexactly(length)
+    if codec == 1:
+        payload = gzip.decompress(payload)
+    return pickle.loads(payload)
+
+
+async def write_frame(writer, message):
+    writer.write(encode_frame(message))
+    await writer.drain()
+
+
+def machine_id():
+    """Stable per-host identity (reference ``network_common.py:72-118``
+    derived it from dbus + MAC; /etc/machine-id is the modern source)."""
+    for path in ("/etc/machine-id", "/var/lib/dbus/machine-id"):
+        try:
+            with open(path) as fin:
+                return fin.read().strip()
+        except OSError:
+            continue
+    return hashlib.sha1(uuid.getnode().to_bytes(6, "big")).hexdigest()
+
+
+def endpoint_id():
+    return "%s/%d" % (machine_id(), os.getpid())

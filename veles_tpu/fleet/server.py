@@ -1,0 +1,263 @@
+"""Master: serves jobs, merges updates, manages the slave fleet.
+
+Reference ``veles/server.py``. Kept semantics:
+
+- handshake validates the workflow checksum and assigns slave ids
+  (``server.py:478-529``);
+- job pipeline with backpressure: a "not ready" workflow answer queues the
+  slave's request, replayed after the next update (``server.py:369-399``);
+- update application serialized through the workflow's aggregation lock
+  and run off the event loop (``server.py:401-430``);
+- hang detection: per-slave job-duration history, timeout =
+  max(mean + 3σ, job_timeout) → drop + blacklist
+  (``server.py:619-635``);
+- elasticity: ``drop_slave`` propagates so the Loader requeues pending
+  minibatches; slaves may join/leave at any time;
+- per-slave pause/resume and reverse-DNS naming kept as attributes on
+  SlaveDescription.
+"""
+
+import asyncio
+import threading
+import time
+
+from veles_tpu.core.logger import Logger
+from veles_tpu.fleet.protocol import read_frame, write_frame
+
+
+class SlaveDescription:
+    """Fleet-roster entry (reference ``server.py:172``)."""
+
+    def __init__(self, sid, info):
+        self.id = sid
+        self.mid = info.get("mid", "?")
+        self.pid = info.get("pid", 0)
+        self.power = info.get("power", 1.0)
+        self.backend = info.get("backend", "?")
+        self.state = "WAIT"
+        self.jobs_done = 0
+        self.job_times = []
+        self.job_started = None
+        self.paused = False
+
+    def timeout(self, default):
+        """mean + 3σ adaptive hang threshold (reference
+        ``server.py:619-635``)."""
+        if len(self.job_times) < 3:
+            return default
+        mean = sum(self.job_times) / len(self.job_times)
+        var = sum((t - mean) ** 2
+                  for t in self.job_times) / len(self.job_times)
+        return max(mean + 3.0 * var ** 0.5, default)
+
+    def as_dict(self):
+        return {"id": self.id, "mid": self.mid, "pid": self.pid,
+                "power": self.power, "state": self.state,
+                "jobs_done": self.jobs_done, "paused": self.paused}
+
+
+class Server(Logger):
+    """The fleet master (reference ``server.py:659``)."""
+
+    def __init__(self, address, workflow, job_timeout=120.0):
+        super().__init__(logger_name="fleet.Server")
+        host, _, port = address.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self.workflow = workflow
+        self.job_timeout = job_timeout
+        self.slaves = {}
+        self.blacklist = set()
+        self._next_id = 0
+        self._pending_requests = []  # backpressured (sid, writer)
+        self._writers = {}
+        self._update_lock = threading.Lock()
+        self._loop = None
+        self._server = None
+        self._thread = None
+        self._stopped = threading.Event()
+        self.on_finished = None  # callback when the job stream is done
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        """Run the asyncio server in a dedicated thread (the reactor role;
+        reference ran Twisted as the main loop, but here jit dispatch owns
+        the main thread)."""
+        ready = threading.Event()
+
+        def run_loop():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            coro = asyncio.start_server(self._handle_slave, self.host,
+                                        self.port)
+            self._server = self._loop.run_until_complete(coro)
+            if not self.port:
+                self.port = self._server.sockets[0].getsockname()[1]
+            ready.set()
+            self._loop.run_forever()
+            self._server.close()
+            self._loop.run_until_complete(self._server.wait_closed())
+            self._loop.close()
+
+        self._thread = threading.Thread(target=run_loop, daemon=True,
+                                        name="fleet-server")
+        self._thread.start()
+        ready.wait()
+        self.info("master listening on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self):
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    @property
+    def address(self):
+        return "%s:%d" % (self.host, self.port)
+
+    # -- per-slave protocol ---------------------------------------------------
+    async def _handle_slave(self, reader, writer):
+        sid = None
+        try:
+            hello = await read_frame(reader)
+            if hello.get("type") != "hello":
+                await write_frame(writer, {"type": "error",
+                                           "error": "bad handshake"})
+                return
+            if hello.get("mid") in self.blacklist:
+                await write_frame(writer, {"type": "error",
+                                           "error": "blacklisted"})
+                return
+            checksum = getattr(self.workflow, "checksum", None)
+            if hello.get("checksum") not in (None, checksum):
+                await write_frame(writer, {
+                    "type": "error",
+                    "error": "workflow checksum mismatch"})
+                self.warning("rejected slave with wrong workflow checksum")
+                return
+            self._next_id += 1
+            sid = "slave-%d" % self._next_id
+            slave = SlaveDescription(sid, hello)
+            self.slaves[sid] = slave
+            self._writers[sid] = writer
+            initial = await self._in_thread(
+                self.workflow.generate_initial_data_for_slave, slave)
+            await write_frame(writer, {"type": "welcome", "id": sid,
+                                       "initial": initial})
+            self.info("slave %s connected (mid=%s power=%.1f)", sid,
+                      slave.mid, slave.power)
+            while not self._stopped.is_set():
+                msg = await read_frame(reader)
+                mtype = msg.get("type")
+                if mtype == "job_request":
+                    await self._serve_job(slave, writer)
+                elif mtype == "update":
+                    await self._apply_update(slave, writer, msg)
+                elif mtype == "power":
+                    slave.power = msg.get("power", slave.power)
+                elif mtype == "bye":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:
+            self.exception("slave handler failed")
+        finally:
+            if sid is not None:
+                self._drop(sid)
+            writer.close()
+
+    async def _serve_job(self, slave, writer):
+        if slave.paused:
+            await write_frame(writer, {"type": "job", "job": None,
+                                       "paused": True})
+            return
+        slave.state = "GETTING_JOB"
+        job = await self._in_thread(
+            self.workflow.generate_data_for_slave, slave)
+        if job is False:
+            # backpressure: some unit not ready — queue the request,
+            # replayed after the next update (reference server.py:369-399)
+            self._pending_requests.append((slave.id, writer))
+            return
+        if job is None:
+            slave.state = "IDLE"
+            await write_frame(writer, {"type": "job", "job": None})
+            self._maybe_finished()
+            return
+        slave.state = "WORK"
+        slave.job_started = time.time()
+        await write_frame(writer, {"type": "job", "job": job})
+        self._watch_hang(slave)
+
+    async def _apply_update(self, slave, writer, msg):
+        if slave.job_started is not None:
+            slave.job_times.append(time.time() - slave.job_started)
+            slave.job_started = None
+        slave.jobs_done += 1
+        update = msg.get("update")
+        if update is not None:
+            await self._in_thread(self._locked_apply, update, slave)
+        await write_frame(writer, {"type": "update_ack"})
+        slave.state = "WAIT"
+        await self._retry_pending()
+
+    def _locked_apply(self, update, slave):
+        with self._update_lock:
+            self.workflow.apply_data_from_slave(update, slave)
+
+    async def _retry_pending(self):
+        pending, self._pending_requests = self._pending_requests, []
+        for sid, writer in pending:
+            slave = self.slaves.get(sid)
+            if slave is not None:
+                await self._serve_job(slave, writer)
+
+    def _watch_hang(self, slave):
+        timeout = slave.timeout(self.job_timeout)
+
+        def check():
+            if slave.job_started is not None \
+                    and time.time() - slave.job_started > timeout:
+                self.warning("slave %s hanged (> %.1fs); dropping + "
+                             "blacklisting", slave.id, timeout)
+                self.blacklist.add(slave.mid)
+                writer = self._writers.get(slave.id)
+                if writer is not None:
+                    writer.close()
+
+        self._loop.call_later(timeout + 1.0, check)
+
+    def _drop(self, sid):
+        slave = self.slaves.pop(sid, None)
+        self._writers.pop(sid, None)
+        self._pending_requests = [
+            (s, w) for s, w in self._pending_requests if s != sid]
+        if slave is not None:
+            self.info("slave %s dropped", sid)
+            self.workflow.drop_slave(slave)
+        self._maybe_finished()
+
+    def _maybe_finished(self):
+        if not self.workflow.has_more_jobs() \
+                and all(s.state == "IDLE" for s in self.slaves.values()):
+            if self.on_finished is not None:
+                self.on_finished()
+
+    # -- helpers --------------------------------------------------------------
+    async def _in_thread(self, fn, *args):
+        return await self._loop.run_in_executor(None, fn, *args)
+
+    def pause_slave(self, sid):
+        if sid in self.slaves:
+            self.slaves[sid].paused = True
+
+    def resume_slave(self, sid):
+        if sid in self.slaves:
+            self.slaves[sid].paused = False
+
+    def fleet_status(self):
+        return [s.as_dict() for s in self.slaves.values()]

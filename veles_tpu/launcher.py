@@ -1,0 +1,129 @@
+"""Launcher: process-orchestration for standalone / master / slave runs.
+
+Reference ``veles/launcher.py``. Mode detection mirrors the CLI contract
+(``launcher.py:333-342``): ``listen_address`` → master, ``master_address``
+→ slave, neither → standalone. The launcher owns the thread pool, builds
+the fleet Server/Client, runs the workflow and coordinates shutdown. The
+Twisted-reactor main loop becomes a simple event wait — jit dispatch owns
+the main thread and asyncio lives in the fleet threads.
+"""
+
+import json
+import threading
+
+from veles_tpu.core.config import root
+from veles_tpu.core.executor import ThreadPool
+from veles_tpu.core.logger import Logger
+
+
+class Launcher(Logger):
+    """Workflow process driver (reference ``launcher.py:100``)."""
+
+    def __init__(self, listen_address=None, master_address=None,
+                 result_file=None, slave_power=1.0, async_slave=False,
+                 slave_death_probability=0.0, **kwargs):
+        super().__init__(logger_name="Launcher")
+        self.listen_address = listen_address
+        self.master_address = master_address
+        self.result_file = result_file
+        self.slave_power = slave_power
+        self.async_slave = async_slave
+        self.slave_death_probability = slave_death_probability
+        self.thread_pool = ThreadPool(name="launcher")
+        self.workflow = None
+        self.agent = None  # Server or Client
+        self._units = []
+        self._finished = threading.Event()
+        self.stopped = False
+
+    # -- mode flags (reference launcher.py:333-342) --------------------------
+    @property
+    def is_master(self):
+        return self.listen_address is not None
+
+    @property
+    def is_slave(self):
+        return self.master_address is not None
+
+    @property
+    def is_standalone(self):
+        return not self.is_master and not self.is_slave
+
+    @property
+    def mode(self):
+        return ("master" if self.is_master else
+                "slave" if self.is_slave else "standalone")
+
+    # -- workflow containment -------------------------------------------------
+    def add_ref(self, unit):
+        self._units.append(unit)
+        self.workflow = unit
+
+    def del_ref(self, unit):
+        if unit in self._units:
+            self._units.remove(unit)
+
+    # -- lifecycle ------------------------------------------------------------
+    def initialize(self, **kwargs):
+        if self.workflow is None:
+            raise ValueError("no workflow attached to the launcher")
+        self.info("launcher mode: %s", self.mode)
+        self.workflow.initialize(**kwargs)
+        if self.is_master:
+            from veles_tpu.fleet.server import Server
+            self.agent = Server(
+                self.listen_address, self.workflow,
+                job_timeout=root.common.fleet.get("job_timeout", 120.0))
+            self.agent.on_finished = self._on_agent_finished
+            self.agent.start()
+        elif self.is_slave:
+            from veles_tpu.fleet.client import Client
+            self.agent = Client(
+                self.master_address, self.workflow,
+                power=self.slave_power, async_mode=self.async_slave,
+                death_probability=self.slave_death_probability,
+                max_reconnect_attempts=root.common.fleet.get(
+                    "max_reconnect_attempts", 7))
+            self.agent.on_finished = self._on_agent_finished
+        return self
+
+    def run(self):
+        """Blocks until the workflow completes (reference ran the reactor
+        here)."""
+        self._finished.clear()
+        if self.is_standalone:
+            self.workflow.run()
+            self._write_results()
+            return self
+        if self.is_slave:
+            self.agent.start()
+        # master: the Server thread drives everything; wait for the
+        # EndPoint/agent to signal completion
+        self._finished.wait()
+        self._write_results()
+        return self
+
+    def on_workflow_finished(self):
+        """Called by the workflow's EndPoint chain (master/standalone)."""
+        self._finished.set()
+
+    def _on_agent_finished(self):
+        self._finished.set()
+
+    def stop(self):
+        if self.stopped:
+            return
+        self.stopped = True
+        if self.agent is not None:
+            self.agent.stop()
+        self.thread_pool.shutdown()
+        self._finished.set()
+
+    # -- results (reference --result-file) ------------------------------------
+    def _write_results(self):
+        if not self.result_file or self.is_slave:
+            return
+        results = self.workflow.gather_results()
+        with open(self.result_file, "w") as fout:
+            json.dump(results, fout, indent=1, default=str)
+        self.info("results written to %s", self.result_file)

@@ -65,6 +65,7 @@ class Loader(Unit):
         self.epoch_number = 0
         self.samples_served = 0
         self.minibatch_class = TRAIN
+        self.minibatch_epoch = 0
         self.minibatch_valid_size = 0
         self.minibatch_offset = 0
         self.last_minibatch = Bool(False)
@@ -170,9 +171,11 @@ class Loader(Unit):
     def serve_next_minibatch(self, slave_id=None):
         """Pick the next minibatch (failed ones first — reference
         ``loader/base.py:726-753``), record it pending for the slave, and
-        return (klass, indices, valid_size, flags)."""
+        return (klass, indices, valid_size, last_of_class, last_of_epoch,
+        epoch). The epoch tag lets the master's Decision bucket updates
+        that arrive out of order across epoch boundaries."""
         if self.failed_minibatches:
-            klass, indices, valid = self.failed_minibatches.pop()
+            klass, indices, valid, epoch = self.failed_minibatches.pop()
             requeued = True
         else:
             block = self._next_block()
@@ -180,29 +183,38 @@ class Loader(Unit):
                 self._roll_epoch()
                 block = self._next_block()
             klass, pos, valid = block
-            indices = self.shuffled_indices[klass][pos:pos + valid]
+            epoch = self.epoch_number
+            # copy, not view: the epoch reshuffle mutates shuffled_indices
+            # in place, which would corrupt pending/requeued payloads
+            indices = self.shuffled_indices[klass][pos:pos + valid].copy()
             requeued = False
         if slave_id is not None:
             self.pending_minibatches_[slave_id].append(
-                (klass, indices, valid))
+                (klass, indices, valid, epoch))
         lengths = self.effective_class_lengths
         last_of_class = (not requeued
                          and self._position[klass] >= lengths[klass])
         last_of_epoch = last_of_class and all(
             self._position[k] >= lengths[k] or lengths[k] == 0
             for k in (TEST, VALID, TRAIN))
-        return klass, indices, valid, last_of_class, last_of_epoch
+        return klass, indices, valid, last_of_class, last_of_epoch, epoch
 
     def run(self):
-        """Standalone/slave-local serving: pick indices and fill on device."""
+        """Standalone: pick the next indices and fill on device. On a slave
+        the minibatch was already applied from the master's job payload
+        (``apply_data_from_master``) — serving locally here would silently
+        train on the wrong data (reference ``loader/base.py:641-663``)."""
+        if self.is_slave:
+            return
         (klass, indices, valid, last_of_class,
-         last_of_epoch) = self.serve_next_minibatch()
+         last_of_epoch, epoch) = self.serve_next_minibatch()
         self._apply_minibatch(klass, indices, valid, last_of_class,
-                              last_of_epoch)
+                              last_of_epoch, epoch)
 
     def _apply_minibatch(self, klass, indices, valid, last_of_class,
-                         last_of_epoch):
+                         last_of_epoch, epoch=0):
         self.minibatch_class = klass
+        self.minibatch_epoch = epoch
         self.minibatch_valid_size = valid
         self.minibatch_offset = int(indices[0]) if len(indices) else 0
         self.last_minibatch.set(last_of_class)
@@ -232,9 +244,9 @@ class Loader(Unit):
         return self.serve_next_minibatch(slave_id)
 
     def apply_data_from_master(self, data):
-        klass, indices, valid, last_of_class, last_of_epoch = data
+        klass, indices, valid, last_of_class, last_of_epoch, epoch = data
         self._apply_minibatch(klass, numpy.asarray(indices), valid,
-                              last_of_class, last_of_epoch)
+                              last_of_class, last_of_epoch, epoch)
 
     def generate_data_for_master(self):
         return {"samples_served": self.samples_served}
@@ -256,7 +268,10 @@ class Loader(Unit):
 
     @property
     def has_data_for_slave(self):
-        return not self.complete
+        # backpressure means "not ready YET"; exhaustion is signalled by
+        # NoMoreJobsError from generate_data_for_slave — returning False
+        # here on completion would park job requests forever
+        return True
 
     # -- results --------------------------------------------------------------
     def get_metric_names(self):

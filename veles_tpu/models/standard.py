@@ -73,6 +73,22 @@ class StandardWorkflow(Workflow):
         self.repeater.link_from(self.gds[0])
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
+        # fleet: the loader's job stream dries up when the decision says so
+        # (same Bool object, so the master's NoMoreJobs check follows it)
+        self.loader.complete = self.decision.complete
+
+    def initialize(self, **kwargs):
+        if self.is_slave:
+            # a slave executes exactly ONE tick per job: break the repeater
+            # loop-back and fire the EndPoint right after the backward chain
+            # so the job callback ships the update (reference
+            # workflow.py:554-569)
+            self.repeater.unlink_from(self.gds[0])
+            self.end_point.unlink_from(self.decision)
+            self.end_point.link_from(self.gds[0])
+            from veles_tpu.core.mutable import Bool
+            self.end_point.gate_block = Bool(False)
+        return super().initialize(**kwargs)
 
     def _build_forwards(self):
         src = self.loader

@@ -46,6 +46,7 @@ class DecisionGD(Unit):
         self.best_epoch = 0
         self.snapshot_suffix = ""
         self._epochs_without_improvement = 0
+        self._epochs_done = 0
 
     def link_from_workflow(self, loader, evaluator):
         self.loader = loader
@@ -61,6 +62,10 @@ class DecisionGD(Unit):
         self.epoch_ended.unset()
         klass = self.loader.minibatch_class
         self.gd_skipped.set(klass != TRAIN)
+        if self.is_slave:
+            # epoch accounting lives on the master (fed by update payloads
+            # via apply_data_from_slave); the slave just executes its job
+            return
         # accumulate metrics as LAZY device scalars — a host read here would
         # block the async XLA dispatch pipeline every minibatch; conversion
         # to Python numbers happens only at class/epoch boundaries
@@ -80,45 +85,43 @@ class DecisionGD(Unit):
             self._on_epoch_ended()
 
     # -- epoch boundary logic -------------------------------------------------
-    def _on_class_ended(self, klass):
-        samples = max(self.epoch_samples[klass], 1)
-        error_pct = 100.0 * self.epoch_n_err[klass] / samples
+    def _class_summary(self, klass, n_err, samples, loss_sum, epoch):
+        """One sample-class sweep of one epoch finished."""
+        samples = max(samples, 1)
+        error_pct = 100.0 * n_err / samples
         self.info(
             "epoch %d %s: errors %d/%d (%.2f%%) avg loss %.6f",
-            self.loader.epoch_number, CLASS_NAMES[klass],
-            self.epoch_n_err[klass], samples, error_pct,
-            self.epoch_loss[klass] / samples)
+            epoch, CLASS_NAMES[klass], n_err, samples, error_pct,
+            loss_sum / samples)
         if klass == VALID:
-            best = self.best_n_err[VALID]
-            if best is None or self.epoch_n_err[VALID] < best:
-                self.best_n_err[VALID] = self.epoch_n_err[VALID]
-                self.best_epoch = self.loader.epoch_number
-                self.improved.set()
-                self._epochs_without_improvement = 0
-                self.snapshot_suffix = "validation_%.2fpt" % error_pct
-            else:
-                self._epochs_without_improvement += 1
+            self._track_improvement(VALID, n_err, epoch,
+                                    "validation_%.2fpt" % error_pct)
 
-    def _on_epoch_ended(self):
+    def _track_improvement(self, klass, n_err, epoch, suffix):
+        best = self.best_n_err[klass]
+        if best is None or n_err < best:
+            self.best_n_err[klass] = n_err
+            self.best_epoch = epoch
+            self.improved.set()
+            self._epochs_without_improvement = 0
+            self.snapshot_suffix = suffix
+        else:
+            self._epochs_without_improvement += 1
+
+    def _epoch_summary(self, stats, epoch):
+        """All classes of ``epoch`` accounted: decide whether to stop.
+        ``stats[klass]`` is (n_err, samples, loss_sum)."""
         self.epoch_ended.set()
+        self._epochs_done += 1
         # when there is no validation set, improvement tracks train error
-        if self.epoch_samples[VALID] == 0 and self.epoch_samples[TRAIN] > 0:
-            best = self.best_n_err[TRAIN]
-            if best is None or self.epoch_n_err[TRAIN] < best:
-                self.best_n_err[TRAIN] = self.epoch_n_err[TRAIN]
-                self.best_epoch = self.loader.epoch_number
-                self.improved.set()
-                self._epochs_without_improvement = 0
-                samples = max(self.epoch_samples[TRAIN], 1)
-                self.snapshot_suffix = "train_%.2fpt" % (
-                    100.0 * self.epoch_n_err[TRAIN] / samples)
-            else:
-                self._epochs_without_improvement += 1
+        if stats[VALID][1] == 0 and stats[TRAIN][1] > 0:
+            n_err, samples, _ = stats[TRAIN]
+            self._track_improvement(
+                TRAIN, n_err, epoch,
+                "train_%.2fpt" % (100.0 * n_err / max(samples, 1)))
         stop = False
-        # epoch_number is 0-based and only increments when the NEXT epoch
-        # starts serving, so at the end of epoch N it still reads N
         if self.max_epochs is not None \
-                and self.loader.epoch_number + 1 >= self.max_epochs:
+                and self._epochs_done >= self.max_epochs:
             self.info("stopping: reached max_epochs=%d", self.max_epochs)
             stop = True
         if self._epochs_without_improvement >= self.fail_iterations:
@@ -128,10 +131,63 @@ class DecisionGD(Unit):
         if stop:
             self.complete.set()
             self.train_ended.set()
+
+    def _on_class_ended(self, klass):
+        self._class_summary(klass, self.epoch_n_err[klass],
+                            self.epoch_samples[klass],
+                            self.epoch_loss[klass], self._epochs_done)
+
+    def _on_epoch_ended(self):
+        stats = [(self.epoch_n_err[k], self.epoch_samples[k],
+                  self.epoch_loss[k]) for k in (TEST, VALID, TRAIN)]
+        self._epoch_summary(stats, self._epochs_done)
         for klass in (TEST, VALID, TRAIN):
             self.epoch_n_err[klass] = 0
             self.epoch_samples[klass] = 0
             self.epoch_loss[klass] = 0.0
+
+    # -- fleet-mode distribution ---------------------------------------------
+    # The slave reports its job's metrics tagged with the serving epoch; the
+    # master buckets them PER EPOCH, because with >=2 slaves (or async
+    # pipelining) next-epoch updates arrive before the current epoch's last
+    # ones — flat accumulators would re-fire class boundaries and drop
+    # samples at the reset (the Znicz Decision's distributed contract).
+    def generate_data_for_master(self):
+        if not self.is_slave:
+            return None
+        return {
+            "klass": self.loader.minibatch_class,
+            "epoch": self.loader.minibatch_epoch,
+            "valid": int(self.loader.minibatch_valid_size),
+            "n_err": int(self.evaluator.n_err.data),
+            "loss": float(self.evaluator.loss.data),
+        }
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        if not hasattr(self, "_epoch_buckets"):
+            self._epoch_buckets = {}
+
+    def apply_data_from_slave(self, data, slave=None):
+        klass = data["klass"]
+        epoch = data.get("epoch", 0)
+        bucket = self._epoch_buckets.setdefault(
+            epoch, {"stats": [[0, 0, 0.0] for _ in range(3)],
+                    "fired": set()})
+        entry = bucket["stats"][klass]
+        entry[0] += data["n_err"]
+        entry[1] += data["valid"]
+        entry[2] += data["loss"] * data["valid"]
+        lengths = self.loader.effective_class_lengths
+        if klass not in bucket["fired"] \
+                and 0 < lengths[klass] <= entry[1]:
+            bucket["fired"].add(klass)
+            self._class_summary(klass, entry[0], entry[1], entry[2], epoch)
+            if all(bucket["stats"][k][1] >= lengths[k]
+                   for k in (TEST, VALID, TRAIN) if lengths[k]):
+                stats = [tuple(s) for s in bucket["stats"]]
+                del self._epoch_buckets[epoch]
+                self._epoch_summary(stats, epoch)
 
     # -- results (IResultProvider) -------------------------------------------
     def get_metric_names(self):
@@ -140,4 +196,4 @@ class DecisionGD(Unit):
     def get_metric_values(self):
         return [self.best_n_err[VALID] if self.best_n_err[VALID] is not None
                 else self.best_n_err[TRAIN],
-                self.best_epoch, self.loader.epoch_number]
+                self.best_epoch, self._epochs_done]
