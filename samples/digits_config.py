@@ -1,0 +1,7 @@
+"""Config file for samples/digits_mlp.py — executable Python mutating
+``root`` (the reference config-file contract)."""
+
+root.digits.update({  # noqa: F821  (root is injected by the CLI)
+    "max_epochs": 5,
+    "learning_rate": 0.12,
+})

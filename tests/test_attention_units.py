@@ -1,0 +1,133 @@
+"""SelfAttention/LayerNorm unit tests: forward math, vjp backward vs
+autodiff, and a transformer workflow assembled via StandardWorkflow."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.memory import Array
+from veles_tpu.models.standard import StandardWorkflow
+from veles_tpu.nn.attention import (
+    GDLayerNorm, GDSelfAttention, LayerNorm, SelfAttention)
+
+
+def _x(b=2, t=8, e=16, seed=0):
+    return numpy.random.RandomState(seed).randn(b, t, e).astype(
+        numpy.float32)
+
+
+def test_self_attention_forward_matches_naive():
+    x = _x()
+    wf = DummyWorkflow()
+    attn = SelfAttention(wf, heads=4, causal=False)
+    attn.input = Array(x)
+    attn.initialize()
+    attn.run()
+    # naive recomputation from the same weights
+    w = attn.weights.data
+    b = attn.bias.data
+    qkv = jnp.asarray(x) @ w + b
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (2, 8, 4, 4)
+    import math
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.reshape(shape),
+                   k.reshape(shape)) / math.sqrt(4)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, v.reshape(shape)).reshape(
+        2, 8, 16) @ attn.out_weights.data + attn.out_bias.data
+    numpy.testing.assert_allclose(numpy.asarray(attn.output.mem),
+                                  numpy.asarray(ref), rtol=2e-2, atol=1e-3)
+
+
+def test_gd_self_attention_matches_autodiff():
+    x = _x(seed=1)
+    err = _x(seed=2) * 0.01
+    wf = DummyWorkflow()
+    attn = SelfAttention(wf, heads=4)
+    attn.input = Array(x)
+    attn.initialize()
+    attn.run()
+    w0 = numpy.asarray(attn.weights.mem).copy()
+    ow0 = numpy.asarray(attn.out_weights.mem).copy()
+
+    gd = GDSelfAttention(wf, learning_rate=1.0)
+    gd.link_attention(attn, type("E", (), {"err_output": Array(err)})())
+    gd.initialize()
+    gd.run()
+
+    def loss(w_qkv, w_out):
+        out = attn._forward(jnp.asarray(x), w_qkv,
+                            jnp.zeros_like(attn.bias.data) + 0,
+                            w_out, jnp.zeros_like(attn.out_bias.data))
+        return jnp.sum(out * jnp.asarray(err))
+
+    # bias terms were initialized to zero, so loss() above matches
+    g_qkv, g_out = jax.grad(loss, argnums=(0, 1))(
+        jnp.asarray(w0), jnp.asarray(ow0))
+    numpy.testing.assert_allclose(
+        numpy.asarray(attn.weights.mem), w0 - numpy.asarray(g_qkv),
+        rtol=2e-2, atol=1e-4)
+    numpy.testing.assert_allclose(
+        numpy.asarray(attn.out_weights.mem), ow0 - numpy.asarray(g_out),
+        rtol=2e-2, atol=1e-4)
+    assert gd.err_input.shape == x.shape
+
+
+def test_layer_norm_forward_and_backward():
+    x = _x(seed=3)
+    wf = DummyWorkflow()
+    ln = LayerNorm(wf)
+    ln.input = Array(x)
+    ln.initialize()
+    ln.run()
+    out = numpy.asarray(ln.output.mem)
+    assert abs(out.mean(-1)).max() < 1e-5
+    assert abs(out.var(-1) - 1).max() < 1e-2
+
+    err = _x(seed=4) * 0.01
+    gd = GDLayerNorm(wf, learning_rate=1.0)
+    gd.link_forward(ln, type("E", (), {"err_output": Array(err)})())
+    gd.initialize()
+    s0 = numpy.asarray(ln.weights.mem).copy()
+    gd.run()
+
+    def loss(scale):
+        return jnp.sum(ln._forward(jnp.asarray(x), scale,
+                                   jnp.zeros(16)) * jnp.asarray(err))
+
+    g = jax.grad(loss)(jnp.asarray(s0))
+    numpy.testing.assert_allclose(
+        numpy.asarray(ln.weights.mem), s0 - numpy.asarray(g),
+        rtol=2e-2, atol=1e-4)
+    assert gd.err_input.shape == x.shape
+
+
+@pytest.mark.slow
+def test_transformer_workflow_learns():
+    """A tiny transformer classifier over synthetic sequences: class = which
+    half of the sequence carries the larger marker."""
+    rng = numpy.random.RandomState(0)
+    n, t, e = 600, 8, 16
+    X = rng.randn(n, t, e).astype(numpy.float32) * 0.1
+    y = rng.randint(0, 2, n).astype(numpy.int32)
+    for i in range(n):
+        X[i, : t // 2 if y[i] == 0 else t, 0] += 1.0  # signal token runs
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        layers=[
+            {"type": "layer_norm"},
+            {"type": "self_attention", "heads": 4},
+            {"type": "softmax", "output_sample_shape": (2,)},
+        ],
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 100, 500],
+                           minibatch_size=100),
+        learning_rate=0.05, gradient_moment=0.9,
+        decision_kwargs=dict(max_epochs=12), name="tiny-transformer")
+    wf.initialize()
+    wf.run()
+    best = wf.decision.best_n_err[1]
+    assert best is not None and best < 35, \
+        "transformer at %s/100 validation errors" % best

@@ -1,0 +1,48 @@
+"""CLI end-to-end tests (mirror reference test_velescli.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*args, timeout=300):
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               VELES_TPU_HOME=os.environ.get("VELES_TPU_HOME",
+                                             "/tmp/veles_cli_test"))
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu"] + list(args),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.slow
+def test_sample_workflow_end_to_end(tmp_path):
+    result_file = str(tmp_path / "results.json")
+    proc = run_cli("samples/digits_mlp.py", "samples/digits_config.py",
+                   "root.digits.max_epochs=2", "--seed", "7",
+                   "--result-file", result_file)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    results = json.load(open(result_file))
+    assert results["epochs"] == 2
+    assert results["best_validation_errors"] < 297
+
+
+def test_dry_run_init():
+    proc = run_cli("samples/digits_mlp.py", "-", "--dry-run", "init")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_dump_config():
+    proc = run_cli("samples/digits_mlp.py", "samples/digits_config.py",
+                   "--dump-config")
+    assert proc.returncode == 0
+    assert "learning_rate" in proc.stdout
+
+
+def test_bad_override_rejected():
+    proc = run_cli("samples/digits_mlp.py", "-", "bogus.path=1")
+    assert proc.returncode != 0

@@ -1,0 +1,180 @@
+"""Command-line entry point: ``python -m veles_tpu <workflow.py> <config.py>``.
+
+Reference ``veles/__main__.py`` + ``cmdline.py``. The workflow module
+contract is preserved (reference ``__main__.py:799-818``): the user module
+defines ``run(load, main)`` where
+
+    load(WorkflowClass, **kwargs) -> (workflow, snapshot_loaded)
+    main(**kwargs)  # initializes and runs the launcher
+
+Config files are executable Python mutating ``root`` (reference
+``__main__.py:426-472``); trailing ``root.a.b=value`` CLI overrides are
+applied after. ``-l/--listen`` makes this process the fleet master,
+``-m/--master-address`` a slave, neither → standalone; ``-w`` resumes from
+a snapshot.
+"""
+
+import argparse
+import importlib.util
+import os
+import runpy
+import sys
+
+from veles_tpu.core import prng
+from veles_tpu.core.config import root
+from veles_tpu.core.logger import Logger, setup_logging
+from veles_tpu.launcher import Launcher
+from veles_tpu.snapshotter import SnapshotterToFile
+
+
+class Main(Logger):
+    """CLI driver (reference ``__main__.py:136``)."""
+
+    def __init__(self):
+        super().__init__(logger_name="Main")
+        self.launcher = None
+        self.workflow = None
+        self.snapshot_path = None
+
+    @staticmethod
+    def init_parser():
+        parser = argparse.ArgumentParser(
+            prog="veles_tpu",
+            description="TPU-native dataflow deep-learning framework")
+        parser.add_argument("workflow", help="workflow python file")
+        parser.add_argument("config", nargs="?", default=None,
+                            help="config python file ('-' to skip)")
+        parser.add_argument("overrides", nargs="*", default=[],
+                            help="root.path=value config overrides")
+        parser.add_argument("-l", "--listen", default=None,
+                            metavar="HOST:PORT",
+                            help="run as fleet master, listening here")
+        parser.add_argument("-m", "--master-address", default=None,
+                            metavar="HOST:PORT",
+                            help="run as fleet slave of this master")
+        parser.add_argument("-w", "--snapshot", default=None,
+                            help="resume from a snapshot file")
+        parser.add_argument("--result-file", default=None,
+                            help="write IResultProvider metrics JSON here")
+        parser.add_argument("--seed", default=None,
+                            help="seed for the named PRNG streams "
+                                 "(int, or key=int,key=int)")
+        parser.add_argument("--train-ratio", type=float, default=None)
+        parser.add_argument("--async-slave", action="store_true",
+                            help="pipelined slave mode")
+        parser.add_argument("--slave-death-probability", type=float,
+                            default=0.0, help="fault injection")
+        parser.add_argument("--dry-run",
+                            choices=("load", "init"), default=None,
+                            help="stop after loading/initializing")
+        parser.add_argument("--dump-config", action="store_true")
+        parser.add_argument("-v", "--verbose", action="count", default=0)
+        return parser
+
+    # -- config handling (reference __main__.py:426-481) ---------------------
+    def apply_config(self, config_path):
+        if config_path in (None, "-"):
+            return
+        runpy.run_path(config_path, init_globals={"root": root})
+
+    def override_config(self, overrides):
+        for item in overrides:
+            if "=" not in item:
+                raise ValueError("override %r is not root.path=value" % item)
+            path, value = item.split("=", 1)
+            parts = path.split(".")
+            if parts[0] != "root":
+                raise ValueError("override must start with 'root.': %r"
+                                 % item)
+            node = root
+            for part in parts[1:-1]:
+                node = getattr(node, part)
+            try:
+                import ast
+                value = ast.literal_eval(value)
+            except (ValueError, SyntaxError):
+                pass  # keep as string
+            setattr(node, parts[-1], value)
+
+    def seed_random(self, spec):
+        """Seed named streams (reference ``_seed_random``,
+        ``__main__.py:483-537``)."""
+        if spec is None:
+            return
+        if "=" in spec:
+            for part in spec.split(","):
+                key, _, value = part.partition("=")
+                prng.get(key).seed(int(value))
+        else:
+            prng.get("default").seed(int(spec))
+            prng.get("loader").seed(int(spec) + 1)
+
+    # -- workflow module loading (reference _load_model) ---------------------
+    def load_module(self, path):
+        name = os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        if spec is None:
+            raise ImportError("cannot import workflow from %r" % path)
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[name] = module
+        spec.loader.exec_module(module)
+        if not hasattr(module, "run"):
+            raise ValueError(
+                "workflow module %s lacks run(load, main)" % path)
+        return module
+
+    # -- the load/main pair handed to the module -----------------------------
+    def _load(self, workflow_class, **kwargs):
+        snapshot_loaded = False
+        if self.snapshot_path:
+            self.info("resuming from %s", self.snapshot_path)
+            self.workflow = SnapshotterToFile.import_(self.snapshot_path)
+            self.workflow.workflow = self.launcher
+            snapshot_loaded = True
+        else:
+            self.workflow = workflow_class(self.launcher, **kwargs)
+        return self.workflow, snapshot_loaded
+
+    def _main(self, **kwargs):
+        if self.dry_run == "load":
+            return
+        self.launcher.initialize(**kwargs)
+        if self.dry_run == "init":
+            return
+        self.launcher.run()
+        self.launcher.stop()
+
+    # -- entry ----------------------------------------------------------------
+    def run(self, argv=None):
+        parser = self.init_parser()
+        args = parser.parse_args(argv)
+        import logging
+        setup_logging(level=logging.DEBUG if args.verbose else logging.INFO)
+        self.dry_run = args.dry_run
+        self.snapshot_path = args.snapshot
+        # module FIRST (its import-time root.* updates are defaults), then
+        # the config file, then CLI overrides — the reference's layering
+        # (__main__.py:396,426-481)
+        module = self.load_module(args.workflow)
+        self.apply_config(args.config)
+        self.override_config(args.overrides)
+        if args.dump_config:
+            root.print_()
+            return 0
+        self.seed_random(args.seed)
+        self.launcher = Launcher(
+            listen_address=args.listen,
+            master_address=args.master_address,
+            result_file=args.result_file,
+            async_slave=args.async_slave,
+            slave_death_probability=args.slave_death_probability)
+        module.run(self._load, self._main)
+        return 0
+
+
+def main(argv=None):
+    return Main().run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,8 +64,17 @@ class Client(Logger):
 
     def stop(self):
         self._stopped.set()
+        # wake the session coroutine: it is usually parked in read_frame,
+        # so close the transport from the loop thread
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._close_connection)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+
+    def _close_connection(self):
+        writer = getattr(self, "_writer_", None)
+        if writer is not None:
+            writer.close()
 
     def join(self, timeout=None):
         self._thread.join(timeout=timeout)
@@ -87,6 +96,7 @@ class Client(Logger):
                 await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
                 continue
             attempts = 0
+            self._writer_ = writer
             try:
                 done = await self._work(reader, writer)
                 if done:

@@ -176,8 +176,7 @@ class Server(Logger):
                                        "paused": True})
             return
         slave.state = "GETTING_JOB"
-        job = await self._in_thread(
-            self.workflow.generate_data_for_slave, slave)
+        job = await self._in_thread(self._locked_generate, slave)
         if job is False:
             # backpressure: some unit not ready — queue the request,
             # replayed after the next update (reference server.py:369-399)
@@ -209,6 +208,12 @@ class Server(Logger):
         with self._update_lock:
             self.workflow.apply_data_from_slave(update, slave)
 
+    def _locked_generate(self, slave):
+        # concurrent job requests from 2+ slaves run on different executor
+        # threads; the Loader's serve is read-modify-write state
+        with self._update_lock:
+            return self.workflow.generate_data_for_slave(slave)
+
     async def _retry_pending(self):
         pending, self._pending_requests = self._pending_requests, []
         for sid, writer in pending:
@@ -238,7 +243,8 @@ class Server(Logger):
             (s, w) for s, w in self._pending_requests if s != sid]
         if slave is not None:
             self.info("slave %s dropped", sid)
-            self.workflow.drop_slave(slave)
+            with self._update_lock:
+                self.workflow.drop_slave(slave)
         self._maybe_finished()
 
     def _maybe_finished(self):

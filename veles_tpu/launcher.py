@@ -89,8 +89,8 @@ class Launcher(Logger):
 
     def run(self):
         """Blocks until the workflow completes (reference ran the reactor
-        here)."""
-        self._finished.clear()
+        here). Never clears ``_finished`` — the fleet agent started by
+        ``initialize()`` may legitimately complete before run() is called."""
         if self.is_standalone:
             self.workflow.run()
             self._write_results()

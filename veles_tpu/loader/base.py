@@ -274,8 +274,10 @@ class Loader(Unit):
         return True
 
     # -- results --------------------------------------------------------------
+    # (the "epochs" metric belongs to the Decision unit — its completed-epoch
+    # count, not this serving-side counter, is the published one)
     def get_metric_names(self):
-        return ["epochs", "total_samples"]
+        return ["total_samples"]
 
     def get_metric_values(self):
-        return [self.epoch_number, self.total_samples]
+        return [self.total_samples]

@@ -28,10 +28,14 @@ from veles_tpu.nn.evaluator import EvaluatorSoftmax
 from veles_tpu.nn.gd import (
     GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh, GradientDescent,
     link_err_output)
+from veles_tpu.nn.attention import (
+    GDLayerNorm, GDSelfAttention, LayerNorm, SelfAttention)
 from veles_tpu.nn.pooling import (
     AvgPooling, GDPooling, MaxAbsPooling, MaxPooling)
 
 FORWARD_TYPES = {
+    "self_attention": (SelfAttention, GDSelfAttention),
+    "layer_norm": (LayerNorm, GDLayerNorm),
     "all2all": (All2All, GradientDescent),
     "all2all_tanh": (All2AllTanh, GDTanh),
     "all2all_relu": (All2AllRELU, GDRELU),
@@ -130,6 +134,9 @@ class StandardWorkflow(Workflow):
             if gd_cls is GDPooling:
                 gd = GDPooling(self, name="gd%d" % i)
                 gd.link_pooling(self.forwards[i], err_src)
+            elif gd_cls is GDSelfAttention:
+                gd = gd_cls(self, name="gd%d" % i, **trainer)
+                gd.link_attention(self.forwards[i], err_src)
             elif issubclass(gd_cls, GDConv):
                 gd = gd_cls(self, name="gd%d" % i, **trainer)
                 gd.link_conv(self.forwards[i], err_src)

@@ -1,0 +1,190 @@
+"""Attention / layer-norm units for sequence models.
+
+No Znicz counterpart (the reference predates attention); these extend the
+unit-graph API to transformers with the same contracts as All2All/Conv:
+shared weight Array slots, ``err_output`` in / ``err_input`` out, exact
+backward via ``jax.vjp`` of the forward inside one jitted compute.
+
+``SelfAttention`` computes fused multi-head self-attention
+(``ops.attention``); over a ``seq``-sharded mesh the same unit math runs
+inside the fused step via ``ops.attention.ring_attention``.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.core.prng import get as get_rng
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import ForwardUnit
+from veles_tpu.nn.gd import GradientDescent
+from veles_tpu.ops.attention import attention
+
+
+class SelfAttention(ForwardUnit):
+    """Multi-head self-attention block: x → attn(norm-free) → out proj.
+
+    Input/output: (B, T, E). Weights: qkv (E, 3·E) fused projection and
+    out (E, E), biases each. One jitted compute; the attention core is the
+    flash kernel on TPU.
+    """
+
+    INPUTS = ("input", "weights", "bias", "out_weights", "out_bias")
+    OUTPUTS = ("output",)
+
+    def __init__(self, workflow, heads=8, causal=False, **kwargs):
+        self.prng_key = kwargs.pop("prng_key", "default")
+        super().__init__(workflow, **kwargs)
+        self.heads = heads
+        self.causal = causal
+        self.weights = Array()
+        self.bias = Array()
+        self.out_weights = Array()
+        self.out_bias = Array()
+        self.input = None
+
+    def initialize(self, **kwargs):
+        if self.input is None or (isinstance(self.input, Array)
+                                  and self.input.data is None):
+            return True
+        batch, t, embed = self.input.shape
+        if embed % self.heads:
+            raise ValueError("%s: embed %d not divisible by %d heads"
+                             % (self.name, embed, self.heads))
+        if self.weights.data is None:
+            rng = get_rng(self.prng_key)
+            stddev = 1.0 / math.sqrt(embed)
+            self.weights.data = jnp.asarray(
+                rng.fill_uniform((embed, 3 * embed), stddev), jnp.float32)
+            self.bias.data = jnp.zeros((3 * embed,), jnp.float32)
+            self.out_weights.data = jnp.asarray(
+                rng.fill_uniform((embed, embed), stddev), jnp.float32)
+            self.out_bias.data = jnp.zeros((embed,), jnp.float32)
+        if self.output.data is None:
+            self.output.data = jnp.zeros(self.input.shape, jnp.float32)
+
+    def _forward(self, x, w_qkv, b_qkv, w_out, b_out):
+        batch, t, embed = x.shape
+        head_dim = embed // self.heads
+        qkv = x @ w_qkv + b_qkv  # (B, T, 3E)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (batch, t, self.heads, head_dim)
+        out = attention(q.reshape(shape), k.reshape(shape),
+                        v.reshape(shape), causal=self.causal)
+        return out.reshape(batch, t, embed) @ w_out + b_out
+
+    def compute(self, x, w_qkv, b_qkv, w_out, b_out):
+        return self._forward(x, w_qkv, b_qkv, w_out, b_out)
+
+
+class GDSelfAttention(GradientDescent):
+    """Backward for SelfAttention via jax.vjp — updates both projections."""
+
+    INPUTS = ("err_output", "input", "weights", "bias", "out_weights",
+              "out_bias", "_velocity_w", "_velocity_b", "_velocity_ow",
+              "_velocity_ob", "_hyper")
+    OUTPUTS = ("err_input", "weights", "bias", "out_weights", "out_bias",
+               "_velocity_w", "_velocity_b", "_velocity_ow", "_velocity_ob")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward_unit = None
+        self.out_weights = None
+        self.out_bias = None
+        self._velocity_ow = Array()
+        self._velocity_ob = Array()
+
+    def link_attention(self, attn_unit, err_source):
+        from veles_tpu.nn.gd import link_err_output
+        self.forward_unit = attn_unit
+        self.link_attrs(attn_unit, "input", "output", "weights", "bias",
+                        "out_weights", "out_bias")
+        link_err_output(self, err_source)
+        return self
+
+    def initialize(self, **kwargs):
+        if self.weights is None or self.weights.data is None:
+            return True
+        if self._velocity_w.data is None:
+            self._velocity_w.data = jnp.zeros_like(self.weights.data)
+            self._velocity_b.data = jnp.zeros_like(self.bias.data)
+            self._velocity_ow.data = jnp.zeros_like(self.out_weights.data)
+            self._velocity_ob.data = jnp.zeros_like(self.out_bias.data)
+        self._refresh_hyper()
+
+    def compute(self, err_output, x, w_qkv, b_qkv, w_out, b_out,
+                vel_w, vel_b, vel_ow, vel_ob, hyper):
+        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
+                                    hyper[4])
+        _, vjp = jax.vjp(self.forward_unit._forward, x, w_qkv, b_qkv,
+                         w_out, b_out)
+        err_input, g_qkv, g_bqkv, g_out, g_bout = vjp(err_output)
+
+        def upd(w, g, v, rate):
+            g = g + l2 * w + l1 * jnp.sign(w)
+            v_new = moment * v - rate * g
+            return w + v_new, v_new
+
+        w_qkv, vel_w = upd(w_qkv, g_qkv, vel_w, lr)
+        b_qkv, vel_b = upd(b_qkv, g_bqkv, vel_b, lr_b)
+        w_out, vel_ow = upd(w_out, g_out, vel_ow, lr)
+        b_out, vel_ob = upd(b_out, g_bout, vel_ob, lr_b)
+        return (err_input, w_qkv, b_qkv, w_out, b_out,
+                vel_w, vel_b, vel_ow, vel_ob)
+
+
+class GDLayerNorm(GradientDescent):
+    """Backward for LayerNorm via jax.vjp — trains scale/shift and routes
+    the input error."""
+
+    def link_forward(self, ln_unit, err_source):
+        from veles_tpu.nn.gd import link_err_output
+        self.forward_unit = ln_unit
+        self.link_attrs(ln_unit, "input", "output", "weights", "bias")
+        link_err_output(self, err_source)
+        return self
+
+    def compute(self, err_output, x, y, scale, shift, vel_w, vel_b, hyper):
+        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
+                                    hyper[4])
+        _, vjp = jax.vjp(self.forward_unit._forward, x, scale, shift)
+        err_input, g_scale, g_shift = vjp(err_output)
+        g_scale = g_scale + l2 * scale + l1 * jnp.sign(scale)
+        new_vel_w = moment * vel_w - lr * g_scale
+        new_vel_b = moment * vel_b - lr_b * g_shift
+        return (err_input, scale + new_vel_w, shift + new_vel_b,
+                new_vel_w, new_vel_b)
+
+
+class LayerNorm(ForwardUnit):
+    """Layer normalization over the last axis with learned scale/shift."""
+
+    INPUTS = ("input", "weights", "bias")
+    OUTPUTS = ("output",)
+
+    def __init__(self, workflow, eps=1e-5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.eps = eps
+        self.weights = Array()
+        self.bias = Array()
+        self.input = None
+
+    def initialize(self, **kwargs):
+        if self.input is None or (isinstance(self.input, Array)
+                                  and self.input.data is None):
+            return True
+        dim = self.input.shape[-1]
+        if self.weights.data is None:
+            self.weights.data = jnp.ones((dim,), jnp.float32)
+            self.bias.data = jnp.zeros((dim,), jnp.float32)
+        if self.output.data is None:
+            self.output.data = jnp.zeros(self.input.shape, jnp.float32)
+
+    def _forward(self, x, scale, shift):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + shift
+
+    def compute(self, x, scale, shift):
+        return self._forward(x, scale, shift)

@@ -1,0 +1,119 @@
+"""Attention ops: flash attention and ring attention (sequence parallel).
+
+No reference counterpart — VELES predates attention (SURVEY §5
+"Long-context: absent") — but long context is first-class here. Two tiers:
+
+- ``attention``: single-device fused attention. Uses the Pallas TPU flash
+  kernel for real workloads, falling back to ``jax.nn.dot_product_attention``
+  (XLA) for small/ragged shapes and non-TPU backends.
+- ``ring_attention``: blockwise attention over a ``seq``-sharded mesh axis.
+  Each device holds one query block; K/V blocks rotate around the ring via
+  ``lax.ppermute`` over ICI while a running online-softmax (m, l, o)
+  accumulator absorbs each visiting block — compute overlaps transfer and
+  no device ever materializes the full sequence. This is the
+  RingAttention/blockwise-parallel pattern; causal masking uses block
+  positions so fully-masked pairs still do one cheap fused pass.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def attention(q, k, v, causal=False, scale=None):
+    """Fused single-device attention. Shapes: (B, T, H, D)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_pallas_flash(q, k):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+        # pallas kernel wants (B, H, T, D)
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, sm_scale=scale)
+        return out.transpose(0, 2, 1, 3)
+    return jax.nn.dot_product_attention(
+        q, k, v, scale=scale, is_causal=causal)
+
+
+def _use_pallas_flash(q, k):
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    # the kernel tiles (T, D) onto (128, 128) MXU blocks
+    return (q.shape[1] >= 256 and k.shape[1] >= 256
+            and q.shape[-1] % 128 == 0)
+
+
+# -- ring attention -----------------------------------------------------------
+
+def _block_attend(q, k, v, scale, mask_value, causal, q_pos, kv_pos):
+    """One (q-block x kv-block) pass returning unnormalized (o, m, l):
+    o = exp(s - m) @ v row-accumulator, m = row max, l = row sum."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qi = q_pos[:, None]
+        ki = kv_pos[None, :]
+        s = jnp.where((ki <= qi)[None, None, :, :], s, mask_value)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   mask_value=-1e30):
+    """Sequence-parallel attention inside shard_map: ``q/k/v`` are the
+    LOCAL sequence blocks (B, T_local, H, D); the full sequence is
+    ``T_local * axis_size`` long, laid out in ring order along
+    ``axis_name``. Returns the local block of the attention output."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    axis_size = lax.axis_size(axis_name)
+    my_index = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_pos = my_index * t_local + jnp.arange(t_local)
+
+    batch, _, heads, _ = q.shape
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((batch, heads, t_local), -jnp.inf, jnp.float32)
+    l = jnp.zeros((batch, heads, t_local), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(step, carry):
+        o, m, l, k_blk, v_blk = carry
+        src_index = (my_index - step) % axis_size
+        kv_pos = src_index * t_local + jnp.arange(t_local)
+        o_i, m_i, l_i = _block_attend(q, k_blk, v_blk, scale, mask_value,
+                                      causal, q_pos, kv_pos)
+        # online-softmax merge of the visiting block
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        l = l * alpha + l_i * beta
+        o = (o * alpha.transpose(0, 2, 1)[..., None]
+             + o_i * beta.transpose(0, 2, 1)[..., None])
+        # rotate K/V around the ring (overlaps with next block's compute)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows (causal first block)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name="seq", causal=False):
+    """shard_map-wrapped ring attention over ``mesh``: takes/returns
+    sequence-sharded (B, T, H, D) arrays."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
