@@ -1,0 +1,44 @@
+// Minimal JSON parser for workflow packages (the rapidjson role in
+// reference libVeles, dependency-free). Supports objects, arrays, strings
+// (with \" \\ \/ \n \t \r \u escapes), numbers, booleans, null.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_rt {
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Type type = Type::Null;
+  bool boolean = false;
+  double number = 0.0;
+  std::string str;
+  std::vector<Json> array;
+  std::map<std::string, Json> object;
+
+  static Json Parse(const std::string& text);
+
+  const Json& at(const std::string& key) const {
+    auto it = object.find(key);
+    if (it == object.end())
+      throw std::runtime_error("json: missing key " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const {
+    return object.count(key) != 0;
+  }
+  const Json& get(const std::string& key, const Json& fallback) const {
+    auto it = object.find(key);
+    return it == object.end() ? fallback : it->second;
+  }
+  int as_int() const { return static_cast<int>(number); }
+  const std::string& as_str() const { return str; }
+};
+
+}  // namespace veles_rt

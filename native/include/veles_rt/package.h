@@ -1,0 +1,36 @@
+// Workflow package reading: ustar archive + .npy arrays.
+//
+// The reference's WorkflowArchive/NumpyArrayLoader
+// (libVeles/src/workflow_archive.cc, numpy_array_loader.cc) used
+// libarchive + hand-written npy parsing with dtype conversion; the package
+// here is an uncompressed POSIX tar, so both readers are dependency-free.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace veles_rt {
+
+// A loaded float32 tensor.
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+// Reads every member of an uncompressed ustar archive into memory.
+std::map<std::string, std::string> ReadTar(const std::string& path);
+
+// Parses a .npy blob: v1/v2 headers; little-endian f2/f4/f8 and i1..i8
+// payloads are converted to float32 (the reference's dtype matrix,
+// numpy_array_loader.cc:250). Fortran order is transposed to C order.
+Tensor ParseNpy(const std::string& blob);
+
+}  // namespace veles_rt

@@ -1,0 +1,122 @@
+// Inference workflow: unit graph + static memory planning + engine.
+//
+// The reference architecture (libVeles) kept verbatim where it is the right
+// design: a UnitFactory mapping type names to constructors
+// (inc/veles/unit_factory.h), a Workflow whose Initialize() solves a static
+// memory-planning problem — each unit's output buffer is an interval
+// [birth, death] in topological time, greedily packed into one arena
+// (src/memory_optimizer.cc:38-99) — and an Engine abstraction scheduling
+// unit execution (inc/veles/engine.h).
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "veles_rt/json.h"
+#include "veles_rt/package.h"
+
+namespace veles_rt {
+
+struct Shape {
+  std::vector<int64_t> dims;  // without the batch dim
+
+  int64_t count() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+};
+
+// One inference op. Units are stateless between Run() calls; parameters
+// live in tensors loaded at construction.
+class Unit {
+ public:
+  virtual ~Unit() = default;
+  virtual const char* type() const = 0;
+  // Resolve the output shape from the input shape; called once.
+  virtual Shape Infer(const Shape& in) = 0;
+  // in/out are (batch, shape.count()) row-major.
+  virtual void Run(const float* in, float* out, int batch) const = 0;
+
+  std::string name;
+  Shape in_shape, out_shape;
+};
+
+// A constructor receives the unit's full spec (type/config/array refs)
+// plus the package's loaded arrays.
+using UnitCtor = std::function<std::unique_ptr<Unit>(
+    const Json& spec, std::map<std::string, Tensor>* arrays)>;
+
+// Global type-name → constructor registry (reference UnitFactory).
+class UnitFactory {
+ public:
+  static UnitFactory& Get();
+  void Register(const std::string& type, UnitCtor ctor);
+  std::unique_ptr<Unit> Create(const std::string& type, const Json& spec,
+                               std::map<std::string, Tensor>* arrays) const;
+
+ private:
+  std::map<std::string, UnitCtor> ctors_;
+};
+
+// Greedy interval packing: given per-buffer [birth, death) intervals and
+// byte sizes, assign arena offsets; returns total arena bytes
+// (reference MemoryOptimizer::Optimize).
+struct BufferInterval {
+  int birth, death;
+  int64_t bytes;
+  int64_t offset = -1;
+};
+int64_t PackIntervals(std::vector<BufferInterval>* buffers);
+
+// Engine: schedules callables; ThreadPoolEngine runs them on workers
+// (sequential fallback for a chain). Reference inc/veles/engine.h.
+class Engine {
+ public:
+  virtual ~Engine() = default;
+  virtual void Schedule(std::function<void()> fn) = 0;
+  virtual void Wait() = 0;
+};
+
+std::unique_ptr<Engine> MakeThreadPoolEngine(int workers);
+
+class Workflow {
+ public:
+  // Load an exported package (tar with contents.json + .npy members).
+  static std::unique_ptr<Workflow> Load(const std::string& path);
+
+  // Plan buffers for this batch size (re-plans if batch changes).
+  void Initialize(int batch);
+  // Run inference: input (batch, input_size), output (batch, output_size).
+  // Thread-safe: concurrent callers serialize on the workflow's run mutex
+  // (the arena is shared state); the batch plan is (re)built under the
+  // same lock so mixed batch sizes from different threads stay correct.
+  void Run(const float* input, int batch, float* output);
+
+  int64_t input_size() const { return input_shape_.count(); }
+  int64_t output_size() const {
+    return units_.empty() ? input_shape_.count()
+                          : units_.back()->out_shape.count();
+  }
+  const std::string& name() const { return name_; }
+  size_t unit_count() const { return units_.size(); }
+  int64_t arena_bytes() const { return arena_.size() * sizeof(float); }
+
+ private:
+  std::string name_;
+  Shape input_shape_;
+  std::vector<std::unique_ptr<Unit>> units_;
+  std::vector<float> arena_;
+  std::vector<int64_t> offsets_;  // per intermediate buffer
+  int batch_ = 0;
+  std::mutex run_mutex_;
+
+  void InitializeLocked(int batch);
+};
+
+}  // namespace veles_rt

@@ -1,0 +1,54 @@
+// C API for language bindings (Python uses it via ctypes —
+// veles_tpu/inference.py). The reference exposed libVeles to the JVM via
+// Mastodon; a flat C surface serves every binding at once.
+#include <cstring>
+#include <exception>
+#include <string>
+
+#include "veles_rt/workflow.h"
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+extern "C" {
+
+void* veles_rt_load(const char* path) {
+  try {
+    return veles_rt::Workflow::Load(path).release();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+const char* veles_rt_last_error() { return g_last_error.c_str(); }
+
+long long veles_rt_input_size(void* wf) {
+  return static_cast<veles_rt::Workflow*>(wf)->input_size();
+}
+
+long long veles_rt_output_size(void* wf) {
+  return static_cast<veles_rt::Workflow*>(wf)->output_size();
+}
+
+int veles_rt_unit_count(void* wf) {
+  return static_cast<int>(
+      static_cast<veles_rt::Workflow*>(wf)->unit_count());
+}
+
+int veles_rt_run(void* wf, const float* input, int batch, float* output) {
+  try {
+    static_cast<veles_rt::Workflow*>(wf)->Run(input, batch, output);
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+void veles_rt_free(void* wf) {
+  delete static_cast<veles_rt::Workflow*>(wf);
+}
+
+}  // extern "C"

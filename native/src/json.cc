@@ -1,0 +1,170 @@
+#include "veles_rt/json.h"
+
+#include <cctype>
+#include <cstdlib>
+
+namespace veles_rt {
+namespace {
+
+struct Parser {
+  const std::string& text;
+  size_t pos = 0;
+
+  explicit Parser(const std::string& t) : text(t) {}
+
+  [[noreturn]] void Fail(const std::string& what) {
+    throw std::runtime_error("json: " + what + " at offset " +
+                             std::to_string(pos));
+  }
+
+  void SkipWs() {
+    while (pos < text.size() && std::isspace(
+               static_cast<unsigned char>(text[pos])))
+      ++pos;
+  }
+
+  char Peek() {
+    SkipWs();
+    if (pos >= text.size()) Fail("unexpected end");
+    return text[pos];
+  }
+
+  void Expect(char c) {
+    if (Peek() != c) Fail(std::string("expected '") + c + "'");
+    ++pos;
+  }
+
+  Json ParseValue() {
+    switch (Peek()) {
+      case '{': return ParseObject();
+      case '[': return ParseArray();
+      case '"': return ParseString();
+      case 't': case 'f': return ParseBool();
+      case 'n': return ParseNull();
+      default: return ParseNumber();
+    }
+  }
+
+  Json ParseObject() {
+    Json out;
+    out.type = Json::Type::Object;
+    Expect('{');
+    if (Peek() == '}') { ++pos; return out; }
+    while (true) {
+      Json key = ParseString();
+      Expect(':');
+      out.object.emplace(key.str, ParseValue());
+      char c = Peek();
+      ++pos;
+      if (c == '}') return out;
+      if (c != ',') Fail("expected ',' or '}'");
+    }
+  }
+
+  Json ParseArray() {
+    Json out;
+    out.type = Json::Type::Array;
+    Expect('[');
+    if (Peek() == ']') { ++pos; return out; }
+    while (true) {
+      out.array.push_back(ParseValue());
+      char c = Peek();
+      ++pos;
+      if (c == ']') return out;
+      if (c != ',') Fail("expected ',' or ']'");
+    }
+  }
+
+  Json ParseString() {
+    Json out;
+    out.type = Json::Type::String;
+    Expect('"');
+    while (pos < text.size()) {
+      char c = text[pos++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (pos >= text.size()) Fail("bad escape");
+        char e = text[pos++];
+        switch (e) {
+          case '"': out.str += '"'; break;
+          case '\\': out.str += '\\'; break;
+          case '/': out.str += '/'; break;
+          case 'n': out.str += '\n'; break;
+          case 't': out.str += '\t'; break;
+          case 'r': out.str += '\r'; break;
+          case 'b': out.str += '\b'; break;
+          case 'f': out.str += '\f'; break;
+          case 'u': {
+            if (pos + 4 > text.size()) Fail("bad \\u escape");
+            unsigned code = std::strtoul(
+                text.substr(pos, 4).c_str(), nullptr, 16);
+            pos += 4;
+            // basic-multilingual-plane UTF-8 encoding
+            if (code < 0x80) {
+              out.str += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out.str += static_cast<char>(0xC0 | (code >> 6));
+              out.str += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out.str += static_cast<char>(0xE0 | (code >> 12));
+              out.str += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out.str += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: Fail("bad escape");
+        }
+      } else {
+        out.str += c;
+      }
+    }
+    Fail("unterminated string");
+  }
+
+  Json ParseBool() {
+    Json out;
+    out.type = Json::Type::Bool;
+    if (text.compare(pos, 4, "true") == 0) {
+      out.boolean = true;
+      pos += 4;
+    } else if (text.compare(pos, 5, "false") == 0) {
+      out.boolean = false;
+      pos += 5;
+    } else {
+      Fail("bad literal");
+    }
+    return out;
+  }
+
+  Json ParseNull() {
+    if (text.compare(pos, 4, "null") != 0) Fail("bad literal");
+    pos += 4;
+    return Json();
+  }
+
+  Json ParseNumber() {
+    size_t start = pos;
+    while (pos < text.size() &&
+           (std::isdigit(static_cast<unsigned char>(text[pos])) ||
+            text[pos] == '-' || text[pos] == '+' || text[pos] == '.' ||
+            text[pos] == 'e' || text[pos] == 'E'))
+      ++pos;
+    if (start == pos) Fail("bad number");
+    Json out;
+    out.type = Json::Type::Number;
+    out.number = std::strtod(text.substr(start, pos - start).c_str(),
+                             nullptr);
+    return out;
+  }
+};
+
+}  // namespace
+
+Json Json::Parse(const std::string& text) {
+  Parser parser(text);
+  Json out = parser.ParseValue();
+  parser.SkipWs();
+  return out;
+}
+
+}  // namespace veles_rt

@@ -1,0 +1,182 @@
+#include "veles_rt/package.h"
+
+#include <algorithm>
+#include <cstring>
+#include <fstream>
+#include <stdexcept>
+
+namespace veles_rt {
+namespace {
+
+int64_t ParseOctal(const char* field, size_t size) {
+  int64_t value = 0;
+  for (size_t i = 0; i < size && field[i]; ++i) {
+    if (field[i] == ' ') continue;
+    if (field[i] < '0' || field[i] > '7') break;
+    value = value * 8 + (field[i] - '0');
+  }
+  return value;
+}
+
+}  // namespace
+
+std::map<std::string, std::string> ReadTar(const std::string& path) {
+  std::ifstream fin(path, std::ios::binary);
+  if (!fin) throw std::runtime_error("cannot open package: " + path);
+  std::map<std::string, std::string> members;
+  char header[512];
+  while (fin.read(header, 512)) {
+    if (header[0] == '\0') break;  // end-of-archive zero block
+    std::string name(header, strnlen(header, 100));
+    int64_t size = ParseOctal(header + 124, 12);
+    char typeflag = header[156];
+    std::string body(static_cast<size_t>(size), '\0');
+    if (size > 0 && !fin.read(&body[0], size))
+      throw std::runtime_error("truncated tar member: " + name);
+    // skip padding to the next 512 boundary
+    int64_t pad = (512 - size % 512) % 512;
+    fin.seekg(pad, std::ios::cur);
+    if (typeflag == '0' || typeflag == '\0')
+      members.emplace(std::move(name), std::move(body));
+  }
+  return members;
+}
+
+namespace {
+
+template <typename T>
+void ConvertTo32(const char* src, int64_t count, std::vector<float>* out) {
+  const T* typed = reinterpret_cast<const T*>(src);
+  out->resize(count);
+  for (int64_t i = 0; i < count; ++i)
+    (*out)[i] = static_cast<float>(typed[i]);
+}
+
+float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h >> 15) & 1, exp = (h >> 10) & 0x1F, man = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign << 31;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while (!(man & 0x400)) { man <<= 1; --exp; }
+      man &= 0x3FF;
+      bits = (sign << 31) | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 0x1F) {
+    bits = (sign << 31) | 0x7F800000 | (man << 13);
+  } else {
+    bits = (sign << 31) | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+}  // namespace
+
+Tensor ParseNpy(const std::string& blob) {
+  if (blob.size() < 10 || blob.compare(1, 5, "NUMPY") != 0)
+    throw std::runtime_error("not a npy blob");
+  uint8_t major = static_cast<uint8_t>(blob[6]);
+  size_t header_len, header_off;
+  if (major == 1) {
+    uint16_t len;
+    std::memcpy(&len, blob.data() + 8, 2);
+    header_len = len;
+    header_off = 10;
+  } else {
+    uint32_t len;
+    std::memcpy(&len, blob.data() + 8, 4);
+    header_len = len;
+    header_off = 12;
+  }
+  std::string header = blob.substr(header_off, header_len);
+
+  auto find_value = [&](const std::string& key) -> std::string {
+    size_t at = header.find("'" + key + "'");
+    if (at == std::string::npos)
+      throw std::runtime_error("npy header missing " + key);
+    at = header.find(':', at) + 1;
+    while (at < header.size() && header[at] == ' ') ++at;
+    size_t end = at;
+    if (header[at] == '\'') {
+      end = header.find('\'', at + 1) + 1;
+    } else if (header[at] == '(') {
+      end = header.find(')', at) + 1;
+    } else {
+      while (end < header.size() && header[end] != ',' &&
+             header[end] != '}')
+        ++end;
+    }
+    return header.substr(at, end - at);
+  };
+
+  std::string descr = find_value("descr");
+  bool fortran = find_value("fortran_order").find("True") !=
+                 std::string::npos;
+  std::string shape_str = find_value("shape");
+
+  Tensor tensor;
+  for (size_t at = 0; at < shape_str.size();) {
+    if (!std::isdigit(static_cast<unsigned char>(shape_str[at]))) {
+      ++at;
+      continue;
+    }
+    size_t end = at;
+    while (end < shape_str.size() &&
+           std::isdigit(static_cast<unsigned char>(shape_str[end])))
+      ++end;
+    tensor.shape.push_back(std::stoll(shape_str.substr(at, end - at)));
+    at = end;
+  }
+  if (tensor.shape.empty()) tensor.shape.push_back(1);
+
+  const char* payload = blob.data() + header_off + header_len;
+  int64_t count = tensor.size();
+  size_t itemsize = descr.find("8") != std::string::npos   ? 8
+                    : descr.find("4") != std::string::npos ? 4
+                    : descr.find("2") != std::string::npos ? 2
+                                                           : 1;
+  if (blob.size() < header_off + header_len +
+                        static_cast<size_t>(count) * itemsize)
+    throw std::runtime_error("truncated npy payload");
+  // dtype conversion matrix (reference numpy_array_loader.cc)
+  if (descr.find("f4") != std::string::npos) {
+    ConvertTo32<float>(payload, count, &tensor.data);
+  } else if (descr.find("f8") != std::string::npos) {
+    ConvertTo32<double>(payload, count, &tensor.data);
+  } else if (descr.find("f2") != std::string::npos) {
+    const uint16_t* halves = reinterpret_cast<const uint16_t*>(payload);
+    tensor.data.resize(count);
+    for (int64_t i = 0; i < count; ++i)
+      tensor.data[i] = HalfToFloat(halves[i]);
+  } else if (descr.find("i1") != std::string::npos) {
+    ConvertTo32<int8_t>(payload, count, &tensor.data);
+  } else if (descr.find("i2") != std::string::npos) {
+    ConvertTo32<int16_t>(payload, count, &tensor.data);
+  } else if (descr.find("i4") != std::string::npos) {
+    ConvertTo32<int32_t>(payload, count, &tensor.data);
+  } else if (descr.find("i8") != std::string::npos) {
+    ConvertTo32<int64_t>(payload, count, &tensor.data);
+  } else {
+    throw std::runtime_error("unsupported npy dtype: " + descr);
+  }
+
+  if (fortran && tensor.shape.size() == 2) {
+    // in-place-style transpose to C order (reference did the same for
+    // column-major weights, numpy_array_loader.cc)
+    Tensor t;
+    t.shape = tensor.shape;
+    int64_t rows = tensor.shape[0], cols = tensor.shape[1];
+    t.data.resize(count);
+    for (int64_t r = 0; r < rows; ++r)
+      for (int64_t c = 0; c < cols; ++c)
+        t.data[r * cols + c] = tensor.data[c * rows + r];
+    return t;
+  }
+  return tensor;
+}
+
+}  // namespace veles_rt

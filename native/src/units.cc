@@ -1,0 +1,307 @@
+// The inference op library (the libZnicz role): all2all family, conv,
+// pooling. Written for cache-blocked CPU execution; this runtime is the
+// embedded/production tier, the TPU path is JAX.
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+
+#include "veles_rt/workflow.h"
+
+namespace veles_rt {
+namespace {
+
+enum class Act { kLinear, kTanh, kSigmoid, kRelu, kStrictRelu, kSoftmax };
+
+Act ParseAct(const std::string& name) {
+  if (name == "linear") return Act::kLinear;
+  if (name == "tanh") return Act::kTanh;
+  if (name == "sigmoid") return Act::kSigmoid;
+  if (name == "relu") return Act::kRelu;
+  if (name == "strict_relu") return Act::kStrictRelu;
+  if (name == "softmax") return Act::kSoftmax;
+  throw std::runtime_error("unknown activation: " + name);
+}
+
+void ApplyAct(Act act, float* data, int rows, int cols) {
+  int64_t n = static_cast<int64_t>(rows) * cols;
+  switch (act) {
+    case Act::kLinear:
+      return;
+    case Act::kTanh:  // Znicz scaled tanh 1.7159*tanh(0.6666x)
+      for (int64_t i = 0; i < n; ++i)
+        data[i] = 1.7159f * std::tanh(0.6666f * data[i]);
+      return;
+    case Act::kSigmoid:
+      for (int64_t i = 0; i < n; ++i)
+        data[i] = 1.0f / (1.0f + std::exp(-data[i]));
+      return;
+    case Act::kRelu:  // softplus (Znicz RELU)
+      for (int64_t i = 0; i < n; ++i)
+        data[i] = data[i] > 20.f ? data[i] : std::log1p(std::exp(data[i]));
+      return;
+    case Act::kStrictRelu:
+      for (int64_t i = 0; i < n; ++i) data[i] = std::max(0.f, data[i]);
+      return;
+    case Act::kSoftmax:
+      for (int r = 0; r < rows; ++r) {
+        float* row = data + static_cast<int64_t>(r) * cols;
+        float mx = *std::max_element(row, row + cols);
+        float sum = 0.f;
+        for (int c = 0; c < cols; ++c) {
+          row[c] = std::exp(row[c] - mx);
+          sum += row[c];
+        }
+        for (int c = 0; c < cols; ++c) row[c] /= sum;
+      }
+      return;
+  }
+}
+
+// Cache-blocked sgemm: C(MxN) = A(MxK) @ B(KxN), C preset with bias rows.
+void Gemm(const float* a, const float* b, float* c, int m, int k, int n) {
+  constexpr int kBlock = 64;
+  for (int i0 = 0; i0 < m; i0 += kBlock)
+    for (int k0 = 0; k0 < k; k0 += kBlock) {
+      int i1 = std::min(i0 + kBlock, m), k1 = std::min(k0 + kBlock, k);
+      for (int i = i0; i < i1; ++i)
+        for (int kk = k0; kk < k1; ++kk) {
+          float av = a[static_cast<int64_t>(i) * k + kk];
+          const float* brow = b + static_cast<int64_t>(kk) * n;
+          float* crow = c + static_cast<int64_t>(i) * n;
+          for (int j = 0; j < n; ++j) crow[j] += av * brow[j];
+        }
+    }
+}
+
+class All2AllUnit : public Unit {
+ public:
+  All2AllUnit(const Json& config, std::map<std::string, Tensor>* arrays,
+              const Json& spec)
+      : act_(ParseAct(config.at("activation").as_str())) {
+    weights_ = std::move((*arrays).at(RefKey(spec, "weights")));
+    bias_ = std::move((*arrays).at(RefKey(spec, "bias")));
+    out_features_ = config.at("out_features").as_int();
+  }
+
+  static std::string RefKey(const Json& spec, const std::string& label) {
+    std::string ref = spec.at("arrays").at(label).as_str();  // "@key.npy"
+    return ref.substr(1, ref.size() - 5);
+  }
+
+  const char* type() const override { return "all2all"; }
+
+  Shape Infer(const Shape& in) override {
+    if (in.count() != weights_.shape[0])
+      throw std::runtime_error("all2all: input " +
+                               std::to_string(in.count()) +
+                               " != weights rows " +
+                               std::to_string(weights_.shape[0]));
+    return Shape{{out_features_}};
+  }
+
+  void Run(const float* in, float* out, int batch) const override {
+    int k = static_cast<int>(weights_.shape[0]);
+    int n = static_cast<int>(weights_.shape[1]);
+    for (int r = 0; r < batch; ++r)
+      std::memcpy(out + static_cast<int64_t>(r) * n, bias_.data.data(),
+                  n * sizeof(float));
+    Gemm(in, weights_.data.data(), out, batch, k, n);
+    ApplyAct(act_, out, batch, n);
+  }
+
+ private:
+  Act act_;
+  Tensor weights_, bias_;
+  int out_features_;
+};
+
+class ConvUnit : public Unit {
+ public:
+  ConvUnit(const Json& config, std::map<std::string, Tensor>* arrays,
+           const Json& spec)
+      : act_(ParseAct(config.at("activation").as_str())) {
+    weights_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "weights")));
+    bias_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "bias")));
+    stride_y_ = config.at("stride_y").as_int();
+    stride_x_ = config.at("stride_x").as_int();
+    same_ = config.at("padding").as_str() == "SAME";
+  }
+
+  const char* type() const override { return "conv"; }
+
+  Shape Infer(const Shape& in) override {
+    if (in.dims.size() != 3)
+      throw std::runtime_error("conv expects HWC input");
+    int64_t h = in.dims[0], w = in.dims[1];
+    ky_ = static_cast<int>(weights_.shape[0]);
+    kx_ = static_cast<int>(weights_.shape[1]);
+    channels_ = static_cast<int>(weights_.shape[2]);
+    kernels_ = static_cast<int>(weights_.shape[3]);
+    if (in.dims[2] != channels_)
+      throw std::runtime_error("conv channel mismatch");
+    int64_t oh, ow;
+    if (same_) {
+      oh = (h + stride_y_ - 1) / stride_y_;
+      ow = (w + stride_x_ - 1) / stride_x_;
+      pad_y_ = static_cast<int>(
+          std::max<int64_t>(0, (oh - 1) * stride_y_ + ky_ - h) / 2);
+      pad_x_ = static_cast<int>(
+          std::max<int64_t>(0, (ow - 1) * stride_x_ + kx_ - w) / 2);
+    } else {
+      oh = (h - ky_) / stride_y_ + 1;
+      ow = (w - kx_) / stride_x_ + 1;
+      pad_y_ = pad_x_ = 0;
+    }
+    in_h_ = static_cast<int>(h);
+    in_w_ = static_cast<int>(w);
+    out_h_ = static_cast<int>(oh);
+    out_w_ = static_cast<int>(ow);
+    return Shape{{oh, ow, kernels_}};
+  }
+
+  void Run(const float* in, float* out, int batch) const override {
+    int64_t in_stride = static_cast<int64_t>(in_h_) * in_w_ * channels_;
+    int64_t out_stride = static_cast<int64_t>(out_h_) * out_w_ * kernels_;
+    for (int b = 0; b < batch; ++b) {
+      const float* img = in + b * in_stride;
+      float* dst = out + b * out_stride;
+      for (int oy = 0; oy < out_h_; ++oy)
+        for (int ox = 0; ox < out_w_; ++ox) {
+          float* px = dst + (static_cast<int64_t>(oy) * out_w_ + ox) *
+                                kernels_;
+          std::memcpy(px, bias_.data.data(), kernels_ * sizeof(float));
+          for (int fy = 0; fy < ky_; ++fy) {
+            int iy = oy * stride_y_ + fy - pad_y_;
+            if (iy < 0 || iy >= in_h_) continue;
+            for (int fx = 0; fx < kx_; ++fx) {
+              int ix = ox * stride_x_ + fx - pad_x_;
+              if (ix < 0 || ix >= in_w_) continue;
+              const float* src = img + (static_cast<int64_t>(iy) * in_w_ +
+                                        ix) * channels_;
+              const float* w = weights_.data.data() +
+                  ((static_cast<int64_t>(fy) * kx_ + fx) * channels_) *
+                      kernels_;
+              for (int c = 0; c < channels_; ++c)
+                for (int k = 0; k < kernels_; ++k)
+                  px[k] += src[c] * w[c * kernels_ + k];
+            }
+          }
+        }
+      ApplyAct(act_, dst, out_h_ * out_w_, kernels_);
+    }
+  }
+
+ private:
+  Act act_;
+  Tensor weights_, bias_;
+  int stride_y_, stride_x_, ky_ = 0, kx_ = 0;
+  int channels_ = 0, kernels_ = 0;
+  int in_h_ = 0, in_w_ = 0, out_h_ = 0, out_w_ = 0;
+  int pad_y_ = 0, pad_x_ = 0;
+  bool same_;
+};
+
+class PoolingUnit : public Unit {
+ public:
+  enum class Mode { kMax, kAvg, kMaxAbs };
+
+  PoolingUnit(const Json& config, Mode mode) : mode_(mode) {
+    ky_ = config.at("ky").as_int();
+    kx_ = config.at("kx").as_int();
+    stride_y_ = config.at("stride_y").as_int();
+    stride_x_ = config.at("stride_x").as_int();
+  }
+
+  const char* type() const override {
+    switch (mode_) {
+      case Mode::kAvg: return "avg_pooling";
+      case Mode::kMaxAbs: return "maxabs_pooling";
+      default: return "max_pooling";
+    }
+  }
+
+  Shape Infer(const Shape& in) override {
+    if (in.dims.size() != 3)
+      throw std::runtime_error("pooling expects HWC input");
+    in_h_ = static_cast<int>(in.dims[0]);
+    in_w_ = static_cast<int>(in.dims[1]);
+    channels_ = static_cast<int>(in.dims[2]);
+    out_h_ = (in_h_ - ky_) / stride_y_ + 1;
+    out_w_ = (in_w_ - kx_) / stride_x_ + 1;
+    return Shape{{out_h_, out_w_, channels_}};
+  }
+
+  void Run(const float* in, float* out, int batch) const override {
+    int64_t in_stride = static_cast<int64_t>(in_h_) * in_w_ * channels_;
+    int64_t out_stride = static_cast<int64_t>(out_h_) * out_w_ * channels_;
+    for (int b = 0; b < batch; ++b) {
+      const float* img = in + b * in_stride;
+      float* dst = out + b * out_stride;
+      for (int oy = 0; oy < out_h_; ++oy)
+        for (int ox = 0; ox < out_w_; ++ox)
+          for (int c = 0; c < channels_; ++c) {
+            float acc = mode_ == Mode::kAvg ? 0.f
+                        : mode_ == Mode::kMax ? -1e30f : 0.f;
+            for (int fy = 0; fy < ky_; ++fy)
+              for (int fx = 0; fx < kx_; ++fx) {
+                float v = img[(static_cast<int64_t>(oy * stride_y_ + fy) *
+                                   in_w_ + ox * stride_x_ + fx) *
+                                  channels_ + c];
+                switch (mode_) {
+                  case Mode::kAvg: acc += v; break;
+                  case Mode::kMax: acc = std::max(acc, v); break;
+                  case Mode::kMaxAbs:
+                    if (std::fabs(v) > std::fabs(acc)) acc = v;
+                    break;
+                }
+              }
+            if (mode_ == Mode::kAvg) acc /= ky_ * kx_;
+            dst[(static_cast<int64_t>(oy) * out_w_ + ox) * channels_ + c] =
+                acc;
+          }
+    }
+  }
+
+ private:
+  Mode mode_;
+  int ky_, kx_, stride_y_, stride_x_;
+  int in_h_ = 0, in_w_ = 0, channels_ = 0, out_h_ = 0, out_w_ = 0;
+};
+
+// Static registrations (reference RegisterUnit<T> statics).
+struct Registrar {
+  Registrar() {
+    auto& factory = UnitFactory::Get();
+    factory.Register("all2all",
+                     [](const Json& spec,
+                        std::map<std::string, Tensor>* arrays) {
+                       return std::make_unique<All2AllUnit>(
+                           spec.at("config"), arrays, spec);
+                     });
+    factory.Register("conv",
+                     [](const Json& spec,
+                        std::map<std::string, Tensor>* arrays) {
+                       return std::make_unique<ConvUnit>(
+                           spec.at("config"), arrays, spec);
+                     });
+    factory.Register("max_pooling",
+                     [](const Json& spec, std::map<std::string, Tensor>*) {
+                       return std::make_unique<PoolingUnit>(
+                           spec.at("config"), PoolingUnit::Mode::kMax);
+                     });
+    factory.Register("avg_pooling",
+                     [](const Json& spec, std::map<std::string, Tensor>*) {
+                       return std::make_unique<PoolingUnit>(
+                           spec.at("config"), PoolingUnit::Mode::kAvg);
+                     });
+    factory.Register("maxabs_pooling",
+                     [](const Json& spec, std::map<std::string, Tensor>*) {
+                       return std::make_unique<PoolingUnit>(
+                           spec.at("config"), PoolingUnit::Mode::kMaxAbs);
+                     });
+  }
+} registrar;
+
+}  // namespace
+}  // namespace veles_rt

@@ -1,0 +1,215 @@
+#include "veles_rt/workflow.h"
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <stdexcept>
+#include <thread>
+
+namespace veles_rt {
+
+// -- factory ------------------------------------------------------------------
+
+UnitFactory& UnitFactory::Get() {
+  static UnitFactory factory;
+  return factory;
+}
+
+void UnitFactory::Register(const std::string& type, UnitCtor ctor) {
+  ctors_[type] = std::move(ctor);
+}
+
+std::unique_ptr<Unit> UnitFactory::Create(
+    const std::string& type, const Json& spec,
+    std::map<std::string, Tensor>* arrays) const {
+  auto it = ctors_.find(type);
+  if (it == ctors_.end())
+    throw std::runtime_error("no unit registered for type: " + type);
+  return it->second(spec, arrays);
+}
+
+// -- interval packing (reference MemoryOptimizer::Optimize) -------------------
+
+int64_t PackIntervals(std::vector<BufferInterval>* buffers) {
+  // Greedy by decreasing size: place each buffer at the lowest offset not
+  // overlapping any time-overlapping, already-placed buffer.
+  std::vector<size_t> order(buffers->size());
+  for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+    return (*buffers)[a].bytes > (*buffers)[b].bytes;
+  });
+  int64_t arena = 0;
+  for (size_t idx : order) {
+    BufferInterval& buf = (*buffers)[idx];
+    // collect occupied [offset, offset+bytes) ranges of live overlaps
+    std::vector<std::pair<int64_t, int64_t>> busy;
+    for (size_t other : order) {
+      const BufferInterval& o = (*buffers)[other];
+      if (o.offset < 0 || &o == &buf) continue;
+      if (o.birth < buf.death && buf.birth < o.death)
+        busy.emplace_back(o.offset, o.offset + o.bytes);
+    }
+    std::sort(busy.begin(), busy.end());
+    int64_t at = 0;
+    for (auto& range : busy) {
+      if (at + buf.bytes <= range.first) break;
+      at = std::max(at, range.second);
+    }
+    buf.offset = at;
+    arena = std::max(arena, at + buf.bytes);
+  }
+  return arena;
+}
+
+// -- engine -------------------------------------------------------------------
+
+namespace {
+
+class ThreadPoolEngine : public Engine {
+ public:
+  explicit ThreadPoolEngine(int workers) {
+    for (int i = 0; i < workers; ++i)
+      threads_.emplace_back([this] { Worker(); });
+  }
+
+  ~ThreadPoolEngine() override {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      down_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  void Schedule(std::function<void()> fn) override {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ++pending_;
+      queue_.push(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  void Wait() override {
+    std::unique_lock<std::mutex> lock(mu_);
+    done_cv_.wait(lock, [this] { return pending_ == 0; });
+  }
+
+ private:
+  void Worker() {
+    while (true) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lock(mu_);
+        cv_.wait(lock, [this] { return down_ || !queue_.empty(); });
+        if (down_ && queue_.empty()) return;
+        fn = std::move(queue_.front());
+        queue_.pop();
+      }
+      fn();
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::queue<std::function<void()>> queue_;
+  std::vector<std::thread> threads_;
+  int pending_ = 0;
+  bool down_ = false;
+};
+
+}  // namespace
+
+std::unique_ptr<Engine> MakeThreadPoolEngine(int workers) {
+  return std::make_unique<ThreadPoolEngine>(workers);
+}
+
+// -- workflow -----------------------------------------------------------------
+
+std::unique_ptr<Workflow> Workflow::Load(const std::string& path) {
+  auto members = ReadTar(path);
+  auto contents_it = members.find("contents.json");
+  if (contents_it == members.end())
+    throw std::runtime_error("package lacks contents.json");
+  Json contents = Json::Parse(contents_it->second);
+
+  std::map<std::string, Tensor> arrays;
+  for (auto& member : members) {
+    if (member.first.size() > 4 &&
+        member.first.compare(member.first.size() - 4, 4, ".npy") == 0)
+      arrays.emplace(member.first.substr(0, member.first.size() - 4),
+                     ParseNpy(member.second));
+  }
+
+  auto wf = std::make_unique<Workflow>();
+  wf->name_ = contents.at("workflow").as_str();
+  for (auto& dim : contents.at("input_shape").array)
+    wf->input_shape_.dims.push_back(static_cast<int64_t>(dim.number));
+
+  Shape shape = wf->input_shape_;
+  for (auto& spec : contents.at("units").array) {
+    auto unit = UnitFactory::Get().Create(spec.at("type").as_str(), spec,
+                                          &arrays);
+    unit->name = spec.at("name").as_str();
+    unit->in_shape = shape;
+    shape = unit->Infer(shape);
+    unit->out_shape = shape;
+    wf->units_.push_back(std::move(unit));
+  }
+  return wf;
+}
+
+void Workflow::Initialize(int batch) {
+  std::lock_guard<std::mutex> lock(run_mutex_);
+  InitializeLocked(batch);
+}
+
+void Workflow::InitializeLocked(int batch) {
+  if (batch == batch_) return;
+  batch_ = batch;
+  // intermediate buffers only: unit i's output feeds unit i+1, so buffer i
+  // is live over [i, i+2) in topological time (producer + consumer steps);
+  // the LAST unit writes straight into the caller's output and needs no
+  // arena slot
+  std::vector<BufferInterval> buffers;
+  for (size_t i = 0; i + 1 < units_.size(); ++i) {
+    buffers.push_back(BufferInterval{
+        static_cast<int>(i), static_cast<int>(i) + 2,
+        static_cast<int64_t>(units_[i]->out_shape.count()) * batch *
+            static_cast<int64_t>(sizeof(float))});
+  }
+  int64_t arena_bytes = PackIntervals(&buffers);
+  arena_.assign(static_cast<size_t>(arena_bytes / sizeof(float)) + 1, 0.f);
+  offsets_.clear();
+  for (auto& buf : buffers)
+    offsets_.push_back(buf.offset / static_cast<int64_t>(sizeof(float)));
+}
+
+void Workflow::Run(const float* input, int batch, float* output) {
+  // serialize: the arena is shared mutable state, and ctypes callers drop
+  // the GIL during this call
+  std::lock_guard<std::mutex> lock(run_mutex_);
+  InitializeLocked(batch > 0 ? batch : 1);
+  const float* src = input;
+  for (size_t i = 0; i < units_.size(); ++i) {
+    float* dst = (i + 1 == units_.size())
+                     ? output
+                     : arena_.data() + offsets_[i];
+    // a chain executes sequentially; the engine exists for branchy
+    // graphs and concurrent requests
+    units_[i]->Run(src, dst, batch_);
+    src = dst;
+  }
+  if (units_.empty())
+    std::memcpy(output, input,
+                static_cast<size_t>(input_size()) * batch_ *
+                    sizeof(float));
+}
+
+}  // namespace veles_rt

@@ -1,0 +1,91 @@
+// Assert-based runtime tests (the googletest role in reference
+// libVeles/tests; gtest isn't vendored here, so plain asserts + exit code).
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "veles_rt/json.h"
+#include "veles_rt/package.h"
+#include "veles_rt/workflow.h"
+
+using veles_rt::BufferInterval;
+using veles_rt::Json;
+using veles_rt::PackIntervals;
+
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "FAILED: %s at %s:%d\n", #cond,       \
+                   __FILE__, __LINE__);                          \
+      std::exit(1);                                              \
+    }                                                            \
+  } while (0)
+
+static void TestJson() {
+  Json v = Json::Parse(
+      R"({"name": "wf", "n": 3, "neg": -2.5, "ok": true,)"
+      R"( "arr": [1, 2, 3], "nested": {"k": "v\n"}})");
+  CHECK(v.at("name").as_str() == "wf");
+  CHECK(v.at("n").as_int() == 3);
+  CHECK(std::fabs(v.at("neg").number + 2.5) < 1e-9);
+  CHECK(v.at("ok").boolean);
+  CHECK(v.at("arr").array.size() == 3);
+  CHECK(v.at("nested").at("k").as_str() == "v\n");
+}
+
+static void TestPackIntervals() {
+  // three buffers: 0 and 2 don't overlap in time, 1 overlaps both
+  std::vector<BufferInterval> bufs = {
+      {0, 2, 100}, {1, 3, 50}, {2, 4, 100}};
+  int64_t arena = PackIntervals(&bufs);
+  CHECK(arena <= 200);                      // 0 and 2 may share space
+  CHECK(bufs[0].offset == bufs[2].offset);  // greedy reuses the slot
+  // overlapping pairs never collide
+  auto overlap = [](const BufferInterval& a, const BufferInterval& b) {
+    return a.birth < b.death && b.birth < a.death &&
+           a.offset < b.offset + b.bytes && b.offset < a.offset + a.bytes;
+  };
+  CHECK(!overlap(bufs[0], bufs[1]));
+  CHECK(!overlap(bufs[1], bufs[2]));
+}
+
+static void TestNpyRoundtrip(const std::string& dir) {
+  // fixture written by the python test driver (f4 C-order)
+  auto members = veles_rt::ReadTar(dir + "/npy_fixture.tar");
+  auto tensor = veles_rt::ParseNpy(members.at("m.npy"));
+  CHECK(tensor.shape.size() == 2);
+  CHECK(tensor.shape[0] == 2 && tensor.shape[1] == 3);
+  for (int i = 0; i < 6; ++i) CHECK(std::fabs(tensor.data[i] - i) < 1e-6);
+}
+
+static void TestPackageInference(const std::string& dir) {
+  auto wf = veles_rt::Workflow::Load(dir + "/mlp_package.tar");
+  CHECK(wf->unit_count() == 2);
+  int batch = 4;
+  std::vector<float> input(static_cast<size_t>(wf->input_size()) * batch);
+  for (size_t i = 0; i < input.size(); ++i)
+    input[i] = static_cast<float>(i % 7) / 7.0f;
+  std::vector<float> output(
+      static_cast<size_t>(wf->output_size()) * batch);
+  wf->Run(input.data(), batch, output.data());
+  // softmax head: rows sum to 1
+  for (int r = 0; r < batch; ++r) {
+    float sum = 0.f;
+    for (int c = 0; c < wf->output_size(); ++c)
+      sum += output[static_cast<size_t>(r) * wf->output_size() + c];
+    CHECK(std::fabs(sum - 1.0f) < 1e-4);
+  }
+}
+
+int main(int argc, char** argv) {
+  TestJson();
+  TestPackIntervals();
+  if (argc > 1) {
+    TestNpyRoundtrip(argv[1]);
+    TestPackageInference(argv[1]);
+  }
+  std::printf("native runtime tests OK\n");
+  return 0;
+}

@@ -1,0 +1,135 @@
+"""Native runtime tests: export a trained workflow, build the C++ runtime,
+and check its inference matches the JAX forward pass bit-for-bit-ish
+(the reference's libVeles/tests tier, driven from Python)."""
+
+import io
+import os
+import subprocess
+import tarfile
+
+import numpy
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.export import package_export
+from veles_tpu.inference import BUILD_DIR, NativeWorkflow, build_native
+from veles_tpu.models.mlp import MLPWorkflow
+from veles_tpu.models.standard import StandardWorkflow
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = d.data.astype(numpy.float32)
+    y = d.target.astype(numpy.int32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    try:
+        return build_native()
+    except subprocess.CalledProcessError as e:
+        pytest.fail("native build failed:\n%s" % e.stderr.decode()[-3000:])
+
+
+@pytest.fixture(scope="module")
+def trained_mlp():
+    X, y = _digits()
+    wf = MLPWorkflow(
+        DummyLauncher(), layers=(16, 10),
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 297, 1500],
+                           minibatch_size=300,
+                           normalization_type="linear"),
+        learning_rate=0.1, max_epochs=2, name="export-test")
+    wf.initialize()
+    wf.run()
+    return wf
+
+
+def test_cpp_unit_tests(native_lib, trained_mlp, tmp_path_factory):
+    """Run the C++ test binary against generated fixtures."""
+    fixture_dir = str(tmp_path_factory.mktemp("fixtures"))
+    # npy fixture
+    buf = io.BytesIO()
+    numpy.save(buf, numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+    with tarfile.open(os.path.join(fixture_dir, "npy_fixture.tar"),
+                      "w") as tar:
+        info = tarfile.TarInfo("m.npy")
+        blob = buf.getvalue()
+        info.size = len(blob)
+        tar.addfile(info, io.BytesIO(blob))
+    package_export(trained_mlp,
+                   os.path.join(fixture_dir, "mlp_package.tar"))
+    proc = subprocess.run(
+        [os.path.join(BUILD_DIR, "veles_rt_tests"), fixture_dir],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_native_matches_jax_forward(native_lib, trained_mlp, tmp_path):
+    package = str(tmp_path / "mlp.tar")
+    package_export(trained_mlp, package)
+    rt = NativeWorkflow(package)
+    assert rt.unit_count == 2
+    assert rt.input_size == 64
+    assert rt.output_size == 10
+
+    X, _ = _digits()
+    batch = X[:32] / numpy.abs(X).max()  # loader-normalized scale
+    native_out = rt.run(batch)
+
+    # jax forward with the same weights (softmax applied to the logits)
+    w0 = trained_mlp.forwards[0].weights.data
+    b0 = trained_mlp.forwards[0].bias.data
+    w1 = trained_mlp.forwards[1].weights.data
+    b1 = trained_mlp.forwards[1].bias.data
+    h = 1.7159 * jnp.tanh(0.6666 * (jnp.asarray(batch) @ w0 + b0))
+    logits = h @ w1 + b1
+    jax_out = numpy.asarray(jnp.exp(logits) /
+                            jnp.sum(jnp.exp(logits), -1, keepdims=True))
+    numpy.testing.assert_allclose(native_out, jax_out, rtol=2e-3,
+                                  atol=1e-5)
+    # agreement on predictions
+    numpy.testing.assert_array_equal(native_out.argmax(-1),
+                                     jax_out.argmax(-1))
+
+
+def test_native_convnet(native_lib, tmp_path):
+    """Conv + pooling + dense export path."""
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.images.astype(numpy.float32) / 16.0)[..., None]
+    y = d.target.astype(numpy.int32)
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        layers=[
+            {"type": "conv_strict_relu", "n_kernels": 4, "kx": 3, "ky": 3},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "softmax", "output_sample_shape": (10,)},
+        ],
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 297, 1500],
+                           minibatch_size=300),
+        learning_rate=0.1, decision_kwargs=dict(max_epochs=1),
+        name="conv-export")
+    wf.initialize()
+    wf.run()
+    package = str(tmp_path / "conv.tar")
+    from veles_tpu.export import package_export as export
+    export(wf, package)
+    rt = NativeWorkflow(package)
+    assert rt.unit_count == 3
+
+    batch = X[:8]
+    native_out = rt.run(batch)
+    # compare against the python units' own forward
+    wf.loader.minibatch_data.data = jnp.asarray(batch)
+    for fwd in wf.forwards:
+        fwd.run()
+    jax_logits = numpy.asarray(wf.forwards[-1].output.mem)[:8]
+    jax_probs = numpy.exp(jax_logits) / numpy.exp(jax_logits).sum(
+        -1, keepdims=True)
+    numpy.testing.assert_allclose(native_out, jax_probs, rtol=2e-2,
+                                  atol=2e-4)

@@ -1,0 +1,111 @@
+"""Workflow package export for the native inference runtime.
+
+Reference ``Workflow.package_export`` (``workflow.py:864-971``) serialized
+exported units + numpy arrays into a zip/tgz consumed by libVeles
+(``contents.json`` + ``.npy`` members, ``libVeles/src/main_file_loader.cc``).
+Here the package is an **uncompressed ustar tar** — trivially parseable by
+the dependency-free C++ runtime (``native/``) — containing:
+
+- ``contents.json``: workflow name/checksum + the forward-unit chain with
+  per-unit type, config and array refs (``@name.npy``);
+- one ``.npy`` per parameter array (float32, C-order).
+
+Only ForwardUnits are exported (inference graph), in control-chain order,
+exactly like the reference exported its forward chain.
+"""
+
+import io
+import json
+import tarfile
+import time
+
+import numpy
+
+from veles_tpu.memory import Array
+
+
+def _npy_bytes(array):
+    buf = io.BytesIO()
+    numpy.save(buf, numpy.ascontiguousarray(array, numpy.float32))
+    return buf.getvalue()
+
+
+def _unit_spec(unit, arrays):
+    """Describe one forward unit; register its arrays."""
+    from veles_tpu.nn.all2all import All2All
+    from veles_tpu.nn.conv import Conv
+    from veles_tpu.nn.pooling import AvgPooling, MaxPooling, Pooling
+
+    spec = {"name": unit.name, "type": None, "config": {}, "arrays": {}}
+
+    def ref(label, value):
+        key = "%s_%s" % (unit.name, label)
+        arrays[key] = numpy.asarray(value.mem if isinstance(value, Array)
+                                    else value)
+        spec["arrays"][label] = "@%s.npy" % key
+
+    if isinstance(unit, All2All):
+        spec["type"] = "all2all"
+        spec["config"] = {"activation": unit.ACTIVATION,
+                          "out_features": unit.neurons_number}
+        ref("weights", unit.weights)
+        ref("bias", unit.bias)
+    elif isinstance(unit, Conv):
+        spec["type"] = "conv"
+        spec["config"] = {"activation": unit.ACTIVATION,
+                          "n_kernels": unit.n_kernels,
+                          "kx": unit.kx, "ky": unit.ky,
+                          "stride_y": unit.sliding[0],
+                          "stride_x": unit.sliding[1],
+                          "padding": unit.padding}
+        ref("weights", unit.weights)
+        ref("bias", unit.bias)
+    elif isinstance(unit, Pooling):
+        from veles_tpu.nn.pooling import MaxAbsPooling
+        if isinstance(unit, MaxAbsPooling):
+            spec["type"] = "maxabs_pooling"
+        elif isinstance(unit, AvgPooling):
+            spec["type"] = "avg_pooling"
+        elif isinstance(unit, MaxPooling):
+            spec["type"] = "max_pooling"
+        else:
+            raise ValueError("cannot export pooling %r (%s)"
+                             % (unit.name, type(unit).__name__))
+        spec["config"] = {"kx": unit.kx, "ky": unit.ky,
+                          "stride_y": unit.sliding[0],
+                          "stride_x": unit.sliding[1]}
+    else:
+        raise ValueError("cannot export unit %r (%s)"
+                         % (unit.name, type(unit).__name__))
+    return spec
+
+
+def package_export(workflow, path):
+    """Export ``workflow``'s forward chain to a tar package at ``path``."""
+    from veles_tpu.nn.all2all import All2AllSoftmax
+
+    arrays = {}
+    units = []
+    for unit in workflow.forwards:
+        units.append(_unit_spec(unit, arrays))
+    if workflow.forwards and isinstance(workflow.forwards[-1],
+                                        All2AllSoftmax):
+        units[-1]["config"]["activation"] = "softmax"
+    contents = {
+        "workflow": workflow.name,
+        "checksum": workflow.checksum,
+        "exported": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
+        "units": units,
+    }
+    payload = json.dumps(contents, indent=1).encode()
+    with tarfile.open(path, "w") as tar:  # uncompressed ustar
+        info = tarfile.TarInfo("contents.json")
+        info.size = len(payload)
+        tar.addfile(info, io.BytesIO(payload))
+        for key, value in arrays.items():
+            blob = _npy_bytes(value)
+            info = tarfile.TarInfo("%s.npy" % key)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    return path

@@ -1,0 +1,105 @@
+"""Python binding for the native inference runtime (ctypes).
+
+The C++ runtime (``native/``, the libVeles equivalent) executes exported
+workflow packages on CPU for embedded/production serving. This wrapper
+loads ``libveles_rt.so`` and exposes::
+
+    rt = NativeWorkflow("model.tar")
+    probs = rt.run(batch_ndarray)
+
+``build_native()`` compiles the library via CMake on first use (the build
+is cached under ``native/build``).
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+LIB_PATH = os.path.join(BUILD_DIR, "libveles_rt.so")
+
+
+def build_native(force=False):
+    """Compile the native runtime; returns the library path."""
+    if os.path.exists(LIB_PATH) and not force:
+        return LIB_PATH
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    subprocess.run(["cmake", "-S", NATIVE_DIR, "-B", BUILD_DIR,
+                    "-DCMAKE_BUILD_TYPE=Release"],
+                   check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", BUILD_DIR, "-j"],
+                   check=True, capture_output=True)
+    return LIB_PATH
+
+
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_native())
+        lib.veles_rt_load.restype = ctypes.c_void_p
+        lib.veles_rt_load.argtypes = [ctypes.c_char_p]
+        lib.veles_rt_last_error.restype = ctypes.c_char_p
+        lib.veles_rt_input_size.restype = ctypes.c_longlong
+        lib.veles_rt_input_size.argtypes = [ctypes.c_void_p]
+        lib.veles_rt_output_size.restype = ctypes.c_longlong
+        lib.veles_rt_output_size.argtypes = [ctypes.c_void_p]
+        lib.veles_rt_unit_count.restype = ctypes.c_int
+        lib.veles_rt_unit_count.argtypes = [ctypes.c_void_p]
+        lib.veles_rt_run.restype = ctypes.c_int
+        lib.veles_rt_run.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.veles_rt_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class NativeWorkflow:
+    """A loaded inference package (reference ``WorkflowLoader::Load`` →
+    ``Workflow::Initialize/Run`` surface)."""
+
+    def __init__(self, package_path):
+        lib = _load_lib()
+        self._lib = lib
+        self._handle = lib.veles_rt_load(
+            os.fsencode(os.path.abspath(package_path)))
+        if not self._handle:
+            raise RuntimeError("native load failed: %s"
+                               % lib.veles_rt_last_error().decode())
+        self.input_size = lib.veles_rt_input_size(self._handle)
+        self.output_size = lib.veles_rt_output_size(self._handle)
+        self.unit_count = lib.veles_rt_unit_count(self._handle)
+
+    def run(self, batch):
+        """Run inference on (batch, ...) float input; returns
+        (batch, output_size) float32."""
+        batch = numpy.ascontiguousarray(batch, numpy.float32)
+        n = batch.shape[0]
+        flat = batch.reshape(n, -1)
+        if flat.shape[1] != self.input_size:
+            raise ValueError("input has %d features, package wants %d"
+                             % (flat.shape[1], self.input_size))
+        out = numpy.empty((n, self.output_size), numpy.float32)
+        rc = self._lib.veles_rt_run(
+            self._handle,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError("native run failed: %s"
+                               % self._lib.veles_rt_last_error().decode())
+        return out
+
+    def __del__(self):
+        try:
+            if self._handle:
+                self._lib.veles_rt_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
