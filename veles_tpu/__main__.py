@@ -16,6 +16,7 @@ a snapshot.
 
 import argparse
 import importlib.util
+import json
 import os
 import runpy
 import sys
@@ -59,7 +60,19 @@ class Main(Logger):
         parser.add_argument("--seed", default=None,
                             help="seed for the named PRNG streams "
                                  "(int, or key=int,key=int)")
-        parser.add_argument("--train-ratio", type=float, default=None)
+        parser.add_argument("--train-ratio", type=float, default=None,
+                            help="use only this fraction of the train set")
+        parser.add_argument("--optimize", default=None,
+                            metavar="SIZE:GENERATIONS",
+                            help="genetic hyperparameter search over "
+                                 "Range() config values")
+        parser.add_argument("--ensemble-train", default=None,
+                            metavar="N:RATIO",
+                            help="train N instances on RATIO of the train "
+                                 "set each; write ensemble.json")
+        parser.add_argument("--ensemble-test", default=None, metavar="FILE",
+                            help="re-evaluate the snapshots of a trained "
+                                 "ensemble")
         parser.add_argument("--async-slave", action="store_true",
                             help="pipelined slave mode")
         parser.add_argument("--slave-death-probability", type=float,
@@ -161,6 +174,17 @@ class Main(Logger):
         if args.dump_config:
             root.print_()
             return 0
+        if args.train_ratio is not None:
+            root.common.train_ratio = args.train_ratio
+        # meta-workflow dispatch (reference _run_core, __main__.py:716-734)
+        if args.optimize:
+            return self._run_optimize(args)
+        if args.ensemble_train:
+            return self._run_ensemble_train(args)
+        if args.ensemble_test:
+            return self._run_ensemble_test(args)
+        from veles_tpu.genetics.config import fix_config
+        fix_config(root)  # strip any Range() declarations for normal runs
         self.seed_random(args.seed)
         self.launcher = Launcher(
             listen_address=args.listen,
@@ -169,6 +193,47 @@ class Main(Logger):
             async_slave=args.async_slave,
             slave_death_probability=args.slave_death_probability)
         module.run(self._load, self._main)
+        return 0
+
+
+    # -- meta-workflows (reference --optimize / --ensemble-*) ----------------
+    def _run_optimize(self, args):
+        from veles_tpu.genetics import GeneticsOptimizer, process_config
+        size, _, gens = args.optimize.partition(":")
+        genes = process_config(root)
+        if not genes:
+            self.error("no Range() values found in the config — nothing "
+                       "to optimize")
+            return 1
+        self.info("optimizing %d genes: %s", len(genes),
+                  [path for path, _ in genes])
+        optimizer = GeneticsOptimizer(
+            args.workflow, args.config, genes=genes,
+            population_size=int(size or 12),
+            generations=int(gens or 5), seed=args.seed)
+        best = optimizer.run()
+        if best is None:
+            return 1
+        print(json.dumps({
+            "best_fitness": best.fitness,
+            "best_values": {path: value for (path, _), value in
+                            zip(best.genes, best.values)}}, indent=1))
+        return 0
+
+    def _run_ensemble_train(self, args):
+        from veles_tpu.ensemble import EnsembleTrainer
+        count, _, ratio = args.ensemble_train.partition(":")
+        trainer = EnsembleTrainer(
+            args.workflow, args.config, instances=int(count),
+            train_ratio=float(ratio or 0.8))
+        trainer.run()
+        return 0
+
+    def _run_ensemble_test(self, args):
+        from veles_tpu.ensemble import EnsembleTester
+        tester = EnsembleTester(args.ensemble_test, args.workflow,
+                                args.config)
+        print(json.dumps(tester.run(), indent=1, default=str))
         return 0
 
 

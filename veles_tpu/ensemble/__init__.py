@@ -1,0 +1,13 @@
+"""veles_tpu.ensemble: train/test model ensembles (reference
+``veles/ensemble/``).
+
+``--ensemble-train N:r``: N independent trainings of the same workflow,
+each a subprocess with ``--train-ratio r`` and a random seed, collecting
+snapshots + metrics into one JSON (reference ``base_workflow.py:59-176``).
+``--ensemble-test file``: re-runs each stored snapshot in evaluation mode,
+collecting outputs for a downstream combiner model
+(``test_workflow.py:50-107`` + ``loader/ensemble.py``).
+"""
+
+from veles_tpu.ensemble.runner import (  # noqa: F401
+    EnsembleTester, EnsembleTrainer)

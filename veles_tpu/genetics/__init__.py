@@ -1,0 +1,14 @@
+"""veles_tpu.genetics: GA hyperparameter optimization (reference
+``veles/genetics/``).
+
+Config values wrapped in :class:`Range` become genes; each chromosome is a
+full training run (a subprocess, exactly like the reference spawned a
+``veles`` per evaluation — ``optimization_workflow.py:216-279``) whose
+result-file fitness drives selection/crossover/mutation. Evaluations are
+embarrassingly parallel and can be spread over fleet slaves or local
+processes (population parallelism, SURVEY §2.5 item 2).
+"""
+
+from veles_tpu.genetics.config import Range, fix_config, process_config  # noqa: F401
+from veles_tpu.genetics.core import Chromosome, Population  # noqa: F401
+from veles_tpu.genetics.optimizer import GeneticsOptimizer  # noqa: F401

@@ -1,0 +1,138 @@
+"""GA core: chromosomes, crossover, mutation, selection.
+
+Reference ``genetics/core.py`` implements binary+gray-code and numeric
+chromosomes with uniform/one-point/two-point/arithmetic/geometric crossover,
+several mutations and roulette selection. The numeric tier carries all the
+optimization power for hyperparameters, so that is what survives here —
+with the same operator set and roulette wheel.
+"""
+
+from veles_tpu.core import prng
+
+
+class Chromosome:
+    """One candidate: a vector of gene values (+ fitness once evaluated)."""
+
+    def __init__(self, genes, values):
+        self.genes = genes  # [(path, Range), ...]
+        self.values = list(values)
+        self.fitness = None
+
+    def config_overrides(self):
+        """root.path=value strings for the evaluation subprocess."""
+        return ["%s=%r" % (path, value)
+                for (path, _), value in zip(self.genes, self.values)]
+
+    def __repr__(self):
+        return "<Chromosome %s fitness=%s>" % (self.values, self.fitness)
+
+
+class Population:
+    """Evolving population (reference ``genetics/core.py``)."""
+
+    def __init__(self, genes, size=20, crossover="uniform",
+                 mutation="gaussian", mutation_rate=0.15, elite=2,
+                 prng_key="genetics"):
+        self.genes = genes
+        self.size = size
+        self.crossover_type = crossover
+        self.mutation_type = mutation
+        self.mutation_rate = mutation_rate
+        self.elite = elite
+        self.rng = prng.get(prng_key)
+        self.generation = 0
+        self.members = [self._random_member() for _ in range(size)]
+        # seed one member with the declared defaults
+        if self.members:
+            self.members[0] = Chromosome(
+                genes, [rng.default for _, rng in genes])
+
+    def _random_member(self):
+        values = []
+        for _, gene in self.genes:
+            span = gene.max_value - gene.min_value
+            values.append(gene.clip(gene.min_value
+                                    + self.rng.random_sample() * span))
+        return Chromosome(self.genes, values)
+
+    # -- selection ------------------------------------------------------------
+    def roulette_pick(self):
+        """Fitness-proportionate selection (reference roulette)."""
+        fits = [max(m.fitness, 0.0) + 1e-9 for m in self.members]
+        total = sum(fits)
+        spin = self.rng.random_sample() * total
+        acc = 0.0
+        for member, fit in zip(self.members, fits):
+            acc += fit
+            if acc >= spin:
+                return member
+        return self.members[-1]
+
+    # -- crossover -------------------------------------------------------------
+    def cross(self, a, b):
+        n = len(a.values)
+        kind = self.crossover_type
+        if kind == "uniform":
+            values = [a.values[i] if self.rng.random_sample() < 0.5
+                      else b.values[i] for i in range(n)]
+        elif kind == "one_point":
+            point = int(self.rng.randint(1, max(n, 2)))
+            values = a.values[:point] + b.values[point:]
+        elif kind == "two_point":
+            p1 = int(self.rng.randint(0, n))
+            p2 = int(self.rng.randint(p1, n)) + 1
+            values = a.values[:p1] + b.values[p1:p2] + a.values[p2:]
+        elif kind == "arithmetic":
+            w = self.rng.random_sample()
+            values = [w * x + (1 - w) * y
+                      for x, y in zip(a.values, b.values)]
+        elif kind == "geometric":
+            values = [(abs(x) * abs(y)) ** 0.5 if x * y >= 0
+                      else (x + y) / 2
+                      for x, y in zip(a.values, b.values)]
+        else:
+            raise ValueError("unknown crossover %r" % kind)
+        values = [gene.clip(v)
+                  for (_, gene), v in zip(self.genes, values)]
+        return Chromosome(self.genes, values)
+
+    # -- mutation --------------------------------------------------------------
+    def mutate(self, member):
+        for i, (_, gene) in enumerate(self.genes):
+            if self.rng.random_sample() >= self.mutation_rate:
+                continue
+            span = gene.max_value - gene.min_value
+            kind = self.mutation_type
+            if kind == "gaussian":
+                value = member.values[i] + self.rng.normal(0, span * 0.1)
+            elif kind == "uniform":
+                value = gene.min_value + self.rng.random_sample() * span
+            elif kind == "altering":  # swap with another random gene slot
+                j = int(self.rng.randint(0, len(self.genes)))
+                member.values[i], member.values[j] = (
+                    member.values[j], member.values[i])
+                value = member.values[i]
+            else:
+                raise ValueError("unknown mutation %r" % kind)
+            member.values[i] = gene.clip(value)
+        return member
+
+    # -- generation step -------------------------------------------------------
+    def evolve(self):
+        """Build the next generation from the evaluated current one."""
+        ranked = sorted(self.members,
+                        key=lambda m: m.fitness, reverse=True)
+        survivors = ranked[:self.elite]
+        children = [Chromosome(self.genes, list(m.values))
+                    for m in survivors]
+        while len(children) < self.size:
+            child = self.cross(self.roulette_pick(), self.roulette_pick())
+            children.append(self.mutate(child))
+        self.members = children
+        self.generation += 1
+
+    @property
+    def best(self):
+        evaluated = [m for m in self.members if m.fitness is not None]
+        return max(evaluated, key=lambda m: m.fitness) if evaluated \
+            else None

@@ -1,0 +1,126 @@
+"""GeneticsOptimizer: drives subprocess evaluations of chromosomes.
+
+Reference ``genetics/optimization_workflow.py:70-283``: each chromosome's
+fitness comes from a FULL training run in a subprocess (pickled config +
+result-file read-back). Kept here: the subprocess-per-evaluation contract
+(CLI override strings instead of pickled configs — same layering),
+generation loop with no-improvement early stop, and parallel evaluation
+(a local process pool plays the slave-fleet role; fleet distribution hands
+the same subprocess commands to slaves).
+
+Fitness: the result JSON's ``EvaluationFitness`` if present, else
+``-best_validation_errors`` (maximized either way).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+from veles_tpu.core.logger import Logger
+from veles_tpu.genetics.core import Population
+
+
+class GeneticsOptimizer(Logger):
+    """Population-parallel hyperparameter search (reference
+    ``GeneticsOptimizer``)."""
+
+    def __init__(self, workflow_file, config_file=None, genes=(),
+                 population_size=12, generations=5, max_parallel=2,
+                 no_improvement_limit=3, extra_args=(), seed=None):
+        super().__init__(logger_name="GeneticsOptimizer")
+        self.workflow_file = workflow_file
+        self.config_file = config_file
+        self.population = Population(list(genes), size=population_size)
+        self.generations = generations
+        self.max_parallel = max_parallel
+        self.no_improvement_limit = no_improvement_limit
+        self.extra_args = list(extra_args)
+        self.seed = seed
+        self.best_fitness_history = []
+
+    # -- one evaluation --------------------------------------------------------
+    def _command(self, chromosome, result_file):
+        cmd = [sys.executable, "-m", "veles_tpu", self.workflow_file,
+               self.config_file or "-"]
+        cmd += chromosome.config_overrides()
+        cmd += ["--result-file", result_file]
+        if self.seed is not None:
+            cmd += ["--seed", str(self.seed)]
+        cmd += self.extra_args
+        return cmd
+
+    @staticmethod
+    def fitness_from_results(results):
+        if "EvaluationFitness" in results:
+            return float(results["EvaluationFitness"])
+        if results.get("best_validation_errors") is not None:
+            return -float(results["best_validation_errors"])
+        raise ValueError("result file carries neither EvaluationFitness "
+                         "nor best_validation_errors")
+
+    def evaluate_generation(self):
+        """Run all unevaluated members, ``max_parallel`` at a time."""
+        pending = [m for m in self.population.members
+                   if m.fitness is None]
+        env = dict(os.environ)
+        running = []  # (member, proc, result_file)
+
+        def harvest(block):
+            nonlocal running
+            still = []
+            for member, proc, result_file in running:
+                if block:
+                    proc.wait()
+                if proc.poll() is None:
+                    still.append((member, proc, result_file))
+                    continue
+                if proc.returncode != 0:
+                    self.warning("evaluation failed (rc=%d): %s",
+                                 proc.returncode, member)
+                    member.fitness = -1e30
+                else:
+                    with open(result_file) as fin:
+                        member.fitness = self.fitness_from_results(
+                            json.load(fin))
+                    self.info("evaluated %s -> %.4f", member.values,
+                              member.fitness)
+                os.unlink(result_file)
+            running = still
+
+        for member in pending:
+            while len(running) >= self.max_parallel:
+                harvest(block=True)
+            fd, result_file = tempfile.mkstemp(suffix=".json",
+                                               prefix="genetics_")
+            os.close(fd)
+            proc = subprocess.Popen(
+                self._command(member, result_file), env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            running.append((member, proc, result_file))
+        while running:
+            harvest(block=True)
+
+    # -- the optimization loop -------------------------------------------------
+    def run(self):
+        best_ever = None
+        stale = 0
+        for generation in range(self.generations):
+            self.evaluate_generation()
+            best = self.population.best
+            self.best_fitness_history.append(best.fitness)
+            self.info("generation %d best: %s fitness=%.4f",
+                      generation, best.values, best.fitness)
+            if best_ever is None or best.fitness > best_ever.fitness:
+                best_ever = best
+                stale = 0
+            else:
+                stale += 1
+                if stale >= self.no_improvement_limit:
+                    self.info("stopping: no improvement for %d "
+                              "generations", stale)
+                    break
+            if generation + 1 < self.generations:
+                self.population.evolve()
+        return best_ever

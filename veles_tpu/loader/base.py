@@ -57,7 +57,8 @@ class Loader(Unit):
 
     def __init__(self, workflow, **kwargs):
         self.minibatch_size = kwargs.pop("minibatch_size", 100)
-        self.train_ratio = kwargs.pop("train_ratio", 1.0)
+        self.train_ratio = kwargs.pop(
+            "train_ratio", root.common.get("train_ratio", 1.0))
         self.shuffle_limit = kwargs.pop("shuffle_limit", None)
         self.prng_key = kwargs.pop("prng_key", "loader")
         super().__init__(workflow, **kwargs)
