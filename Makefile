@@ -1,0 +1,20 @@
+PYTHON ?= python
+
+.PHONY: check test entry hooks
+
+# Full commit gate: whole test suite + both driver entry points.
+check: test entry
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+entry:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import jax, __graft_entry__ as g; \
+fn, args = g.entry(); jax.jit(fn)(*args); print('entry ok')"
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Install the pre-commit test gate into .git/hooks.
+hooks:
+	printf '#!/bin/sh\nmake -C "$$(git rev-parse --show-toplevel)" check\n' \
+		> "$$(git rev-parse --git-path hooks)/pre-commit"
+	chmod +x "$$(git rev-parse --git-path hooks)/pre-commit"

@@ -29,6 +29,7 @@ import collections
 import numpy
 
 from veles_tpu.core import prng
+from veles_tpu.core.config import root
 from veles_tpu.core.errors import NoMoreJobsError
 from veles_tpu.core.mutable import Bool
 from veles_tpu.core.units import Unit
