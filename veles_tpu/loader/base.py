@@ -223,7 +223,13 @@ class Loader(Unit):
         self.epoch_ended_for_class.set(last_of_class)
         self.epoch_ended.set(last_of_epoch)
         padded = self._pad_indices(indices)
-        self.fill_minibatch(padded, valid)
+        if getattr(self, "fill_data", True):
+            self.fill_minibatch(padded, valid)
+        else:
+            # fused-tick mode: the tick gathers in-jit from the originals;
+            # the loader only publishes the served indices
+            import jax.numpy as jnp
+            self.minibatch_indices.data = jnp.asarray(padded)
         self.samples_served += valid
         self._served_this_epoch += valid
         if last_of_epoch:

@@ -62,6 +62,13 @@ class StandardWorkflow(Workflow):
                  loader_cls=None, decision_kwargs=None, **kwargs):
         self.layer_defaults = {k: kwargs.pop(k) for k in TRAINER_KEYS
                                if k in kwargs}
+        # fused tick mode: True/False or "auto" (use it whenever the
+        # topology supports it and we run standalone); mesh_ is not
+        # pickled (jax Device objects) — resumed pod runs fall back to
+        # the single-device fused tick
+        self.fused = kwargs.pop("fused", "auto")
+        self.mesh_ = kwargs.pop("mesh", None)
+        self.fused_tick = None
         super().__init__(workflow, **kwargs)
         loader_cls = loader_cls or FullBatchLoader
         self.repeater = Repeater(self)
@@ -92,7 +99,60 @@ class StandardWorkflow(Workflow):
             self.end_point.link_from(self.gds[0])
             from veles_tpu.core.mutable import Bool
             self.end_point.gate_block = Bool(False)
+        elif self.fused and self.is_standalone:
+            self._enable_fused()
         return super().initialize(**kwargs)
+
+    def _enable_fused(self):
+        """Splice the FusedTick in place of the per-unit compute chain:
+        loader → FusedTick → decision (see parallel/fused.py). Graph mode
+        units stay constructed — they own the weights and serve the fleet
+        and export paths."""
+        from veles_tpu.parallel import fused
+
+        if self.fused_tick is not None:  # resumed snapshot: already wired
+            return
+        mesh = getattr(self, "mesh_", None)
+        if not fused.supports(self, mesh):
+            if self.fused is True:
+                raise ValueError(
+                    "fused=True but the topology/loader is not fusible")
+            return
+        self.fused_tick = fused.FusedTick(self, mesh=mesh,
+                                          name="fused_tick")
+        # detach the graph-mode compute chain from the control path
+        self.forwards[0].unlink_from(self.loader)
+        self.decision.unlink_from(self.evaluator)
+        self.gds[-1].unlink_from(self.decision)
+        self.repeater.unlink_from(self.gds[0])
+        # splice the fused tick in
+        self.fused_tick.link_from(self.loader)
+        self.decision.link_from(self.fused_tick)
+        self.repeater.link_from(self.decision)
+        self.loader.gate_block = self.decision.complete
+        self.loader.fill_data = False
+        self.info("fused tick mode: %d-layer chain compiled into one "
+                  "XLA computation per tick", len(self.forwards))
+
+    def _disable_fused(self):
+        """Reverse the FusedTick splice (e.g. the loader's HBM-OOM host
+        fallback made in-tick gather counterproductive)."""
+        from veles_tpu.core.mutable import Bool
+
+        tick = self.fused_tick
+        if tick is None:
+            return
+        self.fused_tick = None
+        tick.unlink_from(self.loader)
+        self.decision.unlink_from(tick)
+        self.repeater.unlink_from(self.decision)
+        self.del_ref(tick)
+        self.forwards[0].link_from(self.loader)
+        self.decision.link_from(self.evaluator)
+        self.gds[-1].link_from(self.decision)
+        self.repeater.link_from(self.gds[0])
+        self.loader.gate_block = Bool(False)
+        self.loader.fill_data = True
 
     def _build_forwards(self):
         src = self.loader

@@ -1,0 +1,355 @@
+"""FusedTick: the product-path tick compiler.
+
+SURVEY §7.1's headline design translation, wired into the REAL workflow
+loop: the reference executes one trip around the Repeater loop as a chain
+of per-unit kernel launches (loader gather → forward ops → evaluator →
+per-layer GD updates, reference ``workflow.py:347-365``); here the whole
+tick is ONE jitted XLA computation, including the minibatch gather from
+the device-resident dataset and the normalizer — zero host round trips
+per tick, params donated through the step so weights never leave HBM.
+
+``StandardWorkflow`` builds its unit graph as usual (the units remain the
+composition API, the weight owners, and the fleet/graph execution path),
+then — in standalone mode, when the topology is recognizably a
+forward/GD chain — splices a :class:`FusedTick` unit in place of the
+compute chain:
+
+    start → repeater → loader → FusedTick → decision → {repeater, end}
+
+The backward math is ``jax.grad`` of the same masked loss the evaluator
+computes, which is numerically identical to the hand-chained GD units
+(``tests/test_nn.py::test_gd_matches_autodiff`` proves the equivalence;
+``tests/test_fused.py`` proves end-to-end weight equality per epoch).
+
+Sharding: with a mesh (pod mode) the tick is ``shard_map``-ped over the
+``data`` axis — each device gathers its own index shard from the
+replicated originals, gradients/metrics are ``psum``-merged over ICI —
+the synchronous SPMD answer to the reference's master/slave update merge.
+Tensor parallelism for dense chains stays in ``parallel.step``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from veles_tpu.core.units import Unit
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.ops import activations as act_lib, losses
+from veles_tpu.ops.gather import gather_minibatch
+from veles_tpu.ops.gemm import matmul
+from veles_tpu.ops.normalize import mean_disp_normalize
+
+#: forward-unit class name → fused layer kind
+_DENSE = "dense"
+_CONV = "conv"
+_POOL_KINDS = {"MaxPooling": "max", "AvgPooling": "avg",
+               "MaxAbsPooling": "maxabs"}
+
+
+def extract_model_spec(workflow):
+    """Static per-layer config from the workflow's forwards/gds chains.
+    Returns a spec list, or None when a layer type is not fusible (the
+    caller then stays on graph mode)."""
+    from veles_tpu.nn.all2all import All2All
+    from veles_tpu.nn.conv import Conv
+    from veles_tpu.nn.pooling import Pooling
+
+    specs = []
+    for i, fwd in enumerate(workflow.forwards):
+        gd = workflow.gds[i] if workflow.gds else None
+        if isinstance(fwd, All2All):
+            spec = {"kind": _DENSE, "activation": fwd.ACTIVATION}
+        elif isinstance(fwd, Conv):
+            spec = {"kind": _CONV, "activation": fwd.ACTIVATION,
+                    "sliding": fwd.sliding, "padding": fwd.padding}
+        elif isinstance(fwd, Pooling):
+            spec = {"kind": _POOL_KINDS.get(type(fwd).__name__),
+                    "window": (fwd.ky, fwd.kx), "sliding": fwd.sliding}
+            if spec["kind"] is None:
+                return None
+        else:
+            return None
+        if spec["kind"] in (_DENSE, _CONV):
+            if gd is None or not hasattr(gd, "learning_rate"):
+                return None
+            spec["has_params"] = True
+        specs.append(spec)
+    return specs
+
+
+def get_hypers(workflow):
+    """Per-layer hyperparameter vectors, read fresh from the GD units'
+    ``_hyper`` slots each tick — so ``set_learning_rate()`` annealing keeps
+    working in fused mode without retracing (the gd.py contract)."""
+    return [gd._hyper.data if getattr(fwd, "weights", None) is not None
+            else None
+            for fwd, gd in zip(workflow.forwards, workflow.gds)]
+
+
+def get_params(workflow):
+    """Snapshot the unit chain's weights into the per-layer pytree."""
+    params = []
+    for i, fwd in enumerate(workflow.forwards):
+        if getattr(fwd, "weights", None) is None:
+            params.append({})
+            continue
+        gd = workflow.gds[i]
+        params.append({
+            "w": fwd.weights.data,
+            "b": fwd.bias.data,
+            "vw": (gd._velocity_w.data if gd._velocity_w.data is not None
+                   else jnp.zeros_like(fwd.weights.data)),
+            "vb": (gd._velocity_b.data if gd._velocity_b.data is not None
+                   else jnp.zeros_like(fwd.bias.data)),
+        })
+    return params
+
+
+def set_params(workflow, params):
+    """Write fused-step results back into the shared unit Array slots (so
+    the Snapshotter, exporters, and graph mode all see current weights).
+
+    COPIES, not aliases: the train step donates its params argument, so an
+    alias stored in a unit Array would be a deleted buffer one tick later
+    (and the Snapshotter may read it concurrently from a pool thread)."""
+    for fwd, gd, p in zip(workflow.forwards, workflow.gds, params):
+        if not p:
+            continue
+        fwd.weights.data = jnp.copy(p["w"])
+        fwd.bias.data = jnp.copy(p["b"])
+        gd._velocity_w.data = jnp.copy(p["vw"])
+        gd._velocity_b.data = jnp.copy(p["vb"])
+
+
+def _layer_forward(spec):
+    """Pure forward for one layer, matching the forward unit's compute."""
+    kind = spec["kind"]
+    if kind == _DENSE:
+        act = act_lib.ACTIVATIONS[spec["activation"]][0]
+
+        def fwd(p, x):
+            x = x.reshape(x.shape[0], -1)
+            return act(matmul(x, p["w"], out_dtype=jnp.float32) + p["b"])
+        return fwd
+    if kind == _CONV:
+        act = act_lib.ACTIVATIONS[spec["activation"]][0]
+        sliding, padding = spec["sliding"], spec["padding"]
+
+        def fwd(p, x):
+            out = lax.conv_general_dilated(
+                x, p["w"], window_strides=sliding, padding=padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32)
+            return act(out + p["b"])
+        return fwd
+    # pooling (mirrors nn.pooling semantics exactly)
+    ky, kx = spec["window"]
+    window = (1, ky, kx, 1)
+    strides = (1,) + tuple(spec["sliding"]) + (1,)
+    if kind == "max":
+        return lambda p, x: lax.reduce_window(
+            x, -jnp.inf, lax.max, window, strides, "VALID")
+    if kind == "avg":
+        return lambda p, x: lax.reduce_window(
+            x, 0.0, lax.add, window, strides, "VALID") / (kx * ky)
+
+    def absmax(a, b):
+        return lax.select(lax.abs(a) > lax.abs(b), a, b)
+    return lambda p, x: lax.reduce_window(
+        x, 0.0, absmax, window, strides, "VALID")
+
+
+def build_tick(specs, norm_type="none", norm_state=None, mesh=None):
+    """Compile the fused tick pair.
+
+    Returns ``(train_step, eval_step)``:
+
+    - ``train_step(params, hypers, data, labels, indices, valid) ->
+      (params, (loss, n_err))`` — gather → normalize → forward → masked
+      softmax xent → grad → per-layer momentum/decay update. ``hypers``
+      (per-layer 5-vectors from :func:`get_hypers`) are traced inputs so
+      learning-rate annealing never retraces;
+    - ``eval_step(params, data, labels, indices, valid) -> (loss, n_err)``
+      — forward + metrics only (VALID/TEST sweeps, GD skipped exactly as
+      the Decision unit's ``gd_skipped`` gate does in graph mode).
+    """
+    layer_fwds = [_layer_forward(s) for s in specs]
+    norm = {k: jnp.asarray(v) for k, v in (norm_state or {}).items()}
+    data_ax = mesh.shape.get("data", 1) if mesh is not None else 1
+
+    def gather_norm(data, labels, indices):
+        batch, lab = gather_minibatch(data, indices, labels)
+        if norm_type == "mean_disp":
+            batch = mean_disp_normalize(batch, norm["mean"], norm["rdisp"])
+        elif norm_type == "linear":
+            batch = batch * norm["scale"]
+        return batch, lab
+
+    def model_forward(wb, x):
+        for fwd, p in zip(layer_fwds, wb):
+            x = fwd(p, x)
+        return x
+
+    def local_mask(n_local, valid):
+        pos = jnp.arange(n_local)
+        if data_ax > 1:
+            pos = pos + lax.axis_index("data") * n_local
+        return (pos < valid).astype(jnp.float32)
+
+    def metrics_of(wb, batch, lab, mask, valid):
+        logits = model_forward(wb, batch)
+        _, loss_sum, n_err, _ = losses.masked_softmax_xent(
+            logits, lab, mask, valid)
+        return loss_sum, n_err
+
+    def local_train(params, hypers, data, labels, indices, valid):
+        batch, lab = gather_norm(data, labels, indices)
+        mask = local_mask(indices.shape[0], valid)
+        wb = [{"w": p["w"], "b": p["b"]} if p else {} for p in params]
+
+        def loss_fn(wb):
+            loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
+            return loss_sum / valid, (loss_sum, n_err)
+
+        (_, (loss_sum, n_err)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(wb)
+        if data_ax > 1:
+            grads = lax.psum(grads, "data")
+            loss_sum = lax.psum(loss_sum, "data")
+            n_err = lax.psum(n_err, "data")
+        new = []
+        for p, g, hyper in zip(params, grads, hypers):
+            if not p:
+                new.append({})
+                continue
+            lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2],
+                                        hyper[3], hyper[4])
+            gw = g["w"] + l2 * p["w"] + l1 * jnp.sign(p["w"])
+            vw = moment * p["vw"] - lr * gw
+            vb = moment * p["vb"] - lr_b * g["b"]
+            new.append({"w": p["w"] + vw, "b": p["b"] + vb,
+                        "vw": vw, "vb": vb})
+        return new, (loss_sum / valid, n_err)
+
+    def local_eval(params, data, labels, indices, valid):
+        batch, lab = gather_norm(data, labels, indices)
+        mask = local_mask(indices.shape[0], valid)
+        wb = [{"w": p["w"], "b": p["b"]} if p else {} for p in params]
+        loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
+        if data_ax > 1:
+            loss_sum = lax.psum(loss_sum, "data")
+            n_err = lax.psum(n_err, "data")
+        return loss_sum / valid, n_err
+
+    if data_ax == 1:
+        return (jax.jit(local_train, donate_argnums=(0,)),
+                jax.jit(local_eval))
+    eval_specs = (P(), P(), P(), P("data"), P())
+    train_specs = (P(),) + eval_specs
+    train = jax.shard_map(local_train, mesh=mesh, in_specs=train_specs,
+                          out_specs=(P(), (P(), P())), check_vma=False)
+    evaluate = jax.shard_map(local_eval, mesh=mesh, in_specs=eval_specs,
+                             out_specs=(P(), P()), check_vma=False)
+    return (jax.jit(train, donate_argnums=(0,)), jax.jit(evaluate))
+
+
+def supports(workflow, mesh=None):
+    """True when the workflow's compute chain can run as a fused tick."""
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.nn.evaluator import EvaluatorSoftmax
+
+    loader = getattr(workflow, "loader", None)
+    if not isinstance(loader, FullBatchLoader) or not loader.on_device:
+        return False
+    if not isinstance(getattr(workflow, "evaluator", None),
+                      EvaluatorSoftmax):
+        return False
+    if extract_model_spec(workflow) is None:
+        return False
+    if mesh is not None:
+        data_ax = mesh.shape.get("data", 1)
+        if loader.max_minibatch_size % data_ax:
+            return False
+    return True
+
+
+class FusedTick(Unit):
+    """One workflow tick as one fused XLA computation.
+
+    Reads the loader's served indices + epoch flags, runs the train or
+    eval step for the tick's sample class, writes the metric scalars into
+    the evaluator's slots (lazy device values — the Decision unit reads
+    them at epoch boundaries exactly as in graph mode), and writes weights
+    back into the unit Arrays at epoch boundaries so the Snapshotter and
+    fleet paths always see current state.
+    """
+
+    hide_from_registry = True
+    VIEW_GROUP = "WORKER"
+
+    def __init__(self, workflow, mesh=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        # trailing underscore: a jax Mesh holds Device objects and cannot
+        # be pickled — a resumed pod-mode snapshot falls back to the
+        # single-device fused tick unless the caller re-supplies a mesh
+        self.mesh_ = mesh
+        self.ticks = 0
+
+    @property
+    def mesh(self):
+        return self.mesh_
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        if not hasattr(self, "mesh_"):
+            self.mesh_ = None
+        self._params_ = None
+        self._train_step_ = None
+        self._eval_step_ = None
+
+    def initialize(self, **kwargs):
+        wf = self.workflow
+        loader = wf.loader
+        if not loader.on_device:
+            # the loader's HBM-OOM fallback kicked in during load_data —
+            # fused gather from host originals would re-transfer the whole
+            # dataset every tick; revert to graph mode
+            self.warning("dataset fell back to host: disabling fused mode")
+            wf._disable_fused()
+            return
+        for fwd in wf.forwards:
+            weights = getattr(fwd, "weights", None)
+            if weights is not None and weights.data is None:
+                return True  # retry after the forwards initialize
+        specs = extract_model_spec(wf)
+        self._train_step_, self._eval_step_ = build_tick(
+            specs, loader.normalization_type, loader.normalizer_state,
+            self.mesh_)
+
+    def run(self):
+        wf = self.workflow
+        loader = wf.loader
+        if self._params_ is None:
+            # copy: the unit Arrays keep their own buffers — ours get
+            # donated through the train step
+            self._params_ = jax.tree.map(jnp.copy, get_params(wf))
+        data = loader.original_data.data
+        labels = (loader.original_labels.data if loader.original_labels
+                  else jnp.zeros(len(loader.original_data), jnp.int32))
+        indices = loader.minibatch_indices.data
+        valid = jnp.float32(max(loader.minibatch_valid_size, 1))
+        if loader.minibatch_class == TRAIN:
+            self._params_, (loss, n_err) = self._train_step_(
+                self._params_, get_hypers(wf), data, labels, indices,
+                valid)
+        else:
+            loss, n_err = self._eval_step_(
+                self._params_, data, labels, indices, valid)
+        evaluator = wf.evaluator
+        evaluator.loss.data = loss
+        evaluator.n_err.data = n_err
+        self.ticks += 1
+        if loader.epoch_ended:
+            set_params(wf, self._params_)
