@@ -27,7 +27,7 @@ def _digits_dataset():
     return X[perm], y[perm]
 
 
-def _build_mlp(fused, mesh=None, max_epochs=3):
+def _build_mlp(fused, mesh=None, max_epochs=3, sweep=True):
     prng.get("default").seed(4321)
     prng.get("loader").seed(8765)
     X, y = _digits_dataset()
@@ -38,7 +38,7 @@ def _build_mlp(fused, mesh=None, max_epochs=3):
                            minibatch_size=100,
                            normalization_type="linear"),
         learning_rate=0.1, max_epochs=max_epochs, fused=fused, mesh=mesh,
-        name="fused-identity")
+        fused_sweep=sweep, name="fused-identity")
 
 
 def _train(wf):
@@ -47,11 +47,12 @@ def _train(wf):
     return wf
 
 
-def test_fused_mode_matches_graph_mode():
-    """Same seeds, same data: fused and graph mode must produce the same
-    weights and the same per-epoch metrics."""
+@pytest.mark.parametrize("sweep", [False, True])
+def test_fused_mode_matches_graph_mode(sweep):
+    """Same seeds, same data: fused (per-tick AND scanned-sweep engines)
+    and graph mode must produce the same weights and per-epoch metrics."""
     graph = _train(_build_mlp(fused=False))
-    fused = _train(_build_mlp(fused=True))
+    fused = _train(_build_mlp(fused=True, sweep=sweep))
     assert fused.fused_tick is not None, "fused mode did not engage"
     assert fused.fused_tick.ticks > 0
     # identical epoch accounting

@@ -142,6 +142,11 @@ root.common.update({
         "snapshots": os.path.join(_home, "snapshots"),
         "datasets": os.path.join(_home, "datasets"),
         "events": os.path.join(_home, "events"),
+        # XLA persistent compilation cache: first fused-tick compile on a
+        # TPU costs tens of seconds; subsequent processes reload it from
+        # here (the TPU-era descendant of the reference's kernel binary
+        # cache, accelerated_units.py:605-673)
+        "xla_cache": os.path.join(_home, "cache", "xla"),
     },
     "engine": {
         # compute dtype policy: matmuls/convs run in bfloat16 on the MXU with
@@ -197,3 +202,18 @@ def _apply_site_overrides():
 
 
 _apply_site_overrides()
+
+
+def _enable_xla_compilation_cache():
+    """Point jax at the persistent compilation cache directory. Must run
+    before the first compilation; importing veles_tpu does it."""
+    try:
+        import jax
+        path = root.common.dirs.get("xla_cache")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # never let cache plumbing break the import
+        pass
+
+
+_enable_xla_compilation_cache()

@@ -201,12 +201,60 @@ class Loader(Unit):
             for k in (TEST, VALID, TRAIN))
         return klass, indices, valid, last_of_class, last_of_epoch, epoch
 
+    def serve_next_class_sweep(self):
+        """Serve one ENTIRE sample-class sweep at once: the fused sweep
+        engine scans the minibatches inside one XLA computation, so the
+        host loop runs once per class per epoch instead of once per
+        minibatch (the dispatch-latency killer on a tunneled TPU).
+
+        Returns (klass, index_matrix(n_batches, mb), valid_sizes
+        (n_batches,), total_valid, last_of_epoch, epoch)."""
+        lengths = self.effective_class_lengths
+        klass = next((k for k in (TEST, VALID, TRAIN)
+                      if self._position[k] < lengths[k]), None)
+        if klass is None:
+            self._roll_epoch()
+            klass = next(k for k in (TEST, VALID, TRAIN) if lengths[k])
+        mb = self.max_minibatch_size
+        start = self._position[klass]
+        n = lengths[klass] - start
+        n_batches = (n + mb - 1) // mb
+        idx = self.shuffled_indices[klass][start:start + n]
+        matrix = numpy.zeros((n_batches, mb), dtype=numpy.int64)
+        matrix.reshape(-1)[:n] = idx
+        valid_sizes = numpy.full(n_batches, mb, dtype=numpy.int32)
+        if n % mb:
+            valid_sizes[-1] = n % mb
+        self._position[klass] = lengths[klass]
+        last_of_epoch = all(self._position[k] >= lengths[k]
+                            or lengths[k] == 0
+                            for k in (TEST, VALID, TRAIN))
+        return (klass, matrix, valid_sizes, n, last_of_epoch,
+                self.epoch_number)
+
     def run(self):
         """Standalone: pick the next indices and fill on device. On a slave
         the minibatch was already applied from the master's job payload
         (``apply_data_from_master``) — serving locally here would silently
         train on the wrong data (reference ``loader/base.py:641-663``)."""
         if self.is_slave:
+            return
+        if getattr(self, "sweep_serving", False):
+            (klass, matrix, valid_sizes, total, last_of_epoch,
+             epoch) = self.serve_next_class_sweep()
+            self.minibatch_class = klass
+            self.minibatch_epoch = epoch
+            self.minibatch_valid_size = total
+            self.last_minibatch.set(True)
+            self.epoch_ended_for_class.set(True)
+            self.epoch_ended.set(last_of_epoch)
+            self.minibatch_indices.data = matrix
+            self.sweep_valid_sizes = valid_sizes
+            self.samples_served += total
+            self._served_this_epoch += total
+            if last_of_epoch:
+                self.event("epoch", "single", number=self.epoch_number)
+                self._served_this_epoch = 0
             return
         (klass, indices, valid, last_of_class,
          last_of_epoch, epoch) = self.serve_next_minibatch()
@@ -227,9 +275,9 @@ class Loader(Unit):
             self.fill_minibatch(padded, valid)
         else:
             # fused-tick mode: the tick gathers in-jit from the originals;
-            # the loader only publishes the served indices
-            import jax.numpy as jnp
-            self.minibatch_indices.data = jnp.asarray(padded)
+            # the loader only publishes the served indices (host numpy —
+            # the transfer rides the fused step's dispatch)
+            self.minibatch_indices.data = padded
         self.samples_served += valid
         self._served_this_epoch += valid
         if last_of_epoch:

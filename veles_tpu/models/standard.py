@@ -67,6 +67,9 @@ class StandardWorkflow(Workflow):
         # pickled (jax Device objects) — resumed pod runs fall back to
         # the single-device fused tick
         self.fused = kwargs.pop("fused", "auto")
+        # sweep serving: one XLA dispatch per class sweep (lax.scan over
+        # the minibatches) instead of one per minibatch
+        self.fused_sweep = kwargs.pop("fused_sweep", True)
         self.mesh_ = kwargs.pop("mesh", None)
         self.fused_tick = None
         super().__init__(workflow, **kwargs)
@@ -131,8 +134,11 @@ class StandardWorkflow(Workflow):
         self.repeater.link_from(self.decision)
         self.loader.gate_block = self.decision.complete
         self.loader.fill_data = False
+        self.loader.sweep_serving = bool(getattr(self, "fused_sweep",
+                                                 True))
         self.info("fused tick mode: %d-layer chain compiled into one "
-                  "XLA computation per tick", len(self.forwards))
+                  "XLA computation per %s", len(self.forwards),
+                  "class sweep" if self.loader.sweep_serving else "tick")
 
     def _disable_fused(self):
         """Reverse the FusedTick splice (e.g. the loader's HBM-OOM host
@@ -153,6 +159,7 @@ class StandardWorkflow(Workflow):
         self.repeater.link_from(self.gds[0])
         self.loader.gate_block = Bool(False)
         self.loader.fill_data = True
+        self.loader.sweep_serving = False
 
     def _build_forwards(self):
         src = self.loader

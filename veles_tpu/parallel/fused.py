@@ -161,25 +161,52 @@ def _layer_forward(spec):
         x, 0.0, absmax, window, strides, "VALID")
 
 
-def build_tick(specs, norm_type="none", norm_state=None, mesh=None):
-    """Compile the fused tick pair.
+def _freeze(obj):
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj
 
-    Returns ``(train_step, eval_step)``:
 
-    - ``train_step(params, hypers, data, labels, indices, valid) ->
-      (params, (loss, n_err))`` — gather → normalize → forward → masked
-      softmax xent → grad → per-layer momentum/decay update. ``hypers``
-      (per-layer 5-vectors from :func:`get_hypers`) are traced inputs so
-      learning-rate annealing never retraces;
-    - ``eval_step(params, data, labels, indices, valid) -> (loss, n_err)``
-      — forward + metrics only (VALID/TEST sweeps, GD skipped exactly as
-      the Decision unit's ``gd_skipped`` gate does in graph mode).
+#: (frozen specs, norm_type, mesh id) → compiled step tuple. Rebuilding a
+#: workflow with the same topology reuses the SAME jitted callables, so
+#: jax's in-process trace cache (and the persistent XLA cache) hit.
+_TICK_CACHE = {}
+
+
+def build_tick(specs, norm_type="none", mesh=None):
+    """Compile the fused engine.
+
+    Returns ``(train_step, eval_step, train_sweep, eval_sweep)``:
+
+    - ``train_step(params, hypers, norm, data, labels, indices, valid) ->
+      (params, (loss, n_err))`` — one minibatch: gather → normalize →
+      forward → masked softmax xent → grad → per-layer momentum/decay
+      update. ``hypers`` (per-layer 5-vectors from :func:`get_hypers`)
+      and ``norm`` (normalizer-state dict) are traced inputs so annealing
+      and dataset changes never retrace;
+    - ``eval_step(params, norm, data, labels, indices, valid) ->
+      (loss, n_err)`` — forward + metrics only (VALID/TEST sweeps, GD
+      skipped exactly as the Decision unit's ``gd_skipped`` gate does in
+      graph mode);
+    - ``train_sweep(params, hypers, norm, data, labels, index_matrix,
+      valid_sizes, total_valid) -> (params, (loss, n_err))`` — a whole
+      class sweep as ONE dispatch: ``lax.scan`` over the minibatch rows
+      (identical per-row math), metrics summed over the sweep. This is
+      what makes the product path dispatch-bound-free: one XLA call per
+      class per epoch instead of one per minibatch;
+    - ``eval_sweep(...)`` likewise without updates.
     """
+    key = (_freeze(specs), norm_type,
+           None if mesh is None else id(mesh))
+    cached = _TICK_CACHE.get(key)
+    if cached is not None:
+        return cached
     layer_fwds = [_layer_forward(s) for s in specs]
-    norm = {k: jnp.asarray(v) for k, v in (norm_state or {}).items()}
     data_ax = mesh.shape.get("data", 1) if mesh is not None else 1
 
-    def gather_norm(data, labels, indices):
+    def gather_norm(data, labels, indices, norm):
         batch, lab = gather_minibatch(data, indices, labels)
         if norm_type == "mean_disp":
             batch = mean_disp_normalize(batch, norm["mean"], norm["rdisp"])
@@ -204,8 +231,10 @@ def build_tick(specs, norm_type="none", norm_state=None, mesh=None):
             logits, lab, mask, valid)
         return loss_sum, n_err
 
-    def local_train(params, hypers, data, labels, indices, valid):
-        batch, lab = gather_norm(data, labels, indices)
+    # cores return the UNNORMALIZED loss_sum; wrappers divide by the
+    # relevant valid count (per minibatch or per sweep)
+    def core_train(params, hypers, norm, data, labels, indices, valid):
+        batch, lab = gather_norm(data, labels, indices, norm)
         mask = local_mask(indices.shape[0], valid)
         wb = [{"w": p["w"], "b": p["b"]} if p else {} for p in params]
 
@@ -231,28 +260,79 @@ def build_tick(specs, norm_type="none", norm_state=None, mesh=None):
             vb = moment * p["vb"] - lr_b * g["b"]
             new.append({"w": p["w"] + vw, "b": p["b"] + vb,
                         "vw": vw, "vb": vb})
-        return new, (loss_sum / valid, n_err)
+        return new, (loss_sum, n_err)
 
-    def local_eval(params, data, labels, indices, valid):
-        batch, lab = gather_norm(data, labels, indices)
+    def core_eval(params, norm, data, labels, indices, valid):
+        batch, lab = gather_norm(data, labels, indices, norm)
         mask = local_mask(indices.shape[0], valid)
         wb = [{"w": p["w"], "b": p["b"]} if p else {} for p in params]
         loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
         if data_ax > 1:
             loss_sum = lax.psum(loss_sum, "data")
             n_err = lax.psum(n_err, "data")
+        return loss_sum, n_err
+
+    def local_train(params, hypers, norm, data, labels, indices, valid):
+        new, (loss_sum, n_err) = core_train(params, hypers, norm, data,
+                                            labels, indices, valid)
+        return new, (loss_sum / valid, n_err)
+
+    def local_eval(params, norm, data, labels, indices, valid):
+        loss_sum, n_err = core_eval(params, norm, data, labels, indices,
+                                    valid)
         return loss_sum / valid, n_err
 
+    def local_train_sweep(params, hypers, norm, data, labels,
+                          index_matrix, valid_sizes, total_valid):
+        def body(carry, xs):
+            indices, valid = xs
+            new, (loss_sum, n_err) = core_train(
+                carry, hypers, norm, data, labels, indices,
+                valid.astype(jnp.float32))
+            return new, (loss_sum, n_err)
+
+        params, (loss_sums, n_errs) = lax.scan(
+            body, params, (index_matrix, valid_sizes))
+        return params, (jnp.sum(loss_sums) / total_valid,
+                        jnp.sum(n_errs))
+
+    def local_eval_sweep(params, norm, data, labels, index_matrix,
+                         valid_sizes, total_valid):
+        def body(carry, xs):
+            indices, valid = xs
+            return carry, core_eval(params, norm, data, labels, indices,
+                                    valid.astype(jnp.float32))
+
+        _, (loss_sums, n_errs) = lax.scan(
+            body, 0, (index_matrix, valid_sizes))
+        return jnp.sum(loss_sums) / total_valid, jnp.sum(n_errs)
+
     if data_ax == 1:
-        return (jax.jit(local_train, donate_argnums=(0,)),
-                jax.jit(local_eval))
-    eval_specs = (P(), P(), P(), P("data"), P())
+        steps = (jax.jit(local_train, donate_argnums=(0,)),
+                 jax.jit(local_eval),
+                 jax.jit(local_train_sweep, donate_argnums=(0,)),
+                 jax.jit(local_eval_sweep))
+        _TICK_CACHE[key] = steps
+        return steps
+    eval_specs = (P(), P(), P(), P(), P("data"), P())
     train_specs = (P(),) + eval_specs
+    eval_sweep_specs = (P(), P(), P(), P(), P(None, "data"), P(), P())
+    train_sweep_specs = (P(),) + eval_sweep_specs
     train = jax.shard_map(local_train, mesh=mesh, in_specs=train_specs,
                           out_specs=(P(), (P(), P())), check_vma=False)
     evaluate = jax.shard_map(local_eval, mesh=mesh, in_specs=eval_specs,
                              out_specs=(P(), P()), check_vma=False)
-    return (jax.jit(train, donate_argnums=(0,)), jax.jit(evaluate))
+    train_sweep = jax.shard_map(
+        local_train_sweep, mesh=mesh, in_specs=train_sweep_specs,
+        out_specs=(P(), (P(), P())), check_vma=False)
+    eval_sweep = jax.shard_map(
+        local_eval_sweep, mesh=mesh, in_specs=eval_sweep_specs,
+        out_specs=(P(), P()), check_vma=False)
+    steps = (jax.jit(train, donate_argnums=(0,)), jax.jit(evaluate),
+             jax.jit(train_sweep, donate_argnums=(0,)),
+             jax.jit(eval_sweep))
+    _TICK_CACHE[key] = steps
+    return steps
 
 
 def supports(workflow, mesh=None):
@@ -306,8 +386,8 @@ class FusedTick(Unit):
         if not hasattr(self, "mesh_"):
             self.mesh_ = None
         self._params_ = None
-        self._train_step_ = None
-        self._eval_step_ = None
+        self._steps_ = None
+        self._norm_ = None
 
     def initialize(self, **kwargs):
         wf = self.workflow
@@ -324,29 +404,43 @@ class FusedTick(Unit):
             if weights is not None and weights.data is None:
                 return True  # retry after the forwards initialize
         specs = extract_model_spec(wf)
-        self._train_step_, self._eval_step_ = build_tick(
-            specs, loader.normalization_type, loader.normalizer_state,
-            self.mesh_)
+        self._norm_ = {k: jnp.asarray(v) for k, v in
+                       (loader.normalizer_state or {}).items()}
+        self._steps_ = build_tick(specs, loader.normalization_type,
+                                  self.mesh_)
 
     def run(self):
+        import numpy
         wf = self.workflow
         loader = wf.loader
         if self._params_ is None:
             # copy: the unit Arrays keep their own buffers — ours get
             # donated through the train step
             self._params_ = jax.tree.map(jnp.copy, get_params(wf))
+        train_step, eval_step, train_sweep, eval_sweep = self._steps_
+        norm = self._norm_
         data = loader.original_data.data
         labels = (loader.original_labels.data if loader.original_labels
                   else jnp.zeros(len(loader.original_data), jnp.int32))
         indices = loader.minibatch_indices.data
-        valid = jnp.float32(max(loader.minibatch_valid_size, 1))
-        if loader.minibatch_class == TRAIN:
-            self._params_, (loss, n_err) = self._train_step_(
-                self._params_, get_hypers(wf), data, labels, indices,
-                valid)
+        valid = numpy.float32(max(loader.minibatch_valid_size, 1))
+        training = loader.minibatch_class == TRAIN
+        if getattr(loader, "sweep_serving", False):
+            sizes = loader.sweep_valid_sizes
+            if training:
+                self._params_, (loss, n_err) = train_sweep(
+                    self._params_, get_hypers(wf), norm, data, labels,
+                    indices, sizes, valid)
+            else:
+                loss, n_err = eval_sweep(self._params_, norm, data,
+                                         labels, indices, sizes, valid)
+        elif training:
+            self._params_, (loss, n_err) = train_step(
+                self._params_, get_hypers(wf), norm, data, labels,
+                indices, valid)
         else:
-            loss, n_err = self._eval_step_(
-                self._params_, data, labels, indices, valid)
+            loss, n_err = eval_step(self._params_, norm, data, labels,
+                                    indices, valid)
         evaluator = wf.evaluator
         evaluator.loss.data = loss
         evaluator.n_err.data = n_err
