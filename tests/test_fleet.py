@@ -2,6 +2,7 @@
 reference's key distributed-test pattern, ``test_network.py:111-137`` /
 ``test_launcher.py:91-118``)."""
 
+import asyncio
 import threading
 
 import numpy
@@ -54,31 +55,60 @@ def _run_slave(port, kw, **slave_kw):
     return slave
 
 
+class FakeReader:
+    def __init__(self, data):
+        import io
+        self.buf = io.BytesIO(data)
+
+    async def readexactly(self, n):
+        data = self.buf.read(n)
+        if len(data) < n:
+            raise asyncio.IncompleteReadError(data, n)
+        return data
+
+
+KEY = b"test-secret"
+
+
 class TestProtocol:
     def test_frame_roundtrip(self):
-        import asyncio
-        import io
-
         msg = {"type": "job", "job": [numpy.arange(5), {"a": 1}]}
-        frame = encode_frame(msg)
-
-        class FakeReader:
-            def __init__(self, data):
-                self.buf = io.BytesIO(data)
-
-            async def readexactly(self, n):
-                return self.buf.read(n)
-
+        frame = encode_frame(msg, KEY)
         from veles_tpu.fleet.protocol import read_frame
         out = asyncio.get_event_loop().run_until_complete(
-            read_frame(FakeReader(frame)))
+            read_frame(FakeReader(frame), KEY))
         assert out["type"] == "job"
         numpy.testing.assert_array_equal(out["job"][0], numpy.arange(5))
 
     def test_big_frame_compressed(self):
         big = {"data": numpy.zeros(1024 * 1024, numpy.float32)}
-        frame = encode_frame(big)
+        frame = encode_frame(big, KEY)
         assert len(frame) < 1024 * 1024  # gzip kicked in
+
+    def test_unauthenticated_frame_rejected(self):
+        """A frame MAC'd with the wrong key must never reach
+        pickle.loads (pre-handshake RCE hardening)."""
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+        frame = encode_frame({"type": "hello"}, b"attacker-key")
+        with pytest.raises(ProtocolError):
+            asyncio.get_event_loop().run_until_complete(
+                read_frame(FakeReader(frame), KEY))
+
+    def test_tampered_frame_rejected(self):
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+        frame = bytearray(encode_frame({"type": "hello"}, KEY))
+        frame[-1] ^= 0xFF
+        with pytest.raises(ProtocolError):
+            asyncio.get_event_loop().run_until_complete(
+                read_frame(FakeReader(bytes(frame)), KEY))
+
+    def test_secret_defaults_to_workflow_checksum(self):
+        from veles_tpu.fleet.protocol import resolve_secret
+
+        class WF:
+            checksum = "abc123"
+
+        assert resolve_secret(WF()) == b"abc123"
 
     def test_machine_id_stable(self):
         assert machine_id() == machine_id()

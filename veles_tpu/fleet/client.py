@@ -21,19 +21,22 @@ import random
 import threading
 
 from veles_tpu.core.logger import Logger
-from veles_tpu.fleet.protocol import (machine_id, read_frame, write_frame)
+from veles_tpu.fleet.protocol import (
+    ProtocolError, machine_id, read_frame, resolve_secret, write_frame)
 
 
 class Client(Logger):
     """The fleet slave (reference ``client.py:405``)."""
 
     def __init__(self, address, workflow, power=1.0, async_mode=False,
-                 death_probability=0.0, max_reconnect_attempts=7):
+                 death_probability=0.0, max_reconnect_attempts=7,
+                 secret=None):
         super().__init__(logger_name="fleet.Client")
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.workflow = workflow
+        self._secret = resolve_secret(workflow, secret)
         self.power = power
         self.async_mode = async_mode
         self.death_probability = death_probability
@@ -95,14 +98,33 @@ class Client(Logger):
                     return
                 await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
                 continue
-            attempts = 0
             self._writer_ = writer
+            self._handshaked_ = False
             try:
                 done = await self._work(reader, writer)
                 if done:
                     return
-            except (asyncio.IncompleteReadError, ConnectionError):
-                self.warning("connection to master lost; reconnecting")
+                attempts = 0
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    ProtocolError) as exc:
+                if not self._handshaked_:
+                    # the master dropped us mid-handshake (secret/checksum
+                    # mismatch shows up as a silent close on its side):
+                    # this is NOT a transient network loss — burn an
+                    # attempt and back off, or we busy-loop forever
+                    attempts += 1
+                    if attempts > self.max_reconnect_attempts:
+                        self.error(
+                            "master refused the handshake %d times "
+                            "(wrong fleet secret or workflow checksum?); "
+                            "giving up", attempts - 1)
+                        return
+                    self.warning("handshake failed (%s); retrying",
+                                 type(exc).__name__)
+                    await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
+                else:
+                    attempts = 0
+                    self.warning("connection to master lost; reconnecting")
             finally:
                 writer.close()
 
@@ -110,24 +132,25 @@ class Client(Logger):
         await write_frame(writer, {
             "type": "hello", "power": self.power, "mid": machine_id(),
             "pid": os.getpid(), "backend": "tpu",
-            "checksum": getattr(self.workflow, "checksum", None)})
-        welcome = await read_frame(reader)
+            "checksum": getattr(self.workflow, "checksum", None)}, self._secret)
+        welcome = await read_frame(reader, self._secret)
         if welcome.get("type") == "error":
             self.error("master refused: %s", welcome.get("error"))
             return True
+        self._handshaked_ = True
         self.sid = welcome["id"]
         initial = welcome.get("initial")
         if initial:
             self.workflow.apply_initial_data_from_master(initial)
         self.info("connected as %s", self.sid)
-        await write_frame(writer, {"type": "job_request"})
+        await write_frame(writer, {"type": "job_request"}, self._secret)
         while not self._stopped.is_set():
-            msg = await read_frame(reader)
+            msg = await read_frame(reader, self._secret)
             mtype = msg.get("type")
             if mtype == "job":
                 if msg.get("paused"):
                     await asyncio.sleep(0.5)
-                    await write_frame(writer, {"type": "job_request"})
+                    await write_frame(writer, {"type": "job_request"}, self._secret)
                     continue
                 if msg.get("job") is None:
                     self.info("no more jobs; exiting")
@@ -140,14 +163,14 @@ class Client(Logger):
                 if self.async_mode:
                     # pipelined: next request goes out with the update
                     await write_frame(writer, {"type": "update",
-                                               "update": update})
-                    await write_frame(writer, {"type": "job_request"})
+                                               "update": update}, self._secret)
+                    await write_frame(writer, {"type": "job_request"}, self._secret)
                 else:
                     await write_frame(writer, {"type": "update",
-                                               "update": update})
+                                               "update": update}, self._secret)
             elif mtype == "update_ack":
                 if not self.async_mode:
-                    await write_frame(writer, {"type": "job_request"})
+                    await write_frame(writer, {"type": "job_request"}, self._secret)
         return False
 
     async def _do_job(self, job):

@@ -1,51 +1,100 @@
-"""Wire protocol: length-prefixed pickled frames over asyncio TCP.
+"""Wire protocol: length-prefixed, HMAC-authenticated pickled frames.
 
 Replaces the reference's two-plane fabric (Twisted JSON-lines control +
 ZeroMQ streaming-pickle data, ``network_common.py`` + ``txzmq/``) with one
 asyncio stream. Frames:
 
-    [4-byte big-endian length][1-byte codec][payload]
+    [4-byte big-endian length][1-byte codec][32-byte HMAC-SHA256][payload]
 
 codec 0 = raw pickle, 1 = gzip pickle (auto-chosen by size, mirroring the
 reference's pluggable chunk compression). Messages are dicts with a "type"
 key; job/update payloads ride inside them as pickled python objects (the
 units' generate/apply contracts define their content).
+
+Security: pickle is required for arbitrary job/update pytrees, so EVERY
+frame — including the pre-handshake hello — is authenticated with a
+shared-secret HMAC verified *before* any decompression or unpickling; a
+peer without the secret cannot reach ``pickle.loads``. The secret comes
+from (in priority order) an explicit argument, ``$VELES_TPU_FLEET_SECRET``,
+``root.common.fleet.secret``, or defaults to the workflow checksum — which
+both sides must share anyway (the reference's compatibility check,
+``workflow.py:847-862``), so possession of the workflow file is the
+minimum bar. Masters bind 127.0.0.1 unless an interface is given.
 """
 
-import asyncio
 import gzip
 import hashlib
+import hmac as hmac_lib
 import os
 import pickle
 import struct
 import uuid
 
 COMPRESS_THRESHOLD = 64 * 1024
+MAX_FRAME = 1 << 30
 
 _HEADER = struct.Struct(">IB")
+_MAC_SIZE = hashlib.sha256().digest_size
 
 
-def encode_frame(message):
+class ProtocolError(Exception):
+    """Malformed or unauthenticated frame."""
+
+
+def resolve_secret(workflow=None, secret=None):
+    """The shared fleet secret as bytes (see module docstring)."""
+    if secret is None:
+        secret = os.environ.get("VELES_TPU_FLEET_SECRET")
+    if secret is None:
+        from veles_tpu.core.config import root
+        secret = root.common.fleet.get("secret")
+    if secret is None and workflow is not None:
+        secret = getattr(workflow, "checksum", None)
+    if secret is None:
+        raise ProtocolError(
+            "no fleet secret: pass secret=, set VELES_TPU_FLEET_SECRET "
+            "or root.common.fleet.secret, or give the workflow a checksum")
+    if isinstance(secret, str):
+        secret = secret.encode()
+    return secret
+
+
+def _mac(key, codec, payload):
+    return hmac_lib.new(key, bytes([codec]) + payload,
+                        hashlib.sha256).digest()
+
+
+def encode_frame(message, key):
     payload = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
     codec = 0
     if len(payload) >= COMPRESS_THRESHOLD:
         compressed = gzip.compress(payload, compresslevel=1)
         if len(compressed) < len(payload):
             payload, codec = compressed, 1
-    return _HEADER.pack(len(payload), codec) + payload
+    return (_HEADER.pack(len(payload), codec) + _mac(key, codec, payload)
+            + payload)
 
 
-async def read_frame(reader):
+async def read_frame(reader, key, max_frame=MAX_FRAME):
+    """``max_frame`` caps the pre-verification buffer: servers read the
+    pre-auth hello with a small cap so an unauthenticated peer cannot make
+    us buffer a giant bogus payload before the MAC check rejects it."""
     header = await reader.readexactly(_HEADER.size)
     length, codec = _HEADER.unpack(header)
+    if length > max_frame:
+        raise ProtocolError("frame length %d exceeds limit %d"
+                            % (length, max_frame))
+    mac = await reader.readexactly(_MAC_SIZE)
     payload = await reader.readexactly(length)
+    if not hmac_lib.compare_digest(mac, _mac(key, codec, payload)):
+        raise ProtocolError("frame failed HMAC authentication")
     if codec == 1:
         payload = gzip.decompress(payload)
     return pickle.loads(payload)
 
 
-async def write_frame(writer, message):
-    writer.write(encode_frame(message))
+async def write_frame(writer, message, key):
+    writer.write(encode_frame(message, key))
     await writer.drain()
 
 

@@ -18,11 +18,14 @@ Reference ``veles/server.py``. Kept semantics:
 """
 
 import asyncio
+import os
 import threading
 import time
 
+from veles_tpu.core.config import root
 from veles_tpu.core.logger import Logger
-from veles_tpu.fleet.protocol import read_frame, write_frame
+from veles_tpu.fleet.protocol import (
+    ProtocolError, read_frame, resolve_secret, write_frame)
 
 
 class SlaveDescription:
@@ -59,12 +62,24 @@ class SlaveDescription:
 class Server(Logger):
     """The fleet master (reference ``server.py:659``)."""
 
-    def __init__(self, address, workflow, job_timeout=120.0):
+    def __init__(self, address, workflow, job_timeout=120.0, secret=None):
         super().__init__(logger_name="fleet.Server")
         host, _, port = address.rpartition(":")
-        self.host = host or "0.0.0.0"
+        # loopback by default: an exposed master means remote code
+        # execution for anyone with the secret — opt in explicitly
+        self.host = host or "127.0.0.1"
         self.port = int(port)
         self.workflow = workflow
+        self._secret = resolve_secret(workflow, secret)
+        if (secret is None
+                and not os.environ.get("VELES_TPU_FLEET_SECRET")
+                and root.common.fleet.get("secret") is None
+                and self.host not in ("127.0.0.1", "localhost", "::1")):
+            self.warning(
+                "fleet secret defaulted to the workflow checksum on a "
+                "non-loopback bind (%s) — anyone with the workflow source "
+                "can compute it; set VELES_TPU_FLEET_SECRET or "
+                "root.common.fleet.secret for real deployments", self.host)
         self.job_timeout = job_timeout
         self.slaves = {}
         self.blacklist = set()
@@ -123,20 +138,25 @@ class Server(Logger):
     async def _handle_slave(self, reader, writer):
         sid = None
         try:
-            hello = await read_frame(reader)
+            # pre-auth frame: tiny cap (the hello is a small dict) so an
+            # unauthenticated peer cannot balloon our memory
+            hello = await read_frame(reader, self._secret,
+                                     max_frame=1 << 16)
             if hello.get("type") != "hello":
                 await write_frame(writer, {"type": "error",
-                                           "error": "bad handshake"})
+                                           "error": "bad handshake"}, self._secret)
                 return
             if hello.get("mid") in self.blacklist:
                 await write_frame(writer, {"type": "error",
-                                           "error": "blacklisted"})
+                                           "error": "blacklisted"}, self._secret)
                 return
             checksum = getattr(self.workflow, "checksum", None)
-            if hello.get("checksum") not in (None, checksum):
+            # REQUIRED equality: a missing checksum is a mismatch too —
+            # a slave on different code must never join silently
+            if hello.get("checksum") != checksum:
                 await write_frame(writer, {
                     "type": "error",
-                    "error": "workflow checksum mismatch"})
+                    "error": "workflow checksum mismatch"}, self._secret)
                 self.warning("rejected slave with wrong workflow checksum")
                 return
             self._next_id += 1
@@ -147,11 +167,11 @@ class Server(Logger):
             initial = await self._in_thread(
                 self.workflow.generate_initial_data_for_slave, slave)
             await write_frame(writer, {"type": "welcome", "id": sid,
-                                       "initial": initial})
+                                       "initial": initial}, self._secret)
             self.info("slave %s connected (mid=%s power=%.1f)", sid,
                       slave.mid, slave.power)
             while not self._stopped.is_set():
-                msg = await read_frame(reader)
+                msg = await read_frame(reader, self._secret)
                 mtype = msg.get("type")
                 if mtype == "job_request":
                     await self._serve_job(slave, writer)
@@ -163,6 +183,9 @@ class Server(Logger):
                     break
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
+        except ProtocolError as exc:
+            peer = writer.get_extra_info("peername")
+            self.warning("dropping peer %s: %s", peer, exc)
         except Exception:
             self.exception("slave handler failed")
         finally:
@@ -173,7 +196,7 @@ class Server(Logger):
     async def _serve_job(self, slave, writer):
         if slave.paused:
             await write_frame(writer, {"type": "job", "job": None,
-                                       "paused": True})
+                                       "paused": True}, self._secret)
             return
         slave.state = "GETTING_JOB"
         job = await self._in_thread(self._locked_generate, slave)
@@ -184,12 +207,12 @@ class Server(Logger):
             return
         if job is None:
             slave.state = "IDLE"
-            await write_frame(writer, {"type": "job", "job": None})
+            await write_frame(writer, {"type": "job", "job": None}, self._secret)
             self._maybe_finished()
             return
         slave.state = "WORK"
         slave.job_started = time.time()
-        await write_frame(writer, {"type": "job", "job": job})
+        await write_frame(writer, {"type": "job", "job": job}, self._secret)
         self._watch_hang(slave)
 
     async def _apply_update(self, slave, writer, msg):
@@ -200,7 +223,7 @@ class Server(Logger):
         update = msg.get("update")
         if update is not None:
             await self._in_thread(self._locked_apply, update, slave)
-        await write_frame(writer, {"type": "update_ack"})
+        await write_frame(writer, {"type": "update_ack"}, self._secret)
         slave.state = "WAIT"
         await self._retry_pending()
 
@@ -229,7 +252,10 @@ class Server(Logger):
                     and time.time() - slave.job_started > timeout:
                 self.warning("slave %s hanged (> %.1fs); dropping + "
                              "blacklisting", slave.id, timeout)
-                self.blacklist.add(slave.mid)
+                if slave.mid != "?":
+                    # never blacklist the unknown-mid placeholder: one
+                    # anonymous hang would ban every future such slave
+                    self.blacklist.add(slave.mid)
                 writer = self._writers.get(slave.id)
                 if writer is not None:
                     writer.close()

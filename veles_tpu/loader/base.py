@@ -242,27 +242,21 @@ class Loader(Unit):
         if getattr(self, "sweep_serving", False):
             (klass, matrix, valid_sizes, total, last_of_epoch,
              epoch) = self.serve_next_class_sweep()
-            self.minibatch_class = klass
-            self.minibatch_epoch = epoch
-            self.minibatch_valid_size = total
-            self.last_minibatch.set(True)
-            self.epoch_ended_for_class.set(True)
-            self.epoch_ended.set(last_of_epoch)
+            self._publish_flags(klass, matrix.reshape(-1), total, True,
+                                last_of_epoch, epoch)
             self.minibatch_indices.data = matrix
             self.sweep_valid_sizes = valid_sizes
-            self.samples_served += total
-            self._served_this_epoch += total
-            if last_of_epoch:
-                self.event("epoch", "single", number=self.epoch_number)
-                self._served_this_epoch = 0
+            self._account_served(total, last_of_epoch)
             return
         (klass, indices, valid, last_of_class,
          last_of_epoch, epoch) = self.serve_next_minibatch()
         self._apply_minibatch(klass, indices, valid, last_of_class,
                               last_of_epoch, epoch)
 
-    def _apply_minibatch(self, klass, indices, valid, last_of_class,
-                         last_of_epoch, epoch=0):
+    def _publish_flags(self, klass, indices, valid, last_of_class,
+                       last_of_epoch, epoch):
+        """The serve-side state every consumer reads — single source for
+        both per-minibatch and sweep serving."""
         self.minibatch_class = klass
         self.minibatch_epoch = epoch
         self.minibatch_valid_size = valid
@@ -270,6 +264,18 @@ class Loader(Unit):
         self.last_minibatch.set(last_of_class)
         self.epoch_ended_for_class.set(last_of_class)
         self.epoch_ended.set(last_of_epoch)
+
+    def _account_served(self, valid, last_of_epoch):
+        self.samples_served += valid
+        self._served_this_epoch += valid
+        if last_of_epoch:
+            self.event("epoch", "single", number=self.epoch_number)
+            self._served_this_epoch = 0
+
+    def _apply_minibatch(self, klass, indices, valid, last_of_class,
+                         last_of_epoch, epoch=0):
+        self._publish_flags(klass, indices, valid, last_of_class,
+                            last_of_epoch, epoch)
         padded = self._pad_indices(indices)
         if getattr(self, "fill_data", True):
             self.fill_minibatch(padded, valid)
@@ -278,11 +284,7 @@ class Loader(Unit):
             # the loader only publishes the served indices (host numpy —
             # the transfer rides the fused step's dispatch)
             self.minibatch_indices.data = padded
-        self.samples_served += valid
-        self._served_this_epoch += valid
-        if last_of_epoch:
-            self.event("epoch", "single", number=self.epoch_number)
-            self._served_this_epoch = 0
+        self._account_served(valid, last_of_epoch)
 
     def _pad_indices(self, indices):
         """Static shapes: pad short index blocks by repeating index 0; the
