@@ -1,0 +1,5 @@
+"""Config for samples/mnist784.py — executable Python mutating ``root``."""
+
+root.mnist784.update({  # noqa: F821  (root is injected by the CLI)
+    "max_epochs": 50,
+})

@@ -1,0 +1,118 @@
+"""Downloader unit + idx/MNIST pipeline, exercised fully offline via
+local files (the reference tested its downloader against fixture
+archives the same way)."""
+
+import gzip
+import hashlib
+import os
+import struct
+import tarfile
+
+import numpy
+import pytest
+
+from veles_tpu.downloader import Downloader, fetch
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.mnist import FILES, MNISTLoader, read_idx
+
+
+def _write_idx(path, array):
+    codes = {numpy.uint8: 0x08, numpy.int32: 0x0C, numpy.float32: 0x0D}
+    code = codes[array.dtype.type]
+    with open(path, "wb") as out:
+        out.write(struct.pack(">HBB", 0, code, array.ndim))
+        out.write(struct.pack(">" + "I" * array.ndim, *array.shape))
+        out.write(array.astype(array.dtype.newbyteorder(">")).tobytes())
+
+
+def _fake_mnist(directory, n_train=120, n_test=40):
+    rng = numpy.random.RandomState(0)
+    os.makedirs(directory, exist_ok=True)
+    sets = {"train": n_train, "t10k": n_test}
+    for prefix, n in sets.items():
+        images = rng.randint(0, 256, (n, 28, 28)).astype(numpy.uint8)
+        labels = rng.randint(0, 10, n).astype(numpy.uint8)
+        _write_idx(os.path.join(
+            directory, "%s-images-idx3-ubyte" % prefix), images)
+        _write_idx(os.path.join(
+            directory, "%s-labels-idx1-ubyte" % prefix), labels)
+
+
+def test_idx_roundtrip(tmp_path):
+    arr = numpy.arange(24, dtype=numpy.int32).reshape(2, 3, 4)
+    path = str(tmp_path / "x.idx")
+    _write_idx(path, arr)
+    numpy.testing.assert_array_equal(read_idx(path), arr)
+    # gzipped variant
+    with open(path, "rb") as fin, gzip.open(path + ".gz", "wb") as out:
+        out.write(fin.read())
+    numpy.testing.assert_array_equal(read_idx(path + ".gz"), arr)
+
+
+def test_fetch_local_targz(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.txt").write_text("hello")
+    archive = str(tmp_path / "data.tar.gz")
+    with tarfile.open(archive, "w:gz") as tar:
+        tar.add(str(src / "a.txt"), arcname="a.txt")
+    out = str(tmp_path / "out")
+    extracted = fetch(archive, out)
+    assert os.path.exists(os.path.join(out, "a.txt"))
+    assert any(p.endswith("a.txt") for p in extracted)
+
+
+def test_fetch_checksum_mismatch(tmp_path):
+    payload = tmp_path / "x.bin"
+    payload.write_bytes(b"data")
+    with pytest.raises(ValueError):
+        fetch(str(payload), str(tmp_path / "out"), checksum="0" * 64)
+    good = hashlib.sha256(b"data").hexdigest()
+    fetch(str(payload), str(tmp_path / "out2"), checksum=good)
+
+
+def test_downloader_unit_file_url(tmp_path):
+    src = tmp_path / "dataset.tar.gz"
+    inner = tmp_path / "weights.npy"
+    numpy.save(str(inner), numpy.zeros(3))
+    with tarfile.open(str(src), "w:gz") as tar:
+        tar.add(str(inner), arcname="weights.npy")
+    wf = DummyWorkflow()
+    dl = Downloader(wf, url="file://" + str(src),
+                    directory=str(tmp_path / "dst"),
+                    files=["weights.npy"])
+    assert dl.initialize() is None
+    assert os.path.exists(str(tmp_path / "dst" / "weights.npy"))
+    # second initialize short-circuits (no refetch of a removed source)
+    src.unlink()
+    assert dl.initialize() is None
+
+
+def test_mnist_loader_and_training(tmp_path):
+    """The full MNIST784 pipeline on synthetic idx files: load, split
+    [0, test, train], train one epoch through the product path."""
+    from veles_tpu.core import prng
+    from veles_tpu.models.mlp import MLPWorkflow
+
+    data_dir = str(tmp_path / "mnist")
+    _fake_mnist(data_dir)
+    prng.get("default").seed(1)
+    prng.get("loader").seed(1)
+    wf = MLPWorkflow(
+        DummyLauncher(), layers=(16, 10), loader_cls=MNISTLoader,
+        loader_kwargs=dict(directory=data_dir, minibatch_size=20),
+        learning_rate=0.05, max_epochs=1, name="mnist-test")
+    wf.initialize()
+    assert wf.loader.class_lengths == [0, 40, 120]
+    assert wf.loader.original_data.shape == (160, 784)
+    wf.run()
+    assert wf.decision._epochs_done == 1
+    assert wf.decision.best_n_err[VALID] is not None
+
+
+def test_mnist_loader_missing_files(tmp_path):
+    wf = DummyWorkflow()
+    loader = MNISTLoader(wf, directory=str(tmp_path / "nope"))
+    with pytest.raises(FileNotFoundError):
+        loader.load_data()

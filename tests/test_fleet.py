@@ -102,13 +102,18 @@ class TestProtocol:
             asyncio.get_event_loop().run_until_complete(
                 read_frame(FakeReader(bytes(frame)), KEY))
 
-    def test_secret_defaults_to_workflow_checksum(self):
+    def test_secret_defaults_to_workflow_checksum(self, monkeypatch):
+        from veles_tpu.core.config import root
         from veles_tpu.fleet.protocol import resolve_secret
+
+        monkeypatch.delenv("VELES_TPU_FLEET_SECRET", raising=False)
+        assert root.common.fleet.get("secret") is None
 
         class WF:
             checksum = "abc123"
 
-        assert resolve_secret(WF()) == b"abc123"
+        secret, source = resolve_secret(WF(), with_source=True)
+        assert secret == b"abc123" and source == "checksum"
 
     def test_machine_id_stable(self):
         assert machine_id() == machine_id()

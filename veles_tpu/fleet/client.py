@@ -125,6 +125,10 @@ class Client(Logger):
                 else:
                     attempts = 0
                     self.warning("connection to master lost; reconnecting")
+                    # breathe before reconnecting: a master that welcomes
+                    # then consistently drops would otherwise be hammered
+                    # by a zero-backoff loop
+                    await asyncio.sleep(0.2)
             finally:
                 writer.close()
 

@@ -41,22 +41,28 @@ class ProtocolError(Exception):
     """Malformed or unauthenticated frame."""
 
 
-def resolve_secret(workflow=None, secret=None):
-    """The shared fleet secret as bytes (see module docstring)."""
+def resolve_secret(workflow=None, secret=None, with_source=False):
+    """The shared fleet secret as bytes (see module docstring). With
+    ``with_source=True`` returns ``(secret, source)`` where source is one
+    of "explicit"/"env"/"config"/"checksum"."""
+    source = "explicit"
     if secret is None:
         secret = os.environ.get("VELES_TPU_FLEET_SECRET")
+        source = "env"
     if secret is None:
         from veles_tpu.core.config import root
         secret = root.common.fleet.get("secret")
+        source = "config"
     if secret is None and workflow is not None:
         secret = getattr(workflow, "checksum", None)
+        source = "checksum"
     if secret is None:
         raise ProtocolError(
             "no fleet secret: pass secret=, set VELES_TPU_FLEET_SECRET "
             "or root.common.fleet.secret, or give the workflow a checksum")
     if isinstance(secret, str):
         secret = secret.encode()
-    return secret
+    return (secret, source) if with_source else secret
 
 
 def _mac(key, codec, payload):
@@ -71,6 +77,13 @@ def encode_frame(message, key):
         compressed = gzip.compress(payload, compresslevel=1)
         if len(compressed) < len(payload):
             payload, codec = compressed, 1
+    if len(payload) > MAX_FRAME:
+        # fail at the SENDER with a clear message — the receiver would
+        # reject it as a protocol violation and misdiagnose the cause
+        raise ProtocolError(
+            "outgoing %r frame is %d bytes (limit %d): shrink the "
+            "job/update payload" % (message.get("type", "?"),
+                                    len(payload), MAX_FRAME))
     return (_HEADER.pack(len(payload), codec) + _mac(key, codec, payload)
             + payload)
 

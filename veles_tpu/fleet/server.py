@@ -18,11 +18,9 @@ Reference ``veles/server.py``. Kept semantics:
 """
 
 import asyncio
-import os
 import threading
 import time
 
-from veles_tpu.core.config import root
 from veles_tpu.core.logger import Logger
 from veles_tpu.fleet.protocol import (
     ProtocolError, read_frame, resolve_secret, write_frame)
@@ -70,11 +68,10 @@ class Server(Logger):
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.workflow = workflow
-        self._secret = resolve_secret(workflow, secret)
-        if (secret is None
-                and not os.environ.get("VELES_TPU_FLEET_SECRET")
-                and root.common.fleet.get("secret") is None
-                and self.host not in ("127.0.0.1", "localhost", "::1")):
+        self._secret, source = resolve_secret(workflow, secret,
+                                              with_source=True)
+        if source == "checksum" \
+                and self.host not in ("127.0.0.1", "localhost", "::1"):
             self.warning(
                 "fleet secret defaulted to the workflow checksum on a "
                 "non-loopback bind (%s) — anyone with the workflow source "

@@ -1,0 +1,90 @@
+"""MNIST loader: the idx-format pipeline behind the MNIST784 parity model.
+
+The reference's MNIST workflow (znicz MNIST784 sample; topology and error
+anchors in ``docs/source/manualrst_veles_example.rst:55-66``) reads the
+LeCun idx files. This loader parses idx1 (labels) / idx3 (images) —
+gzipped or raw — into a device-resident FullBatchLoader with the
+reference's split: the 10k test set serves as VALIDATION, the 60k train
+set as TRAIN (class order [test=0, valid=10000, train=60000]).
+
+Files are fetched via :mod:`veles_tpu.downloader` when ``url_base`` is
+given; offline runs point ``directory`` at pre-downloaded files.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+
+from veles_tpu.core.config import root
+from veles_tpu.loader.base import register_loader
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+#: idx payloads are big-endian (the format predates little-endian wins)
+IDX_DTYPES = {0x08: ">u1", 0x09: ">i1", 0x0B: ">i2",
+              0x0C: ">i4", 0x0D: ">f4", 0x0E: ">f8"}
+
+
+def read_idx(path):
+    """Parse one idx file (``.gz`` accepted) into a numpy array."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fin:
+        zero, dtype_code, ndim = struct.unpack(">HBB", fin.read(4))
+        if zero != 0 or dtype_code not in IDX_DTYPES:
+            raise ValueError("%s: not an idx file" % path)
+        shape = struct.unpack(">" + "I" * ndim, fin.read(4 * ndim))
+        data = numpy.frombuffer(fin.read(), IDX_DTYPES[dtype_code])
+    return data.reshape(shape).astype(data.dtype.newbyteorder("="))
+
+
+@register_loader("mnist")
+class MNISTLoader(FullBatchLoader):
+    """MNIST via idx files (the MNIST784 data pipeline)."""
+
+    def __init__(self, workflow, directory=None, url_base=None, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super().__init__(workflow, **kwargs)
+        self.directory = directory or os.path.join(
+            root.common.dirs.get("datasets"), "mnist")
+        self.url_base = url_base
+
+    def _resolve(self, stem):
+        for name in (stem, stem + ".gz"):
+            path = os.path.join(self.directory, name)
+            if os.path.exists(path):
+                return path
+        return None
+
+    def load_data(self):
+        if any(self._resolve(stem) is None for stem in FILES.values()):
+            if self.url_base is None:
+                raise FileNotFoundError(
+                    "%s: idx files not found in %s and no url_base given"
+                    % (self.name, self.directory))
+            from veles_tpu.downloader import fetch
+            for stem in FILES.values():
+                if self._resolve(stem) is None:
+                    fetch(self.url_base.rstrip("/") + "/" + stem + ".gz",
+                          self.directory, logger=self)
+        train_x = read_idx(self._resolve(FILES["train_images"]))
+        train_y = read_idx(self._resolve(FILES["train_labels"]))
+        test_x = read_idx(self._resolve(FILES["test_images"]))
+        test_y = read_idx(self._resolve(FILES["test_labels"]))
+        n_valid, n_train = len(test_x), len(train_x)
+        data = numpy.concatenate([
+            test_x.reshape(n_valid, -1).astype(numpy.float32),
+            train_x.reshape(n_train, -1).astype(numpy.float32)])
+        labels = numpy.concatenate([
+            test_y.astype(numpy.int32), train_y.astype(numpy.int32)])
+        self._provided_data = data
+        self._provided_labels = labels
+        self._provided_lengths = [0, n_valid, n_train]
+        super().load_data()
