@@ -107,7 +107,9 @@ class TestProtocol:
         from veles_tpu.fleet.protocol import resolve_secret
 
         monkeypatch.delenv("VELES_TPU_FLEET_SECRET", raising=False)
-        assert root.common.fleet.get("secret") is None
+        # root is a process-global singleton: force the unset state rather
+        # than assuming no earlier test configured a secret
+        monkeypatch.setattr(root.common.fleet, "secret", None, raising=False)
 
         class WF:
             checksum = "abc123"

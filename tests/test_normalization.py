@@ -1,0 +1,258 @@
+"""Tests for the normalizer registry (mirrors reference
+test_normalization.py semantics) and the loader label analysis."""
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+from veles_tpu.loader.normalization import (make_normalizer,
+                                            normalizer_registry)
+
+
+def sample_data():
+    rng = numpy.random.RandomState(7)
+    return rng.uniform(-3, 5, size=(40, 6)).astype(numpy.float32)
+
+
+class TestRegistry:
+    def test_all_eight_registered(self):
+        assert set(normalizer_registry) == {
+            "none", "mean_disp", "linear", "range_linear", "exp",
+            "pointwise", "external_mean", "internal_mean"}
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            make_normalizer("bogus")
+
+
+class TestRoundTrips:
+    """normalize → denormalize recovers the input for every invertible
+    normalizer (the reference guarantees this via coefficients/state)."""
+
+    def test_none(self):
+        n = make_normalizer("none")
+        data = sample_data()
+        numpy.testing.assert_array_equal(n.normalize(data), data)
+        numpy.testing.assert_array_equal(n.denormalize(data), data)
+
+    def test_mean_disp(self):
+        n = make_normalizer("mean_disp")
+        data = sample_data()
+        n.analyze(data)
+        normed = n.normalize(data)
+        assert abs(float(normed.mean(axis=0).max())) < 1e-4
+        numpy.testing.assert_allclose(n.denormalize(normed), data,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_mean_disp_incremental_equals_single_pass(self):
+        data = sample_data()
+        whole, parts = make_normalizer("mean_disp"), \
+            make_normalizer("mean_disp")
+        whole.analyze(data)
+        parts.analyze(data[:13])
+        parts.analyze(data[13:])
+        numpy.testing.assert_allclose(whole.normalize(data),
+                                      parts.normalize(data), rtol=1e-5)
+
+    def test_linear_samplewise(self):
+        n = make_normalizer("linear", interval=(-1, 1))
+        data = sample_data()
+        normed, stats = n.normalize_with_stats(data)
+        assert normed.min() >= -1.0 - 1e-5 and normed.max() <= 1.0 + 1e-5
+        # every sample spans the full interval
+        numpy.testing.assert_allclose(normed.max(axis=1),
+                                      numpy.ones(len(data)), rtol=1e-5)
+        numpy.testing.assert_allclose(n.denormalize(normed, **stats), data,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_linear_uniform_sample_midpoint(self):
+        n = make_normalizer("linear", interval=(0, 2))
+        data = numpy.ones((2, 4), numpy.float32) * 9.0
+        normed = n.normalize(data)
+        numpy.testing.assert_allclose(normed, 1.0)
+
+    def test_range_linear(self):
+        n = make_normalizer("range_linear", interval=(0, 1))
+        data = sample_data()
+        n.analyze(data)
+        normed = n.normalize(data)
+        assert normed.min() >= -1e-6 and normed.max() <= 1 + 1e-6
+        numpy.testing.assert_allclose(n.denormalize(normed), data,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_range_linear_negative_max(self):
+        # regression: dmax == 0 must not be treated as "no range"
+        n = make_normalizer("range_linear", interval=(-1, 1))
+        data = numpy.linspace(-5, 0, 20, dtype=numpy.float32).reshape(4, 5)
+        n.analyze(data)
+        normed = n.normalize(data)
+        assert abs(float(normed.min()) + 1) < 1e-5
+        assert abs(float(normed.max()) - 1) < 1e-5
+        numpy.testing.assert_allclose(n.denormalize(normed), data,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_range_linear_rejects_drifting_range(self):
+        n = make_normalizer("range_linear")
+        n.analyze(sample_data())
+        with pytest.raises(ValueError):
+            n.analyze(sample_data() * 100)
+
+    def test_exp_is_softmax(self):
+        n = make_normalizer("exp")
+        data = sample_data()
+        normed, stats = n.normalize_with_stats(data)
+        numpy.testing.assert_allclose(normed.sum(axis=1),
+                                      numpy.ones(len(data)), rtol=1e-5)
+        numpy.testing.assert_allclose(n.denormalize(normed, **stats), data,
+                                      rtol=1e-3, atol=1e-3)
+
+    def test_pointwise(self):
+        n = make_normalizer("pointwise")
+        data = sample_data()
+        data[:, 2] = 4.0  # constant feature
+        n.analyze(data)
+        normed = n.normalize(data)
+        assert normed[:, 2].max() == 0.0  # constant -> 0
+        assert normed.min() >= -1 - 1e-5 and normed.max() <= 1 + 1e-5
+        numpy.testing.assert_allclose(n.denormalize(normed), data,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_internal_mean(self):
+        n = make_normalizer("internal_mean", scale=2.0)
+        data = sample_data()
+        n.analyze(data)
+        normed = n.normalize(data)
+        numpy.testing.assert_allclose(
+            normed, (data - data.mean(axis=0)) * 2.0, rtol=1e-4, atol=1e-4)
+        numpy.testing.assert_allclose(n.denormalize(normed), data,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_external_mean_from_npy(self, tmp_path):
+        mean = sample_data().mean(axis=0)
+        path = str(tmp_path / "mean.npy")
+        numpy.save(path, mean)
+        n = make_normalizer("external_mean", mean_source=path)
+        data = sample_data()
+        numpy.testing.assert_allclose(n.normalize(data), data - mean,
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_external_mean_from_ndarray(self):
+        mean = numpy.ones(6, numpy.float32)
+        n = make_normalizer("external_mean", mean_source=mean)
+        data = sample_data()
+        numpy.testing.assert_allclose(n.normalize(data), data - 1.0,
+                                      rtol=1e-5)
+
+
+class TestStatePersistence:
+    def test_state_roundtrip(self):
+        n = make_normalizer("mean_disp")
+        data = sample_data()
+        n.analyze(data)
+        clone = make_normalizer("mean_disp", state=n.state)
+        numpy.testing.assert_allclose(clone.normalize(data),
+                                      n.normalize(data))
+
+    def test_uninitialized_normalize_raises(self):
+        with pytest.raises(RuntimeError):
+            make_normalizer("mean_disp").normalize(sample_data())
+
+
+class TestLoaderIntegration:
+    def test_fullbatch_normalization_types(self):
+        for norm in ("none", "mean_disp", "pointwise", "internal_mean"):
+            loader = FullBatchLoader(
+                DummyWorkflow(), data=sample_data(),
+                labels=numpy.arange(40) % 4,
+                class_lengths=[0, 8, 32], minibatch_size=8,
+                normalization_type=norm)
+            loader.initialize()
+            loader.run()
+            assert loader.minibatch_data.shape == (8, 6)
+
+    def test_label_automapping_strings(self):
+        labels = numpy.array((["cat"] * 5 + ["dog"] * 5) * 4)
+        loader = FullBatchLoader(
+            DummyWorkflow(), data=sample_data(),
+            labels=labels, class_lengths=[0, 10, 30], minibatch_size=10)
+        loader.initialize()
+        assert loader.labels_mapping == {"cat": 0, "dog": 1}
+        assert loader.reversed_labels_mapping == ["cat", "dog"]
+        assert loader.unique_labels_count == 2
+        mapped = numpy.asarray(loader.original_labels.mem)
+        assert set(mapped.tolist()) == {0, 1}
+
+    def test_unknown_validation_label_rejected(self):
+        labels = numpy.array(["odd"] * 10 + ["a"] * 15 + ["b"] * 15)
+        loader = FullBatchLoader(
+            DummyWorkflow(), data=sample_data(),
+            labels=labels, class_lengths=[0, 10, 30])
+        with pytest.raises(ValueError, match="missing from the training"):
+            loader.initialize()
+
+
+class TestMSELoader:
+    def make(self, **kwargs):
+        data = sample_data()
+        targets = (data[:, :2] * 3.0 + 1.0).astype(numpy.float32)
+        loader = FullBatchLoaderMSE(
+            DummyWorkflow(), data=data, targets=targets,
+            class_lengths=[0, 8, 32], minibatch_size=8, **kwargs)
+        loader.initialize()
+        return loader, targets
+
+    def test_serves_targets(self):
+        loader, targets = self.make()
+        loader.run()
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        got = numpy.asarray(loader.minibatch_targets.mem)
+        numpy.testing.assert_allclose(got, targets[idx], rtol=1e-5)
+        assert loader.targets_shape == (2,)
+
+    def test_target_normalizer_denormalizes(self):
+        loader, targets = self.make(
+            target_normalization_type="mean_disp")
+        loader.run()
+        got = numpy.asarray(loader.minibatch_targets.mem)
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        back = loader.target_normalizer.denormalize(got)
+        numpy.testing.assert_allclose(back, targets[idx], rtol=1e-3,
+                                      atol=1e-3)
+
+    def test_targets_respliced_with_validation_ratio(self):
+        # regression: resplit must keep targets row-aligned with data
+        data = sample_data()
+        targets = (data[:, :1] * 2.0).astype(numpy.float32)
+        loader = FullBatchLoaderMSE(
+            DummyWorkflow(), data=data, targets=targets,
+            class_lengths=[0, 0, 40], minibatch_size=10,
+            validation_ratio=0.25)
+        loader.initialize()
+        assert loader.class_lengths == [0, 10, 30]
+        for _ in range(4):
+            loader.run()
+            idx = numpy.asarray(loader.minibatch_indices.mem)
+            got = numpy.asarray(loader.minibatch_targets.mem)
+            rows = numpy.asarray(loader.original_data.mem)[idx]
+            numpy.testing.assert_allclose(got, rows[:, :1] * 2.0,
+                                          rtol=1e-5)
+
+    def test_stateless_target_normalizer_rejected(self):
+        with pytest.raises(ValueError, match="stateless"):
+            FullBatchLoaderMSE(
+                DummyWorkflow(), data=sample_data(),
+                targets=sample_data()[:, :2],
+                target_normalization_type="exp")
+
+
+class TestOnInitialized:
+    def test_callback_fires(self):
+        fired = []
+        loader = FullBatchLoader(
+            DummyWorkflow(), data=sample_data(),
+            class_lengths=[0, 8, 32],
+            on_initialized=lambda: fired.append(True))
+        loader.initialize()
+        assert fired == [True]

@@ -99,16 +99,6 @@ class TestDataOps:
         batch = ops.gather_minibatch(data, idx, scale=2.0, shift=-1.0)
         numpy.testing.assert_array_equal(batch, numpy.ones((2, 3)))
 
-    def test_mean_disp(self):
-        from veles_tpu.ops.normalize import (compute_mean_disp,
-                                             mean_disp_normalize)
-        rng = numpy.random.RandomState(5)
-        data = jnp.asarray(rng.rand(100, 7).astype(numpy.float32) * 10)
-        mean, rdisp = compute_mean_disp(data)
-        normed = mean_disp_normalize(data, mean, rdisp)
-        assert abs(float(jnp.mean(normed))) < 1e-5
-        assert float(jnp.max(normed)) <= 1.0 + 1e-5
-
     def test_rng_reproducible(self):
         key = jax.random.PRNGKey(42)
         a = ops.uniform(key, (4, 4))

@@ -1,5 +1,8 @@
 """veles_tpu.loader: the data layer (reference ``veles/loader/``)."""
 
 from veles_tpu.loader.base import (  # noqa: F401
-    Loader, TEST, VALID, TRAIN, CLASS_NAMES)
-from veles_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401
+    Loader, LoaderMSEMixin, TEST, VALID, TRAIN, CLASS_NAMES)
+from veles_tpu.loader.fullbatch import (  # noqa: F401
+    FullBatchLoader, FullBatchLoaderMSE)
+from veles_tpu.loader.normalization import (  # noqa: F401
+    make_normalizer, normalizer_registry)

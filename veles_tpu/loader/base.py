@@ -60,9 +60,20 @@ class Loader(Unit):
         self.minibatch_size = kwargs.pop("minibatch_size", 100)
         self.train_ratio = kwargs.pop(
             "train_ratio", root.common.get("train_ratio", 1.0))
-        self.shuffle_limit = kwargs.pop("shuffle_limit", None)
+        # config-driven default (reference root.common.loader.shuffle_limit)
+        self.shuffle_limit = kwargs.pop(
+            "shuffle_limit", root.common.loader.get("shuffle_limit", None))
         self.prng_key = kwargs.pop("prng_key", "loader")
+        on_initialized = kwargs.pop("on_initialized", None)
         super().__init__(workflow, **kwargs)
+        # after super(): init_unpickled resets the slot (trailing-underscore
+        # attrs are rebuilt, not pickled — the callback does not survive
+        # snapshots, like the reference's marshal-pickled variant)
+        self._on_initialized_ = on_initialized
+        #: raw label -> contiguous class index (reference
+        #: ``loader/base.py:925-944`` auto-mapping)
+        self.labels_mapping = {}
+        self._reversed_labels_mapping = []
         self.class_lengths = [0, 0, 0]
         self.epoch_number = 0
         self.samples_served = 0
@@ -90,6 +101,7 @@ class Loader(Unit):
     def init_unpickled(self):
         super().init_unpickled()
         self.pending_minibatches_ = collections.defaultdict(list)
+        self._on_initialized_ = None
 
     # -- the ILoader contract (reference loader/base.py:100-115) -------------
     def load_data(self):
@@ -139,7 +151,121 @@ class Loader(Unit):
                     numpy.arange(length, dtype=numpy.int64)
                     + self.class_offset(klass))
             self._shuffle_train()
+        self.analyze_dataset()
         self.create_minibatch_data()
+        if self._on_initialized_ is not None:
+            self._on_initialized_()
+
+    # -- label analysis (reference loader/base.py:925-1018) ------------------
+    def get_raw_labels(self):
+        """Full-length label array aligned with the [test|valid|train] row
+        layout, or None when the dataset has no labels. Hook for
+        subclasses; drives label mapping and distribution checks."""
+        return None
+
+    @property
+    def has_labels(self):
+        return self.get_raw_labels() is not None
+
+    @property
+    def unique_labels_count(self):
+        return len(self.labels_mapping)
+
+    @property
+    def reversed_labels_mapping(self):
+        """index -> raw label (for denormalizing predictions)."""
+        return self._reversed_labels_mapping
+
+    def map_labels(self, raw):
+        """Raw labels -> contiguous int32 indices via labels_mapping."""
+        raw = numpy.asarray(raw)
+        if not self.labels_mapping:
+            return raw.astype(numpy.int32)
+        return numpy.fromiter(
+            (self.labels_mapping[l] for l in raw.tolist()),
+            numpy.int32, count=len(raw))
+
+    def analyze_dataset(self):
+        """Build the label auto-mapping from the train split, check the
+        test/validation labels are a subset, log per-class cardinality
+        stats, and chi-square-compare the split distributions (reference
+        ``loader/base.py:925-1018``)."""
+        raw = self.get_raw_labels()
+        if raw is None:
+            return
+        counters = []
+        for klass in (TEST, VALID, TRAIN):
+            start = self.class_offset(klass)
+            counters.append(collections.Counter(
+                numpy.asarray(raw[start:start + self.class_lengths[klass]]
+                              ).tolist()))
+        self._setup_labels_mapping(counters)
+
+    def _setup_labels_mapping(self, counters):
+        test_counts, valid_counts, train_counts = counters
+        if not self.labels_mapping:
+            # evaluation-only datasets (empty train split) map over ALL
+            # labels; the subset check below is train-relative so it only
+            # applies when a train split exists
+            source = sorted(train_counts) if train_counts else sorted(
+                set(test_counts) | set(valid_counts))
+            self.labels_mapping.update(
+                {k: i for i, k in enumerate(source)})
+            self._reversed_labels_mapping = sorted(self.labels_mapping)
+        self._print_label_stats(train_counts, CLASS_NAMES[TRAIN])
+        for klass, counts in ((TEST, test_counts), (VALID, valid_counts)):
+            if not self.class_lengths[klass] or not train_counts:
+                continue
+            unknown = set(counts) - set(self.labels_mapping)
+            if unknown:
+                raise ValueError(
+                    "%s: %s labels missing from the training set: %s"
+                    % (self.name, CLASS_NAMES[klass], sorted(unknown)))
+            missing = set(self.labels_mapping) - set(counts)
+            if missing:
+                self.warning("no %s samples for labels: %s",
+                             CLASS_NAMES[klass], sorted(missing))
+                for label in missing:
+                    counts[label] = 0
+            self._print_label_stats(counts, CLASS_NAMES[klass])
+            self._compare_label_distributions(train_counts, counts,
+                                              CLASS_NAMES[klass])
+
+    def _print_label_stats(self, counts, set_name):
+        values = numpy.array([v for _, v in sorted(counts.items())])
+        if not values.sum():
+            self.info("no %s labels specified", set_name)
+            return
+        mean = float(values.mean())
+        std = float(values.std())
+        self.info(
+            "%s label cardinalities: min=%d max=%d avg=%d sigma=%d (%d%%)",
+            set_name, values.min(), values.max(), mean, std,
+            std * 100 // max(mean, 1))
+        if std > mean / 2:
+            self.warning("%s labels are heavily imbalanced", set_name)
+
+    def _compare_label_distributions(self, train_counts, other_counts,
+                                     other_name):
+        """Chi-square test that the split's label distribution matches the
+        train split's (reference ``loader/base.py:1006-1018``)."""
+        try:
+            from scipy.stats import chisquare
+        except ImportError:  # scipy is optional
+            return
+        train = numpy.array(
+            [v for _, v in sorted(train_counts.items())], numpy.float64)
+        other = numpy.array(
+            [v for _, v in sorted(other_counts.items())], numpy.float64)
+        if not other.sum() or not train.sum():
+            return
+        _, p = chisquare(other / other.sum(), train / train.sum())
+        if p > 0.95:
+            self.info("OK: train and %s label distributions match "
+                      "(chi-square p=%.3f)", other_name, p)
+        else:
+            self.warning("train and %s label distributions differ "
+                         "(chi-square p=%.3f)", other_name, p)
 
     def restored_from_snapshot(self):
         wf = self.workflow
@@ -339,3 +465,36 @@ class Loader(Unit):
 
     def get_metric_values(self):
         return [self.total_samples]
+
+
+class LoaderMSEMixin:
+    """Adds regression targets to a Loader (reference
+    ``loader/base.py:1034-1155`` LoaderMSEMixin/LoaderMSE).
+
+    Serves ``minibatch_targets`` alongside data/labels, normalized by a
+    *separate* target normalizer whose state supports ``denormalize()`` —
+    stateless normalizers (other than "none") are rejected because the
+    network output could never be mapped back to target units (reference
+    ``base.py:1100-1111``)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.targets_shape = kwargs.pop("targets_shape", ())
+        self.target_normalization_type = kwargs.pop(
+            "target_normalization_type",
+            kwargs.get("normalization_type", "none"))
+        self.target_normalization_parameters = kwargs.pop(
+            "target_normalization_parameters",
+            kwargs.get("normalization_parameters", {}))
+        super().__init__(workflow, **kwargs)
+        from veles_tpu.loader.normalization import normalizer_registry
+        cls = normalizer_registry.get(self.target_normalization_type)
+        if cls is None:
+            raise ValueError("unknown target_normalization_type %r"
+                             % self.target_normalization_type)
+        if cls.STATELESS and cls.MAPPING != "none":
+            raise ValueError(
+                "target normalization %r is stateless: test-time forward "
+                "propagation could not be denormalized"
+                % self.target_normalization_type)
+        self.minibatch_targets = Array()
+        self.target_normalizer = None

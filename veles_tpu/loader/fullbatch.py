@@ -19,10 +19,11 @@ import numpy
 import jax
 import jax.numpy as jnp
 
-from veles_tpu.loader.base import Loader, TRAIN, VALID, register_loader
+from veles_tpu.loader.base import (Loader, LoaderMSEMixin, TRAIN, VALID,
+                                   register_loader)
+from veles_tpu.loader.normalization import make_normalizer
 from veles_tpu.memory import Array
 from veles_tpu.ops.gather import gather_minibatch
-from veles_tpu.ops.normalize import mean_disp_normalize
 
 
 @register_loader("full_batch")
@@ -32,6 +33,8 @@ class FullBatchLoader(Loader):
     def __init__(self, workflow, **kwargs):
         self.on_device = kwargs.pop("on_device", True)
         self.normalization_type = kwargs.pop("normalization_type", "none")
+        self.normalization_parameters = kwargs.pop(
+            "normalization_parameters", {})
         self.validation_ratio = kwargs.pop("validation_ratio", None)
         data = kwargs.pop("data", None)
         labels = kwargs.pop("labels", None)
@@ -42,7 +45,8 @@ class FullBatchLoader(Loader):
         self._provided_data = data
         self._provided_labels = labels
         self._provided_lengths = lengths
-        self.normalizer_state = None
+        self._raw_labels = None
+        self.normalizer = None
 
     # -- ILoader --------------------------------------------------------------
     def load_data(self):
@@ -52,8 +56,7 @@ class FullBatchLoader(Loader):
         data = numpy.asarray(self._provided_data, numpy.float32)
         self.original_data.reset(data)
         if self._provided_labels is not None:
-            self.original_labels.reset(
-                numpy.asarray(self._provided_labels, numpy.int32))
+            self._raw_labels = numpy.asarray(self._provided_labels)
         if self._provided_lengths is not None:
             self.class_lengths = list(self._provided_lengths)
         else:
@@ -64,12 +67,26 @@ class FullBatchLoader(Loader):
         if self.on_device:
             try:
                 self.original_data.to_device()
-                if self.original_labels:
-                    self.original_labels.to_device()
             except Exception as exc:
                 # graceful fallback to host gather (reference OOM path)
                 self.warning("keeping dataset on host: %s", exc)
                 self.on_device = False
+
+    def get_raw_labels(self):
+        return self._raw_labels
+
+    def analyze_dataset(self):
+        """Label mapping first (base), then materialize the int32 label
+        array the device gather uses."""
+        super().analyze_dataset()
+        if self._raw_labels is not None:
+            self.original_labels.reset(self.map_labels(self._raw_labels))
+            if self.on_device:
+                try:
+                    self.original_labels.to_device()
+                except Exception as exc:
+                    self.warning("keeping labels on host: %s", exc)
+                    self.on_device = False
 
     def _resplit_validation(self):
         """Move the tail of TRAIN into VALID (reference
@@ -78,41 +95,37 @@ class FullBatchLoader(Loader):
         # layout is [test | valid | train]; splice the LAST n_valid train
         # rows in after the existing valid block so all three stay contiguous
         valid_end = self.class_offset(TRAIN)
+        total = self.total_samples
         self.class_lengths[VALID] += n_valid
         self.class_lengths[TRAIN] -= n_valid
+        perm = numpy.concatenate([
+            numpy.arange(valid_end),
+            numpy.arange(total - n_valid, total),
+            numpy.arange(valid_end, total - n_valid)])
+        self._apply_resplit(perm)
 
-        def splice(arr):
-            return numpy.concatenate([
-                arr[:valid_end], arr[len(arr) - n_valid:],
-                arr[valid_end:len(arr) - n_valid]])
-
-        self.original_data.reset(splice(self.original_data.mem))
-        if self.original_labels:
-            self.original_labels.reset(splice(self.original_labels.mem))
+    def _apply_resplit(self, perm):
+        """Apply the resplit permutation to every per-sample array; MSE
+        subclasses extend this to keep targets row-aligned."""
+        self.original_data.reset(self.original_data.mem[perm])
+        if self._raw_labels is not None:
+            self._raw_labels = self._raw_labels[perm]
 
     def _analyze_normalization(self):
-        """One pass over the train set for normalizer statistics
-        (reference ``loader/base.py:755-802``)."""
-        if self.normalization_type == "none":
+        """One pass over the train set accumulating normalizer statistics
+        (reference ``loader/base.py:755-802``). Host-side numpy: a device
+        transfer of the whole train split here would defeat the OOM
+        fallback in load_data."""
+        self.normalizer = make_normalizer(self.normalization_type,
+                                          **self.normalization_parameters)
+        if self.normalizer.STATELESS:
             return
         start = self.class_offset(TRAIN)
         train = self.original_data.mem[
             start:start + self.class_lengths[TRAIN]]
         if not len(train):  # no train split (e.g. pure evaluation runs)
             train = self.original_data.mem
-        if self.normalization_type == "mean_disp":
-            # host-side numpy: a device transfer of the whole train split
-            # here would defeat the OOM fallback below
-            mean = train.mean(axis=0)
-            disp = train.max(axis=0) - train.min(axis=0)
-            rdisp = 1.0 / numpy.maximum(disp, 1e-8)
-            self.normalizer_state = {"mean": mean, "rdisp": rdisp}
-        elif self.normalization_type == "linear":
-            vmax = float(numpy.max(numpy.abs(train))) or 1.0
-            self.normalizer_state = {"scale": 1.0 / vmax}
-        else:
-            raise ValueError("unknown normalization_type %r"
-                             % self.normalization_type)
+        self.normalizer.analyze(train)
 
     def create_minibatch_data(self):
         size = self.max_minibatch_size
@@ -131,17 +144,15 @@ class FullBatchLoader(Loader):
     @property
     def _fill_jit(self):
         if self._fill_jit_ is None:
-            norm = self.normalizer_state or {}
-            norm_type = self.normalization_type
+            normalizer = self.normalizer
 
             @jax.jit
             def fill(data, labels, indices, valid):
                 batch, lab = gather_minibatch(data, indices, labels)
-                if norm_type == "mean_disp":
-                    batch = mean_disp_normalize(
-                        batch, norm["mean"], norm["rdisp"])
-                elif norm_type == "linear":
-                    batch = batch * norm["scale"]
+                # normalizer coefficients fold in as XLA constants and the
+                # elementwise math fuses into the gather (retires the
+                # reference's mean_disp_normalizer kernel)
+                batch = normalizer.apply_batch(jnp, batch)
                 mask = (jnp.arange(indices.shape[0]) < valid).astype(
                     jnp.float32)
                 return batch, lab, mask
@@ -159,12 +170,7 @@ class FullBatchLoader(Loader):
             batch = numpy.take(numpy.asarray(data), indices, axis=0)
             lab = numpy.take(numpy.asarray(labels), indices, axis=0)
             mask = (numpy.arange(len(indices)) < valid).astype(numpy.float32)
-            if self.normalization_type == "mean_disp":
-                batch = (batch - numpy.asarray(
-                    self.normalizer_state["mean"])) * numpy.asarray(
-                    self.normalizer_state["rdisp"])
-            elif self.normalization_type == "linear":
-                batch = batch * self.normalizer_state["scale"]
+            batch = self.normalizer.apply_batch(numpy, batch)
             self.minibatch_data.data = jnp.asarray(batch)
             self.minibatch_labels.data = jnp.asarray(lab)
             self.sample_mask.data = jnp.asarray(mask)
@@ -175,3 +181,73 @@ class FullBatchLoader(Loader):
             self.minibatch_labels.data = lab
             self.sample_mask.data = mask
         self.minibatch_indices.data = idx
+
+
+@register_loader("full_batch_mse")
+class FullBatchLoaderMSE(LoaderMSEMixin, FullBatchLoader):
+    """Full-batch loader with regression targets (reference
+    ``loader/fullbatch.py`` FullBatchLoaderMSE + ``base.py:1147``).
+
+    Targets live beside the data as a device-resident ``original_targets``
+    array; the minibatch target gather rides the same jitted fill. The
+    target normalizer accumulates over the train split and its
+    ``denormalize()`` maps network output back to target units."""
+
+    def __init__(self, workflow, **kwargs):
+        targets = kwargs.pop("targets", None)
+        super().__init__(workflow, **kwargs)
+        self.original_targets = Array()
+        self._provided_targets = targets
+
+    def _apply_resplit(self, perm):
+        super()._apply_resplit(perm)
+        # targets must stay row-aligned with the respliced data
+        self._provided_targets = self._provided_targets[perm]
+
+    def load_data(self):
+        if self._provided_targets is None:
+            raise NotImplementedError(
+                "%s: override load_data() or pass targets=" % self.name)
+        self._provided_targets = numpy.asarray(
+            self._provided_targets, numpy.float32)
+        super().load_data()
+        targets = self._provided_targets
+        if len(targets) != self.total_samples:
+            raise ValueError(
+                "targets length %d != total samples %d"
+                % (len(targets), self.total_samples))
+        self.target_normalizer = make_normalizer(
+            self.target_normalization_type,
+            **self.target_normalization_parameters)
+        start = self.class_offset(TRAIN)
+        train = targets[start:start + self.class_lengths[TRAIN]]
+        if not self.target_normalizer.STATELESS:
+            self.target_normalizer.analyze(
+                train if len(train) else targets)
+        self.original_targets.reset(
+            numpy.asarray(self.target_normalizer.apply_batch(
+                numpy, targets), numpy.float32))
+        if not self.targets_shape:
+            self.targets_shape = targets.shape[1:]
+        if self.on_device:
+            try:
+                self.original_targets.to_device()
+            except Exception as exc:
+                self.warning("keeping targets on host: %s", exc)
+                self.on_device = False
+
+    def create_minibatch_data(self):
+        super().create_minibatch_data()
+        size = self.max_minibatch_size
+        self.minibatch_targets.reset(numpy.zeros(
+            (size,) + tuple(self.targets_shape), numpy.float32))
+
+    def fill_minibatch(self, indices, valid):
+        super().fill_minibatch(indices, valid)
+        targets = self.original_targets.data
+        if isinstance(targets, jax.Array):
+            gathered = jnp.take(targets, jnp.asarray(indices), axis=0)
+        else:
+            gathered = jnp.asarray(
+                numpy.take(numpy.asarray(targets), indices, axis=0))
+        self.minibatch_targets.data = gathered

@@ -1,0 +1,474 @@
+"""Feature normalizer family with a name registry.
+
+TPU-native re-design of reference ``veles/normalization.py:110-636``. The
+reference normalizers are *stateful objects* that mutate numpy arrays in
+place; here they are stateful only in their accumulated statistics
+(``analyze``) while ``normalize``/``denormalize`` are **functional** — they
+return new arrays — because in-place mutation is meaningless for jax.Arrays.
+
+Every normalizer also exposes ``apply_batch(xp, batch)``: the same
+normalization expressed over an array-namespace parameter (``numpy`` or
+``jax.numpy``), so the FullBatchLoader's jitted fill applies normalization
+*inside* the XLA computation (the reference instead shipped a dedicated
+``mean_disp_normalizer`` GPU kernel — XLA fuses the equivalent for free).
+
+Registry semantics follow the reference ``NormalizerRegistry`` metaclass
+(``normalization.py:110-121``): every concrete class with a ``MAPPING`` name
+is registered and constructible via :func:`make_normalizer`.
+
+The eight reference types, with their accumulation semantics
+(``normalization.py`` line anchors in each class docstring):
+
+========== =====================================================
+name        behavior
+========== =====================================================
+none        identity
+mean_disp   subtract global mean, divide by (max - min)
+linear      samplewise rescale of [min, max] to an interval
+range_linear like linear but the global range is fixed at init
+exp         samplewise softmax
+pointwise   per-feature rescale of accumulated [min, max] to [-1, 1]
+external_mean subtract a mean sample loaded from a file
+internal_mean subtract the accumulated global mean sample
+========== =====================================================
+"""
+
+import pickle
+
+import numpy
+
+#: MAPPING name -> class (reference NormalizerRegistry.normalizers).
+normalizer_registry = {}
+
+
+def register_normalizer(cls):
+    assert cls.MAPPING, "normalizer must define MAPPING"
+    normalizer_registry[cls.MAPPING] = cls
+    return cls
+
+
+def make_normalizer(name, **kwargs):
+    """Instantiate a registered normalizer by MAPPING name."""
+    try:
+        cls = normalizer_registry[name]
+    except KeyError:
+        raise ValueError(
+            "unknown normalization type %r (have: %s)"
+            % (name, ", ".join(sorted(normalizer_registry))))
+    return cls(**kwargs)
+
+
+def _feature_axes(batch):
+    return tuple(range(1, batch.ndim))
+
+
+class NormalizerBase:
+    """Base contract (reference ``normalization.py:124``): ``analyze(data)``
+    accumulates statistics over (possibly several) train-set passes;
+    ``normalize(data)`` returns the normalized copy; ``denormalize`` inverts
+    it. ``state``/``state=`` round-trip everything for snapshots."""
+
+    MAPPING = None
+    #: stateless normalizers need no analyze() before normalize()
+    STATELESS = False
+
+    def __init__(self, state=None, **kwargs):
+        self._initialized = False
+        if state is not None:
+            if not isinstance(state, dict):
+                raise TypeError("state must be a dict")
+            self.__dict__.update(state)
+            self._initialized = True
+
+    # -- accumulation -------------------------------------------------------
+    def analyze(self, data):
+        data = numpy.asarray(data)
+        if not self._initialized:
+            self._initialize(data)
+            self._initialized = True
+        self._analyze(data)
+
+    def _initialize(self, data):
+        pass
+
+    def _analyze(self, data):
+        pass
+
+    @property
+    def is_initialized(self):
+        return self._initialized or self.STATELESS
+
+    def reset(self):
+        self._initialized = False
+
+    @property
+    def state(self):
+        """Everything needed to reconstruct via ``cls(state=...)``."""
+        return {k: v for k, v in self.__dict__.items()
+                if k != "_initialized" and not callable(v)}
+
+    def analyze_and_normalize(self, data):
+        self.analyze(data)
+        return self.normalize(data)
+
+    # -- application --------------------------------------------------------
+    def _require_initialized(self):
+        if not self.is_initialized:
+            raise RuntimeError(
+                "%s.normalize() before analyze()" % type(self).__name__)
+
+    def normalize(self, data):
+        self._require_initialized()
+        return self.apply_batch(numpy, numpy.asarray(data, numpy.float32))
+
+    def denormalize(self, data, **kwargs):
+        raise NotImplementedError
+
+    def jit_state(self):
+        """Coefficients as a flat dict of arrays/scalars — the traced
+        inputs of the fused tick's normalization stage, so changing
+        datasets never retraces (``parallel/fused.py``)."""
+        return {}
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        """Pure normalization over array namespace ``xp`` (numpy on host,
+        jax.numpy inside jit) using only ``state`` — no instance data."""
+        raise NotImplementedError
+
+    def apply_batch(self, xp, batch):
+        """Normalize ``batch`` (leading axis = samples) with this
+        instance's accumulated coefficients."""
+        return self.apply_state(xp, batch, self.jit_state())
+
+
+@register_normalizer
+class NoneNormalizer(NormalizerBase):
+    """Identity (reference ``normalization.py:496``)."""
+
+    MAPPING = "none"
+    STATELESS = True
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        return batch
+
+    def denormalize(self, data, **kwargs):
+        return numpy.asarray(data)
+
+
+@register_normalizer
+class MeanDispersionNormalizer(NormalizerBase):
+    """Subtract the accumulated global mean and divide by (max - min); note
+    "dispersion" here is the range, not the statistical variance (reference
+    ``normalization.py:284-318``). Accumulates in float64 to dodge float32
+    saturation on large sets."""
+
+    MAPPING = "mean_disp"
+
+    def _initialize(self, data):
+        self._sum = numpy.zeros_like(data[0], dtype=numpy.float64)
+        self._count = 0
+        self._min = numpy.array(data[0], dtype=numpy.float64)
+        self._max = numpy.array(data[0], dtype=numpy.float64)
+
+    def _analyze(self, data):
+        self._count += data.shape[0]
+        self._sum += numpy.sum(data, axis=0, dtype=numpy.float64)
+        numpy.minimum(self._min, numpy.min(data, axis=0), self._min)
+        numpy.maximum(self._max, numpy.max(data, axis=0), self._max)
+
+    @property
+    def coefficients(self):
+        mean = (self._sum / self._count).astype(numpy.float32)
+        disp = (self._max - self._min).astype(numpy.float32)
+        disp[disp == 0] = 1.0
+        return mean, disp
+
+    def jit_state(self):
+        mean, disp = self.coefficients
+        return {"mean": mean, "disp": disp}
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        return (batch - state["mean"]) / state["disp"]
+
+    def denormalize(self, data, **kwargs):
+        mean, disp = self.coefficients
+        return numpy.asarray(data) * disp + mean
+
+
+class IntervalMixin:
+    """Target-interval validation shared by linear normalizers (reference
+    ``normalization.py:322-344``)."""
+
+    def _set_interval(self, value):
+        try:
+            vmin, vmax = value
+        except (TypeError, ValueError):
+            raise ValueError("interval must consist of two values")
+        for v in (vmin, vmax):
+            if not isinstance(v, (int, float)):
+                raise TypeError("interval bounds must be numbers")
+        self.interval = (float(vmin), float(vmax))
+
+
+@register_normalizer
+class LinearNormalizer(IntervalMixin, NormalizerBase):
+    """Samplewise rescale: each sample's own [min, max] maps to the target
+    interval (reference ``normalization.py:347-395``). Stateless — the
+    per-sample (dmin, dmax) needed to invert are returned by
+    :meth:`normalize_with_stats`. Uniform samples land on the interval
+    midpoint."""
+
+    MAPPING = "linear"
+    STATELESS = True
+
+    def __init__(self, state=None, **kwargs):
+        interval = kwargs.pop("interval", (-1, 1))
+        super().__init__(state, **kwargs)
+        if state is None:
+            self._set_interval(interval)
+
+    def jit_state(self):
+        return {"imin": self.interval[0], "imax": self.interval[1]}
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        axes = _feature_axes(batch)
+        dmin = xp.min(batch, axis=axes, keepdims=True)
+        dmax = xp.max(batch, axis=axes, keepdims=True)
+        imin, imax = state["imin"], state["imax"]
+        diff = xp.where(dmax == dmin, xp.ones_like(dmax), dmax - dmin)
+        scaled = (batch - dmin) * ((imax - imin) / diff) + imin
+        # uniform samples -> interval midpoint
+        return xp.where(dmax == dmin,
+                        xp.full_like(batch, (imin + imax) / 2), scaled)
+
+    def normalize_with_stats(self, data):
+        data = numpy.asarray(data, numpy.float32)
+        axes = _feature_axes(data)
+        stats = {"dmin": data.min(axis=axes), "dmax": data.max(axis=axes)}
+        return self.apply_batch(numpy, data), stats
+
+    def denormalize(self, data, **kwargs):
+        data = numpy.asarray(data, numpy.float32)
+        dmin = numpy.asarray(kwargs["dmin"], numpy.float32)
+        dmax = numpy.asarray(kwargs["dmax"], numpy.float32)
+        shape = (-1,) + (1,) * (data.ndim - 1)
+        dmin, dmax = dmin.reshape(shape), dmax.reshape(shape)
+        imin, imax = self.interval
+        diff = numpy.where(dmax == dmin, 1.0, dmax - dmin)
+        out = (data - imin) * (diff / (imax - imin)) + dmin
+        return numpy.where(dmax == dmin, dmin, out)
+
+
+@register_normalizer
+class RangeLinearNormalizer(IntervalMixin, NormalizerBase):
+    """Like linear but the *global* data range is fixed at first analyze and
+    every later analyze must confirm it (reference
+    ``normalization.py:398-464``) — guaranteeing the mapping is invertible
+    from state alone."""
+
+    MAPPING = "range_linear"
+
+    def __init__(self, state=None, **kwargs):
+        interval = kwargs.pop("interval", (-1, 1))
+        super().__init__(state, **kwargs)
+        if state is None:
+            self._set_interval(interval)
+
+    def _initialize(self, data):
+        self._dmin = float(numpy.min(data))
+        self._dmax = float(numpy.max(data))
+
+    def _analyze(self, data):
+        if float(numpy.min(data)) != self._dmin \
+                or float(numpy.max(data)) != self._dmax:
+            raise ValueError(
+                "range_linear requires a stable global [min, max]: got "
+                "[%f, %f], expected [%f, %f]" % (
+                    float(numpy.min(data)), float(numpy.max(data)),
+                    self._dmin, self._dmax))
+
+    def jit_state(self):
+        return {"imin": self.interval[0], "imax": self.interval[1],
+                "dmin": self._dmin,
+                "diff": (self._dmax - self._dmin) or 1.0}
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        imin, imax = state["imin"], state["imax"]
+        return (batch - state["dmin"]) \
+            * ((imax - imin) / state["diff"]) + imin
+
+    def denormalize(self, data, **kwargs):
+        imin, imax = self.interval
+        diff = (self._dmax - self._dmin) or 1.0
+        return (numpy.asarray(data, numpy.float32) - imin) \
+            * (diff / (imax - imin)) + self._dmin
+
+
+@register_normalizer
+class ExponentNormalizer(NormalizerBase):
+    """Samplewise softmax: subtract the sample max, exponentiate, divide by
+    the sample sum (reference ``normalization.py:467-492``). Stateless; the
+    per-sample (dmax, dsum) to invert come from
+    :meth:`normalize_with_stats`."""
+
+    MAPPING = "exp"
+    STATELESS = True
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        axes = _feature_axes(batch)
+        dmax = xp.max(batch, axis=axes, keepdims=True)
+        e = xp.exp(batch - dmax)
+        return e / xp.sum(e, axis=axes, keepdims=True)
+
+    def normalize_with_stats(self, data):
+        data = numpy.asarray(data, numpy.float32)
+        axes = _feature_axes(data)
+        dmax = data.max(axis=axes)
+        shape = (-1,) + (1,) * (data.ndim - 1)
+        e = numpy.exp(data - dmax.reshape(shape))
+        dsum = e.sum(axis=axes)
+        return e / dsum.reshape(shape), {"dmax": dmax, "dsum": dsum}
+
+    def denormalize(self, data, **kwargs):
+        data = numpy.asarray(data, numpy.float32)
+        shape = (-1,) + (1,) * (data.ndim - 1)
+        dmax = numpy.asarray(kwargs["dmax"]).reshape(shape)
+        dsum = numpy.asarray(kwargs["dsum"]).reshape(shape)
+        return numpy.log(data * dsum) + dmax
+
+
+@register_normalizer
+class PointwiseNormalizer(NormalizerBase):
+    """Accumulates per-feature [min, max] over analyze passes, then rescales
+    each feature to [-1, 1] (reference ``normalization.py:511-562``).
+    Constant features normalize to 0 and denormalize back to their constant
+    value (the reference divided by zero there)."""
+
+    MAPPING = "pointwise"
+
+    def _initialize(self, data):
+        self._min = numpy.array(data[0], dtype=numpy.float32)
+        self._max = numpy.array(data[0], dtype=numpy.float32)
+
+    def _analyze(self, data):
+        numpy.minimum(self._min, numpy.min(data, axis=0), self._min)
+        numpy.maximum(self._max, numpy.max(data, axis=0), self._max)
+
+    @property
+    def coefficients(self):
+        disp = self._max - self._min
+        nz = disp != 0
+        mul = numpy.zeros_like(disp)
+        mul[nz] = 2.0 / disp[nz]
+        add = numpy.zeros_like(disp)
+        add[nz] = -1.0 - self._min[nz] * mul[nz]
+        return mul, add
+
+    def jit_state(self):
+        mul, add = self.coefficients
+        return {"mul": mul, "add": add}
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        return batch * state["mul"] + state["add"]
+
+    def denormalize(self, data, **kwargs):
+        mul, add = self.coefficients
+        safe_mul = numpy.where(mul == 0, 1.0, mul)
+        out = (numpy.asarray(data, numpy.float32) - add) / safe_mul
+        return numpy.where(mul == 0, self._min, out)
+
+
+class MeanNormalizerBase(NormalizerBase):
+    """Mean-subtraction family with an optional scalar scale (reference
+    ``normalization.py:566-590``)."""
+
+    def __init__(self, state=None, **kwargs):
+        scale = kwargs.pop("scale", 1)
+        super().__init__(state, **kwargs)
+        if state is None:
+            if not isinstance(scale, (int, float)):
+                raise TypeError("scale must be a scalar")
+            self.scale = float(scale)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    def jit_state(self):
+        return {"mean": self.mean, "scale": self.scale}
+
+    @classmethod
+    def apply_state(cls, xp, batch, state):
+        return (batch - state["mean"]) * state["scale"]
+
+    def denormalize(self, data, **kwargs):
+        return numpy.asarray(data, numpy.float32) / self.scale + self.mean
+
+
+@register_normalizer
+class ExternalMeanNormalizer(MeanNormalizerBase):
+    """Subtract a mean sample supplied externally — an image file, ``.npy``,
+    a pickle, or an ndarray (reference ``normalization.py:593-633``)."""
+
+    MAPPING = "external_mean"
+    STATELESS = True
+
+    def __init__(self, state=None, **kwargs):
+        mean_source = kwargs.pop("mean_source", None)
+        super().__init__(state, **kwargs)
+        if state is not None:
+            return
+        if mean_source is None:
+            raise ValueError("external_mean requires mean_source=")
+        self._mean = self._load_mean(mean_source)
+
+    @staticmethod
+    def _load_mean(source):
+        if isinstance(source, numpy.ndarray):
+            return source.astype(numpy.float32)
+        for attempt in ("image", "npy", "pickle"):
+            try:
+                if attempt == "image":
+                    from PIL import Image
+                    with open(source, "rb") as fin:
+                        return numpy.array(Image.open(fin),
+                                           dtype=numpy.float32)
+                if attempt == "npy":
+                    return numpy.load(source).astype(numpy.float32)
+                with open(source, "rb") as fin:
+                    loaded = pickle.load(fin)
+                return numpy.asarray(loaded, numpy.float32)
+            except Exception:
+                continue
+        raise ValueError("unable to load mean from %r" % (source,))
+
+    @property
+    def mean(self):
+        return self._mean
+
+
+@register_normalizer
+class InternalMeanNormalizer(MeanNormalizerBase):
+    """Subtract the mean sample accumulated over analyze passes (reference
+    ``normalization.py:636-662``)."""
+
+    MAPPING = "internal_mean"
+
+    def _initialize(self, data):
+        self._sum = numpy.zeros_like(data[0], dtype=numpy.float64)
+        self._count = 0
+
+    def _analyze(self, data):
+        self._count += data.shape[0]
+        self._sum += numpy.sum(data, axis=0, dtype=numpy.float64)
+
+    @property
+    def mean(self):
+        return (self._sum / self._count).astype(numpy.float32)

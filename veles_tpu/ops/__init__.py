@@ -7,7 +7,6 @@ the GPU sources:
 - ``gemm``      — reference ``ocl/matrix_multiplication*.cl``, ``ocl/gemm.cl``
 - ``reduce``    — reference ``ocl/matrix_reduce.cl``, ``cuda/matrix_reduce.cu``
 - ``gather``    — reference ``cuda/fullbatch_loader.cu`` (minibatch gather)
-- ``normalize`` — reference ``ocl/mean_disp_normalizer.cl``
 - ``rng``       — reference ``ocl/random.cl`` (xorshift1024*) → threefry/pallas PRNG
 - ``activations``/``losses`` — the Znicz forward/evaluator math
 """
@@ -16,5 +15,4 @@ from veles_tpu.ops.gemm import matmul  # noqa: F401
 from veles_tpu.ops import activations, losses  # noqa: F401
 from veles_tpu.ops.reduce import reduce_sum, reduce_mean, reduce_max  # noqa: F401
 from veles_tpu.ops.gather import gather_minibatch  # noqa: F401
-from veles_tpu.ops.normalize import mean_disp_normalize  # noqa: F401
 from veles_tpu.ops.rng import uniform, normal, fill_uniform  # noqa: F401

@@ -38,7 +38,7 @@ from veles_tpu.loader.base import TRAIN
 from veles_tpu.ops import activations as act_lib, losses
 from veles_tpu.ops.gather import gather_minibatch
 from veles_tpu.ops.gemm import matmul
-from veles_tpu.ops.normalize import mean_disp_normalize
+from veles_tpu.loader.normalization import normalizer_registry
 
 #: forward-unit class name → fused layer kind
 _DENSE = "dense"
@@ -206,13 +206,13 @@ def build_tick(specs, norm_type="none", mesh=None):
     layer_fwds = [_layer_forward(s) for s in specs]
     data_ax = mesh.shape.get("data", 1) if mesh is not None else 1
 
+    # normalizer coefficients ride in through the traced ``norm`` dict
+    # (``jit_state()``), so re-analyzed datasets never retrace the tick
+    norm_cls = normalizer_registry[norm_type]
+
     def gather_norm(data, labels, indices, norm):
         batch, lab = gather_minibatch(data, indices, labels)
-        if norm_type == "mean_disp":
-            batch = mean_disp_normalize(batch, norm["mean"], norm["rdisp"])
-        elif norm_type == "linear":
-            batch = batch * norm["scale"]
-        return batch, lab
+        return norm_cls.apply_state(jnp, batch, norm), lab
 
     def model_forward(wb, x):
         for fwd, p in zip(layer_fwds, wb):
@@ -405,7 +405,7 @@ class FusedTick(Unit):
                 return True  # retry after the forwards initialize
         specs = extract_model_spec(wf)
         self._norm_ = {k: jnp.asarray(v) for k, v in
-                       (loader.normalizer_state or {}).items()}
+                       loader.normalizer.jit_state().items()}
         self._steps_ = build_tick(specs, loader.normalization_type,
                                   self.mesh_)
 
