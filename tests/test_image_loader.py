@@ -1,0 +1,207 @@
+"""Tests for the file/image loader pipeline (reference test_loader
+image-loading coverage + VERDICT round-1 item 4)."""
+
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.file_loader import (AutoLabelMixin, FileFilter,
+                                          FileListScannerMixin)
+from veles_tpu.loader.image import (AutoLabelFileImageLoader,
+                                    FileListImageLoader, crop_image,
+                                    decode_image, scale_image)
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def write_png(path, color, size=(12, 12)):
+    arr = numpy.zeros(size + (3,), numpy.uint8)
+    arr[:, :] = color
+    # distinguishing texture: a bright corner square
+    arr[:3, :3] = 255
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    """<split>/<label>/<n>.png tree: red vs blue squares."""
+    rng = numpy.random.RandomState(3)
+    for split, count in (("train", 20), ("validation", 8)):
+        for label, base in (("red", (200, 30, 30)), ("blue", (30, 30, 200))):
+            d = tmp_path / split / label
+            d.mkdir(parents=True)
+            for i in range(count):
+                jitter = rng.randint(-20, 20, 3)
+                color = numpy.clip(numpy.array(base) + jitter, 0, 255)
+                write_png(str(d / ("%02d.png" % i)), color)
+    return tmp_path
+
+
+class TestHelpers:
+    def test_decode_scale_crop(self, tmp_path):
+        p = str(tmp_path / "img.png")
+        write_png(p, (10, 20, 30), size=(20, 10))
+        arr = decode_image(p)
+        assert arr.shape == (20, 10, 3)
+        scaled = scale_image(arr, (8, 8))
+        assert scaled.shape == (8, 8, 3)
+        fitted = scale_image(arr, (8, 8), maintain_aspect_ratio=True,
+                             background_color=0)
+        assert fitted.shape == (8, 8, 3)
+        # aspect preserved: 20x10 -> 8x4 centered, columns 0-1 background
+        assert float(fitted[:, 0].max()) == 0.0
+        cropped = crop_image(scaled, (4, 4), offset="center")
+        assert cropped.shape == (4, 4, 3)
+
+    def test_decode_gray(self, tmp_path):
+        p = str(tmp_path / "img.png")
+        write_png(p, (100, 100, 100))
+        assert decode_image(p, "GRAY").shape == (12, 12, 1)
+
+    def test_file_filter(self):
+        f = FileFilter(file_type="image", file_subtypes=["png"],
+                       ignored_files=[".*bad.*"])
+        assert f.is_valid_filename("/data/x.png")
+        assert not f.is_valid_filename("/data/x.jpg")
+        assert not f.is_valid_filename("/data/bad.png")
+        assert not f.is_valid_filename("/data/x.txt")
+
+    def test_file_filter_alternatives_fully_anchored(self):
+        # regression: '^a|b$' would anchor only the outer alternatives
+        f = FileFilter(file_type="image", file_subtypes=["png"],
+                       ignored_files=["junk.png", "bad.png"])
+        assert f.is_valid_filename("junk.pngXXX.png")
+        assert not f.is_valid_filename("junk.png")
+        assert not f.is_valid_filename("bad.png")
+
+    def test_fractional_crop(self, tmp_path):
+        d = tmp_path / "c" / "lab"
+        d.mkdir(parents=True)
+        write_png(str(d / "0.png"), (90, 90, 90))
+        loader = AutoLabelFileImageLoader(
+            DummyWorkflow(), train_paths=[str(tmp_path / "c")],
+            size=(12, 12), crop=(0.5, 0.5), minibatch_size=1)
+        loader.initialize()
+        assert loader.minibatch_data.shape == (1, 6, 6, 3)
+
+    def test_auto_label(self):
+        m = AutoLabelMixin()
+        assert m.get_label_from_filename(
+            os.path.join("data", "cats", "1.png")) == "cats"
+        with pytest.raises(ValueError):
+            m.get_label_from_filename("orphan.png")
+
+
+class TestAutoLabelFileImageLoader:
+    def make(self, tree, **kwargs):
+        loader = AutoLabelFileImageLoader(
+            DummyWorkflow(),
+            train_paths=[str(tree / "train")],
+            validation_paths=[str(tree / "validation")],
+            size=(12, 12), minibatch_size=8, **kwargs)
+        loader.initialize()
+        return loader
+
+    def test_scans_and_labels(self, image_tree):
+        loader = self.make(image_tree)
+        assert loader.class_lengths == [0, 16, 40]
+        assert loader.labels_mapping == {"blue": 0, "red": 1}
+        loader.run()
+        assert loader.minibatch_data.shape == (8, 12, 12, 3)
+        assert loader.minibatch_class == VALID
+
+    def test_crop(self, image_tree):
+        loader = self.make(image_tree, crop=(8, 8))
+        assert loader.minibatch_data.shape[1:] == (8, 8, 3)
+
+    def test_mirror_augmentation_train_only(self, image_tree):
+        loader = self.make(image_tree, mirror="random")
+        assert loader.has_fill_transforms
+        # drain validation (not augmented)
+        loader.run()
+        valid_batch = numpy.asarray(loader.minibatch_data.mem)
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        raw = numpy.asarray(loader.original_data.mem)[idx]
+        numpy.testing.assert_array_equal(valid_batch, raw)
+        loader.run()
+        # train minibatches: some samples mirrored
+        mirrored_any = False
+        for _ in range(5):
+            loader.run()
+            if loader.minibatch_class != TRAIN:
+                continue
+            got = numpy.asarray(loader.minibatch_data.mem)
+            idx = numpy.asarray(loader.minibatch_indices.mem)
+            raw = numpy.asarray(loader.original_data.mem)[idx]
+            flipped = raw[:, :, ::-1]
+            for i in range(len(got)):
+                if numpy.array_equal(got[i], flipped[i]) \
+                        and not numpy.array_equal(got[i], raw[i]):
+                    mirrored_any = True
+        assert mirrored_any
+
+
+class TestFileListImageLoader:
+    def test_index_file(self, image_tree, tmp_path):
+        index = tmp_path / "train.txt"
+        lines = []
+        for label in ("red", "blue"):
+            d = image_tree / "train" / label
+            for name in sorted(os.listdir(d)):
+                lines.append("%s %s" % (d / name, label))
+        index.write_text("\n".join(lines) + "\n")
+        loader = FileListImageLoader(
+            DummyWorkflow(), path_to_train_text_file=str(index),
+            size=(12, 12), minibatch_size=10, validation_ratio=0.2)
+        loader.initialize()
+        assert loader.class_lengths == [0, 8, 32]
+        assert set(loader.labels_mapping) == {"red", "blue"}
+
+    def test_json_index(self, image_tree, tmp_path):
+        d = image_tree / "train" / "red"
+        entries = {
+            name: {"path": str(d / name), "label": ["red"]}
+            for name in sorted(os.listdir(d))}
+        index = tmp_path / "train.json"
+        import json
+        index.write_text(json.dumps(entries))
+        m = FileListScannerMixin()
+        m.info = lambda *a: None
+        m.warning = lambda *a: None
+        files = m.scan_files(str(index))
+        assert len(files) == 20
+        assert m.get_label_from_filename(files[0]) == "red"
+
+
+@pytest.mark.slow
+class TestConvnetEndToEnd:
+    def test_convnet_trains_through_image_pipeline(self, image_tree):
+        """VERDICT round-1 item 4 'done' criterion: a CIFAR-style convnet
+        trains end-to-end through the image pipeline."""
+        from veles_tpu.models.standard import StandardWorkflow
+
+        wf = StandardWorkflow(
+            DummyLauncher(),
+            loader_cls=AutoLabelFileImageLoader,
+            loader_kwargs=dict(
+                train_paths=[str(image_tree / "train")],
+                validation_paths=[str(image_tree / "validation")],
+                size=(12, 12), minibatch_size=8,
+                normalization_type="internal_mean"),
+            layers=[
+                {"type": "conv_relu", "n_kernels": 8, "kx": 3, "ky": 3},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 2},
+            ],
+            learning_rate=0.02,
+            decision_kwargs=dict(max_epochs=6), name="image-convnet")
+        wf.initialize()
+        wf.run()
+        best = wf.decision.best_n_err[1]
+        assert best is not None and best <= 4, \
+            "convnet at %s/16 validation errors" % best

@@ -239,12 +239,29 @@ class TestMSELoader:
             numpy.testing.assert_allclose(got, rows[:, :1] * 2.0,
                                           rtol=1e-5)
 
-    def test_stateless_target_normalizer_rejected(self):
-        with pytest.raises(ValueError, match="stateless"):
+    def test_samplewise_target_normalizer_rejected(self):
+        # linear/exp need per-sample stats -> cannot invert at test time
+        with pytest.raises(ValueError, match="per-sample"):
             FullBatchLoaderMSE(
                 DummyWorkflow(), data=sample_data(),
                 targets=sample_data()[:, :2],
                 target_normalization_type="exp")
+
+    def test_external_mean_target_normalizer_allowed(self):
+        # regression: external_mean is stateless but fully invertible
+        data = sample_data()
+        loader = FullBatchLoaderMSE(
+            DummyWorkflow(), data=data, targets=data[:, :2],
+            class_lengths=[0, 8, 32], minibatch_size=8,
+            target_normalization_type="external_mean",
+            target_normalization_parameters=dict(
+                mean_source=numpy.ones(2, numpy.float32)))
+        loader.initialize()
+        loader.run()
+        got = numpy.asarray(loader.minibatch_targets.mem)
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        numpy.testing.assert_allclose(got, data[idx][:, :2] - 1.0,
+                                      rtol=1e-5)
 
 
 class TestOnInitialized:

@@ -6,3 +6,6 @@ from veles_tpu.loader.fullbatch import (  # noqa: F401
     FullBatchLoader, FullBatchLoaderMSE)
 from veles_tpu.loader.normalization import (  # noqa: F401
     make_normalizer, normalizer_registry)
+from veles_tpu.loader.image import (  # noqa: F401
+    AutoLabelFileImageLoader, FileImageLoader, FileListImageLoader,
+    FullBatchImageLoader)

@@ -196,9 +196,16 @@ class Loader(Unit):
         counters = []
         for klass in (TEST, VALID, TRAIN):
             start = self.class_offset(klass)
-            counters.append(collections.Counter(
-                numpy.asarray(raw[start:start + self.class_lengths[klass]]
-                              ).tolist()))
+            values = numpy.asarray(
+                raw[start:start + self.class_lengths[klass]],
+                dtype=object).tolist()
+            missing = sum(1 for v in values if v is None)
+            if missing:
+                raise ValueError(
+                    "%s: %d %s sample(s) have no label — label every "
+                    "sample or provide none" % (
+                        self.name, missing, CLASS_NAMES[klass]))
+            counters.append(collections.Counter(values))
         self._setup_labels_mapping(counters)
 
     def _setup_labels_mapping(self, counters):
@@ -259,7 +266,10 @@ class Loader(Unit):
             [v for _, v in sorted(other_counts.items())], numpy.float64)
         if not other.sum() or not train.sum():
             return
-        _, p = chisquare(other / other.sum(), train / train.sum())
+        # observed COUNTS against expected counts scaled to the observed
+        # total — normalizing both to proportions would discard sample
+        # size and make the test degenerate
+        _, p = chisquare(other, train / train.sum() * other.sum())
         if p > 0.95:
             self.info("OK: train and %s label distributions match "
                       "(chi-square p=%.3f)", other_name, p)
@@ -491,10 +501,10 @@ class LoaderMSEMixin:
         if cls is None:
             raise ValueError("unknown target_normalization_type %r"
                              % self.target_normalization_type)
-        if cls.STATELESS and cls.MAPPING != "none":
+        if not cls.INVERTIBLE_FROM_STATE:
             raise ValueError(
-                "target normalization %r is stateless: test-time forward "
-                "propagation could not be denormalized"
+                "target normalization %r needs per-sample stats to invert: "
+                "test-time forward propagation could not be denormalized"
                 % self.target_normalization_type)
         self.minibatch_targets = Array()
         self.target_normalizer = None

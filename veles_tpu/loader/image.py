@@ -1,0 +1,303 @@
+"""Image loading pipeline.
+
+TPU-native re-design of reference ``veles/loader/image.py:106-705`` +
+``fullbatch_image.py:56-266``. The reference decoded with PIL/OpenCV,
+scaled/cropped/rotated each sample on the host, and *inflated* the dataset
+(``samples_inflation`` copies per mirror/rotation/crop combination) before
+uploading to the device.
+
+TPU design decisions:
+
+- **decode once, host-side** (PIL): color conversion, aspect-preserving
+  scale onto a background canvas, fixed/center crop — these are one-time
+  load costs, exactly like the reference's load pass;
+- **dataset device-resident** afterwards (inherits FullBatchLoader's HBM
+  residency + jitted gather);
+- **augmentation in-jit, not by inflation**: random mirror (and random
+  crop jitter) are applied inside a jitted transform on the *gathered
+  minibatch*, re-randomized every epoch from the loader PRNG stream. The
+  reference's N-fold ``samples_inflation`` costs N× HBM and sees each
+  fixed distortion once per epoch; transforming in-jit costs zero extra
+  HBM and samples fresh distortions forever.
+
+Loaders that declare in-fill transforms set ``has_fill_transforms`` so the
+fused-tick engine (whose gather skips ``fill_minibatch``) declines and the
+graph path — which does run the transform — executes instead.
+"""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.loader.base import TEST, VALID, TRAIN, register_loader
+from veles_tpu.loader.file_loader import (AutoLabelMixin, FileFilter,
+                                          FileListScannerMixin,
+                                          FileScannerMixin)
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.core import prng
+
+#: PIL modes for the supported color spaces.
+_COLOR_MODES = {"RGB": "RGB", "GRAY": "L", "L": "L", "RGBA": "RGBA"}
+
+
+def decode_image(source, color_space="RGB", background_color=None):
+    """Decode an image file/path to float32 HWC (reference ImageLoader
+    decode + background blending, ``image.py:406-443``). RGBA sources are
+    alpha-blended over ``background_color`` when converting to RGB."""
+    from PIL import Image
+    img = Image.open(source)
+    mode = _COLOR_MODES.get(color_space)
+    if mode is None:
+        raise ValueError("unsupported color_space %r" % color_space)
+    if img.mode == "RGBA" and mode != "RGBA":
+        background = Image.new(
+            "RGBA", img.size,
+            tuple(background_color or (0, 0, 0)) + (255,))
+        img = Image.alpha_composite(background, img)
+    if img.mode != mode:
+        img = img.convert(mode)
+    arr = numpy.asarray(img, dtype=numpy.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def scale_image(arr, target_hw, maintain_aspect_ratio=False,
+                background_color=0):
+    """Bicubic resize to (H, W); with ``maintain_aspect_ratio`` the image
+    is fit inside and centered on a background canvas (reference
+    ``scale_image``, ``image.py:444-483``)."""
+    from PIL import Image
+    th, tw = target_hw
+    h, w = arr.shape[:2]
+    if (h, w) == (th, tw):
+        return arr
+    channels = arr.shape[2]
+    img = Image.fromarray(arr.astype(numpy.uint8).squeeze()
+                          if channels == 1 else arr.astype(numpy.uint8))
+    if maintain_aspect_ratio:
+        if w >= h:
+            dw, dh = tw, max(1, int(round(tw * h / w)))
+        else:
+            dh, dw = th, max(1, int(round(th * w / h)))
+        img = img.resize((dw, dh), Image.BICUBIC)
+        canvas = numpy.full((th, tw, channels), background_color,
+                            numpy.float32)
+        y0, x0 = (th - dh) // 2, (tw - dw) // 2
+        resized = numpy.asarray(img, dtype=numpy.float32)
+        if resized.ndim == 2:
+            resized = resized[:, :, None]
+        canvas[y0:y0 + dh, x0:x0 + dw] = resized
+        return canvas
+    img = img.resize((tw, th), Image.BICUBIC)
+    out = numpy.asarray(img, dtype=numpy.float32)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+def crop_image(arr, crop_hw, offset="center", rng=None):
+    """Cut a (H, W) window; ``offset`` is "center", "random", or explicit
+    (y, x). Fractional crop sizes are ratios of the source (reference
+    ``crop_image``, ``image.py:508-531``)."""
+    h, w = arr.shape[:2]
+    ch, cw = (int(c * s) if isinstance(c, float) else int(c)
+              for c, s in zip(crop_hw, (h, w)))
+    if ch > h or cw > w:
+        raise ValueError("crop %s larger than image %s" % ((ch, cw), (h, w)))
+    if offset == "center":
+        y0, x0 = (h - ch) // 2, (w - cw) // 2
+    elif offset == "random":
+        gen = rng or prng.get("loader")
+        y0 = int(gen.randint(0, h - ch + 1))
+        x0 = int(gen.randint(0, w - cw + 1))
+    else:
+        y0, x0 = offset
+    return arr[y0:y0 + ch, x0:x0 + cw]
+
+
+class FullBatchImageLoader(FullBatchLoader):
+    """Device-resident image dataset with load-time scale/crop and in-jit
+    train-time mirror augmentation (reference ``FullBatchImageLoader``,
+    ``fullbatch_image.py:56-177``).
+
+    Subclasses (or mixins) provide the image source:
+    ``get_keys(klass) -> [key...]``, ``get_image_label(key)``,
+    ``get_image_data(key) -> float32 HWC`` (reference IImageLoader,
+    ``image.py:83-104``).
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.size = tuple(kwargs.pop("size"))
+        self.color_space = kwargs.pop("color_space", "RGB")
+        self.scale_maintain_aspect_ratio = kwargs.pop(
+            "scale_maintain_aspect_ratio", False)
+        self.crop = kwargs.pop("crop", None)
+        self.crop_offset = kwargs.pop("crop_offset", "center")
+        self.mirror = kwargs.pop("mirror", False)
+        self.background_color = kwargs.pop("background_color", 0)
+        if self.mirror not in (False, "random"):
+            raise ValueError(
+                "mirror must be False or 'random' (deterministic mirror "
+                "inflation is replaced by in-jit random augmentation)")
+        super().__init__(workflow, **kwargs)
+
+    #: the fused tick's in-XLA gather bypasses fill_minibatch; loaders
+    #: with fill-time transforms must run the graph path
+    @property
+    def has_fill_transforms(self):
+        return self.mirror == "random"
+
+    # -- image source contract ----------------------------------------------
+    def get_keys(self, klass):
+        raise NotImplementedError
+
+    def get_image_label(self, key):
+        raise NotImplementedError
+
+    def get_image_data(self, key):
+        """Decode one sample. Default: treat key as a file path."""
+        return decode_image(key, self.color_space, self.background_color)
+
+    # -- loading -------------------------------------------------------------
+    @property
+    def sample_shape(self):
+        if self.crop:
+            # fractional crops are ratios of the scaled size
+            hw = tuple(int(c * s) if isinstance(c, float) else int(c)
+                       for c, s in zip(self.crop, self.size))
+        else:
+            hw = self.size
+        channels = 1 if self.color_space in ("GRAY", "L") else (
+            4 if self.color_space == "RGBA" else 3)
+        return (int(hw[0]), int(hw[1]), channels)
+
+    def _load_one(self, key):
+        arr = self.get_image_data(key)
+        arr = scale_image(arr, self.size, self.scale_maintain_aspect_ratio,
+                          self.background_color)
+        if self.crop:
+            arr = crop_image(arr, self.crop, self.crop_offset,
+                             prng.get(self.prng_key))
+        return arr
+
+    def load_data(self):
+        keys = [self.get_keys(klass) for klass in (TEST, VALID, TRAIN)]
+        self.class_keys = keys
+        total = sum(len(k) for k in keys)
+        if not total:
+            raise ValueError("%s found no images" % self.name)
+        shape = self.sample_shape
+        data = numpy.zeros((total,) + shape, numpy.float32)
+        labels = []
+        row = 0
+        for klass in (TEST, VALID, TRAIN):
+            for key in keys[klass]:
+                arr = self._load_one(key)
+                if arr.shape != shape:
+                    raise ValueError("image %s decoded to %s, expected %s"
+                                     % (key, arr.shape, shape))
+                data[row] = arr
+                labels.append(self.get_image_label(key))
+                row += 1
+        self._provided_data = data
+        has_labels = any(l is not None for l in labels)
+        self._provided_labels = labels if has_labels else None
+        self._provided_lengths = [len(k) for k in keys]
+        super().load_data()
+
+    # -- in-jit augmentation --------------------------------------------------
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._augment_jit_ = None
+
+    @property
+    def _augment_jit(self):
+        if self._augment_jit_ is None:
+            @jax.jit
+            def augment(batch, seed):
+                key = jax.random.key(seed)
+                flip = jax.random.bernoulli(key, 0.5, (batch.shape[0],))
+                mirrored = jnp.flip(batch, axis=2)  # horizontal (W axis)
+                return jnp.where(flip[:, None, None, None], mirrored, batch)
+
+            self._augment_jit_ = augment
+        return self._augment_jit_
+
+    def fill_minibatch(self, indices, valid):
+        super().fill_minibatch(indices, valid)
+        if self.mirror == "random" and self.minibatch_class == TRAIN:
+            seed = int(prng.get(self.prng_key).randint(0, 2 ** 31 - 1))
+            self.minibatch_data.data = self._augment_jit(
+                self.minibatch_data.data, seed)
+
+
+@register_loader("file_image")
+class FileImageLoader(FileFilter, FileScannerMixin, FullBatchImageLoader):
+    """Images from recursive directory scans with MIME filtering
+    (reference ``FileImageLoader``, ``file_image.py:53-177``). Subclasses
+    define :meth:`get_label_from_filename`."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("file_type", "image")
+        kwargs.setdefault("file_subtypes", ["png", "jpeg", "bmp"])
+        FileFilter.__init__(
+            self, **{k: kwargs.pop(k) for k in
+                     ("ignored_files", "included_files", "file_type",
+                      "file_subtypes") if k in kwargs})
+        FileScannerMixin.__init__(
+            self, **{k: kwargs.pop(k) for k in
+                     ("test_paths", "validation_paths", "train_paths")
+                     if k in kwargs})
+        FullBatchImageLoader.__init__(self, workflow, **kwargs)
+
+    def get_keys(self, klass):
+        paths = (self.test_paths, self.validation_paths,
+                 self.train_paths)[klass]
+        return self.collect_keys(paths)
+
+    def get_image_label(self, key):
+        return self.get_label_from_filename(key)
+
+
+@register_loader("auto_label_file_image")
+class AutoLabelFileImageLoader(AutoLabelMixin, FileImageLoader):
+    """Directory-scanned images labeled by path regexp, default = parent
+    directory name (reference ``FullBatchAutoLabelFileImageLoader``,
+    ``fullbatch_image.py:238-245``)."""
+
+    def __init__(self, workflow, **kwargs):
+        AutoLabelMixin.__init__(
+            self, **{k: kwargs.pop(k) for k in ("label_regexp",)
+                     if k in kwargs})
+        FileImageLoader.__init__(self, workflow, **kwargs)
+
+
+@register_loader("file_list_image")
+class FileListImageLoader(FileListScannerMixin, FullBatchImageLoader):
+    """Images enumerated by index files (text ``path label`` lines or a
+    JSON map; reference ``FileListImageLoader``, ``file_image.py:53`` +
+    ``file_loader.py:150-203``)."""
+
+    def __init__(self, workflow, **kwargs):
+        FileListScannerMixin.__init__(
+            self, **{k: kwargs.pop(k) for k in
+                     ("path_to_test_text_file", "path_to_val_text_file",
+                      "path_to_train_text_file", "base_directory")
+                     if k in kwargs})
+        FullBatchImageLoader.__init__(self, workflow, **kwargs)
+
+    def get_keys(self, klass):
+        index = (self.path_to_test_text_file, self.path_to_val_text_file,
+                 self.path_to_train_text_file)[klass]
+        if not index:
+            return []
+        return self.scan_files(index)
+
+    def get_image_label(self, key):
+        return self.get_label_from_filename(key)

@@ -71,6 +71,9 @@ class NormalizerBase:
     MAPPING = None
     #: stateless normalizers need no analyze() before normalize()
     STATELESS = False
+    #: False when denormalize() needs per-call stats (samplewise types) —
+    #: such types cannot be MSE target normalizers (loader/base.py)
+    INVERTIBLE_FROM_STATE = True
 
     def __init__(self, state=None, **kwargs):
         self._initialized = False
@@ -223,6 +226,7 @@ class LinearNormalizer(IntervalMixin, NormalizerBase):
 
     MAPPING = "linear"
     STATELESS = True
+    INVERTIBLE_FROM_STATE = False
 
     def __init__(self, state=None, **kwargs):
         interval = kwargs.pop("interval", (-1, 1))
@@ -318,6 +322,7 @@ class ExponentNormalizer(NormalizerBase):
 
     MAPPING = "exp"
     STATELESS = True
+    INVERTIBLE_FROM_STATE = False
 
     @classmethod
     def apply_state(cls, xp, batch, state):

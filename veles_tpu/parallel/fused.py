@@ -343,6 +343,10 @@ def supports(workflow, mesh=None):
     loader = getattr(workflow, "loader", None)
     if not isinstance(loader, FullBatchLoader) or not loader.on_device:
         return False
+    if getattr(loader, "has_fill_transforms", False):
+        # the fused gather bypasses fill_minibatch, which would silently
+        # drop the loader's augmentation (e.g. random mirror)
+        return False
     if not isinstance(getattr(workflow, "evaluator", None),
                       EvaluatorSoftmax):
         return False
