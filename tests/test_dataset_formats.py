@@ -1,0 +1,155 @@
+"""Tests for pickles/HDF5 loaders and the minibatch saver/replay pair
+(reference test_pickles / test_minibatches_saver_loader coverage)."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.pickles import PicklesLoader
+from veles_tpu.loader.saver import MinibatchesLoader, MinibatchesSaver
+
+
+def dataset(n=48, dim=5, seed=0):
+    rng = numpy.random.RandomState(seed)
+    return (rng.uniform(-1, 1, (n, dim)).astype(numpy.float32),
+            (rng.randint(0, 3, n)).astype(numpy.int32))
+
+
+class TestPicklesLoader:
+    def test_tuple_payloads(self, tmp_path):
+        X, y = dataset()
+        paths = []
+        for i, sl in enumerate((slice(0, 16), slice(16, 48))):
+            p = str(tmp_path / ("part%d.pickle" % i))
+            with open(p, "wb") as f:
+                pickle.dump((X[sl], y[sl]), f)
+            paths.append(p)
+        loader = PicklesLoader(
+            DummyWorkflow(), validation_pickles=[paths[0]],
+            train_pickles=[paths[1]], minibatch_size=8)
+        loader.initialize()
+        assert loader.class_lengths == [0, 16, 32]
+        loader.run()
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        numpy.testing.assert_allclose(
+            numpy.asarray(loader.minibatch_data.mem), X[idx], rtol=1e-6)
+
+    def test_dict_payload_and_shape_mismatch(self, tmp_path):
+        X, y = dataset()
+        good = str(tmp_path / "good.pickle")
+        with open(good, "wb") as f:
+            pickle.dump({"data": X, "labels": y}, f)
+        bad = str(tmp_path / "bad.pickle")
+        with open(bad, "wb") as f:
+            pickle.dump({"data": numpy.zeros((4, 9), numpy.float32),
+                         "labels": numpy.zeros(4, numpy.int32)}, f)
+        loader = PicklesLoader(DummyWorkflow(), train_pickles=[good],
+                               validation_pickles=[bad])
+        with pytest.raises(ValueError, match="sample shapes differ"):
+            loader.initialize()
+
+
+class TestHDF5Loaders:
+    @pytest.fixture
+    def h5_files(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        X, y = dataset()
+        paths = {}
+        for name, sl in (("validation", slice(0, 16)),
+                         ("train", slice(16, 48))):
+            p = str(tmp_path / (name + ".h5"))
+            with h5py.File(p, "w") as f:
+                f["data"] = X[sl]
+                f["label"] = y[sl]
+            paths[name] = p
+        return paths, X, y
+
+    def test_fullbatch(self, h5_files):
+        from veles_tpu.loader.hdf5 import FullBatchHDF5Loader
+        paths, X, y = h5_files
+        loader = FullBatchHDF5Loader(
+            DummyWorkflow(), validation_path=paths["validation"],
+            train_path=paths["train"], minibatch_size=8)
+        loader.initialize()
+        assert loader.class_lengths == [0, 16, 32]
+        loader.run()
+        idx = numpy.asarray(loader.minibatch_indices.mem)
+        numpy.testing.assert_allclose(
+            numpy.asarray(loader.minibatch_data.mem), X[idx], rtol=1e-6)
+
+    def test_streaming(self, h5_files):
+        from veles_tpu.loader.hdf5 import HDF5Loader
+        paths, X, y = h5_files
+        loader = HDF5Loader(
+            DummyWorkflow(), validation_path=paths["validation"],
+            train_path=paths["train"], minibatch_size=8,
+            normalization_type="mean_disp")
+        loader.initialize()
+        served = 0
+        loader.run()
+        while True:
+            idx = numpy.asarray(loader.minibatch_indices.mem)
+            valid = loader.minibatch_valid_size
+            got = numpy.asarray(loader.minibatch_data.mem)[:valid]
+            expected = loader.normalizer.apply_batch(numpy, X[idx[:valid]])
+            numpy.testing.assert_allclose(got, expected, rtol=1e-4,
+                                          atol=1e-5)
+            lab = numpy.asarray(loader.minibatch_labels.mem)[:valid]
+            numpy.testing.assert_array_equal(lab, y[idx[:valid]])
+            served += valid
+            if loader.epoch_ended:
+                break
+            loader.run()
+        assert served == 48
+
+
+class TestSaverReplay:
+    def test_roundtrip(self, tmp_path):
+        X, y = dataset()
+        wf = DummyWorkflow()
+        loader = FullBatchLoader(
+            wf, data=X, labels=y, class_lengths=[0, 16, 32],
+            minibatch_size=8, shuffle_limit=0)
+        wf.loader = loader
+        saver = MinibatchesSaver(
+            wf, file_name=str(tmp_path / "stream.dat"), compression="gz")
+        saver.link_attrs(loader, "minibatch_data", "minibatch_labels",
+                         "minibatch_class", "minibatch_valid_size",
+                         "class_lengths", "max_minibatch_size")
+        loader.initialize()
+        saver.initialize()
+        for _ in range(6):  # one full epoch: 2 valid + 4 train
+            loader.run()
+            saver.run()
+        saver.stop()
+
+        replay = MinibatchesLoader(
+            DummyWorkflow(), file_name=str(tmp_path / "stream.dat"),
+            minibatch_size=8)
+        replay.initialize()
+        assert replay.class_lengths == [0, 16, 32]
+        assert replay.labels_mapping == {0: 0, 1: 1, 2: 2}
+        replay.run()
+        idx = numpy.asarray(replay.minibatch_indices.mem)
+        got = numpy.asarray(replay.minibatch_data.mem)
+        numpy.testing.assert_allclose(got, X[idx], rtol=1e-6)
+        lab = numpy.asarray(replay.minibatch_labels.mem)
+        numpy.testing.assert_array_equal(lab, y[idx])
+
+    def test_saver_requires_no_shuffle(self, tmp_path):
+        X, y = dataset()
+        wf = DummyWorkflow()
+        loader = FullBatchLoader(wf, data=X, labels=y,
+                                 class_lengths=[0, 16, 32])
+        wf.loader = loader
+        saver = MinibatchesSaver(wf, file_name=str(tmp_path / "s.dat"))
+        saver.link_attrs(loader, "minibatch_data", "minibatch_labels",
+                         "minibatch_class", "minibatch_valid_size",
+                         "class_lengths", "max_minibatch_size")
+        loader.initialize()
+        with pytest.raises(ValueError, match="shuffle"):
+            saver.initialize()
