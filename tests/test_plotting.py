@@ -1,0 +1,161 @@
+"""Tests for the plotting tier (reference test_plotting_units.py role —
+golden-image fixtures replaced by render-to-file assertions)."""
+
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.core.config import root
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.plotting import (AccumulatingPlotter, AutoHistogramPlotter,
+                                GraphicsServer, Histogram, ImagePlotter,
+                                ImmediatePlotter, MatrixPlotter,
+                                MultiHistogram, SlaveStats, TableMaxMin)
+
+pytest.importorskip("matplotlib")
+
+
+@pytest.fixture
+def server(tmp_path, monkeypatch):
+    monkeypatch.setattr(root.common.disable, "plotting", False,
+                        raising=False)
+    srv = GraphicsServer(backend="file", directory=str(tmp_path))
+    yield srv
+    srv.shutdown()
+
+
+def render(server, plotter):
+    server.enqueue(plotter)
+    server.flush()
+    path = server.rendered.get(plotter.name)
+    assert path and os.path.exists(path) and os.path.getsize(path) > 0
+    return path
+
+
+class TestAccumulatingPlotter:
+    def test_accumulates_and_renders(self, server):
+        p = AccumulatingPlotter(DummyWorkflow(), name="errors")
+        p.graphics_server = server
+        for v in (10.0, 8.0, 5.0, 4.0, 3.5):
+            p.input = v
+            p.fill()
+        assert p.values == [10.0, 8.0, 5.0, 4.0, 3.5]
+        render(server, p)
+
+    def test_input_field_and_offset(self, server):
+        p = AccumulatingPlotter(DummyWorkflow(), name="field")
+
+        class Source:
+            epoch_metrics = numpy.array([1.0, 2.0, 3.0])
+
+        p.input = Source()
+        p.input_field = "epoch_metrics"
+        p.input_offset = 1
+        p.fill()
+        assert p.values == [2.0]
+
+    def test_throttling(self, server):
+        p = AccumulatingPlotter(DummyWorkflow(), name="throttled",
+                                redraw_threshold=3600)
+        p.graphics_server = server
+        p.input = 1.0
+        p.run()  # first run renders
+        p.input = 2.0
+        p.run()  # within threshold: fill only
+        server.flush()
+        assert p.values == [1.0, 2.0]
+        assert "throttled" in server.rendered
+
+    def test_disabled_by_config(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(root.common.disable, "plotting", True,
+                            raising=False)
+        srv = GraphicsServer(backend="file", directory=str(tmp_path))
+        p = AccumulatingPlotter(DummyWorkflow(), name="off")
+        p.graphics_server = srv
+        p.input = 1.0
+        p.run()
+        srv.flush()
+        assert srv.rendered == {}
+
+
+class TestOtherPlotters:
+    def test_matrix(self, server):
+        p = MatrixPlotter(DummyWorkflow(), name="confusion")
+        p.graphics_server = server
+        p.input = numpy.array([[5, 1], [0, 6]])
+        p.reversed_labels_mapping = ["cat", "dog"]
+        render(server, p)
+
+    def test_image(self, server):
+        p = ImagePlotter(DummyWorkflow(), name="imgs")
+        p.graphics_server = server
+        p.inputs = [numpy.random.rand(8, 8), numpy.random.rand(8, 8, 3)]
+        render(server, p)
+
+    def test_immediate(self, server):
+        p = ImmediatePlotter(DummyWorkflow(), name="imm")
+        p.graphics_server = server
+        p.inputs = [numpy.arange(10.0), numpy.arange(10.0) ** 2]
+        render(server, p)
+
+    def test_histogram(self, server):
+        p = Histogram(DummyWorkflow(), name="hist")
+        p.graphics_server = server
+        p.x = numpy.arange(10.0)
+        p.y = numpy.arange(10.0) * 2
+        render(server, p)
+
+    def test_auto_histogram(self, server):
+        p = AutoHistogramPlotter(DummyWorkflow(), name="autohist")
+        p.graphics_server = server
+        p.input = numpy.random.randn(100)
+        render(server, p)
+
+    def test_multi_histogram(self, server):
+        p = MultiHistogram(DummyWorkflow(), name="multihist",
+                           hist_number=4)
+        p.graphics_server = server
+        p.input = numpy.random.randn(6, 20)
+        render(server, p)
+
+    def test_table_max_min(self, server):
+        p = TableMaxMin(DummyWorkflow(), name="maxmin")
+        p.graphics_server = server
+        p.inputs = [numpy.arange(5.0), numpy.ones(3)]
+        p.input_names = ["weights", "bias"]
+        render(server, p)
+
+    def test_slave_stats(self, server):
+        p = SlaveStats(DummyWorkflow(), name="slaves")
+        p.graphics_server = server
+
+        class FakeServer:
+            @staticmethod
+            def fleet_status():
+                return {"slaves": [
+                    {"id": "s1", "mid": "m", "power": 2.0, "jobs_done": 7}]}
+
+        p.fleet_server = FakeServer()
+        render(server, p)
+
+
+class TestListeners:
+    def test_listener_fires(self, server):
+        seen = []
+        server.add_listener(lambda name, path: seen.append((name, path)))
+        p = AccumulatingPlotter(DummyWorkflow(), name="listened")
+        p.graphics_server = server
+        p.input = 1.0
+        p.fill()
+        render(server, p)
+        assert seen and seen[0][0] == "listened"
+
+    def test_snapshot_is_picklable(self):
+        import pickle
+        p = AccumulatingPlotter(DummyWorkflow(), name="x")
+        p.input = 3.0
+        p.fill()
+        blob = pickle.dumps((type(p), p.name, p.snapshot()))
+        cls, name, snap = pickle.loads(blob)
+        assert snap["values"] == [3.0]

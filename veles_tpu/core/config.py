@@ -171,7 +171,9 @@ root.common.update({
     "trace": {"run": False},
     "timings": False,
     "disable": {"plotting": False, "publishing": False, "snapshotting": False},
-    "web": {"host": "localhost", "port": 8090, "notification_interval": 1.0},
+    "web": {"enabled": False, "host": "localhost", "port": 8090,
+            "notification_interval": 1.0},
+    "api": {"port": 8180, "path": "/api"},
     "fleet": {
         "job_timeout": 120.0,
         "sync_interval": 1.0,

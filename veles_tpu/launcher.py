@@ -32,6 +32,8 @@ class Launcher(Logger):
         self.thread_pool = ThreadPool(name="launcher")
         self.workflow = None
         self.agent = None  # Server or Client
+        self.graphics_server = None
+        self.status_notifier = None
         self._units = []
         self._finished = threading.Event()
         self.stopped = False
@@ -68,6 +70,13 @@ class Launcher(Logger):
         if self.workflow is None:
             raise ValueError("no workflow attached to the launcher")
         self.info("launcher mode: %s", self.mode)
+        if not root.common.disable.get("plotting", False) \
+                and not self.is_slave:
+            from veles_tpu.plotting.server import GraphicsServer
+            self.graphics_server = GraphicsServer()
+        if root.common.web.get("enabled", False) and not self.is_slave:
+            from veles_tpu.web_status import StatusNotifier
+            self.status_notifier = StatusNotifier(self).start()
         self.workflow.initialize(**kwargs)
         if self.is_master:
             from veles_tpu.fleet.server import Server
@@ -116,6 +125,11 @@ class Launcher(Logger):
         self.stopped = True
         if self.agent is not None:
             self.agent.stop()
+        if self.status_notifier is not None:
+            self.status_notifier.stop()
+        if self.graphics_server is not None:
+            self.graphics_server.flush()
+            self.graphics_server.shutdown()
         self.thread_pool.shutdown()
         self._finished.set()
 

@@ -446,6 +446,9 @@ class FusedTick(Unit):
             loss, n_err = eval_step(self._params_, norm, data, labels,
                                     indices, valid)
         evaluator = wf.evaluator
+        # NOTE: the fused step publishes loss + n_err only; the confusion
+        # matrix (MatrixPlotter feed) is populated by the graph-mode
+        # evaluator — run with fused=False when you need it live
         evaluator.loss.data = loss
         evaluator.n_err.data = n_err
         self.ticks += 1

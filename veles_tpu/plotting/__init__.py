@@ -1,0 +1,8 @@
+"""veles_tpu.plotting: live training visualization (reference
+``veles/plotter.py``, ``plotting_units.py``, ``graphics_server.py``)."""
+
+from veles_tpu.plotting.server import GraphicsServer  # noqa: F401
+from veles_tpu.plotting.units import (  # noqa: F401
+    AccumulatingPlotter, AutoHistogramPlotter, Histogram, ImagePlotter,
+    ImmediatePlotter, MatrixPlotter, MultiHistogram, Plotter, SlaveStats,
+    TableMaxMin)

@@ -1,0 +1,117 @@
+"""Graphics server: the render backend behind Plotter units.
+
+TPU-native re-design of reference ``veles/graphics_server.py:73-245`` +
+``graphics_client.py``. The reference strip-pickled each Plotter, published
+it over ZMQ PUB (inproc/ipc/epgm multicast) and rendered in a separate
+``graphics_client.py`` process (Qt4Agg/WebAgg/Pdf).
+
+Here the transport is a plain queue + one render thread: plotters enqueue
+*snapshots* — ``(plotter_class, figure name, plain-data dict)`` — and the
+render thread draws them with matplotlib Agg and writes image files under
+``root.common.dirs.plots``. Snapshots are picklable by construction, so a
+remote viewer transport (fleet protocol / web) can be layered on without
+touching the units; ``add_listener`` callbacks fire after each render and
+feed the web-status dashboard's plot list.
+
+Backends: ``file`` (PNG, default), ``pdf``, ``none`` (drop everything —
+the test default, reference ``config.py:193``).
+"""
+
+import os
+import queue
+import threading
+
+from veles_tpu.core.config import root
+from veles_tpu.core.logger import Logger
+
+
+class GraphicsServer(Logger):
+    """Render queue + worker thread (reference ``GraphicsServer`` role)."""
+
+    def __init__(self, backend=None, directory=None):
+        super().__init__()
+        self.backend = backend or root.common.get("graphics_backend", "file")
+        self.directory = directory or root.common.dirs.get(
+            "plots", os.path.join(root.common.dirs.get("cache", "."),
+                                  "plots"))
+        self._queue = queue.Queue()
+        self._listeners = []
+        self._rendered = {}
+        self._thread = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._render_loop, name="graphics-server",
+                    daemon=True)
+                self._thread.start()
+
+    def shutdown(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=10)
+
+    def flush(self):
+        """Block until everything enqueued so far has rendered."""
+        if self._thread is None or not self._thread.is_alive():
+            return
+        done = threading.Event()
+        self._queue.put(done)
+        done.wait(timeout=30)
+
+    # -- producer side -------------------------------------------------------
+    def enqueue(self, plotter):
+        """Queue one snapshot of ``plotter`` for rendering."""
+        if self.backend == "none":
+            return
+        snapshot = plotter.snapshot()
+        self._ensure_thread()
+        self._queue.put((type(plotter), plotter.name, snapshot))
+
+    def add_listener(self, callback):
+        """``callback(name, path)`` after each rendered figure."""
+        self._listeners.append(callback)
+
+    @property
+    def rendered(self):
+        """name -> last written file path."""
+        return dict(self._rendered)
+
+    # -- render thread -------------------------------------------------------
+    def _render_loop(self):
+        import matplotlib
+        matplotlib.use("Agg", force=True)
+        import matplotlib.pyplot as pp
+
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            if isinstance(item, threading.Event):
+                item.set()
+                continue
+            cls, name, snapshot = item
+            try:
+                figure = pp.figure(name)
+                figure.clf()
+                cls.redraw(pp, figure, snapshot)
+                path = self._write(figure, name)
+                self._rendered[name] = path
+                for listener in self._listeners:
+                    listener(name, path)
+            except Exception as exc:
+                self.warning("failed to render %s: %s", name, exc)
+
+    def _write(self, figure, name):
+        os.makedirs(self.directory, exist_ok=True)
+        ext = "pdf" if self.backend == "pdf" else "png"
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in name)
+        path = os.path.join(self.directory, "%s.%s" % (safe, ext))
+        tmp = path + ".tmp"
+        figure.savefig(tmp, format=ext)
+        os.replace(tmp, path)
+        return path
