@@ -1,0 +1,197 @@
+"""Tests for REST serving, interactive loader, and web status (reference
+test_restful.py / test_web_status.py roles)."""
+
+import json
+import threading
+import urllib.request
+import urllib.error
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.serving import InteractiveLoader, RESTfulAPI, RestfulLoader
+from veles_tpu.web_status import StatusNotifier, WebStatusServer
+
+
+def post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+class ServingHarness:
+    """loader -> double(input) -> api loop on a background thread."""
+
+    def __init__(self, mb=4):
+        wf = DummyWorkflow()
+        self.loader = RestfulLoader(wf, sample_shape=(3,),
+                                    minibatch_size=mb,
+                                    max_response_time=0.05)
+        self.loader.initialize()
+        self.api = RESTfulAPI(wf, port=0, path="/api")
+        self.api.feed = self.loader.feed
+        self.api.requests = []
+        self.api.initialize()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.loader.run()
+            if self.loader.complete:
+                return
+            batch = numpy.asarray(self.loader.minibatch_data.mem)
+            self.api.results = batch * 2.0
+            self.api.requests = self.loader.requests
+            self.api.run()
+
+    @property
+    def url(self):
+        return "http://127.0.0.1:%d/api" % self.api.port
+
+    def close(self):
+        self._stop.set()
+        self.loader.stop()
+        self.api.stop()
+
+
+@pytest.fixture
+def harness():
+    h = ServingHarness()
+    yield h
+    h.close()
+
+
+class TestRESTfulAPI:
+    def test_list_codec(self, harness):
+        out = post(harness.url, {"input": [1.0, 2.0, 3.0],
+                                 "codec": "list"})
+        assert out["result"] == [2.0, 4.0, 6.0]
+
+    def test_base64_codec(self, harness):
+        import base64
+        arr = numpy.array([0.5, 1.5, 2.5], numpy.float32)
+        out = post(harness.url, {
+            "input": base64.b64encode(arr.tobytes()).decode(),
+            "codec": "base64", "shape": [3], "type": "float32"})
+        assert out["result"] == [1.0, 3.0, 5.0]
+
+    def test_concurrent_requests_batched(self, harness):
+        results = {}
+
+        def call(i):
+            results[i] = post(harness.url,
+                              {"input": [float(i)] * 3, "codec": "list"})
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        for i in range(3):
+            assert results[i]["result"] == [2.0 * i] * 3
+
+    def test_bad_requests(self, harness):
+        for payload in ({"input": [1, 2, 3]},  # no codec
+                        {"codec": "list"},  # no input
+                        {"input": "x", "codec": "bogus"}):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(harness.url, payload)
+            assert err.value.code == 400
+
+    def test_base64_needs_shape_and_type(self, harness):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(harness.url, {"input": "QUFB", "codec": "base64"})
+        assert err.value.code == 400
+
+
+class TestInteractiveLoader:
+    def test_feed_and_complete(self):
+        loader = InteractiveLoader(DummyWorkflow(), sample_shape=(4,))
+        loader.initialize()
+        served = []
+
+        def run_once():
+            loader.run()
+            served.append(numpy.asarray(loader.minibatch_data.mem).copy())
+
+        t = threading.Thread(target=run_once)
+        t.start()
+        loader.feed(numpy.arange(4.0))
+        t.join(timeout=10)
+        assert not t.is_alive()
+        numpy.testing.assert_array_equal(served[0][0],
+                                         [0.0, 1.0, 2.0, 3.0])
+        loader.feed(None)
+        assert bool(loader.complete)
+
+    def test_feed_from_npy(self, tmp_path):
+        path = str(tmp_path / "x.npy")
+        numpy.save(path, numpy.ones(4, numpy.float32))
+        loader = InteractiveLoader(DummyWorkflow(), sample_shape=(4,))
+        loader.initialize()
+        t = threading.Thread(target=loader.run)
+        t.start()
+        loader.feed(path)
+        t.join(timeout=10)
+        numpy.testing.assert_array_equal(
+            numpy.asarray(loader.minibatch_data.mem)[0], numpy.ones(4))
+
+
+class TestWebStatus:
+    @pytest.fixture
+    def server(self, tmp_path):
+        srv = WebStatusServer(port=0, plots_directory=str(tmp_path))
+        srv.start()
+        yield srv, tmp_path
+        srv.stop()
+
+    def test_update_and_service(self, server):
+        srv, _ = server
+        base = "http://127.0.0.1:%d" % srv.port
+        post(base + "/update", {"name": "wf1", "mode": "master",
+                                "slaves": [{"id": "s1"}], "runtime": 12})
+        with urllib.request.urlopen(base + "/service", timeout=5) as resp:
+            data = json.loads(resp.read().decode())
+        (key, status), = data.items()
+        assert status["name"] == "wf1" and len(status["slaves"]) == 1
+
+    def test_dashboard_html_and_plots(self, server):
+        srv, tmp_path = server
+        (tmp_path / "loss.png").write_bytes(b"\x89PNG fake")
+        base = "http://127.0.0.1:%d" % srv.port
+        with urllib.request.urlopen(base + "/", timeout=5) as resp:
+            html = resp.read().decode()
+        assert "loss.png" in html
+        with urllib.request.urlopen(base + "/plots/loss.png",
+                                    timeout=5) as resp:
+            assert resp.read() == b"\x89PNG fake"
+        # path traversal blocked
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/plots/../secret", timeout=5)
+
+    def test_notifier(self, server):
+        srv, _ = server
+
+        class FakeAgent:
+            @staticmethod
+            def fleet_status():
+                return {"slaves": [{"id": "s1"}, {"id": "s2"}]}
+
+        class FakeLauncher:
+            workflow = type("W", (), {"name": "notified"})()
+            mode = "master"
+            agent = FakeAgent()
+
+        notifier = StatusNotifier(
+            FakeLauncher(), url="http://127.0.0.1:%d/update" % srv.port)
+        assert notifier.notify_once()
+        statuses = srv.statuses()
+        status = next(iter(statuses.values()))
+        assert status["name"] == "notified"
+        assert len(status["slaves"]) == 2

@@ -289,4 +289,8 @@ class Server(Logger):
             self.slaves[sid].paused = False
 
     def fleet_status(self):
-        return [s.as_dict() for s in self.slaves.values()]
+        """Observability snapshot consumed by the web-status dashboard
+        and the SlaveStats plotter (reference ``web_status.py`` feed)."""
+        return {"slaves": [s.as_dict() for s in self.slaves.values()],
+                "blacklist": sorted(self.blacklist),
+                "queued_jobs": len(self._pending_requests)}

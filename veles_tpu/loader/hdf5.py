@@ -26,17 +26,19 @@ from veles_tpu.loader.normalization import make_normalizer
 
 
 def _open_class_file(path, expect_labels):
-    """Open one class file, returning (data, labels, length)."""
+    """Open one class file, returning (h5file, data, labels)."""
     import h5py
     h5f = h5py.File(path, "r")
     data = h5f["data"]
     labels = h5f["label"] if "label" in h5f else None
     if expect_labels is not None and (labels is None) == expect_labels:
+        h5f.close()
         raise ValueError("%s: some class files have labels and some do not"
                          % path)
     if labels is not None and len(labels) != len(data):
+        h5f.close()
         raise ValueError("%s: data and label lengths differ" % path)
-    return data, labels
+    return h5f, data, labels
 
 
 class HDF5PathsMixin:
@@ -62,12 +64,14 @@ class FullBatchHDF5Loader(HDF5PathsMixin, FullBatchLoader):
             if not path:
                 lengths.append(0)
                 continue
-            data, labs = _open_class_file(path, expect_labels)
+            h5f, data, labs = _open_class_file(path, expect_labels)
             expect_labels = labs is not None
+            lengths.append(len(data))
+            # copy out, then close — nothing references the live handles
             datas.append(numpy.asarray(data[:], numpy.float32))
             if labs is not None:
                 labels.append(numpy.asarray(labs[:]))
-            lengths.append(len(data))
+            h5f.close()
         if not datas:
             raise ValueError("%s: no HDF5 paths given" % self.name)
         self._provided_data = numpy.concatenate(datas)
@@ -94,6 +98,15 @@ class HDF5Loader(HDF5PathsMixin, Loader):
     def init_unpickled(self):
         super().init_unpickled()
         self._datasets_ = [None, None, None]
+        self._h5_files_ = []
+
+    def stop(self):
+        for h5f in self._h5_files_:
+            try:
+                h5f.close()
+            except Exception:
+                pass
+        self._h5_files_ = []
 
     def load_data(self):
         expect_labels = None
@@ -102,7 +115,8 @@ class HDF5Loader(HDF5PathsMixin, Loader):
         for klass, path in enumerate(self.class_paths):
             if not path:
                 continue
-            data, labs = _open_class_file(path, expect_labels)
+            h5f, data, labs = _open_class_file(path, expect_labels)
+            self._h5_files_.append(h5f)
             expect_labels = labs is not None
             self._datasets_[klass] = (data, labs)
             self.class_lengths[klass] = len(data)
@@ -160,6 +174,7 @@ class HDF5Loader(HDF5PathsMixin, Loader):
         batch = self.normalizer.apply_batch(numpy, batch)
         mask = (numpy.arange(len(indices)) < valid).astype(numpy.float32)
         self.minibatch_data.data = jnp.asarray(batch)
-        self.minibatch_labels.data = jnp.asarray(labels)
+        if self._raw_labels is not None:
+            self.minibatch_labels.data = jnp.asarray(labels)
         self.sample_mask.data = jnp.asarray(mask)
         self.minibatch_indices.data = jnp.asarray(indices)

@@ -82,7 +82,7 @@ class MinibatchesSaver(Unit):
             "labels_shape": (tuple(self.minibatch_labels.shape)
                              if self.minibatch_labels else None),
             "labels_mapping": dict(getattr(
-                self.workflow.loader, "labels_mapping", {}) or {}),
+                loader, "labels_mapping", {}) or {}),
         }
         pickle.dump(header, self._file_, protocol=4)
 
@@ -192,6 +192,7 @@ class MinibatchesLoader(Loader):
                 labels[i] = labs[local % mb]
         mask = (numpy.arange(len(indices)) < valid).astype(numpy.float32)
         self.minibatch_data.data = jnp.asarray(batch)
-        self.minibatch_labels.data = jnp.asarray(labels)
+        if self._header["labels_shape"] is not None:
+            self.minibatch_labels.data = jnp.asarray(labels)
         self.sample_mask.data = jnp.asarray(mask)
         self.minibatch_indices.data = jnp.asarray(indices)

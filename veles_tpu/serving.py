@@ -1,0 +1,329 @@
+"""Online inference serving: REST API unit + request-fed loaders.
+
+TPU-native re-design of reference ``veles/restful_api.py:78-215``,
+``veles/loader/restful.py:52-140`` and ``veles/loader/interactive.py:57``.
+The reference served over Twisted; here the HTTP server is a stdlib
+``ThreadingHTTPServer`` on a daemon thread and the workflow loop stays in
+the main thread — each handler thread stages its sample, blocks on a
+per-request event, and the loader/API pair wakes it with the result after
+the forward tick.
+
+Request format (identical to the reference):
+``POST <path> {"input": ..., "codec": "list"|"base64"[, "shape": [...],
+"type": "float32"]}`` → ``{"result": ...}``.
+
+Batching: requests accumulate into one static-shape minibatch; a tick
+fires when the batch is full or ``max_response_time`` elapses with at
+least one request staged — so single requests still see bounded latency
+while bursts amortize one XLA dispatch across the whole batch (the TPU
+translation of the reference's LoopingCall flush).
+"""
+
+import base64
+import json
+import threading
+
+import numpy
+
+import jax.numpy as jnp
+
+from veles_tpu.core.config import root
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.units import Unit
+from veles_tpu.loader.base import Loader, TEST, register_loader
+
+
+@register_loader("restful")
+class RestfulLoader(Loader):
+    """Minibatches assembled from live HTTP requests (reference
+    ``RestfulLoader``, ``loader/restful.py:52``)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.sample_shape = tuple(kwargs.pop("sample_shape", ()))
+        self.max_response_time = float(kwargs.pop("max_response_time", 0.1))
+        if self.max_response_time < 0:
+            raise ValueError("max_response_time must be >= 0")
+        super().__init__(workflow, **kwargs)
+        self.complete = Bool(False)
+        self.requests = []
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._event_ = threading.Event()
+        self._lock_ = threading.Lock()
+        self._staged_data_ = None
+        self._staged_requests_ = []
+
+    def derive_from(self, loader):
+        """Adopt the trained loader's sample geometry + normalizer so
+        served inputs get identical preprocessing (reference
+        ``derive_from``)."""
+        self.sample_shape = tuple(loader.minibatch_data.shape[1:])
+        self.normalizer = getattr(loader, "normalizer", None)
+
+    # -- ILoader --------------------------------------------------------------
+    def load_data(self):
+        if not self.sample_shape:
+            raise ValueError(
+                "%s: set sample_shape= or derive_from(trained_loader)"
+                % self.name)
+        self.class_lengths = [self.max_minibatch_size, 0, 0]
+        self._staged_data_ = numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape, numpy.float32)
+
+    def create_minibatch_data(self):
+        mb = self.max_minibatch_size
+        self.minibatch_data.reset(numpy.zeros(
+            (mb,) + self.sample_shape, numpy.float32))
+        self.minibatch_indices.reset(numpy.zeros(mb, numpy.int64))
+        self.sample_mask.reset(numpy.zeros(mb, numpy.float32))
+
+    def fill_minibatch(self, indices, valid):
+        raise AssertionError("RestfulLoader overrides run()")
+
+    # -- serving loop ---------------------------------------------------------
+    def run(self):
+        """Block until at least one request is staged (the flush timer or
+        a full batch sets the event), then publish the minibatch."""
+        while not self._event_.wait(timeout=self.max_response_time or None):
+            if self.complete:
+                return
+            with self._lock_:
+                if self._staged_requests_:
+                    break
+        self._event_.clear()
+        if self.complete:
+            return
+        with self._lock_:
+            n = len(self._staged_requests_)
+            batch = self._staged_data_.copy()
+            self.requests = list(self._staged_requests_)
+            self._staged_requests_ = []
+        normalizer = getattr(self, "normalizer", None)
+        if normalizer is not None:
+            batch = normalizer.apply_batch(numpy, batch)
+        self.minibatch_class = TEST
+        self.minibatch_valid_size = n
+        self.minibatch_data.data = jnp.asarray(batch)
+        self.sample_mask.data = jnp.asarray(
+            (numpy.arange(self.max_minibatch_size) < n
+             ).astype(numpy.float32))
+        self.samples_served += n
+
+    def feed(self, data, request):
+        """Called from HTTP handler threads: stage one sample."""
+        data = numpy.asarray(data, numpy.float32)
+        if data.shape != self.sample_shape:
+            data = data.reshape(self.sample_shape)
+        with self._lock_:
+            slot = len(self._staged_requests_)
+            if slot >= self.max_minibatch_size:
+                raise OverflowError("minibatch overflow: retry")
+            self._staged_data_[slot] = data
+            self._staged_requests_.append(request)
+            if slot + 1 == self.max_minibatch_size:
+                self._event_.set()
+
+    def stop(self):
+        self.complete.set(True)
+        self._event_.set()
+
+
+@register_loader("interactive")
+class InteractiveLoader(Loader):
+    """One-sample serving driven from a REPL: ``loader.feed(obj)``
+    (reference ``InteractiveLoader``, ``loader/interactive.py:57``).
+    ``feed(None)`` completes the workflow."""
+
+    def __init__(self, workflow, **kwargs):
+        self.sample_shape = tuple(kwargs.pop("sample_shape", ()))
+        self.loadtxt_kwargs = kwargs.pop("loadtxt_kwargs", {})
+        kwargs.setdefault("minibatch_size", 1)
+        super().__init__(workflow, **kwargs)
+        self.complete = Bool(False)
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._event_ = threading.Event()
+        self._food_ = None
+
+    def load_data(self):
+        if not self.sample_shape:
+            raise ValueError("%s: set sample_shape=" % self.name)
+        self.class_lengths = [1, 0, 0]
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (1,) + self.sample_shape, numpy.float32))
+        self.minibatch_indices.reset(numpy.zeros(1, numpy.int64))
+        self.sample_mask.reset(numpy.ones(1, numpy.float32))
+
+    def fill_minibatch(self, indices, valid):
+        raise AssertionError("InteractiveLoader overrides run()")
+
+    def run(self):
+        self.info("waiting for feed()...")
+        self._event_.wait()
+        self._event_.clear()
+        if self.complete:
+            return
+        self.minibatch_class = TEST
+        self.minibatch_valid_size = 1
+        self.minibatch_data.data = jnp.asarray(
+            self._food_.reshape((1,) + self.sample_shape))
+        self.samples_served += 1
+
+    def feed(self, obj):
+        if obj is None:
+            self.complete.set(True)
+            self._event_.set()
+            return
+        if isinstance(obj, str):
+            obj = self._load_file(obj)
+        self._food_ = numpy.asarray(obj, numpy.float32)
+        self._event_.set()
+
+    def _load_file(self, path):
+        try:
+            loaded = numpy.load(path)
+            if hasattr(loaded, "files"):  # npz
+                return loaded[loaded.files[0]]
+            return loaded
+        except Exception:
+            return numpy.loadtxt(path, **self.loadtxt_kwargs)
+
+
+class RESTfulAPI(Unit):
+    """HTTP inference endpoint (reference ``RESTfulAPI``,
+    ``restful_api.py:78-215``).
+
+    Wire-up: ``api.link_attrs(loader, "feed", "requests",
+    "minibatch_valid_size")`` and ``api.results = forward_output_array``;
+    place it after the last forward in the control loop."""
+
+    VIEW_GROUP = "SERVICE"
+    #: handler threads give up after this long without a tick
+    RESPONSE_TIMEOUT = 60.0
+
+    def __init__(self, workflow, **kwargs):
+        self.port = int(kwargs.pop("port", root.common.api.get("port",
+                                                               8180)))
+        self.path = kwargs.pop("path",
+                               root.common.api.get("path", "/api"))
+        if not self.path.startswith("/"):
+            raise ValueError("path must start with '/'")
+        super().__init__(workflow, **kwargs)
+        self.results = None
+        self.demand("feed", "requests")
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._httpd_ = None
+        self._thread_ = None
+
+    def initialize(self, **kwargs):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                if self.path != api.path:
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                api.serve(self, self.rfile.read(length))
+
+        self._httpd_ = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd_.server_address[1]
+        self._thread_ = threading.Thread(
+            target=self._httpd_.serve_forever, name="restful-api",
+            daemon=True)
+        self._thread_.start()
+        self.info("listening on 0.0.0.0:%d%s", self.port, self.path)
+
+    def stop(self):
+        if self._httpd_ is not None:
+            self._httpd_.shutdown()
+            self._httpd_ = None
+
+    # -- request side (handler threads) ---------------------------------------
+    def _fail(self, handler, message):
+        self.warning(message)
+        body = json.dumps({"error": message}).encode()
+        handler.send_response(400)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _decode(self, handler, payload):
+        codec = payload.get("codec")
+        if codec == "list":
+            try:
+                return numpy.asarray(payload["input"], numpy.float32)
+            except (ValueError, TypeError) as exc:
+                self._fail(handler, "invalid input array: %s" % exc)
+                return None
+        if codec != "base64":
+            self._fail(handler, "codec must be 'list' or 'base64'")
+            return None
+        shape = payload.get("shape")
+        dtype = payload.get("type")
+        if not isinstance(shape, list) or not shape or dtype is None:
+            self._fail(handler, "base64 codec needs 'shape' and 'type'")
+            return None
+        try:
+            buf = base64.b64decode(payload["input"])
+            return numpy.frombuffer(
+                buf, numpy.dtype(dtype)).reshape(shape).astype(
+                numpy.float32)
+        except Exception as exc:
+            self._fail(handler, "failed to decode: %s" % exc)
+            return None
+
+    def serve(self, handler, raw):
+        try:
+            payload = json.loads(raw.decode())
+        except ValueError:
+            self._fail(handler, "failed to parse JSON")
+            return
+        if not isinstance(payload, dict) or "input" not in payload \
+                or "codec" not in payload:
+            self._fail(handler, "need 'input' and 'codec' attributes")
+            return
+        data = self._decode(handler, payload)
+        if data is None:
+            return
+        responder = {"event": threading.Event(), "result": None}
+        try:
+            self.feed(data, responder)
+        except Exception as exc:
+            self._fail(handler, "invalid input: %s" % exc)
+            return
+        if not responder["event"].wait(self.RESPONSE_TIMEOUT):
+            self._fail(handler, "inference timed out")
+            return
+        body = json.dumps({"result": responder["result"]}).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    # -- response side (workflow thread, after the forward tick) --------------
+    def run(self):
+        if self.results is None:
+            return
+        out = numpy.asarray(getattr(self.results, "mem", self.results))
+        for i, responder in enumerate(self.requests):
+            if responder is None:
+                continue
+            value = out[i]
+            responder["result"] = (value.tolist()
+                                   if isinstance(value, numpy.ndarray)
+                                   else float(value))
+            responder["event"].set()

@@ -1,0 +1,265 @@
+"""Web status dashboard.
+
+TPU-native re-design of reference ``veles/web_status.py:113-165`` + the
+``web/`` SPA. The reference ran a standalone Tornado daemon (auto-launched
+over SSH) backed by MongoDB; masters POSTed status JSON on a timer and a
+bower/gulp dashboard rendered it.
+
+Here it is a dependency-free stdlib server, embeddable in-process or run
+standalone via ``python -m veles_tpu.web_status``:
+
+- ``POST /update``   — masters push status JSON (same role as reference);
+- ``GET  /service``  — AJAX: current statuses as JSON;
+- ``GET  /``         — self-contained HTML dashboard (auto-refreshing):
+  workflows table (name, mode, slaves, runtime) + latest rendered plots;
+- ``GET  /plots/<f>``— serves the GraphicsServer's rendered images;
+- ``GET  /events``   — tail of the event JSONL stream (the Mongo-backed
+  logs page's role, reference ``logger.py:264-289`` consumers).
+
+:class:`StatusNotifier` is the launcher-side agent (reference
+``launcher.py:852-885``): a daemon thread that assembles + POSTs the
+status snapshot every ``notification_interval``.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from veles_tpu.core.config import root
+from veles_tpu.core.logger import Logger
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu status</title>
+<meta http-equiv="refresh" content="3">
+<style>
+ body { font-family: sans-serif; margin: 2em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #999; padding: 4px 10px; }
+ img { max-width: 420px; margin: 8px; border: 1px solid #ccc; }
+</style></head><body>
+<h1>veles_tpu status</h1>
+<h2>Workflows</h2>
+<table><tr><th>name</th><th>mode</th><th>slaves</th><th>runtime (s)</th>
+<th>updated</th></tr>%(rows)s</table>
+<h2>Plots</h2>%(plots)s
+</body></html>"""
+
+
+class WebStatusServer(Logger):
+    """Status receiver + dashboard (reference ``WebServer``,
+    ``web_status.py:113``)."""
+
+    #: drop master records not refreshed for this long (reference GC)
+    STALE_AFTER = 3600.0
+
+    def __init__(self, port=None, plots_directory=None, events_path=None):
+        super().__init__()
+        self.port = port if port is not None \
+            else root.common.web.get("port", 8090)
+        self.plots_directory = plots_directory
+        self.events_path = events_path
+        self._statuses = {}
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, body, content_type="application/json",
+                       code=200):
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/update":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    status = json.loads(self.rfile.read(length).decode())
+                except ValueError:
+                    self._reply('{"error": "bad json"}', code=400)
+                    return
+                server.update(status)
+                self._reply('{"ok": true}')
+
+            def do_GET(self):
+                if self.path.startswith("/service"):
+                    self._reply(json.dumps(server.statuses()))
+                elif self.path.startswith("/events"):
+                    self._reply(json.dumps(server.tail_events()))
+                elif self.path.startswith("/plots/"):
+                    self._serve_plot(self.path[len("/plots/"):])
+                elif self.path in ("/", "/index.html"):
+                    self._reply(server.render_page(), "text/html")
+                else:
+                    self.send_error(404)
+
+            def _serve_plot(self, name):
+                directory = server.plots_directory
+                if not directory or os.path.sep in name or ".." in name:
+                    self.send_error(404)
+                    return
+                path = os.path.join(directory, name)
+                if not os.path.isfile(path):
+                    self.send_error(404)
+                    return
+                with open(path, "rb") as fin:
+                    data = fin.read()
+                ctype = ("application/pdf" if name.endswith(".pdf")
+                         else "image/png")
+                self._reply(data, ctype)
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="web-status",
+            daemon=True)
+        self._thread.start()
+        self.info("web status on http://localhost:%d/", self.port)
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    # -- state ----------------------------------------------------------------
+    def update(self, status):
+        with self._lock:
+            key = status.get("id") or status.get("name", "?")
+            status["updated"] = time.time()
+            self._statuses[key] = status
+            # GC stale masters (reference old-record GC)
+            cutoff = time.time() - self.STALE_AFTER
+            for k in [k for k, s in self._statuses.items()
+                      if s["updated"] < cutoff]:
+                del self._statuses[k]
+
+    def statuses(self):
+        with self._lock:
+            return dict(self._statuses)
+
+    def tail_events(self, limit=200):
+        path = self.events_path
+        if not path or not os.path.isfile(path):
+            return []
+        with open(path, "r") as fin:
+            lines = fin.readlines()[-limit:]
+        out = []
+        for line in lines:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+        return out
+
+    def render_page(self):
+        rows = []
+        for key, s in sorted(self.statuses().items()):
+            rows.append(
+                "<tr><td>%s</td><td>%s</td><td>%s</td><td>%.0f</td>"
+                "<td>%s</td></tr>" % (
+                    s.get("name", key), s.get("mode", "?"),
+                    len(s.get("slaves", [])), s.get("runtime", 0),
+                    time.strftime("%X",
+                                  time.localtime(s.get("updated", 0)))))
+        plots = []
+        if self.plots_directory and os.path.isdir(self.plots_directory):
+            for path in sorted(glob.glob(
+                    os.path.join(self.plots_directory, "*.png"))):
+                name = os.path.basename(path)
+                plots.append('<img src="/plots/%s" alt="%s"/>'
+                             % (name, name))
+        return _PAGE % {"rows": "".join(rows) or
+                        "<tr><td colspan=5>none</td></tr>",
+                        "plots": "".join(plots) or "<p>none</p>"}
+
+
+class StatusNotifier:
+    """Launcher-side status pusher (reference ``launcher.py:852-885``):
+    POSTs the workflow/fleet snapshot to a WebStatusServer every
+    ``notification_interval`` seconds."""
+
+    def __init__(self, launcher, url=None, interval=None):
+        self.launcher = launcher
+        self.url = url or "http://%s:%d/update" % (
+            root.common.web.get("host", "localhost"),
+            root.common.web.get("port", 8090))
+        self.interval = interval if interval is not None \
+            else root.common.web.get("notification_interval", 1.0)
+        self._stop = threading.Event()
+        self._thread = None
+        self._started_at = time.time()
+
+    def snapshot(self):
+        launcher = self.launcher
+        status = {
+            "id": "%s-%d" % (getattr(launcher.workflow, "name", "workflow"),
+                             os.getpid()),
+            "name": getattr(launcher.workflow, "name", "workflow"),
+            "mode": launcher.mode,
+            "runtime": time.time() - self._started_at,
+            "slaves": [],
+        }
+        agent = getattr(launcher, "agent", None)
+        if agent is not None and hasattr(agent, "fleet_status"):
+            status["slaves"] = agent.fleet_status().get("slaves", [])
+        return status
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="status-notifier", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def notify_once(self):
+        body = json.dumps(self.snapshot()).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status == 200
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.notify_once()
+            except Exception:
+                pass  # dashboard down is never fatal to training
+
+
+def main():  # pragma: no cover - manual entry point
+    from veles_tpu.core.logger import setup_logging
+    setup_logging()
+    server = WebStatusServer(
+        plots_directory=os.path.join(
+            root.common.dirs.get("cache", "."), "plots"))
+    server.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
