@@ -1,0 +1,154 @@
+"""Tests for InputJoiner, Avatar, Shell, and the callable-module API
+(reference test_input_joiner.py / test_avatar coverage + __init__ API)."""
+
+import numpy
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu.avatar import Avatar
+from veles_tpu.core.mutable import Bool
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.interaction import Shell
+from veles_tpu.memory import Array
+from veles_tpu.nn.joiner import InputJoiner
+
+
+class TestInputJoiner:
+    def test_join_two(self):
+        a, b = Array(), Array()
+        a.reset(numpy.arange(12, dtype=numpy.float32).reshape(4, 3))
+        b.reset(numpy.arange(8, dtype=numpy.float32).reshape(4, 2))
+        joiner = InputJoiner(DummyWorkflow(), inputs=[a, b])
+        joiner.initialize()
+        assert (joiner.offset_0, joiner.length_0) == (0, 3)
+        assert (joiner.offset_1, joiner.length_1) == (3, 2)
+        a.to_device()
+        b.to_device()
+        joiner.run()
+        out = numpy.asarray(joiner.output.mem)
+        assert out.shape == (4, 5)
+        numpy.testing.assert_array_equal(out[:, :3], a.mem)
+        numpy.testing.assert_array_equal(out[:, 3:], b.mem)
+
+    def test_join_flattens_trailing_dims(self):
+        a, b = Array(), Array()
+        a.reset(numpy.ones((2, 2, 2), numpy.float32))
+        b.reset(numpy.zeros((2, 3), numpy.float32))
+        joiner = InputJoiner(DummyWorkflow(), inputs=[a, b])
+        joiner.initialize()
+        a.to_device()
+        b.to_device()
+        joiner.run()
+        assert joiner.output.shape == (2, 7)
+
+    def test_shorter_first_axis_truncates(self):
+        a, b = Array(), Array()
+        a.reset(numpy.ones((4, 2), numpy.float32))
+        b.reset(numpy.ones((3, 2), numpy.float32))
+        joiner = InputJoiner(DummyWorkflow(), inputs=[a, b])
+        joiner.initialize()
+        a.to_device()
+        b.to_device()
+        joiner.run()
+        assert joiner.output.shape == (3, 4)
+
+    def test_no_inputs_raises(self):
+        with pytest.raises(ValueError):
+            InputJoiner(DummyWorkflow()).initialize()
+
+
+class TestAvatar:
+    def test_clones_arrays_bools_and_plain(self):
+        wf = DummyWorkflow()
+
+        class Producer:
+            weights = Array()
+            flag = Bool(False)
+            epoch = 3
+            stats = {"a": 1}
+
+        producer = Producer()
+        producer.weights.reset(numpy.ones((2, 2), numpy.float32))
+        producer.weights.to_device()
+        avatar = Avatar(wf)
+        avatar.link_clones(producer, "weights", "flag", "epoch", "stats")
+        avatar.initialize()
+        numpy.testing.assert_array_equal(
+            numpy.asarray(avatar.weights.mem), numpy.ones((2, 2)))
+        assert not bool(avatar.flag)
+        assert avatar.epoch == 3
+        # mutate producer: avatar stays stale until next clone
+        producer.weights.data = jnp.zeros((2, 2))
+        producer.flag.set(True)
+        producer.stats["a"] = 2
+        assert float(numpy.asarray(avatar.weights.mem).max()) == 1.0
+        assert avatar.stats == {"a": 1}
+        avatar.run()
+        assert float(numpy.asarray(avatar.weights.mem).max()) == 0.0
+        assert bool(avatar.flag)
+        assert avatar.stats == {"a": 2}
+
+
+class TestShell:
+    def test_noop_without_trigger(self):
+        shell = Shell(DummyWorkflow())
+        shell.run()  # no trigger: silently continues
+
+    def test_interrupt_embeds(self, monkeypatch):
+        shell = Shell(DummyWorkflow())
+        opened = []
+        monkeypatch.setattr(shell, "embed",
+                            lambda: opened.append(True))
+        shell.run()
+        assert not opened
+        shell.interrupt()
+        shell.run()
+        assert opened == [True]
+        shell.run()  # trigger consumed
+        assert opened == [True]
+
+    def test_file_trigger(self, tmp_path, monkeypatch):
+        trigger = tmp_path / "shell"
+        shell = Shell(DummyWorkflow(), trigger_path=str(trigger))
+        opened = []
+        monkeypatch.setattr(shell, "embed", lambda: opened.append(True))
+        shell.run()
+        assert not opened
+        trigger.write_text("")
+        shell.run()
+        assert opened == [True]
+        assert not trigger.exists()  # consumed
+
+
+class TestCallableModule:
+    def test_kwargs_to_argv(self):
+        from veles_tpu.cli import kwargs_to_argv
+        argv = kwargs_to_argv("wf.py", "cfg.py",
+                              overrides=("root.a=1",),
+                              listen="0.0.0.0:5050", seed=42,
+                              async_slave=True, dump_config=False)
+        assert argv == ["wf.py", "cfg.py", "root.a=1",
+                        "--listen", "0.0.0.0:5050", "--seed", "42",
+                        "--async-slave"]
+
+    def test_module_is_callable_end_to_end(self, tmp_path):
+        import veles_tpu
+        wf_file = tmp_path / "tiny_wf.py"
+        wf_file.write_text("""
+import numpy
+from veles_tpu.models.mlp import MLPWorkflow
+
+def run(load, main):
+    rng = numpy.random.RandomState(0)
+    X = rng.rand(80, 8).astype(numpy.float32)
+    y = (X[:, 0] > 0.5).astype(numpy.int32)
+    load(MLPWorkflow, layers=(8, 2),
+         loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 20, 60],
+                            minibatch_size=20),
+         learning_rate=0.5, max_epochs=2)
+    main()
+""")
+        launcher = veles_tpu(str(wf_file))
+        assert launcher is not None
+        assert launcher.workflow.decision.epochs_done >= 2

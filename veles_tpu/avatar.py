@@ -1,0 +1,68 @@
+"""Avatar: run a sub-graph on a frozen copy of other units' state.
+
+TPU-native re-design of reference ``veles/avatar.py:22-129``: an Avatar
+registers (unit, attrs) pairs via :meth:`link_clones`; each ``run()`` (or
+explicit :meth:`clone`) deep-copies those attributes onto itself — Arrays
+become independent device buffers (``jnp`` arrays are immutable, so the
+"copy" is a reference publish; host numpy is copied), Bools keep their
+value, everything else is deep-copied. Consumers link from the Avatar
+instead of the live units and therefore see a stale-but-consistent
+snapshot, e.g. a plotter or exporter running concurrently with training.
+"""
+
+import copy
+
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.units import Unit
+from veles_tpu.memory import Array
+
+import numpy
+
+
+class Avatar(Unit):
+    """State-cloning proxy unit (reference ``Avatar``, ``avatar.py:22``)."""
+
+    VIEW_GROUP = "LOADER"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.reals = {}
+        self._remembers_gates = False
+
+    def link_clones(self, unit, *attrs):
+        """Declare which attributes of ``unit`` this Avatar mirrors."""
+        self.reals.setdefault(unit, []).extend(attrs)
+
+    def clone(self):
+        for unit, attrs in self.reals.items():
+            for attr in attrs:
+                value = getattr(unit, attr)
+                if isinstance(value, Array):
+                    mine = getattr(self, attr, None)
+                    if not isinstance(mine, Array):
+                        mine = Array()
+                        setattr(self, attr, mine)
+                    if value.data is not None:
+                        # jax arrays are immutable: publishing the ref IS
+                        # a snapshot; the producer writes new arrays, not
+                        # in-place mutations
+                        mine.data = value.data
+                    elif value.mem is not None:
+                        mine.reset(numpy.array(value.mem))
+                elif isinstance(value, Bool):
+                    mine = getattr(self, attr, None)
+                    if isinstance(mine, Bool):
+                        mine.set(bool(value))
+                    else:
+                        setattr(self, attr, Bool(bool(value)))
+                elif isinstance(value, (int, float, str, bytes, tuple,
+                                        type(None))):
+                    setattr(self, attr, value)
+                else:
+                    setattr(self, attr, copy.deepcopy(value))
+
+    def initialize(self, **kwargs):
+        self.clone()
+
+    def run(self):
+        self.clone()

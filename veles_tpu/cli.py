@@ -1,0 +1,41 @@
+"""Programmatic launch API — the callable-module backend.
+
+Reference ``veles/__init__.py:126-189`` (``VelesModule.__call__``): the
+package itself is callable — ``import veles_tpu; veles_tpu("wf.py",
+"wf_config.py", listen="0.0.0.0:5050", seed=42)`` runs exactly what the
+``python -m veles_tpu`` command line would, with kwargs mirroring the CLI
+flags (underscores for dashes). ``subprocess=True`` forks the run into a
+``multiprocessing.Process`` and returns it immediately (reference
+``__init__.py:169-175``)."""
+
+
+def kwargs_to_argv(workflow_file, config_file=None, overrides=(),
+                   **kwargs):
+    """Translate call kwargs into the equivalent CLI argv."""
+    argv = [str(workflow_file), str(config_file or "-")]
+    argv.extend(overrides)
+    for key, value in kwargs.items():
+        flag = "--" + key.replace("_", "-")
+        if isinstance(value, bool):
+            if value:
+                argv.append(flag)
+        elif value is not None:
+            argv.extend((flag, str(value)))
+    return argv
+
+
+def run_workflow_file(workflow_file, config_file=None, **kwargs):
+    """Run a workflow file; returns the Launcher (or the started Process
+    with ``subprocess=True``)."""
+    if kwargs.pop("subprocess", False):
+        from multiprocessing import Process
+        proc = Process(target=run_workflow_file, name="veles_tpu.__call__",
+                       args=(workflow_file, config_file), kwargs=kwargs)
+        proc.start()
+        return proc
+    from veles_tpu.__main__ import Main
+    main = Main()
+    rc = main.run(kwargs_to_argv(workflow_file, config_file, **kwargs))
+    if rc:
+        raise RuntimeError("workflow run failed with exit code %s" % rc)
+    return main.launcher

@@ -1,0 +1,74 @@
+"""Shell: drop into an interactive console mid-workflow.
+
+TPU-native re-design of reference ``veles/interaction.py:49-95``: the
+reference Shell listened for ``i``+Enter on stdin and embedded IPython on
+the next run(). Here the unit checks a trigger each run (stdin key, an
+explicit ``interrupt()`` call, or ``trigger_path`` file existence — the
+last works under nohup/cluster runs where stdin is detached) and embeds an
+IPython console with the workflow in scope; training resumes when the
+console exits."""
+
+import os
+import select
+import sys
+
+from veles_tpu.core.units import Unit
+
+
+class Shell(Unit):
+    """Interactive breakpoint unit (reference ``Shell``,
+    ``interaction.py:49``)."""
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, **kwargs):
+        self.trigger_path = kwargs.pop("trigger_path", None)
+        super().__init__(workflow, **kwargs)
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._interrupt_ = False
+
+    def interrupt(self):
+        """Programmatic trigger: the next run() opens the console."""
+        self._interrupt_ = True
+
+    def _stdin_triggered(self):
+        if not sys.stdin or not sys.stdin.isatty():
+            return False
+        try:
+            ready, _, _ = select.select([sys.stdin], [], [], 0)
+        except (OSError, ValueError):
+            return False
+        if not ready:
+            return False
+        line = sys.stdin.readline()
+        return line.strip().lower() == "i"
+
+    def _file_triggered(self):
+        if self.trigger_path and os.path.exists(self.trigger_path):
+            os.unlink(self.trigger_path)
+            return True
+        return False
+
+    def run(self):
+        if not (self._interrupt_ or self._file_triggered()
+                or self._stdin_triggered()):
+            return
+        self._interrupt_ = False
+        self.info("dropping into the interactive shell "
+                  "(exit to resume training)")
+        self.embed()
+
+    def embed(self):
+        banner = ("veles_tpu shell — workflow=%r; `workflow` and `unit` "
+                  "are in scope" % self.workflow.name)
+        try:
+            import IPython
+            IPython.embed(banner1=banner,
+                          user_ns={"workflow": self.workflow,
+                                   "unit": self})
+        except ImportError:
+            import code
+            code.interact(banner=banner,
+                          local={"workflow": self.workflow, "unit": self})

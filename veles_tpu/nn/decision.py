@@ -108,6 +108,11 @@ class DecisionGD(Unit):
         else:
             self._epochs_without_improvement += 1
 
+    @property
+    def epochs_done(self):
+        """Completed-epoch count (the published 'epochs' metric)."""
+        return self._epochs_done
+
     def _epoch_summary(self, stats, epoch):
         """All classes of ``epoch`` accounted: decide whether to stop.
         ``stats[klass]`` is (n_err, samples, loss_sum)."""
