@@ -25,11 +25,11 @@ def post(url, payload, timeout=10):
 class ServingHarness:
     """loader -> double(input) -> api loop on a background thread."""
 
-    def __init__(self, mb=4):
+    def __init__(self, mb=4, max_response_time=0.05):
         wf = DummyWorkflow()
         self.loader = RestfulLoader(wf, sample_shape=(3,),
                                     minibatch_size=mb,
-                                    max_response_time=0.05)
+                                    max_response_time=max_response_time)
         self.loader.initialize()
         self.api = RESTfulAPI(wf, port=0, path="/api")
         self.api.feed = self.loader.feed
@@ -109,6 +109,22 @@ class TestRESTfulAPI:
             post(harness.url, {"input": "QUFB", "codec": "base64"})
         assert err.value.code == 400
 
+    def test_ragged_list_input_gets_400(self, harness):
+        # regression: ragged arrays must 400, not drop the connection
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(harness.url, {"input": [[1], [2, 3]], "codec": "list"})
+        assert err.value.code == 400
+
+    def test_zero_max_response_time_still_flushes(self):
+        # regression: max_response_time=0 meant "wait forever"
+        h = ServingHarness(mb=4, max_response_time=0)
+        try:
+            out = post(h.url, {"input": [1.0, 1.0, 1.0], "codec": "list"},
+                       timeout=15)
+            assert out["result"] == [2.0, 2.0, 2.0]
+        finally:
+            h.close()
+
 
 class TestInteractiveLoader:
     def test_feed_and_complete(self):
@@ -174,6 +190,19 @@ class TestWebStatus:
         # path traversal blocked
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(base + "/plots/../secret", timeout=5)
+
+    def test_update_payloads_escaped_and_coerced(self, server):
+        # regression: /update is unauthenticated — hostile payloads must
+        # neither script-inject nor 500 the dashboard
+        srv, _ = server
+        base = "http://127.0.0.1:%d" % srv.port
+        post(base + "/update", {"name": "<script>alert(1)</script>",
+                                "mode": "<b>x</b>", "runtime": "12s",
+                                "slaves": "not-a-list"})
+        with urllib.request.urlopen(base + "/", timeout=5) as resp:
+            html = resp.read().decode()
+        assert "<script>alert(1)</script>" not in html
+        assert "&lt;script&gt;" in html
 
     def test_notifier(self, server):
         srv, _ = server

@@ -66,6 +66,15 @@ class Main(Logger):
                             metavar="SIZE:GENERATIONS",
                             help="genetic hyperparameter search over "
                                  "Range() config values")
+        parser.add_argument("--optimize-fleet", default=None,
+                            metavar="HOST:PORT",
+                            help="distribute --optimize evaluations to "
+                                 "fleet slaves (run them with "
+                                 "`python -m veles_tpu.fleet.farm "
+                                 "HOST:PORT --name genetics`)")
+        parser.add_argument("--optimize-representation", default="numeric",
+                            choices=("numeric", "gray"),
+                            help="chromosome representation for --optimize")
         parser.add_argument("--ensemble-train", default=None,
                             metavar="N:RATIO",
                             help="train N instances on RATIO of the train "
@@ -210,7 +219,9 @@ class Main(Logger):
         optimizer = GeneticsOptimizer(
             args.workflow, args.config, genes=genes,
             population_size=int(size or 12),
-            generations=int(gens or 5), seed=args.seed)
+            generations=int(gens or 5), seed=args.seed,
+            fleet=args.optimize_fleet,
+            representation=args.optimize_representation)
         best = optimizer.run()
         if best is None:
             return 1

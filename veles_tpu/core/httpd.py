@@ -1,0 +1,49 @@
+"""Shared stdlib HTTP-server plumbing for the service units.
+
+One implementation of the ThreadingHTTPServer-on-daemon-thread lifecycle
+and JSON reply bookkeeping, used by the REST inference API
+(``serving.py``) and the web-status dashboard (``web_status.py``).
+Binds loopback by default — the same posture as the fleet server
+(``fleet/server.py``); pass an explicit host to expose wider.
+"""
+
+import json
+import threading
+
+
+class QuietHandlerMixin:
+    """Suppress the per-request stderr log lines."""
+
+    def log_message(self, *args):
+        pass
+
+
+def reply(handler, body, code=200, content_type="application/json"):
+    """Write one complete HTTP response."""
+    if isinstance(body, (dict, list)):
+        body = json.dumps(body).encode()
+    elif isinstance(body, str):
+        body = body.encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def read_body(handler):
+    length = int(handler.headers.get("Content-Length", 0))
+    return handler.rfile.read(length)
+
+
+def start_server(handler_cls, host="127.0.0.1", port=0, name="httpd"):
+    """Start a ThreadingHTTPServer on a daemon thread.
+
+    Returns (httpd, resolved_port). Stop with ``httpd.shutdown()``."""
+    from http.server import ThreadingHTTPServer
+
+    httpd = ThreadingHTTPServer((host, port), handler_cls)
+    thread = threading.Thread(target=httpd.serve_forever, name=name,
+                              daemon=True)
+    thread.start()
+    return httpd, httpd.server_address[1]

@@ -9,5 +9,7 @@ collecting outputs for a downstream combiner model
 (``test_workflow.py:50-107`` + ``loader/ensemble.py``).
 """
 
+from veles_tpu.ensemble.combiner import (  # noqa: F401
+    EnsembleLoader, OutputDumper, build_combiner_file)
 from veles_tpu.ensemble.runner import (  # noqa: F401
     EnsembleTester, EnsembleTrainer)

@@ -118,6 +118,27 @@ class Server(Logger):
         self.info("master listening on %s:%d", self.host, self.port)
         return self
 
+    def kick(self):
+        """Replay backpressured job requests. The task farm calls this
+        after submit() so parked slaves re-request without waiting for
+        the next update (which, between GA generations, never comes)."""
+        if self._loop is not None and not self._stopped.is_set():
+            asyncio.run_coroutine_threadsafe(self._retry_pending(),
+                                             self._loop)
+
+    def drain(self, timeout=10.0):
+        """Block until every slave has disconnected or gone IDLE (all
+        parked requests answered). Call between kick() and stop() so the
+        clean 'no more jobs' frames actually reach the slaves before the
+        event loop dies."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not self._pending_requests and all(
+                    s.state in ("IDLE",) for s in self.slaves.values()):
+                return True
+            time.sleep(0.05)
+        return False
+
     def stop(self):
         if self._stopped.is_set():
             return

@@ -2,12 +2,75 @@
 
 Reference ``genetics/core.py`` implements binary+gray-code and numeric
 chromosomes with uniform/one-point/two-point/arithmetic/geometric crossover,
-several mutations and roulette selection. The numeric tier carries all the
-optimization power for hyperparameters, so that is what survives here —
-with the same operator set and roulette wheel.
+several mutations and roulette selection. Both tiers exist here:
+
+- **numeric** (default): gene values crossed/mutated directly;
+- **gray** (``representation="gray"``): each gene quantized to
+  ``accuracy`` steps and encoded as a fixed-width Gray-code bit field
+  (reference ``core.py:70-120``: recursive code tables + binary-point
+  mutation; here the codec is the arithmetic identity ``n ^ (n >> 1)`` —
+  same codes, no tables). Crossover cuts the concatenated bit string;
+  ``binary_point`` mutation flips individual bits. Gray coding keeps
+  single-bit flips adjacent in value space, the property the reference's
+  binary tier existed for.
 """
 
+import math
+
 from veles_tpu.core import prng
+
+
+def gray_encode(n):
+    """Integer -> Gray code (reference ``gray()`` tables, core.py:70)."""
+    return n ^ (n >> 1)
+
+
+def gray_decode(g):
+    n = 0
+    while g:
+        n ^= g
+        g >>= 1
+    return n
+
+
+class GrayCodec:
+    """Fixed-width Gray-code codec for one gene list (reference
+    ``bin_to_num``/``num_to_bin``, core.py:86-120)."""
+
+    def __init__(self, genes, accuracy=1000):
+        self.genes = genes
+        self.accuracy = accuracy
+        self.widths = []
+        for _, gene in genes:
+            steps = max(1, int(round(
+                (gene.max_value - gene.min_value) * accuracy)))
+            self.widths.append(max(1, math.ceil(math.log2(steps + 1))))
+
+    @property
+    def total_bits(self):
+        return sum(self.widths)
+
+    def encode(self, values):
+        bits = []
+        for (_, gene), width, value in zip(self.genes, self.widths,
+                                           values):
+            step = int(round((value - gene.min_value) * self.accuracy))
+            step = min(max(step, 0), (1 << width) - 1)
+            code = gray_encode(step)
+            bits.extend((code >> (width - 1 - b)) & 1
+                        for b in range(width))
+        return bits
+
+    def decode(self, bits):
+        values, pos = [], 0
+        for (_, gene), width in zip(self.genes, self.widths):
+            code = 0
+            for b in bits[pos:pos + width]:
+                code = (code << 1) | b
+            pos += width
+            value = gene.min_value + gray_decode(code) / self.accuracy
+            values.append(gene.clip(value))
+        return values
 
 
 class Chromosome:
@@ -32,6 +95,7 @@ class Population:
 
     def __init__(self, genes, size=20, crossover="uniform",
                  mutation="gaussian", mutation_rate=0.15, elite=2,
+                 representation="numeric", accuracy=1000,
                  prng_key="genetics"):
         self.genes = genes
         self.size = size
@@ -39,6 +103,13 @@ class Population:
         self.mutation_type = mutation
         self.mutation_rate = mutation_rate
         self.elite = elite
+        if representation not in ("numeric", "gray"):
+            raise ValueError("representation must be 'numeric' or 'gray'")
+        self.representation = representation
+        self.codec = (GrayCodec(genes, accuracy)
+                      if representation == "gray" else None)
+        if representation == "gray" and mutation == "gaussian":
+            self.mutation_type = "binary_point"
         self.rng = prng.get(prng_key)
         self.generation = 0
         self.members = [self._random_member() for _ in range(size)]
@@ -68,8 +139,41 @@ class Population:
                 return member
         return self.members[-1]
 
+    # -- gray-tier operators --------------------------------------------------
+    def _cross_bits(self, a, b):
+        """Crossover over the concatenated Gray bit strings (reference
+        ``cross_pointed``/``cross_uniform`` binary branches)."""
+        abits, bbits = self.codec.encode(a.values), \
+            self.codec.encode(b.values)
+        n = len(abits)
+        kind = self.crossover_type
+        if kind == "uniform":
+            bits = [abits[i] if self.rng.random_sample() < 0.5
+                    else bbits[i] for i in range(n)]
+        elif kind == "one_point":
+            point = int(self.rng.randint(1, max(n, 2)))
+            bits = abits[:point] + bbits[point:]
+        else:  # two_point (cross() routes only the three bit kinds here)
+            p1 = int(self.rng.randint(0, n))
+            p2 = int(self.rng.randint(p1, n)) + 1
+            bits = abits[:p1] + bbits[p1:p2] + abits[p2:]
+        return Chromosome(self.genes, self.codec.decode(bits))
+
+    def _mutate_bits(self, member):
+        """binary_point mutation: flip bits with mutation_rate probability
+        (reference ``mutation_binary_point``, core.py:260)."""
+        bits = self.codec.encode(member.values)
+        for i in range(len(bits)):
+            if self.rng.random_sample() < self.mutation_rate:
+                bits[i] ^= 1
+        member.values = self.codec.decode(bits)
+        return member
+
     # -- crossover -------------------------------------------------------------
     def cross(self, a, b):
+        if self.codec is not None and self.crossover_type in (
+                "uniform", "one_point", "two_point"):
+            return self._cross_bits(a, b)
         n = len(a.values)
         kind = self.crossover_type
         if kind == "uniform":
@@ -98,6 +202,11 @@ class Population:
 
     # -- mutation --------------------------------------------------------------
     def mutate(self, member):
+        if self.mutation_type == "binary_point":
+            if self.codec is None:
+                raise ValueError("binary_point mutation needs "
+                                 "representation='gray'")
+            return self._mutate_bits(member)
         for i, (_, gene) in enumerate(self.genes):
             if self.rng.random_sample() >= self.mutation_rate:
                 continue

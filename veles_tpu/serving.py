@@ -85,7 +85,11 @@ class RestfulLoader(Loader):
     def run(self):
         """Block until at least one request is staged (the flush timer or
         a full batch sets the event), then publish the minibatch."""
-        while not self._event_.wait(timeout=self.max_response_time or None):
+        # max_response_time=0 means "flush as soon as anything is staged":
+        # poll at a small interval rather than waiting forever
+        poll = self.max_response_time if self.max_response_time > 0 \
+            else 0.01
+        while not self._event_.wait(timeout=poll):
             if self.complete:
                 return
             with self._lock_:
@@ -210,6 +214,9 @@ class RESTfulAPI(Unit):
                                                                8180)))
         self.path = kwargs.pop("path",
                                root.common.api.get("path", "/api"))
+        # loopback by default — same posture as the fleet server
+        self.host = kwargs.pop("host",
+                               root.common.api.get("host", "127.0.0.1"))
         if not self.path.startswith("/"):
             raise ValueError("path must start with '/'")
         super().__init__(workflow, **kwargs)
@@ -219,31 +226,24 @@ class RESTfulAPI(Unit):
     def init_unpickled(self):
         super().init_unpickled()
         self._httpd_ = None
-        self._thread_ = None
 
     def initialize(self, **kwargs):
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from http.server import BaseHTTPRequestHandler
+        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
+                                          start_server)
 
         api = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
+        class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_POST(self):
                 if self.path != api.path:
                     self.send_error(404)
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                api.serve(self, self.rfile.read(length))
+                api.serve(self, read_body(self))
 
-        self._httpd_ = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
-        self.port = self._httpd_.server_address[1]
-        self._thread_ = threading.Thread(
-            target=self._httpd_.serve_forever, name="restful-api",
-            daemon=True)
-        self._thread_.start()
-        self.info("listening on 0.0.0.0:%d%s", self.port, self.path)
+        self._httpd_, self.port = start_server(
+            Handler, self.host, self.port, name="restful-api")
+        self.info("listening on %s:%d%s", self.host, self.port, self.path)
 
     def stop(self):
         if self._httpd_ is not None:
@@ -252,13 +252,9 @@ class RESTfulAPI(Unit):
 
     # -- request side (handler threads) ---------------------------------------
     def _fail(self, handler, message):
+        from veles_tpu.core.httpd import reply
         self.warning(message)
-        body = json.dumps({"error": message}).encode()
-        handler.send_response(400)
-        handler.send_header("Content-Type", "application/json")
-        handler.send_header("Content-Length", str(len(body)))
-        handler.end_headers()
-        handler.wfile.write(body)
+        reply(handler, {"error": message}, code=400)
 
     def _decode(self, handler, payload):
         codec = payload.get("codec")
@@ -307,12 +303,8 @@ class RESTfulAPI(Unit):
         if not responder["event"].wait(self.RESPONSE_TIMEOUT):
             self._fail(handler, "inference timed out")
             return
-        body = json.dumps({"result": responder["result"]}).encode()
-        handler.send_response(200)
-        handler.send_header("Content-Type", "application/json")
-        handler.send_header("Content-Length", str(len(body)))
-        handler.end_headers()
-        handler.wfile.write(body)
+        from veles_tpu.core.httpd import reply
+        reply(handler, {"result": responder["result"]})
 
     # -- response side (workflow thread, after the forward tick) --------------
     def run(self):

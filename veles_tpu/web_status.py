@@ -55,58 +55,52 @@ class WebStatusServer(Logger):
     #: drop master records not refreshed for this long (reference GC)
     STALE_AFTER = 3600.0
 
-    def __init__(self, port=None, plots_directory=None, events_path=None):
+    def __init__(self, port=None, host=None, plots_directory=None,
+                 events_path=None):
         super().__init__()
         self.port = port if port is not None \
             else root.common.web.get("port", 8090)
+        # loopback by default — same posture as the fleet server
+        self.host = host or root.common.web.get("host", "127.0.0.1")
         self.plots_directory = plots_directory
         self.events_path = events_path
         self._statuses = {}
         self._lock = threading.Lock()
         self._httpd = None
-        self._thread = None
 
     def start(self):
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from http.server import BaseHTTPRequestHandler
+        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
+                                          reply, start_server)
 
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
-            def _reply(self, body, content_type="application/json",
-                       code=200):
-                if isinstance(body, str):
-                    body = body.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_POST(self):
                 if self.path != "/update":
                     self.send_error(404)
                     return
-                length = int(self.headers.get("Content-Length", 0))
                 try:
-                    status = json.loads(self.rfile.read(length).decode())
+                    status = json.loads(read_body(self).decode())
                 except ValueError:
-                    self._reply('{"error": "bad json"}', code=400)
+                    reply(self, {"error": "bad json"}, code=400)
+                    return
+                if not isinstance(status, dict):
+                    reply(self, {"error": "status must be an object"},
+                          code=400)
                     return
                 server.update(status)
-                self._reply('{"ok": true}')
+                reply(self, {"ok": True})
 
             def do_GET(self):
                 if self.path.startswith("/service"):
-                    self._reply(json.dumps(server.statuses()))
+                    reply(self, server.statuses())
                 elif self.path.startswith("/events"):
-                    self._reply(json.dumps(server.tail_events()))
+                    reply(self, server.tail_events())
                 elif self.path.startswith("/plots/"):
                     self._serve_plot(self.path[len("/plots/"):])
                 elif self.path in ("/", "/index.html"):
-                    self._reply(server.render_page(), "text/html")
+                    reply(self, server.render_page(), 200, "text/html")
                 else:
                     self.send_error(404)
 
@@ -123,15 +117,11 @@ class WebStatusServer(Logger):
                     data = fin.read()
                 ctype = ("application/pdf" if name.endswith(".pdf")
                          else "image/png")
-                self._reply(data, ctype)
+                reply(self, data, 200, ctype)
 
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="web-status",
-            daemon=True)
-        self._thread.start()
-        self.info("web status on http://localhost:%d/", self.port)
+        self._httpd, self.port = start_server(
+            Handler, self.host, self.port, name="web-status")
+        self.info("web status on http://%s:%d/", self.host, self.port)
         return self
 
     def stop(self):
@@ -170,20 +160,31 @@ class WebStatusServer(Logger):
         return out
 
     def render_page(self):
+        # /update is unauthenticated: escape everything interpolated into
+        # the page (stored-XSS guard) and coerce numerics defensively
+        from html import escape
         rows = []
         for key, s in sorted(self.statuses().items()):
+            try:
+                runtime = float(s.get("runtime", 0))
+            except (TypeError, ValueError):
+                runtime = 0.0
+            slaves = s.get("slaves", [])
             rows.append(
-                "<tr><td>%s</td><td>%s</td><td>%s</td><td>%.0f</td>"
+                "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.0f</td>"
                 "<td>%s</td></tr>" % (
-                    s.get("name", key), s.get("mode", "?"),
-                    len(s.get("slaves", [])), s.get("runtime", 0),
+                    escape(str(s.get("name", key))),
+                    escape(str(s.get("mode", "?"))),
+                    len(slaves) if isinstance(slaves, (list, tuple))
+                    else 0,
+                    runtime,
                     time.strftime("%X",
                                   time.localtime(s.get("updated", 0)))))
         plots = []
         if self.plots_directory and os.path.isdir(self.plots_directory):
             for path in sorted(glob.glob(
                     os.path.join(self.plots_directory, "*.png"))):
-                name = os.path.basename(path)
+                name = escape(os.path.basename(path), quote=True)
                 plots.append('<img src="/plots/%s" alt="%s"/>'
                              % (name, name))
         return _PAGE % {"rows": "".join(rows) or
