@@ -1,0 +1,167 @@
+"""Forge model-hub tests (reference test_forge_client/server.py roles)."""
+
+import io
+import json
+import os
+import tarfile
+import urllib.error
+
+import numpy
+import pytest
+
+from veles_tpu.forge import ForgeClient, ForgeServer, package as pkg
+
+
+def make_model_dir(tmp_path, name="toy-model", version="1.0"):
+    d = tmp_path / name
+    d.mkdir(parents=True)
+    (d / "manifest.json").write_text(json.dumps({
+        "name": name, "version": version,
+        "short_description": "toy model",
+        "workflow": "wf.py", "config": "cfg.py",
+        "requires": ["numpy"]}))
+    (d / "wf.py").write_text("""
+import numpy
+from veles_tpu.models.mlp import MLPWorkflow
+
+def run(load, main):
+    rng = numpy.random.RandomState(0)
+    X = rng.rand(60, 6).astype(numpy.float32)
+    y = (X[:, 0] > 0.5).astype(numpy.int32)
+    load(MLPWorkflow, layers=(6, 2),
+         loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 20, 40],
+                            minibatch_size=20),
+         learning_rate=0.5, max_epochs=2)
+    main()
+""")
+    (d / "cfg.py").write_text("root.toy.x = 1\n")
+    return str(d)
+
+
+class TestPackage:
+    def test_pack_unpack_roundtrip(self, tmp_path):
+        d = make_model_dir(tmp_path)
+        path, manifest = pkg.pack(d)
+        assert manifest["name"] == "toy-model"
+        with open(path, "rb") as fin:
+            blob = fin.read()
+        assert pkg.read_manifest(blob)["version"] == "1.0"
+        dest = str(tmp_path / "out")
+        pkg.unpack(blob, dest)
+        assert sorted(os.listdir(dest)) == ["cfg.py", "manifest.json",
+                                            "wf.py"]
+
+    def test_manifest_validation(self):
+        with pytest.raises(ValueError):
+            pkg.validate_manifest({"workflow": "wf.py"})  # no name
+        with pytest.raises(ValueError):
+            pkg.validate_manifest({"name": "../evil", "workflow": "w"})
+        with pytest.raises(ValueError):
+            pkg.validate_manifest({"name": "x", "workflow": "w",
+                                   "requires": ["numpy", "numpy>=1"]})
+
+    def test_unpack_rejects_traversal(self, tmp_path):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            manifest = json.dumps({"name": "evil",
+                                   "workflow": "w.py"}).encode()
+            info = tarfile.TarInfo("manifest.json")
+            info.size = len(manifest)
+            tar.addfile(info, io.BytesIO(manifest))
+            payload = b"boom"
+            info = tarfile.TarInfo("../escape.txt")
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+        with pytest.raises(ValueError, match="unsafe"):
+            pkg.unpack(buf.getvalue(), str(tmp_path / "dest"))
+        assert not (tmp_path / "escape.txt").exists()
+
+
+class TestForgeRoundtrip:
+    @pytest.fixture
+    def server(self, tmp_path):
+        srv = ForgeServer(str(tmp_path / "store"), token="sekrit")
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def client(self, server, token="sekrit"):
+        return ForgeClient("http://127.0.0.1:%d" % server.port,
+                           token=token)
+
+    def test_upload_list_details_fetch_delete(self, server, tmp_path):
+        client = self.client(server)
+        result = client.upload(make_model_dir(tmp_path))
+        assert result == {"name": "toy-model", "version": "1.0"}
+        listing = client.list()
+        assert [m["name"] for m in listing] == ["toy-model"]
+        details = client.details("toy-model")
+        assert details["latest"] == "1.0"
+        assert details["versions"]["1.0"]["workflow"] == "wf.py"
+        dest, manifest = client.fetch(
+            "toy-model", dest=str(tmp_path / "fetched"))
+        assert manifest["name"] == "toy-model"
+        assert os.path.isfile(os.path.join(dest, "wf.py"))
+        assert client.delete("toy-model") == {"deleted": True}
+        assert client.list() == []
+
+    def test_versioning(self, server, tmp_path):
+        client = self.client(server)
+        client.upload(make_model_dir(tmp_path, version="1.0"))
+        d2 = make_model_dir(tmp_path / "v2", version="2.0")
+        client.upload(d2)
+        assert client.details("toy-model")["latest"] == "2.0"
+        # duplicate version rejected
+        with pytest.raises(urllib.error.HTTPError) as err:
+            client.upload(make_model_dir(tmp_path / "dup", version="2.0"))
+        assert err.value.code == 400
+        # fetch a pinned old version
+        dest, _ = client.fetch("toy-model", version="1.0",
+                               dest=str(tmp_path / "old"))
+        assert os.path.isdir(dest)
+
+    def test_version_traversal_rejected(self, server, tmp_path):
+        # regression: version strings are filesystem path components
+        client = self.client(server)
+        client.upload(make_model_dir(tmp_path))
+        with pytest.raises(urllib.error.HTTPError) as err:
+            client.fetch("toy-model", version="../../etc/passwd",
+                         dest=str(tmp_path / "x"))
+        assert err.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            client.upload(make_model_dir(tmp_path / "t2"),
+                          version="../../../tmp/evil")
+        assert err.value.code == 400
+
+    def test_malformed_upload_gets_400(self, server):
+        # regression: junk bytes must 400, not crash the handler
+        import urllib.request
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/upload" % server.port,
+            data=b"this is not a tarball",
+            headers={"X-Forge-Token": "sekrit",
+                     "Content-Type": "application/octet-stream"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400
+
+    def test_write_actions_need_token(self, server, tmp_path):
+        anon = self.client(server, token=None)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            anon.upload(make_model_dir(tmp_path))
+        assert err.value.code == 403
+        # reads are open
+        assert anon.list() == []
+
+    def test_fetched_model_runs(self, server, tmp_path):
+        """The full hub story: upload, fetch, run the fetched workflow."""
+        import veles_tpu
+        client = self.client(server)
+        client.upload(make_model_dir(tmp_path))
+        dest, manifest = client.fetch("toy-model",
+                                      dest=str(tmp_path / "run"))
+        launcher = veles_tpu(os.path.join(dest, manifest["workflow"]),
+                             os.path.join(dest, manifest["config"]))
+        assert launcher.workflow.decision.epochs_done >= 2
+        from veles_tpu.core.config import root
+        assert root.toy.x == 1

@@ -249,6 +249,13 @@ class Main(Logger):
 
 
 def main(argv=None):
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `veles_tpu forge ...` subcommand dispatch (reference
+    # __main__.py:230-241 special-arg handling)
+    if argv and argv[0] == "forge":
+        from veles_tpu.forge.client import main as forge_main
+        return forge_main(argv[1:])
     return Main().run(argv)
 
 

@@ -179,7 +179,8 @@ root.common.update({
         "sync_interval": 1.0,
         "max_reconnect_attempts": 7,
     },
-    "forge": {"service_name": "forge", "manifest": "manifest.json"},
+    "forge": {"service_name": "forge", "manifest": "manifest.json",
+              "server": "http://127.0.0.1:8190"},
 })
 
 

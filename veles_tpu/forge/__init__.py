@@ -1,0 +1,10 @@
+"""veles_tpu.forge: the model hub (reference ``veles/forge/``).
+
+Model packages (tar.gz + manifest.json naming the workflow/config entry
+files) are published to and fetched from a forge server; see
+``package.py`` for the format, ``server.py`` / ``client.py`` for the two
+sides, and ``python -m veles_tpu forge --help`` for the CLI."""
+
+from veles_tpu.forge.client import ForgeClient  # noqa: F401
+from veles_tpu.forge.package import pack, read_manifest, unpack  # noqa: F401
+from veles_tpu.forge.server import ForgeServer  # noqa: F401

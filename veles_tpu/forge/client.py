@@ -1,0 +1,126 @@
+"""Forge client: fetch/upload/list/details/delete against a forge server.
+
+Reference ``veles/forge/forge_client.py:88-430``. CLI surface preserved:
+``python -m veles_tpu forge <action> [-s SERVER] ...`` with actions
+``list``, ``details -n NAME``, ``fetch -n NAME [-v VERSION] [-d DIR]``,
+``upload -d DIR [-v VERSION]``, ``delete -n NAME [-v VERSION]``.
+Write actions send the shared token (``-t`` /
+``VELES_TPU_FORGE_TOKEN``)."""
+
+import argparse
+import json
+import os
+import urllib.parse
+import urllib.request
+
+from veles_tpu.core.config import root
+from veles_tpu.core.logger import Logger
+from veles_tpu.forge import package as pkg
+
+
+class ForgeClient(Logger):
+    def __init__(self, base_url=None, token=None):
+        super().__init__()
+        self.base_url = (base_url
+                         or root.common.forge.get("server",
+                                                  "http://127.0.0.1:8190")
+                         ).rstrip("/")
+        self.token = token or os.environ.get("VELES_TPU_FORGE_TOKEN")
+
+    def _request(self, path, query=None, data=None):
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        headers = {}
+        if data is not None:
+            headers["Content-Type"] = "application/octet-stream"
+            if self.token:
+                headers["X-Forge-Token"] = self.token
+        req = urllib.request.Request(url, data=data, headers=headers)
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = resp.read()
+        if resp.headers.get("Content-Type", "").startswith(
+                "application/json"):
+            return json.loads(body.decode())
+        return body
+
+    # -- actions (reference forge_client.py subcommands) ----------------------
+    def list(self):
+        return self._request("/service", {"query": "list"})
+
+    def details(self, name):
+        return self._request("/service", {"query": "details",
+                                          "name": name})
+
+    def fetch(self, name, version=None, dest=None):
+        query = {"name": name}
+        if version:
+            query["version"] = version
+        blob = self._request("/fetch", query)
+        dest = dest or name
+        manifest = pkg.unpack(blob, dest)
+        self.info("fetched %s %s into %s", name,
+                  version or "(latest)", dest)
+        return dest, manifest
+
+    def upload(self, directory, version=None):
+        path, manifest = pkg.pack(directory)
+        try:
+            with open(path, "rb") as fin:
+                blob = fin.read()
+            query = {}
+            if version or manifest.get("version"):
+                query["version"] = version or manifest["version"]
+            result = self._request("/upload", query, data=blob)
+        finally:
+            os.unlink(path)
+        self.info("uploaded %s version %s", result["name"],
+                  result["version"])
+        return result
+
+    def delete(self, name, version=None):
+        query = {"name": name}
+        if version:
+            query["version"] = version
+        return self._request("/delete", query, data=b"")
+
+
+def main(argv=None):
+    """``veles_tpu forge`` subcommand entry (reference
+    ``__main__.py:230-241`` wiring)."""
+    parser = argparse.ArgumentParser(prog="veles_tpu forge")
+    parser.add_argument("action", choices=("list", "details", "fetch",
+                                           "upload", "delete"))
+    parser.add_argument("-s", "--server", default=None,
+                        help="forge server base URL")
+    parser.add_argument("-n", "--name", default=None)
+    parser.add_argument("-v", "--version", default=None)
+    parser.add_argument("-d", "--directory", default=None,
+                        help="fetch destination / upload source")
+    parser.add_argument("-t", "--token", default=None)
+    args = parser.parse_args(argv)
+    client = ForgeClient(args.server, args.token)
+    if args.action == "list":
+        print(json.dumps(client.list(), indent=1))
+    elif args.action == "details":
+        if not args.name:
+            parser.error("details needs -n NAME")
+        print(json.dumps(client.details(args.name), indent=1))
+    elif args.action == "fetch":
+        if not args.name:
+            parser.error("fetch needs -n NAME")
+        dest, manifest = client.fetch(args.name, args.version,
+                                      args.directory)
+        print(json.dumps({"directory": dest, "manifest": manifest},
+                         indent=1))
+    elif args.action == "upload":
+        if not args.directory:
+            parser.error("upload needs -d DIRECTORY")
+        print(json.dumps(client.upload(args.directory, args.version),
+                         indent=1))
+    elif args.action == "delete":
+        if not args.name:
+            parser.error("delete needs -n NAME")
+        print(json.dumps(client.delete(args.name, args.version),
+                         indent=1))
+    return 0

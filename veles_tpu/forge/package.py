@@ -1,0 +1,92 @@
+"""Forge package format: tar.gz + manifest.json.
+
+Reference ``veles/forge_common.py:47`` + ``forge/forge_client.py:88-120``:
+a model package is a gzipped tarball whose ``manifest.json`` declares
+``name``, ``version``, ``workflow`` (the entry Python file), ``config``,
+``short_description`` and a requirements-style ``requires`` list. Both
+named files must exist in the archive.
+"""
+
+import io
+import json
+import os
+import re
+import tarfile
+
+MANIFEST = "manifest.json"
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def validate_manifest(manifest):
+    if not isinstance(manifest, dict):
+        raise TypeError("manifest must be a JSON object")
+    for field in ("name", "workflow"):
+        if not manifest.get(field):
+            raise ValueError("manifest is missing %r" % field)
+    if not _NAME_RE.match(manifest["name"]):
+        raise ValueError("invalid package name %r" % manifest["name"])
+    requires = manifest.get("requires", [])
+    if not isinstance(requires, list) \
+            or not all(isinstance(r, str) for r in requires):
+        raise TypeError("'requires' must be a list of requirement strings")
+    seen = set()
+    for item in requires:
+        project = re.split(r"[<>=!~\[; ]", item, 1)[0].strip()
+        if project in seen:
+            raise ValueError("%r listed in 'requires' twice" % project)
+        seen.add(project)
+    return manifest
+
+
+def pack(directory, out_path=None):
+    """Pack ``directory`` (which must contain manifest.json) into a
+    tar.gz; returns (path, manifest)."""
+    manifest_path = os.path.join(directory, MANIFEST)
+    with open(manifest_path) as fin:
+        manifest = validate_manifest(json.load(fin))
+    for field in ("workflow", "config"):
+        name = manifest.get(field)
+        if name and not os.path.isfile(os.path.join(directory, name)):
+            raise FileNotFoundError(
+                "manifest names %s=%r but the file is absent"
+                % (field, name))
+    if out_path is None:
+        out_path = os.path.join(
+            directory, "%s.tar.gz" % manifest["name"])
+    with tarfile.open(out_path, "w:gz") as tar:
+        for entry in sorted(os.listdir(directory)):
+            full = os.path.join(directory, entry)
+            if os.path.abspath(full) == os.path.abspath(out_path):
+                continue
+            tar.add(full, arcname=entry)
+    return out_path, manifest
+
+
+def read_manifest(blob):
+    """Extract + validate the manifest from package bytes."""
+    try:
+        with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+            try:
+                member = tar.getmember(MANIFEST)
+            except KeyError:
+                raise ValueError("package has no %s" % MANIFEST)
+            manifest = json.load(tar.extractfile(member))
+    except tarfile.TarError as exc:
+        raise ValueError("not a valid package archive: %s" % exc)
+    return validate_manifest(manifest)
+
+
+def unpack(blob, dest):
+    """Safely extract package bytes into ``dest``; returns the manifest."""
+    os.makedirs(dest, exist_ok=True)
+    manifest = read_manifest(blob)
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            # no absolute paths / traversal out of dest
+            target = os.path.realpath(os.path.join(dest, member.name))
+            if not target.startswith(os.path.realpath(dest) + os.sep):
+                raise ValueError("unsafe member path %r" % member.name)
+            if not (member.isfile() or member.isdir()):
+                continue  # no links/devices from untrusted archives
+            tar.extract(member, dest, set_attrs=False, filter="data")
+    return manifest

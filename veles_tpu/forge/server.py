@@ -1,0 +1,235 @@
+"""Forge server: the model-hub backend.
+
+TPU-native re-design of reference ``veles/forge/forge_server.py:103-440``.
+The reference kept one git repository per model (tags as versions) behind
+Tornado with an HTML gallery and e-mail registration; here the store is a
+plain versioned directory tree behind the shared stdlib HTTP plumbing —
+the same API surface (list / details / fetch / upload / delete), with a
+shared-token write guard instead of account registration.
+
+Store layout::
+
+    <root>/<model>/<version>.tar.gz
+    <root>/<model>/meta.json   {"versions": {...}, "latest": "..."}
+
+Endpoints (reference ``forge_server.py`` handlers):
+
+- ``GET /service?query=list`` — all models (name, latest, description);
+- ``GET /service?query=details&name=N`` — full metadata;
+- ``GET /fetch?name=N[&version=V]`` — package bytes;
+- ``POST /upload?version=V`` — package bytes (manifest inside names the
+  model); requires the token when one is set;
+- ``POST /delete?name=N[&version=V]`` — remove; token required.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+
+from veles_tpu.core.logger import Logger
+from veles_tpu.forge import package as pkg
+
+
+class ForgeServer(Logger):
+    def __init__(self, root_dir, port=0, host="127.0.0.1", token=None):
+        super().__init__()
+        self.root_dir = root_dir
+        self.port = port
+        self.host = host
+        self.token = token
+        self._lock = threading.Lock()
+        self._httpd = None
+        os.makedirs(root_dir, exist_ok=True)
+
+    # -- store ----------------------------------------------------------------
+    def _meta_path(self, name):
+        return os.path.join(self.root_dir, name, "meta.json")
+
+    def _load_meta(self, name):
+        try:
+            with open(self._meta_path(name)) as fin:
+                return json.load(fin)
+        except OSError:
+            return None
+
+    def _store_meta(self, name, meta):
+        with open(self._meta_path(name), "w") as fout:
+            json.dump(meta, fout, indent=1)
+
+    def list_models(self):
+        with self._lock:
+            out = []
+            for name in sorted(os.listdir(self.root_dir)):
+                meta = self._load_meta(name)
+                if meta:
+                    out.append({
+                        "name": name, "latest": meta.get("latest"),
+                        "short_description": meta.get("versions", {}).get(
+                            meta.get("latest"), {}).get(
+                            "short_description", "")})
+            return out
+
+    def details(self, name):
+        with self._lock:
+            return self._load_meta(name)
+
+    @staticmethod
+    def _safe_version(version):
+        if not pkg._NAME_RE.match(version):
+            raise ValueError("invalid version %r" % version)
+        return version
+
+    def upload(self, blob, version=None):
+        manifest = pkg.read_manifest(blob)
+        name = manifest["name"]
+        version = self._safe_version(
+            str(version or manifest.get("version", "1.0")))
+        with self._lock:
+            model_dir = os.path.join(self.root_dir, name)
+            os.makedirs(model_dir, exist_ok=True)
+            meta = self._load_meta(name) or {"versions": {}}
+            if version in meta["versions"]:
+                raise ValueError("%s version %s already exists"
+                                 % (name, version))
+            with open(os.path.join(model_dir, version + ".tar.gz"),
+                      "wb") as fout:
+                fout.write(blob)
+            entry = dict(manifest)
+            entry["uploaded"] = time.time()
+            entry["size"] = len(blob)
+            meta["versions"][version] = entry
+            meta["latest"] = version
+            self._store_meta(name, meta)
+        self.info("stored %s version %s (%d bytes)", name, version,
+                  len(blob))
+        return {"name": name, "version": version}
+
+    def fetch(self, name, version=None):
+        with self._lock:
+            meta = self._load_meta(name)
+            if not meta:
+                return None
+            version = str(version or meta.get("latest"))
+            if not pkg._NAME_RE.match(version):
+                return None
+            path = os.path.join(self.root_dir, name, version + ".tar.gz")
+            if not os.path.isfile(path):
+                return None
+            with open(path, "rb") as fin:
+                return fin.read()
+
+    def delete(self, name, version=None):
+        with self._lock:
+            meta = self._load_meta(name)
+            if not meta:
+                return False
+            if version is None:
+                versions = list(meta["versions"])
+            else:
+                version = str(version)
+                if not pkg._NAME_RE.match(version):
+                    return False
+                versions = [version]
+            for v in versions:
+                meta["versions"].pop(v, None)
+                try:
+                    os.unlink(os.path.join(self.root_dir, name,
+                                           v + ".tar.gz"))
+                except OSError:
+                    pass
+            if meta["versions"]:
+                meta["latest"] = sorted(meta["versions"])[-1]
+                self._store_meta(name, meta)
+            else:
+                for leftover in (self._meta_path(name),):
+                    try:
+                        os.unlink(leftover)
+                    except OSError:
+                        pass
+                try:
+                    os.rmdir(os.path.join(self.root_dir, name))
+                except OSError:
+                    pass
+            return True
+
+    # -- HTTP -----------------------------------------------------------------
+    @staticmethod
+    def _safe_name(name):
+        return bool(name) and pkg._NAME_RE.match(name) is not None
+
+    def _authorized(self, handler):
+        if self.token is None:
+            return True
+        return handler.headers.get("X-Forge-Token") == self.token
+
+    def start(self):
+        from http.server import BaseHTTPRequestHandler
+        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
+                                          reply, start_server)
+
+        server = self
+
+        class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
+            def _query(self):
+                parsed = urllib.parse.urlparse(self.path)
+                return parsed.path, dict(urllib.parse.parse_qsl(
+                    parsed.query))
+
+            def do_GET(self):
+                path, query = self._query()
+                if path == "/service":
+                    if query.get("query") == "list":
+                        reply(self, server.list_models())
+                    elif query.get("query") == "details":
+                        name = query.get("name", "")
+                        meta = server.details(name) \
+                            if server._safe_name(name) else None
+                        if meta is None:
+                            reply(self, {"error": "unknown model"},
+                                  code=404)
+                        else:
+                            reply(self, dict(meta, name=name))
+                    else:
+                        reply(self, {"error": "unknown query"}, code=400)
+                elif path == "/fetch":
+                    name = query.get("name", "")
+                    blob = server.fetch(name, query.get("version")) \
+                        if server._safe_name(name) else None
+                    if blob is None:
+                        reply(self, {"error": "not found"}, code=404)
+                    else:
+                        reply(self, blob, 200, "application/gzip")
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                path, query = self._query()
+                if not server._authorized(self):
+                    reply(self, {"error": "bad token"}, code=403)
+                    return
+                if path == "/upload":
+                    try:
+                        reply(self, server.upload(read_body(self),
+                                                  query.get("version")))
+                    except (ValueError, TypeError, OSError) as exc:
+                        reply(self, {"error": str(exc)}, code=400)
+                elif path == "/delete":
+                    name = query.get("name", "")
+                    ok = server.delete(name, query.get("version")) \
+                        if server._safe_name(name) else False
+                    reply(self, {"deleted": ok},
+                          code=200 if ok else 404)
+                else:
+                    self.send_error(404)
+
+        self._httpd, self.port = start_server(
+            Handler, self.host, self.port, name="forge-server")
+        self.info("forge server on http://%s:%d/", self.host, self.port)
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
