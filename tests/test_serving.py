@@ -203,6 +203,11 @@ class TestWebStatus:
             html = resp.read().decode()
         assert "<script>alert(1)</script>" not in html
         assert "&lt;script&gt;" in html
+        # unhashable/heterogeneous ids must not 500 /update or /
+        post(base + "/update", {"id": [1, 2], "name": "l"})
+        post(base + "/update", {"id": 5, "name": "n"})
+        with urllib.request.urlopen(base + "/", timeout=5) as resp:
+            assert resp.status == 200
 
     def test_notifier(self, server):
         srv, _ = server

@@ -83,6 +83,21 @@ class Client(Logger):
         self._thread.join(timeout=timeout)
         return not self._thread.is_alive()
 
+    def update_power(self, power):
+        """Re-report computing power mid-run (reference periodic power
+        re-upload, client.py:308-313; the master rebalances parked job
+        requests by it)."""
+        self.power = power
+
+        async def send():
+            writer = getattr(self, "_writer_", None)
+            if writer is not None:
+                await write_frame(writer, {"type": "power",
+                                           "power": power}, self._secret)
+
+        if self._loop is not None and self._loop.is_running():
+            asyncio.run_coroutine_threadsafe(send(), self._loop)
+
     # -- session with reconnect budget ---------------------------------------
     async def _session(self):
         attempts = 0

@@ -133,8 +133,10 @@ class Server(Logger):
         event loop dies."""
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if not self._pending_requests and all(
-                    s.state in ("IDLE",) for s in self.slaves.values()):
+            # snapshot: the event-loop thread mutates these concurrently
+            slaves = list(self.slaves.values())
+            if not list(self._pending_requests) and all(
+                    s.state in ("IDLE",) for s in slaves):
                 return True
             time.sleep(0.05)
         return False
@@ -257,6 +259,11 @@ class Server(Logger):
 
     async def _retry_pending(self):
         pending, self._pending_requests = self._pending_requests, []
+        # power-weighted balancing (reference workflow.py:613-619 +
+        # DeviceBenchmark power): when several slaves are parked, the
+        # strongest gets the next job first
+        pending.sort(key=lambda item: -getattr(
+            self.slaves.get(item[0]), "power", 0.0))
         for sid, writer in pending:
             slave = self.slaves.get(sid)
             if slave is not None:

@@ -79,6 +79,8 @@ class Launcher(Logger):
             self.status_notifier = StatusNotifier(self).start()
         self.workflow.initialize(**kwargs)
         if self.is_master:
+            from veles_tpu.nn.gd import fleet_merge_mode
+            fleet_merge_mode()  # fail fast on a merge-mode typo
             from veles_tpu.fleet.server import Server
             self.agent = Server(
                 self.listen_address, self.workflow,

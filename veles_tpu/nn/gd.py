@@ -27,6 +27,18 @@ from veles_tpu.ops import activations
 from veles_tpu.ops.gemm import matmul
 
 
+def fleet_merge_mode():
+    """Validated ``root.common.fleet.merge``. The Launcher checks it at
+    startup too — a typo must fail fast, not put every slave into a
+    silent drop/reconnect loop when the first update arrives."""
+    from veles_tpu.core.config import root
+    mode = root.common.fleet.get("merge", "overwrite")
+    if mode not in ("overwrite", "average"):
+        raise ValueError("unknown fleet merge mode %r (use 'overwrite' "
+                         "or 'average')" % mode)
+    return mode
+
+
 class GradientDescent(JitUnit):
     """Backward unit for All2All (linear activation)."""
 
@@ -110,10 +122,25 @@ class GradientDescent(JitUnit):
         return {"weights": self.weights.mem, "bias": self.bias.mem}
 
     def apply_data_from_slave(self, data, slave=None):
-        # reference Znicz GD units overwrite master state with the slave's
-        # result (asynchronous DP: last-writer-wins, stale updates accepted)
-        self.weights.data = jnp.asarray(data["weights"])
-        self.bias.data = jnp.asarray(data["bias"])
+        """Merge a slave's trained weights into master state.
+
+        Modes (``root.common.fleet.merge``):
+
+        - ``overwrite`` (default) — reference Znicz parity: master state
+          replaced by the slave's result (asynchronous DP,
+          last-writer-wins, stale updates accepted);
+        - ``average`` — master keeps the mean of its current state and
+          the slave's: N slaves pushing divergent updates blend instead
+          of thrashing, an EASGD-flavored option the reference lacked.
+        """
+        mode = fleet_merge_mode()
+        weights = jnp.asarray(data["weights"])
+        bias = jnp.asarray(data["bias"])
+        if mode == "average" and self.weights.data is not None:
+            weights = (jnp.asarray(self.weights.mem) + weights) * 0.5
+            bias = (jnp.asarray(self.bias.mem) + bias) * 0.5
+        self.weights.data = weights
+        self.bias.data = bias
 
     def generate_data_for_slave(self, slave=None):
         return {"weights": self.weights.mem, "bias": self.bias.mem}

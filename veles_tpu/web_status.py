@@ -132,7 +132,9 @@ class WebStatusServer(Logger):
     # -- state ----------------------------------------------------------------
     def update(self, status):
         with self._lock:
-            key = status.get("id") or status.get("name", "?")
+            # str() coercion: hostile ids must be hashable AND sortable
+            # against other masters' string keys
+            key = str(status.get("id") or status.get("name", "?"))
             status["updated"] = time.time()
             self._statuses[key] = status
             # GC stale masters (reference old-record GC)
