@@ -1,0 +1,161 @@
+"""Tests for Kohonen SOM, the AlexNet topology, and the autotune CLI
+(SURVEY §7 item 10 + BASELINE conv anchor + VERDICT item 10)."""
+
+import numpy
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+
+
+def two_blobs(n=200, dim=6, seed=0):
+    rng = numpy.random.RandomState(seed)
+    a = rng.normal(-2.0, 0.3, (n // 2, dim))
+    b = rng.normal(+2.0, 0.3, (n // 2, dim))
+    X = numpy.concatenate([a, b]).astype(numpy.float32)
+    labels = numpy.array([0] * (n // 2) + [1] * (n // 2))
+    perm = rng.permutation(n)
+    return X[perm], labels[perm]
+
+
+class TestKohonen:
+    def test_trainer_reduces_quantization_error(self):
+        from veles_tpu.nn.kohonen import KohonenTrainer
+
+        X, _ = two_blobs()
+        trainer = KohonenTrainer(DummyWorkflow(), shape=(4, 4),
+                                 learning_rate=0.5)
+        trainer.input = X
+        trainer.initialize()
+        errors = []
+        for _ in range(15):
+            trainer.run()
+            errors.append(float(trainer.quantization_error))
+        assert errors[-1] < errors[0] * 0.5, errors
+
+    def test_bmu_separates_clusters(self):
+        from veles_tpu.nn.kohonen import KohonenForward, KohonenTrainer
+
+        X, labels = two_blobs()
+        trainer = KohonenTrainer(DummyWorkflow(), shape=(4, 4),
+                                 learning_rate=0.5)
+        trainer.input = X
+        trainer.initialize()
+        for _ in range(20):
+            trainer.run()
+        fwd = KohonenForward(DummyWorkflow())
+        fwd.input = jnp.asarray(X)
+        fwd.weights = trainer.weights.data
+        fwd.run()
+        winners = numpy.asarray(fwd.output.mem)
+        # the two blobs must map to disjoint BMU sets
+        set_a = set(winners[labels == 0].tolist())
+        set_b = set(winners[labels == 1].tolist())
+        assert not (set_a & set_b)
+
+    def test_workflow_end_to_end(self):
+        from veles_tpu.models.kohonen import KohonenWorkflow
+
+        X, _ = two_blobs()
+        wf = KohonenWorkflow(
+            DummyLauncher(), shape=(4, 4),
+            loader_kwargs=dict(data=X, class_lengths=[0, 0, len(X)],
+                               minibatch_size=50),
+            max_epochs=5, name="som")
+        wf.initialize()
+        wf.run()
+        results = wf.gather_results()
+        assert results["epochs"] == 5
+        assert results["quantization_error"] < 1.0
+
+
+class TestAlexNet:
+    @pytest.mark.slow
+    def test_scaled_alexnet_trains(self):
+        """The AlexNet spec compiles + trains on synthetic 64x64 images
+        (scale=0.05 shrinks widths; geometry/stride structure intact)."""
+        from veles_tpu.models.alexnet import AlexNetWorkflow
+
+        rng = numpy.random.RandomState(0)
+        n = 64
+        y = rng.randint(0, 4, n).astype(numpy.int32)
+        X = rng.rand(n, 64, 64, 3).astype(numpy.float32) * 0.1
+        for i in range(n):  # class = bright quadrant (spatial pattern)
+            y0, x0 = (y[i] // 2) * 32, (y[i] % 2) * 32
+            X[i, y0:y0 + 32, x0:x0 + 32, :] += 0.8
+        wf = AlexNetWorkflow(
+            DummyLauncher(), n_classes=4, scale=0.05,
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 16, 48],
+                               minibatch_size=16,
+                               normalization_type="mean_disp"),
+            learning_rate=0.05,
+            decision_kwargs=dict(max_epochs=10), name="mini-alexnet")
+        wf.initialize()
+        losses = []
+        orig = wf.decision._epoch_summary
+
+        def capture(stats, epoch):
+            losses.append(stats[2][2] / max(stats[2][1], 1))
+            return orig(stats, epoch)
+
+        wf.decision._epoch_summary = capture
+        wf.run()
+        # smoke criterion: the full 5-conv geometry compiles and the
+        # optimizer makes progress (48 samples can't prove accuracy;
+        # conv accuracy is covered by the digits convnet test)
+        assert wf.decision.epochs_done == 10
+        assert len(losses) == 10
+        assert losses[-1] < losses[0] * 0.95, losses
+
+    def test_full_size_spec_shapes(self):
+        from veles_tpu.models.alexnet import alexnet_layers
+
+        layers = alexnet_layers()
+        assert layers[0]["n_kernels"] == 96
+        assert layers[0]["sliding"] == (4, 4)
+        assert layers[-3]["output_sample_shape"] == 4096
+        assert layers[-1]["output_sample_shape"] == 1000
+        assert sum(1 for l in layers if l["type"].startswith("conv")) == 5
+
+
+class TestAutotuneCLI:
+    def test_cache_roundtrip(self, tmp_path, monkeypatch):
+        """VERDICT item 10: --autotune persists winners and _tuned_blocks
+        reads them back (devices/device_infos.json semantics)."""
+        from veles_tpu.core.config import root
+        from veles_tpu.ops import gemm
+
+        cache_file = str(tmp_path / "tuning.json")
+        monkeypatch.setattr(root.common.engine, "pallas_autotune_cache",
+                            cache_file, raising=False)
+        monkeypatch.setattr(gemm, "_tuning_cache", None, raising=False)
+        calls = []
+
+        def fake_matmul(a, b, out_dtype=None, bm=None, bn=None, bk=None):
+            calls.append((bm, bn, bk))
+            return jnp.zeros((a.shape[0], b.shape[1]), jnp.float32)
+
+        monkeypatch.setattr(gemm, "pallas_matmul", fake_matmul)
+        blocks = gemm.autotune_matmul(512, 512, 1024, iters=1)
+        assert calls, "no candidates benchmarked"
+        assert blocks in [c for c in calls]
+        # cache round-trips through a fresh load
+        monkeypatch.setattr(gemm, "_tuning_cache", None, raising=False)
+        assert gemm._tuned_blocks(512, 512, 1024, "bfloat16") == blocks
+
+    def test_cli_entry(self, tmp_path, monkeypatch, capsys):
+        from veles_tpu.core.config import root
+        from veles_tpu.ops import gemm
+
+        monkeypatch.setattr(root.common.engine, "pallas_autotune_cache",
+                            str(tmp_path / "t.json"), raising=False)
+        monkeypatch.setattr(gemm, "_tuning_cache", None, raising=False)
+        monkeypatch.setattr(
+            gemm, "pallas_matmul",
+            lambda a, b, **kw: jnp.zeros((a.shape[0], b.shape[1]),
+                                         jnp.float32))
+        assert gemm.autotune_main(["512x512x1024"]) == 0
+        out = capsys.readouterr().out
+        assert '"shape": [512, 512, 1024]' in out

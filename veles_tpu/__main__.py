@@ -256,6 +256,9 @@ def main(argv=None):
     if argv and argv[0] == "forge":
         from veles_tpu.forge.client import main as forge_main
         return forge_main(argv[1:])
+    if argv and argv[0] == "autotune":
+        from veles_tpu.ops.gemm import autotune_main
+        return autotune_main(argv[1:])
     return Main().run(argv)
 
 

@@ -133,9 +133,9 @@ class Server(Logger):
         event loop dies."""
         deadline = time.time() + timeout
         while time.time() < deadline:
-            # snapshot: the event-loop thread mutates these concurrently
+            # snapshot slaves: the event-loop thread mutates the dict
             slaves = list(self.slaves.values())
-            if not list(self._pending_requests) and all(
+            if not self._pending_requests and all(
                     s.state in ("IDLE",) for s in slaves):
                 return True
             time.sleep(0.05)
@@ -198,7 +198,11 @@ class Server(Logger):
                 elif mtype == "update":
                     await self._apply_update(slave, writer, msg)
                 elif mtype == "power":
-                    slave.power = msg.get("power", slave.power)
+                    try:
+                        slave.power = float(msg.get("power"))
+                    except (TypeError, ValueError):
+                        self.warning("ignoring non-numeric power from %s",
+                                     slave.id)
                 elif mtype == "bye":
                     break
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -262,8 +266,12 @@ class Server(Logger):
         # power-weighted balancing (reference workflow.py:613-619 +
         # DeviceBenchmark power): when several slaves are parked, the
         # strongest gets the next job first
-        pending.sort(key=lambda item: -getattr(
-            self.slaves.get(item[0]), "power", 0.0))
+
+        def power_of(item):
+            power = getattr(self.slaves.get(item[0]), "power", 0.0)
+            return -power if isinstance(power, (int, float)) else 0.0
+
+        pending.sort(key=power_of)
         for sid, writer in pending:
             slave = self.slaves.get(sid)
             if slave is not None:

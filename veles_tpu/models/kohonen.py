@@ -1,0 +1,63 @@
+"""Kohonen SOM workflow (SURVEY §7 build-plan item 10).
+
+Topology: Repeater → Loader → KohonenTrainer → epoch gate → (loop | End).
+Unsupervised: no evaluator/GD chain; the decision criterion is the epoch
+budget, with the quantization error published as the result metric.
+"""
+
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.plumbing import Repeater
+from veles_tpu.core.units import Unit
+from veles_tpu.core.workflow import Workflow
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.nn.kohonen import KohonenTrainer
+
+
+class EpochLimiter(Unit):
+    """Set ``complete`` after the loader finishes ``max_epochs``."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, max_epochs=10, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.max_epochs = max_epochs
+        self.complete = Bool(False)
+        self.epochs_done = 0
+        self.demand("epoch_ended")
+
+    def run(self):
+        if self.epoch_ended:
+            self.epochs_done += 1
+            if self.epochs_done >= self.max_epochs:
+                self.info("stopping after %d epochs", self.epochs_done)
+                self.complete.set(True)
+
+    def get_metric_names(self):
+        return ["epochs"]
+
+    def get_metric_values(self):
+        return [self.epochs_done]
+
+
+class KohonenWorkflow(Workflow):
+    """Self-organizing-map training workflow."""
+
+    def __init__(self, workflow, shape=(8, 8), loader_kwargs=None,
+                 trainer_kwargs=None, max_epochs=10, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+        self.loader = FullBatchLoader(self, **(loader_kwargs or {}))
+        self.loader.link_from(self.repeater)
+        self.trainer = KohonenTrainer(self, shape=shape,
+                                      **(trainer_kwargs or {}))
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.trainer.link_from(self.loader)
+        self.limiter = EpochLimiter(self, max_epochs=max_epochs)
+        self.limiter.link_attrs(self.loader, "epoch_ended")
+        self.limiter.link_from(self.trainer)
+        self.repeater.link_from(self.limiter)
+        self.end_point.link_from(self.limiter)
+        self.end_point.gate_block = ~self.limiter.complete
+        self.loader.gate_block = self.limiter.complete
+        self.loader.complete = self.limiter.complete

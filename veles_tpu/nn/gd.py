@@ -136,9 +136,13 @@ class GradientDescent(JitUnit):
         mode = fleet_merge_mode()
         weights = jnp.asarray(data["weights"])
         bias = jnp.asarray(data["bias"])
-        if mode == "average" and self.weights.data is not None:
-            weights = (jnp.asarray(self.weights.mem) + weights) * 0.5
-            bias = (jnp.asarray(self.bias.mem) + bias) * 0.5
+        if mode == "average":
+            # device-resident math: .mem here would serialize two PCIe
+            # round-trips per layer per update under the server's lock
+            if self.weights.data is not None:
+                weights = (self.weights.data + weights) * 0.5
+            if self.bias.data is not None:
+                bias = (self.bias.data + bias) * 0.5
         self.weights.data = weights
         self.bias.data = bias
 

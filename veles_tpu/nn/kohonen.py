@@ -1,0 +1,150 @@
+"""Kohonen self-organizing map units.
+
+The reference shipped SOM units in the Znicz plugin (absent submodule;
+SURVEY §7 build-plan item 10 lists Kohonen as a parity model — it
+exercises the reduce + argmin + random op families). TPU design: the
+entire SOM step — pairwise distances, best-matching-unit argmin, grid
+neighborhood kernel, weight delta — is ONE jitted computation over the
+whole minibatch; the classic sample-at-a-time SOM loop would be scalar
+poison on the MXU, so the batch variant averages the neighborhood-weighted
+deltas of all samples (batch SOM, equivalent in the small-learning-rate
+limit).
+
+Units:
+
+- :class:`KohonenForward` — winner (BMU) index per sample;
+- :class:`KohonenTrainer` — one batch update with exponentially decayed
+  learning rate + neighborhood radius.
+"""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.core.units import Unit
+from veles_tpu.core import prng
+from veles_tpu.memory import Array
+from veles_tpu.nn.jit_unit import JitUnit
+
+
+def _grid_coords(shape):
+    gy, gx = shape
+    ys, xs = jnp.meshgrid(jnp.arange(gy), jnp.arange(gx), indexing="ij")
+    return jnp.stack([ys.ravel(), xs.ravel()], axis=1).astype(jnp.float32)
+
+
+@jax.jit
+def _bmu(batch, weights):
+    """Best-matching unit per sample: argmin over squared distances."""
+    # ||x - w||^2 = ||x||^2 - 2 x.w + ||w||^2 ; the x term is constant
+    # per-row and cannot change the argmin
+    scores = batch @ weights.T - 0.5 * jnp.sum(weights * weights, axis=1)
+    return jnp.argmax(scores, axis=1)
+
+
+class KohonenForward(JitUnit):
+    """Winner lookup: output[i] = BMU index of sample i."""
+
+    INPUTS = ("input", "weights")
+    OUTPUTS = ("output",)
+
+    def compute(self, batch, weights):
+        n = batch.shape[0]
+        return _bmu(batch.reshape(n, -1), weights)
+
+
+class KohonenTrainer(Unit):
+    """One batch-SOM update per run (the whole step is one XLA
+    computation).
+
+    Attributes: ``shape`` (gy, gx) neuron grid; ``weights`` (gy*gx, D);
+    decayed ``sigma`` / ``learning_rate``; ``quantization_error`` — the
+    mean distance of samples to their BMU, the SOM convergence metric.
+    """
+
+    VIEW_GROUP = "TRAINER"
+
+    def __init__(self, workflow, **kwargs):
+        self.shape = tuple(kwargs.pop("shape", (8, 8)))
+        self.learning_rate = kwargs.pop("learning_rate", 0.5)
+        self.sigma = kwargs.pop("sigma", max(self.shape) / 2.0)
+        self.decay = kwargs.pop("decay", 0.05)
+        self.prng_key = kwargs.pop("prng_key", "kohonen")
+        super().__init__(workflow, **kwargs)
+        self.weights = Array()
+        self.winners = Array()
+        self.quantization_error = None
+        self.steps = 0
+        self.demand("input")
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._step_jit_ = None
+
+    @property
+    def n_neurons(self):
+        return self.shape[0] * self.shape[1]
+
+    def initialize(self, **kwargs):
+        batch = numpy.asarray(getattr(self.input, "mem", self.input))
+        dim = int(numpy.prod(batch.shape[1:]))
+        if self.weights.mem is None:
+            init = prng.get(self.prng_key).normal(
+                0.0, 0.1, size=(self.n_neurons, dim))
+            self.weights.reset(init.astype(numpy.float32))
+            self.weights.to_device()
+
+    @property
+    def _step_jit(self):
+        if self._step_jit_ is None:
+            coords = _grid_coords(self.shape)
+
+            @jax.jit
+            def step(weights, batch, lr, sigma):
+                n = batch.shape[0]
+                x = batch.reshape(n, -1)
+                d2 = jnp.sum(
+                    (x[:, None, :] - weights[None, :, :]) ** 2, axis=2)
+                winners = jnp.argmin(d2, axis=1)
+                qerr = jnp.mean(jnp.sqrt(jnp.min(d2, axis=1)))
+                # grid-space neighborhood of each sample's winner
+                win_xy = coords[winners]  # (B, 2)
+                grid_d2 = jnp.sum(
+                    (win_xy[:, None, :] - coords[None, :, :]) ** 2, axis=2)
+                h = jnp.exp(-grid_d2 / (2.0 * sigma * sigma))  # (B, N)
+                # batch update: neighborhood-weighted mean pull
+                num = h.T @ x                       # (N, D)
+                den = jnp.sum(h, axis=0)[:, None]   # (N, 1)
+                target = num / jnp.maximum(den, 1e-8)
+                moved = weights + lr * (target - weights)
+                active = (den > 1e-8).astype(jnp.float32)
+                return weights * (1 - active) + moved * active, \
+                    winners, qerr
+
+            self._step_jit_ = step
+        return self._step_jit_
+
+    def run(self):
+        if isinstance(self.input, Array):
+            batch = self.input.data
+        else:  # plain ndarray (.data would be its memoryview!)
+            batch = jnp.asarray(numpy.asarray(self.input))
+        decay = jnp.float32(numpy.exp(-self.decay * self.steps))
+        lr = jnp.float32(self.learning_rate) * decay
+        sigma = jnp.maximum(jnp.float32(self.sigma) * decay,
+                            jnp.float32(0.5))
+        new_w, winners, qerr = self._step_jit(
+            self.weights.data, batch, lr, sigma)
+        self.weights.data = new_w
+        self.winners.data = winners
+        self.quantization_error = qerr  # lazy device scalar
+        self.steps += 1
+
+    # -- results --------------------------------------------------------------
+    def get_metric_names(self):
+        return ["quantization_error"]
+
+    def get_metric_values(self):
+        return [float(self.quantization_error)
+                if self.quantization_error is not None else None]

@@ -179,6 +179,41 @@ def _size_bucket(m, n, k):
     return bucket
 
 
+def autotune_main(argv=None):
+    """``python -m veles_tpu autotune MxNxK[,MxNxK...]`` — benchmark the
+    Pallas GEMM block candidates for each shape on the current device and
+    persist the winners (the role of the reference's per-device GEMM
+    autotune + ``devices/device_infos.json``)."""
+    import argparse
+    parser = argparse.ArgumentParser(prog="veles_tpu autotune")
+    parser.add_argument("shapes",
+                        help="comma-separated MxNxK matmul shapes")
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=("bfloat16", "float32"))
+    parser.add_argument("--iters", type=int, default=3)
+    args = parser.parse_args(argv)
+    dtype = getattr(jnp, args.dtype)
+    failed = 0
+    for spec in args.shapes.split(","):
+        m, n, k = (int(x) for x in spec.lower().split("x"))
+        blocks = autotune_matmul(m, n, k, dtype=dtype, iters=args.iters)
+        key = "%s:%d" % (str(jnp.dtype(dtype)), _size_bucket(m, n, k))
+        try:  # read the file back: proves the winner actually persisted
+            with open(_cache_path()) as fin:
+                persisted = key in json.load(fin)
+        except (OSError, ValueError):
+            persisted = False
+        if not persisted:
+            failed += 1
+        print(json.dumps({"shape": [m, n, k], "dtype": args.dtype,
+                          "blocks": list(blocks),
+                          "persisted": persisted,
+                          "cache": _cache_path()}))
+    # nonzero when nothing ran/persisted (e.g. no candidate fits or the
+    # Pallas kernels are unavailable on this backend)
+    return 1 if failed else 0
+
+
 def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=3):
     """Benchmark candidate block sizes for this shape bucket and persist the
     winner (reference ``backends.py:623-731`` per-device GEMM autotune)."""
