@@ -1,0 +1,154 @@
+"""Tests for the streaming loader, device benchmark, compare_snapshots
+script, and the --visualize/--dump-unit-attributes CLI additions."""
+
+import json
+import threading
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+
+
+class TestStreamLoader:
+    def test_push_and_serve(self):
+        from veles_tpu.loader.stream import StreamFeeder, StreamLoader
+
+        loader = StreamLoader(DummyWorkflow(), sample_shape=(4,),
+                              minibatch_size=8, secret="s3")
+        loader.initialize()
+        feeder = StreamFeeder("127.0.0.1:%d" % loader.port, secret="s3")
+        feeder.push(numpy.arange(4.0), numpy.arange(4.0) * 2)
+        loader.run()
+        assert loader.minibatch_valid_size == 2
+        got = numpy.asarray(loader.minibatch_data.mem)
+        numpy.testing.assert_array_equal(got[0], [0, 1, 2, 3])
+        numpy.testing.assert_array_equal(got[1], [0, 2, 4, 6])
+        mask = numpy.asarray(loader.sample_mask.mem)
+        assert mask.sum() == 2
+        feeder.end()
+        loader.run()
+        assert bool(loader.complete)
+        loader.stop()
+
+    def test_wrong_secret_rejected(self):
+        from veles_tpu.loader.stream import StreamFeeder, StreamLoader
+
+        loader = StreamLoader(DummyWorkflow(), sample_shape=(2,),
+                              minibatch_size=4, secret="right")
+        loader.initialize()
+        feeder = StreamFeeder("127.0.0.1:%d" % loader.port,
+                              secret="wrong")
+        with pytest.raises(Exception):
+            feeder.push(numpy.zeros(2))
+        assert loader._queue_.qsize() == 0
+        loader.stop()
+
+
+class TestDeviceBenchmark:
+    def test_returns_positive_power(self):
+        from veles_tpu.ops.benchmark import device_benchmark
+
+        power = device_benchmark(size=128, depth=2, iters=2)
+        assert power > 0
+        # deterministic enough to be a balancing weight: two runs within
+        # an order of magnitude
+        power2 = device_benchmark(size=128, depth=2, iters=2)
+        assert 0.1 < power / power2 < 10
+
+
+class TestCompareSnapshots:
+    def test_identical_and_diverged(self, tmp_path):
+        from veles_tpu.models.mlp import MLPWorkflow
+        from veles_tpu.scripts.compare_snapshots import compare
+        from veles_tpu.snapshotter import Snapshotter, SnapshotterToFile
+
+        rng = numpy.random.RandomState(0)
+        X = rng.rand(60, 6).astype(numpy.float32)
+        y = (X[:, 0] > 0.5).astype(numpy.int32)
+
+        def build(epochs):
+            wf = MLPWorkflow(
+                DummyLauncher(), layers=(6, 2),
+                loader_kwargs=dict(data=X, labels=y,
+                                   class_lengths=[0, 20, 40],
+                                   minibatch_size=20),
+                learning_rate=0.5, max_epochs=epochs, name="cmp")
+            wf.initialize()
+            wf.run()
+            return wf
+
+        wf_a = build(1)
+        wf_b = build(3)
+        report = compare(wf_a, wf_a)
+        assert report["identical"]
+        report = compare(wf_a, wf_b)
+        assert not report["identical"]
+        assert any("weights" in k for k in report["array_diffs"])
+
+    def test_cli(self, tmp_path):
+        from veles_tpu.dummy import DummyWorkflow as DW  # noqa: F401
+        from veles_tpu.models.mlp import MLPWorkflow
+        from veles_tpu.scripts.compare_snapshots import main
+        from veles_tpu.snapshotter import Snapshotter
+
+        rng = numpy.random.RandomState(0)
+        X = rng.rand(40, 4).astype(numpy.float32)
+        y = (X[:, 0] > 0.5).astype(numpy.int32)
+        wf = MLPWorkflow(
+            DummyLauncher(), layers=(4, 2),
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 10, 30],
+                               minibatch_size=10),
+            learning_rate=0.5, max_epochs=1, name="cli-cmp")
+        snap = Snapshotter(wf, prefix="cmp", directory=str(tmp_path),
+                           interval=1, time_interval=0)
+        wf.initialize()
+        snap.initialize()
+        wf.run()
+        snap.run()
+        path = snap.destination
+        assert main([path, path]) == 0  # identical with itself
+
+
+class TestCLIIntrospection:
+    @pytest.fixture
+    def wf_file(self, tmp_path):
+        p = tmp_path / "wf.py"
+        p.write_text("""
+import numpy
+from veles_tpu.models.mlp import MLPWorkflow
+
+def run(load, main):
+    rng = numpy.random.RandomState(0)
+    X = rng.rand(40, 4).astype(numpy.float32)
+    y = (X[:, 0] > 0.5).astype(numpy.int32)
+    load(MLPWorkflow, layers=(4, 2),
+         loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 10, 30],
+                            minibatch_size=10),
+         learning_rate=0.5, max_epochs=1)
+    main()
+""")
+        return str(p)
+
+    def test_visualize_writes_dot(self, tmp_path, wf_file):
+        from veles_tpu.__main__ import main
+
+        dot = str(tmp_path / "graph.dot")
+        assert main([wf_file, "-", "--dry-run", "init",
+                     "--visualize", dot]) == 0
+        text = open(dot).read()
+        assert text.startswith("digraph")
+        assert "FullBatchLoader" in text
+
+    def test_dump_unit_attributes(self, capsys, wf_file):
+        from veles_tpu.__main__ import main
+
+        assert main([wf_file, "-", "--dry-run", "init",
+                     "--dump-unit-attributes"]) == 0
+        out = capsys.readouterr().out
+        lines = [json.loads(l) for l in out.splitlines()
+                 if l.startswith("{")]
+        names = {entry["unit"] for entry in lines}
+        assert any("Loader" in entry["type"] for entry in lines)
+        assert len(names) >= 5

@@ -1,0 +1,88 @@
+"""Publisher tests (reference veles/publishing coverage)."""
+
+import json
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.core.config import root
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mlp import MLPWorkflow
+from veles_tpu.publishing import Publisher, backend_registry
+
+
+@pytest.fixture
+def trained_wf(monkeypatch):
+    monkeypatch.setattr(root.common.disable, "publishing", False,
+                        raising=False)
+    rng = numpy.random.RandomState(0)
+    X = rng.rand(60, 6).astype(numpy.float32)
+    y = (X[:, 0] > 0.5).astype(numpy.int32)
+    wf = MLPWorkflow(
+        DummyLauncher(), layers=(6, 2),
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 20, 40],
+                           minibatch_size=20),
+        learning_rate=0.5, max_epochs=2, name="publish-me")
+    wf.initialize()
+    wf.run()
+    return wf
+
+
+class TestPublisher:
+    def test_backends_registered(self):
+        assert set(backend_registry) >= {"markdown", "html", "json"}
+
+    def test_markdown_and_json_reports(self, trained_wf, tmp_path):
+        pub = Publisher(trained_wf, backends=("markdown", "json"),
+                        directory=str(tmp_path))
+        published = pub.publish()
+        assert set(published) == {"markdown", "json"}
+        md = open(published["markdown"]).read()
+        assert md.startswith("# publish-me")
+        assert "best_validation_errors" in md
+        assert "## Workflow graph" in md
+        data = json.loads(open(published["json"]).read())
+        assert data["name"] == "publish-me"
+        assert "epochs" in data["results"]
+
+    def test_html_report_inlines_plots(self, trained_wf, tmp_path,
+                                       monkeypatch):
+        pytest.importorskip("matplotlib")
+        from veles_tpu.plotting import AccumulatingPlotter, GraphicsServer
+
+        monkeypatch.setattr(root.common.disable, "plotting", False,
+                            raising=False)
+        gs = GraphicsServer(backend="file",
+                            directory=str(tmp_path / "plots"))
+        trained_wf.workflow.graphics_server = gs
+        plotter = AccumulatingPlotter(trained_wf, name="errors")
+        plotter.graphics_server = gs
+        plotter.input = 3.0
+        plotter.fill()
+        gs.enqueue(plotter)
+        gs.flush()
+        pub = Publisher(trained_wf, backends=("html",),
+                        directory=str(tmp_path))
+        published = pub.publish()
+        html = open(published["html"]).read()
+        assert "data:image/png;base64," in html
+        assert "publish-me" in html
+
+    def test_disabled_by_config(self, trained_wf, tmp_path, monkeypatch):
+        monkeypatch.setattr(root.common.disable, "publishing", True,
+                            raising=False)
+        pub = Publisher(trained_wf, directory=str(tmp_path))
+        assert pub.publish() == {}
+        assert not os.listdir(str(tmp_path))
+
+    def test_unknown_backend_rejected(self, trained_wf):
+        with pytest.raises(ValueError, match="unknown publishing"):
+            Publisher(trained_wf, backends=("pdf-teleport",))
+
+    def test_wired_into_workflow(self, trained_wf, tmp_path):
+        """Publisher as a unit gated on decision.complete."""
+        pub = Publisher(trained_wf, backends=("markdown",),
+                        directory=str(tmp_path))
+        pub.run()
+        assert pub.published

@@ -36,6 +36,8 @@ class Main(Logger):
         self.launcher = None
         self.workflow = None
         self.snapshot_path = None
+        self.visualize = None
+        self.dump_unit_attributes = False
 
     @staticmethod
     def init_parser():
@@ -89,6 +91,12 @@ class Main(Logger):
         parser.add_argument("--dry-run",
                             choices=("load", "init"), default=None,
                             help="stop after loading/initializing")
+        parser.add_argument("--visualize", default=None, metavar="PATH",
+                            help="write the workflow unit graph as "
+                                 "Graphviz DOT after initialize")
+        parser.add_argument("--dump-unit-attributes", action="store_true",
+                            help="print every unit's post-init state as "
+                                 "JSON lines")
         parser.add_argument("--dump-config", action="store_true")
         parser.add_argument("-v", "--verbose", action="count", default=0)
         return parser
@@ -145,6 +153,29 @@ class Main(Logger):
                 "workflow module %s lacks run(load, main)" % path)
         return module
 
+    def _resolve_snapshot(self, path):
+        """Support ``-w http(s)://...`` snapshot sources: download to a
+        temp file first (reference ``__main__.py:572-581``)."""
+        if not path or not path.startswith(("http://", "https://")):
+            return path
+        import shutil
+        import tempfile
+        import urllib.request
+        suffix = os.path.splitext(path)[1] or ".pickle"
+        fd, local = tempfile.mkstemp(suffix=suffix, prefix="snapshot_")
+        self.info("downloading snapshot %s", path)
+        try:
+            with urllib.request.urlopen(path, timeout=60) as resp, \
+                    os.fdopen(fd, "wb") as fout:
+                shutil.copyfileobj(resp, fout)  # stream, don't buffer
+        except Exception:
+            try:
+                os.unlink(local)
+            except OSError:
+                pass
+            raise
+        return local
+
     # -- the load/main pair handed to the module -----------------------------
     def _load(self, workflow_class, **kwargs):
         snapshot_loaded = False
@@ -161,10 +192,36 @@ class Main(Logger):
         if self.dry_run == "load":
             return
         self.launcher.initialize(**kwargs)
+        if self.visualize:
+            path = self.visualize
+            with open(path, "w") as fout:
+                fout.write(self.workflow.generate_graph())
+            self.info("workflow graph written to %s (render with "
+                      "`dot -Tsvg`)", path)
+        if self.dump_unit_attributes:
+            self._dump_unit_attributes()
         if self.dry_run == "init":
             return
         self.launcher.run()
         self.launcher.stop()
+
+    def _dump_unit_attributes(self):
+        """Post-init unit state dump (reference ``--dump-unit-attributes``,
+        ``__main__.py:663-685``)."""
+        for unit in self.workflow.units:
+            attrs = {}
+            for key, value in sorted(vars(unit).items()):
+                if key.startswith("_") or key.endswith("_"):
+                    continue
+                if isinstance(value, (int, float, str, bool, type(None))):
+                    attrs[key] = value
+                elif isinstance(value, (list, tuple)) and len(value) < 16:
+                    attrs[key] = repr(value)
+                else:
+                    attrs[key] = type(value).__name__
+            print(json.dumps({"unit": unit.name,
+                              "type": type(unit).__name__,
+                              "attrs": attrs}))
 
     # -- entry ----------------------------------------------------------------
     def run(self, argv=None):
@@ -173,7 +230,9 @@ class Main(Logger):
         import logging
         setup_logging(level=logging.DEBUG if args.verbose else logging.INFO)
         self.dry_run = args.dry_run
-        self.snapshot_path = args.snapshot
+        self.snapshot_path = self._resolve_snapshot(args.snapshot)
+        self.visualize = args.visualize
+        self.dump_unit_attributes = args.dump_unit_attributes
         # module FIRST (its import-time root.* updates are defaults), then
         # the config file, then CLI overrides — the reference's layering
         # (__main__.py:396,426-481)

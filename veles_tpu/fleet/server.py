@@ -32,8 +32,13 @@ class SlaveDescription:
     def __init__(self, sid, info):
         self.id = sid
         self.mid = info.get("mid", "?")
+        # coerce ONCE at ingestion: every consumer (logs, status API,
+        # power-weighted retry sort) can then rely on a float
+        try:
+            self.power = float(info.get("power", 1.0))
+        except (TypeError, ValueError):
+            self.power = 1.0
         self.pid = info.get("pid", 0)
-        self.power = info.get("power", 1.0)
         self.backend = info.get("backend", "?")
         self.state = "WAIT"
         self.jobs_done = 0
@@ -267,11 +272,8 @@ class Server(Logger):
         # DeviceBenchmark power): when several slaves are parked, the
         # strongest gets the next job first
 
-        def power_of(item):
-            power = getattr(self.slaves.get(item[0]), "power", 0.0)
-            return -power if isinstance(power, (int, float)) else 0.0
-
-        pending.sort(key=power_of)
+        pending.sort(key=lambda item: -getattr(
+            self.slaves.get(item[0]), "power", 0.0))
         for sid, writer in pending:
             slave = self.slaves.get(sid)
             if slave is not None:

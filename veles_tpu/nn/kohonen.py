@@ -87,7 +87,14 @@ class KohonenTrainer(Unit):
         return self.shape[0] * self.shape[1]
 
     def initialize(self, **kwargs):
-        batch = numpy.asarray(getattr(self.input, "mem", self.input))
+        raw = getattr(self.input, "mem", self.input)
+        if raw is None:
+            # a clear error here beats an opaque broadcast failure from
+            # (n_neurons, 1) weights deep inside the jitted step
+            raise ValueError(
+                "%s: linked input has no data at initialize time — "
+                "initialize the loader first" % self.name)
+        batch = numpy.asarray(raw)
         dim = int(numpy.prod(batch.shape[1:]))
         if self.weights.mem is None:
             init = prng.get(self.prng_key).normal(
@@ -104,8 +111,13 @@ class KohonenTrainer(Unit):
             def step(weights, batch, lr, sigma):
                 n = batch.shape[0]
                 x = batch.reshape(n, -1)
-                d2 = jnp.sum(
-                    (x[:, None, :] - weights[None, :, :]) ** 2, axis=2)
+                # MXU expansion of ||x - w||^2 — the broadcasted (B,N,D)
+                # difference would be VPU elementwise work and O(B*N*D)
+                # intermediate memory
+                d2 = (jnp.sum(x * x, axis=1)[:, None]
+                      - 2.0 * (x @ weights.T)
+                      + jnp.sum(weights * weights, axis=1)[None, :])
+                d2 = jnp.maximum(d2, 0.0)
                 winners = jnp.argmin(d2, axis=1)
                 qerr = jnp.mean(jnp.sqrt(jnp.min(d2, axis=1)))
                 # grid-space neighborhood of each sample's winner

@@ -1,0 +1,33 @@
+"""Device benchmark: the computing-power measurement.
+
+Reference ``accelerated_units.py:706-824`` (DeviceBenchmark): time a
+standard GEMM workload and report ``1000/dt`` arbitrary "power" units —
+the number a slave sends in its fleet handshake so the master can
+power-weight job balancing (``workflow.py:613-619``). Here the workload
+is a jitted bfloat16 matmul chain on whatever device JAX resolves.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def device_benchmark(size=1024, depth=4, iters=3):
+    """Measured device power in the reference's 1000/dt units."""
+
+    @jax.jit
+    def chain(x):
+        for _ in range(depth):
+            x = jnp.matmul(x, x, preferred_element_type=jnp.float32)
+            x = x.astype(jnp.bfloat16) / jnp.float32(size)
+        return x
+
+    x = jnp.ones((size, size), jnp.bfloat16)
+    chain(x).block_until_ready()  # compile outside the timing
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = chain(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return 1000.0 / max(dt, 1e-9)

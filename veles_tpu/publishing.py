@@ -1,0 +1,189 @@
+"""Publisher: render an experiment report when the workflow finishes.
+
+TPU-native re-design of reference ``veles/publishing/`` (1.1k LoC:
+Publisher unit + Markdown/HTML/Confluence/PDF/jinja2 backends). Kept: the
+Publisher unit contract — it fires at workflow end, gathers every
+IResultProvider metric, the config snapshot, the DOT workflow graph, the
+rendered plot images and run metadata, and hands the bundle to one or
+more registered backends. Backends here: ``markdown`` (the canonical
+report), ``html`` (self-contained page with inlined plot images), and
+``json`` (machine-readable; the CI artifact). Confluence/PDF publishing
+were service integrations around the same bundle — the backend registry
+is the extension point for them.
+"""
+
+import base64
+import html as html_lib
+import json
+import os
+import pprint
+import time
+
+from veles_tpu.core.config import root
+from veles_tpu.core.units import Unit
+
+#: name -> backend class (reference publishing/registry.py)
+backend_registry = {}
+
+
+def register_backend(cls):
+    backend_registry[cls.MAPPING] = cls
+    return cls
+
+
+class Backend:
+    """One output format; ``render(bundle) -> text``."""
+
+    MAPPING = None
+    EXTENSION = "txt"
+
+    def __init__(self, **kwargs):
+        self.options = kwargs
+
+    def render(self, bundle):
+        raise NotImplementedError
+
+
+@register_backend
+class MarkdownBackend(Backend):
+    """Reference ``markdown_backend.py:49``."""
+
+    MAPPING = "markdown"
+    EXTENSION = "md"
+
+    def render(self, bundle):
+        lines = ["# %s" % bundle["name"], "",
+                 "*generated %s; run time %.1fs*" % (
+                     bundle["timestamp"], bundle["run_time"]), "",
+                 "## Results", ""]
+        for key, value in sorted(bundle["results"].items()):
+            lines.append("- **%s**: %s" % (key, value))
+        lines += ["", "## Configuration", "", "```"]
+        lines += bundle["config"].splitlines()
+        lines += ["```", ""]
+        if bundle["plots"]:
+            lines += ["## Plots", ""]
+            for name, path in sorted(bundle["plots"].items()):
+                lines.append("![%s](%s)" % (name, path))
+            lines.append("")
+        if bundle.get("graph"):
+            lines += ["## Workflow graph", "", "```dot"]
+            lines += bundle["graph"].splitlines()
+            lines += ["```", ""]
+        return "\n".join(lines)
+
+
+@register_backend
+class HTMLBackend(Backend):
+    """Self-contained HTML (plot images inlined as data URIs) —
+    the role of the reference's markdown→HTML template."""
+
+    MAPPING = "html"
+    EXTENSION = "html"
+
+    def render(self, bundle):
+        esc = html_lib.escape
+        rows = "".join(
+            "<tr><td>%s</td><td>%s</td></tr>"
+            % (esc(str(k)), esc(str(v)))
+            for k, v in sorted(bundle["results"].items()))
+        plots = []
+        for name, path in sorted(bundle["plots"].items()):
+            try:
+                with open(path, "rb") as fin:
+                    data = base64.b64encode(fin.read()).decode()
+                plots.append('<figure><img src="data:image/png;base64,%s"'
+                             '/><figcaption>%s</figcaption></figure>'
+                             % (data, esc(name)))
+            except OSError:
+                continue
+        return ("<!DOCTYPE html><html><head><title>%(name)s</title>"
+                "<style>body{font-family:sans-serif;margin:2em} "
+                "td{border:1px solid #999;padding:4px 10px} "
+                "img{max-width:480px}</style></head><body>"
+                "<h1>%(name)s</h1><p><em>%(ts)s — %(rt).1fs</em></p>"
+                "<h2>Results</h2><table>%(rows)s</table>"
+                "<h2>Plots</h2>%(plots)s"
+                "<h2>Configuration</h2><pre>%(config)s</pre>"
+                "</body></html>") % {
+            "name": esc(bundle["name"]), "ts": esc(bundle["timestamp"]),
+            "rt": bundle["run_time"], "rows": rows,
+            "plots": "".join(plots) or "<p>none</p>",
+            "config": esc(bundle["config"])}
+
+
+@register_backend
+class JSONBackend(Backend):
+    MAPPING = "json"
+    EXTENSION = "json"
+
+    def render(self, bundle):
+        payload = dict(bundle)
+        payload.pop("graph", None)
+        return json.dumps(payload, indent=1, default=str)
+
+
+class Publisher(Unit):
+    """Report-rendering unit (reference ``publishing/publisher.py:57``).
+
+    Link it from the Decision (or EndPoint predecessor) with
+    ``gate_skip = ~decision.complete`` so it fires once at the end; or
+    call :meth:`publish` directly."""
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, **kwargs):
+        backends = kwargs.pop("backends", ("markdown",))
+        self.directory = kwargs.pop(
+            "directory",
+            os.path.join(root.common.dirs.get("cache", "."), "reports"))
+        self.include_plots = kwargs.pop("plots", True)
+        super().__init__(workflow, **kwargs)
+        self._remembers_gates = False
+        self.backends = {}
+        for spec in backends:
+            name, options = (spec, {}) if isinstance(spec, str) else spec
+            cls = backend_registry.get(name)
+            if cls is None:
+                raise ValueError("unknown publishing backend %r (have %s)"
+                                 % (name, sorted(backend_registry)))
+            self.backends[name] = cls(**options)
+        self.published = {}
+
+    def gather_bundle(self):
+        wf = self.workflow
+        plots = {}
+        if self.include_plots:
+            launcher = getattr(wf, "workflow", None)
+            server = getattr(launcher, "graphics_server", None)
+            if server is not None:
+                server.flush()
+                plots = server.rendered
+        return {
+            "name": getattr(wf, "name", "workflow"),
+            "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "run_time": float(getattr(wf, "run_time", 0.0) or 0.0),
+            "results": wf.gather_results(),
+            "config": pprint.pformat(root.__content__()),
+            "plots": plots,
+            "graph": wf.generate_graph(),
+        }
+
+    def publish(self):
+        if root.common.disable.get("publishing", False):
+            return {}
+        bundle = self.gather_bundle()
+        os.makedirs(self.directory, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in bundle["name"])
+        for name, backend in self.backends.items():
+            path = os.path.join(self.directory, "%s_report.%s"
+                                % (safe, backend.EXTENSION))
+            with open(path, "w") as fout:
+                fout.write(backend.render(bundle))
+            self.published[name] = path
+            self.info("published %s report: %s", name, path)
+        return dict(self.published)
+
+    def run(self):
+        self.publish()
