@@ -111,6 +111,61 @@ class TestCompareSnapshots:
         assert main([path, path]) == 0  # identical with itself
 
 
+class TestFrontendGenerator:
+    def test_generates_form(self, tmp_path):
+        from veles_tpu.scripts.generate_frontend import generate
+
+        path = generate(str(tmp_path / "frontend.html"))
+        html = open(path).read()
+        assert "--listen" in html and "--optimize" in html
+        assert "command-line composer" in html
+        assert 'data-flag="--seed"' in html
+
+
+class TestStandardPlotters:
+    def test_add_standard_plotters(self, tmp_path, monkeypatch):
+        pytest.importorskip("matplotlib")
+        from veles_tpu.core.config import root
+        from veles_tpu.models.standard import StandardWorkflow
+        from veles_tpu.plotting import GraphicsServer
+
+        monkeypatch.setattr(root.common.disable, "plotting", False,
+                            raising=False)
+        rng = numpy.random.RandomState(0)
+        X = rng.rand(60, 6).astype(numpy.float32)
+        y = (X[:, 0] > 0.5).astype(numpy.int32)
+        wf = StandardWorkflow(
+            DummyLauncher(),
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 20, 40],
+                               minibatch_size=20),
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 8},
+                    {"type": "softmax", "output_sample_shape": 2}],
+            learning_rate=0.5, fused=False,
+            decision_kwargs=dict(max_epochs=3), name="plotted")
+        plotters = wf.add_standard_plotters(weights=True)
+        assert len(plotters) == 3
+        gs = GraphicsServer(backend="file", directory=str(tmp_path))
+        for p in plotters:
+            p.graphics_server = gs
+            p.redraw_threshold = 0
+        wf.initialize()
+        wf.run()
+        gs.flush()
+        rendered = gs.rendered
+        gs.shutdown()
+        assert any("validation errors" in name for name in rendered)
+        assert any("confusion" in name for name in rendered)
+        # regression: the decision freezes per-epoch snapshots BEFORE
+        # resetting its accumulators — the error plotter must record the
+        # REAL count, and the confusion must cover the WHOLE valid sweep
+        err = plotters[0]
+        assert err.values, "no plotter firings recorded"
+        assert all(float(v).is_integer() and v >= 0 for v in err.values)
+        cm = wf.decision.last_epoch_confusion
+        assert cm is not None and int(cm.sum()) == 20  # all VALID rows
+
+
 class TestCLIIntrospection:
     @pytest.fixture
     def wf_file(self, tmp_path):

@@ -98,8 +98,30 @@ class Main(Logger):
                             help="print every unit's post-init state as "
                                  "JSON lines")
         parser.add_argument("--dump-config", action="store_true")
+        parser.add_argument("-b", "--background", action="store_true",
+                            help="daemonize: run detached with stdio "
+                                 "redirected to <cache>/daemon.log")
         parser.add_argument("-v", "--verbose", action="count", default=0)
         return parser
+
+    def _daemonize(self):
+        """POSIX double-fork detach (reference ``-b``,
+        ``__main__.py`` daemonize via external.daemon)."""
+        if os.fork() > 0:
+            os._exit(0)
+        os.setsid()
+        if os.fork() > 0:
+            os._exit(0)
+        log_path = os.path.join(root.common.dirs.get("cache", "."),
+                                "daemon.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        log = open(log_path, "ab", buffering=0)
+        devnull = open(os.devnull, "rb")
+        os.dup2(devnull.fileno(), 0)
+        os.dup2(log.fileno(), 1)
+        os.dup2(log.fileno(), 2)
+        self.info("daemonized (pid %d), logging to %s", os.getpid(),
+                  log_path)
 
     # -- config handling (reference __main__.py:426-481) ---------------------
     def apply_config(self, config_path):
@@ -239,6 +261,10 @@ class Main(Logger):
         module = self.load_module(args.workflow)
         self.apply_config(args.config)
         self.override_config(args.overrides)
+        if args.background:
+            # AFTER config layering: daemon.log must honor a cache dir
+            # set by the config file or CLI overrides
+            self._daemonize()
         if args.dump_config:
             root.print_()
             return 0

@@ -140,6 +140,44 @@ class StandardWorkflow(Workflow):
                   "XLA computation per %s", len(self.forwards),
                   "class sweep" if self.loader.sweep_serving else "tick")
 
+    def add_standard_plotters(self, confusion=True, weights=False):
+        """Attach the stock live-training plotters (the reference model
+        workflows wired these by hand in every sample): a validation
+        error curve, optionally the confusion matrix (graph mode only —
+        the fused tick publishes loss/n_err) and a weights
+        multi-histogram. Call BEFORE initialize(); the launcher's
+        GraphicsServer renders them."""
+        from veles_tpu.plotting import (AccumulatingPlotter,
+                                        MatrixPlotter, MultiHistogram)
+
+        self.plotters = []
+        err = AccumulatingPlotter(self, name="%s: validation errors"
+                                  % self.name, last=0)
+        # last_epoch_* are FROZEN per-epoch snapshots: the live
+        # accumulators are already zeroed when a leaf plotter fires
+        err.link_attrs(self.decision, ("input", "last_epoch_n_err"))
+        err.input_field = 1  # VALID class
+        err.gate_skip = ~self.decision.epoch_ended
+        err.link_from(self.decision)
+        self.plotters.append(err)
+        if confusion:
+            # the decision accumulates the VALID confusion over each
+            # epoch in graph mode; under the fused tick it stays None
+            # and the plotter renders nothing
+            cm = MatrixPlotter(self, name="%s: confusion" % self.name)
+            cm.link_attrs(self.decision, ("input", "last_epoch_confusion"))
+            cm.link_attrs(self.loader, "reversed_labels_mapping")
+            cm.gate_skip = ~self.decision.epoch_ended
+            cm.link_from(self.decision)
+            self.plotters.append(cm)
+        if weights:
+            wh = MultiHistogram(self, name="%s: weights" % self.name)
+            wh.link_attrs(self.forwards[0], ("input", "weights"))
+            wh.gate_skip = ~self.decision.epoch_ended
+            wh.link_from(self.decision)
+            self.plotters.append(wh)
+        return self.plotters
+
     def _disable_fused(self):
         """Reverse the FusedTick splice (e.g. the loader's HBM-OOM host
         fallback made in-tick gather counterproductive)."""

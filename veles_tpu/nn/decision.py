@@ -45,6 +45,12 @@ class DecisionGD(Unit):
         self.best_n_err = [None, None, None]
         self.best_epoch = 0
         self.snapshot_suffix = ""
+        # frozen copies of the LAST finished epoch (plotter/publisher feed)
+        self.last_epoch_n_err = [0, 0, 0]
+        self.last_epoch_samples = [0, 0, 0]
+        self.last_epoch_loss = [0.0, 0.0, 0.0]
+        self.last_epoch_confusion = None
+        self._epoch_confusion = None
         self._epochs_without_improvement = 0
         self._epochs_done = 0
 
@@ -75,6 +81,16 @@ class DecisionGD(Unit):
         self.epoch_samples[klass] += size
         self.epoch_loss[klass] = (self.epoch_loss[klass]
                                   + self.evaluator.loss.data * size)
+        # accumulate the VALID confusion matrix over the epoch (graph
+        # mode publishes it per minibatch; fused mode leaves it unset)
+        if klass == VALID:
+            cm = getattr(self.evaluator, "confusion_matrix", None)
+            cm_data = getattr(cm, "data", None)
+            if cm_data is not None:
+                self._epoch_confusion = (cm_data
+                                         if self._epoch_confusion is None
+                                         else self._epoch_confusion
+                                         + cm_data)
         if not self.loader.epoch_ended_for_class:
             return
         # one sample-class sweep finished: sync its accumulators to host
@@ -116,6 +132,13 @@ class DecisionGD(Unit):
     def _epoch_summary(self, stats, epoch):
         """All classes of ``epoch`` accounted: decide whether to stop.
         ``stats[klass]`` is (n_err, samples, loss_sum)."""
+        # STABLE per-epoch snapshots for side-band consumers (plotters,
+        # publishers): the live accumulators are zeroed right after this
+        # — and this method is reached by BOTH the standalone and the
+        # fleet epoch-bucket paths
+        self.last_epoch_n_err = [s[0] for s in stats]
+        self.last_epoch_samples = [s[1] for s in stats]
+        self.last_epoch_loss = [s[2] for s in stats]
         self.epoch_ended.set()
         self._epochs_done += 1
         # when there is no validation set, improvement tracks train error
@@ -146,6 +169,11 @@ class DecisionGD(Unit):
         stats = [(self.epoch_n_err[k], self.epoch_samples[k],
                   self.epoch_loss[k]) for k in (TEST, VALID, TRAIN)]
         self._epoch_summary(stats, self._epochs_done)
+        if self._epoch_confusion is not None:
+            import numpy
+            self.last_epoch_confusion = numpy.asarray(
+                self._epoch_confusion)
+            self._epoch_confusion = None
         for klass in (TEST, VALID, TRAIN):
             self.epoch_n_err[klass] = 0
             self.epoch_samples[klass] = 0

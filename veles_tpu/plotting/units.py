@@ -167,6 +167,8 @@ class MatrixPlotter(Plotter):
         self.demand("input")
 
     def snapshot(self):
+        if self.input is None:  # producer has nothing yet (e.g. fused)
+            return {"matrix": [], "labels": []}
         matrix = numpy.asarray(getattr(self.input, "mem", self.input))
         labels = self.reversed_labels_mapping
         if labels is None:
@@ -176,6 +178,8 @@ class MatrixPlotter(Plotter):
 
     @classmethod
     def redraw(cls, pp, figure, data):
+        if not data["matrix"]:
+            return
         matrix = numpy.asarray(data["matrix"], numpy.float64)
         labels = data["labels"]
         axes = figure.add_subplot(111)

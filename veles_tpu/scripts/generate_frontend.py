@@ -1,0 +1,101 @@
+"""Generate the command-line composer frontend.
+
+Reference ``veles/scripts/generate_frontend.py`` + ``--frontend``
+(``__main__.py:258-332``): a browser form built from the global argparse
+registry that composes a ``veles`` command line. Here the form is
+generated straight from ``Main.init_parser()`` into one self-contained
+``frontend.html`` — every flag with its help text, live-assembling the
+``python -m veles_tpu ...`` invocation to copy (no Tornado round-trip;
+the composed line is the product).
+
+Usage: ``python -m veles_tpu.scripts.generate_frontend [out.html]``
+"""
+
+import argparse
+import html
+import sys
+
+
+def form_rows(parser):
+    rows = []
+    for action in parser._actions:
+        if isinstance(action, argparse._HelpAction):
+            continue
+        name = (action.option_strings[-1] if action.option_strings
+                else action.dest)
+        help_text = html.escape(action.help or "")
+        ident = action.dest
+        if not action.option_strings:
+            field = ('<input type="text" id="%s" data-positional="1" '
+                     'placeholder="%s"/>' % (ident, name))
+        elif isinstance(action, (argparse._StoreTrueAction,
+                                 argparse._CountAction)):
+            field = ('<input type="checkbox" id="%s" data-flag="%s"/>'
+                     % (ident, name))
+        elif action.choices:
+            options = "".join('<option value="%s">%s</option>'
+                              % (c, c) for c in [""] + list(action.choices))
+            field = ('<select id="%s" data-flag="%s">%s</select>'
+                     % (ident, name, options))
+        else:
+            default = "" if action.default in (None, False) \
+                else html.escape(str(action.default))
+            field = ('<input type="text" id="%s" data-flag="%s" '
+                     'value="%s"/>' % (ident, name, default))
+        rows.append(
+            "<tr><td><code>%s</code></td><td>%s</td><td>%s</td></tr>"
+            % (html.escape(name), field, help_text))
+    return "".join(rows)
+
+
+PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu frontend</title><style>
+ body { font-family: sans-serif; margin: 2em; }
+ td { border: 1px solid #ccc; padding: 4px 10px; vertical-align: top; }
+ #cmdline { font-family: monospace; background: #f4f4f4; padding: 1em;
+            display: block; margin-top: 1em; white-space: pre-wrap; }
+</style></head><body>
+<h1>veles_tpu command-line composer</h1>
+<table>%(rows)s</table>
+<code id="cmdline"></code>
+<script>
+function rebuild() {
+  var parts = ["python", "-m", "veles_tpu"];
+  document.querySelectorAll("[data-positional]").forEach(function (el) {
+    if (el.value) parts.push(el.value);
+  });
+  document.querySelectorAll("[data-flag]").forEach(function (el) {
+    if (el.type === "checkbox") {
+      if (el.checked) parts.push(el.dataset.flag);
+    } else if (el.value) {
+      parts.push(el.dataset.flag, el.value);
+    }
+  });
+  document.getElementById("cmdline").textContent = parts.join(" ");
+}
+document.querySelectorAll("input,select").forEach(function (el) {
+  el.addEventListener("input", rebuild);
+  el.addEventListener("change", rebuild);
+});
+rebuild();
+</script></body></html>"""
+
+
+def generate(out_path="frontend.html"):
+    from veles_tpu.__main__ import Main
+
+    parser = Main.init_parser()
+    with open(out_path, "w") as fout:
+        fout.write(PAGE % {"rows": form_rows(parser)})
+    return out_path
+
+
+def main(argv=None):
+    args = sys.argv[1:] if argv is None else argv
+    path = generate(args[0] if args else "frontend.html")
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
