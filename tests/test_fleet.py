@@ -257,6 +257,80 @@ class TestLoopback:
         slave.stop()
 
 
+class TestRespawn:
+    def test_manager_backoff_and_budget(self):
+        from veles_tpu.fleet.respawn import RespawnManager
+
+        spawned = []
+        mgr = RespawnManager(
+            spawner=lambda host, cmd, cwd=None, env=None:
+            spawned.append((host, cmd, cwd, env)),
+            max_attempts=2, base_delay=0.01)
+        recipe = {"executable": "/usr/bin/python3",
+                  "argv": ["wf.py", "-m", "h:1"],
+                  "cwd": "/work", "pythonpath": "/lib"}
+        assert mgr.schedule("10.0.0.5", recipe, key="mid-1")
+        assert mgr.schedule("10.0.0.5", recipe, key="mid-1")
+        # budget exhausted
+        assert not mgr.schedule("10.0.0.5", recipe, key="mid-1")
+        import time as _t
+        deadline = _t.time() + 5
+        while len(spawned) < 2 and _t.time() < deadline:
+            _t.sleep(0.01)
+        assert len(spawned) == 2
+        host, cmd, cwd, env = spawned[0]
+        assert host == "10.0.0.5" and cwd == "/work"
+        assert env == {"PYTHONPATH": "/lib"}
+        assert "-b" in cmd and "wf.py" in cmd  # daemonized relaunch
+        # a self-reconnect resets the budget
+        mgr.notify_reconnected("mid-1")
+        assert mgr.schedule("10.0.0.5", recipe, key="mid-1")
+        mgr.stop()
+
+    def test_incomplete_recipe_rejected(self):
+        from veles_tpu.fleet.respawn import RespawnManager
+
+        mgr = RespawnManager(spawner=lambda *a, **k: None)
+        assert not mgr.schedule("h", {})
+        assert not mgr.schedule("h", {"executable": "python"})
+
+    def test_server_respawns_dropped_slave(self):
+        """Loopback: a dying slave with a recipe triggers the master's
+        respawn schedule (reference server.py:637-655 semantics)."""
+        spawned = []
+        kw = _kw(max_epochs=2)
+        _seed()
+        master = Launcher(listen_address="127.0.0.1:0", respawn=True)
+        wf_m = MLPWorkflow(master, name="fleet-t", **kw)
+        master.initialize()
+        master.agent.respawn_manager.spawner = \
+            lambda host, cmd, cwd=None, env=None: spawned.append(
+                (host, cmd))
+        master.agent.respawn_manager.base_delay = 0.01
+        mthread = threading.Thread(target=master.run, daemon=True)
+        mthread.start()
+        slave = _run_slave(master.agent.port, kw, respawn=True)
+        sthread = threading.Thread(target=slave.run, daemon=True)
+        sthread.start()
+        import time as _t
+        deadline = _t.time() + 10
+        while not master.agent.slaves and _t.time() < deadline:
+            _t.sleep(0.05)
+        assert master.agent.slaves, "slave never connected"
+        # abrupt death: close the transport with no 'bye' (the in-process
+        # stand-in for the fault injection's os._exit)
+        slave.agent.stop()
+        deadline = _t.time() + 10
+        while not spawned and _t.time() < deadline:
+            _t.sleep(0.05)
+        master.stop()
+        slave.stop()
+        assert spawned, "master never scheduled a respawn"
+        host, cmd = spawned[0]
+        assert host in ("127.0.0.1", "::1")
+        assert "-b" in cmd
+
+
 class TestChecksum:
     def test_checksum_mismatch_rejected(self):
         import types

@@ -86,6 +86,9 @@ class Main(Logger):
                                  "ensemble")
         parser.add_argument("--async-slave", action="store_true",
                             help="pipelined slave mode")
+        parser.add_argument("--respawn", action="store_true",
+                            help="master: relaunch dead slaves on their "
+                                 "hosts; slave: ship the relaunch recipe")
         parser.add_argument("--slave-death-probability", type=float,
                             default=0.0, help="fault injection")
         parser.add_argument("--dry-run",
@@ -105,23 +108,27 @@ class Main(Logger):
         return parser
 
     def _daemonize(self):
-        """POSIX double-fork detach (reference ``-b``,
-        ``__main__.py`` daemonize via external.daemon)."""
-        if os.fork() > 0:
-            os._exit(0)
-        os.setsid()
-        if os.fork() > 0:
-            os._exit(0)
+        """Detach by RE-EXEC, not fork (reference ``-b`` daemonized via
+        double-fork): by the time the flag is handled the workflow module
+        import has initialized JAX/XLA worker threads, and a forked child
+        inherits their wedged mutexes — its first dispatch dies. A fresh
+        detached process of the same command (minus ``-b``) is fork-safe
+        by construction."""
+        import subprocess
         log_path = os.path.join(root.common.dirs.get("cache", "."),
                                 "daemon.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        log = open(log_path, "ab", buffering=0)
-        devnull = open(os.devnull, "rb")
-        os.dup2(devnull.fileno(), 0)
-        os.dup2(log.fileno(), 1)
-        os.dup2(log.fileno(), 2)
-        self.info("daemonized (pid %d), logging to %s", os.getpid(),
+        argv = [a for a in sys.argv[1:]
+                if a not in ("-b", "--background")]
+        with open(log_path, "ab") as log, \
+                open(os.devnull, "rb") as devnull:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "veles_tpu"] + argv,
+                stdin=devnull, stdout=log, stderr=log,
+                start_new_session=True)
+        self.info("daemonized as pid %d, logging to %s", proc.pid,
                   log_path)
+        os._exit(0)
 
     # -- config handling (reference __main__.py:426-481) ---------------------
     def apply_config(self, config_path):
@@ -285,6 +292,7 @@ class Main(Logger):
             master_address=args.master_address,
             result_file=args.result_file,
             async_slave=args.async_slave,
+            respawn=args.respawn,
             slave_death_probability=args.slave_death_probability)
         module.run(self._load, self._main)
         return 0

@@ -216,12 +216,20 @@ class Workflow(Container):
                 self.exception("%s.stop() failed", unit.name)
         self.stopped = True
         callback = self._job_callback_
-        if callback is not None:
+        if callback is not None and self._sync_error_ is None:
+            # slave: one JOB finished, not the training — ship the update
+            # and do NOT tell the launcher to shut down (that made a CLI
+            # slave exit after its first job; reference workflow.py:393-396
+            # routes to exactly one of the two). A job that ERRORED must
+            # never masquerade as a successful update — fall through to
+            # the shutdown path instead.
             self._job_callback_ = None
             callback(self.generate_data_for_master())
-        parent = self.workflow
-        if parent is not None and hasattr(parent, "on_workflow_finished"):
-            parent.on_workflow_finished()
+        else:
+            parent = self.workflow
+            if parent is not None and hasattr(parent,
+                                              "on_workflow_finished"):
+                parent.on_workflow_finished()
         self._sync_event_.set()
 
     def stop(self):

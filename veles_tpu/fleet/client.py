@@ -30,8 +30,9 @@ class Client(Logger):
 
     def __init__(self, address, workflow, power=1.0, async_mode=False,
                  death_probability=0.0, max_reconnect_attempts=7,
-                 secret=None):
+                 secret=None, enable_respawn=False):
         super().__init__(logger_name="fleet.Client")
+        self.enable_respawn = enable_respawn
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -148,10 +149,16 @@ class Client(Logger):
                 writer.close()
 
     async def _work(self, reader, writer):
-        await write_frame(writer, {
+        hello = {
             "type": "hello", "power": self.power, "mid": machine_id(),
             "pid": os.getpid(), "backend": "tpu",
-            "checksum": getattr(self.workflow, "checksum", None)}, self._secret)
+            "checksum": getattr(self.workflow, "checksum", None)}
+        if self.enable_respawn:
+            # relaunch recipe for the master's --respawn (reference
+            # client.py:362-373 shipped argv/cwd/PYTHONPATH)
+            from veles_tpu.fleet.respawn import respawn_recipe
+            hello["respawn"] = respawn_recipe()
+        await write_frame(writer, hello, self._secret)
         welcome = await read_frame(reader, self._secret)
         if welcome.get("type") == "error":
             self.error("master refused: %s", welcome.get("error"))

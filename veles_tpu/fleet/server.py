@@ -65,8 +65,15 @@ class SlaveDescription:
 class Server(Logger):
     """The fleet master (reference ``server.py:659``)."""
 
-    def __init__(self, address, workflow, job_timeout=120.0, secret=None):
+    def __init__(self, address, workflow, job_timeout=120.0, secret=None,
+                 respawn=False, spawner=None):
         super().__init__(logger_name="fleet.Server")
+        # --respawn: relaunch dead slaves on their hosts (reference
+        # server.py:637-655); see fleet/respawn.py
+        self.respawn_manager = None
+        if respawn:
+            from veles_tpu.fleet.respawn import RespawnManager
+            self.respawn_manager = RespawnManager(spawner=spawner)
         host, _, port = address.rpartition(":")
         # loopback by default: an exposed master means remote code
         # execution for anyone with the secret — opt in explicitly
@@ -150,6 +157,8 @@ class Server(Logger):
         if self._stopped.is_set():
             return
         self._stopped.set()
+        if self.respawn_manager is not None:
+            self.respawn_manager.stop()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
@@ -187,6 +196,9 @@ class Server(Logger):
             self._next_id += 1
             sid = "slave-%d" % self._next_id
             slave = SlaveDescription(sid, hello)
+            slave.respawn_recipe = hello.get("respawn")
+            peer = writer.get_extra_info("peername")
+            slave.peer_host = peer[0] if peer else "127.0.0.1"
             self.slaves[sid] = slave
             self._writers[sid] = writer
             initial = await self._in_thread(
@@ -249,6 +261,12 @@ class Server(Logger):
             slave.job_times.append(time.time() - slave.job_started)
             slave.job_started = None
         slave.jobs_done += 1
+        if slave.jobs_done == 1 and self.respawn_manager is not None \
+                and slave.mid != "?":
+            # reset the respawn budget only once the slave proves it can
+            # WORK — resetting at handshake would let a crash-on-init
+            # loop respawn forever at base delay
+            self.respawn_manager.notify_reconnected(slave.mid)
         update = msg.get("update")
         if update is not None:
             await self._in_thread(self._locked_apply, update, slave)
@@ -283,6 +301,12 @@ class Server(Logger):
         timeout = slave.timeout(self.job_timeout)
 
         def check():
+            if self.slaves.get(slave.id) is not slave:
+                # the slave already dropped (death/disconnect): a stale
+                # timer must NOT blacklist its machine-id posthumously —
+                # that would ban every future (e.g. respawned) slave of
+                # that host
+                return
             if slave.job_started is not None \
                     and time.time() - slave.job_started > timeout:
                 self.warning("slave %s hanged (> %.1fs); dropping + "
@@ -299,6 +323,8 @@ class Server(Logger):
 
     def _drop(self, sid):
         slave = self.slaves.pop(sid, None)
+        if slave is not None:
+            slave.job_started = None  # disarm any in-flight hang timer
         self._writers.pop(sid, None)
         self._pending_requests = [
             (s, w) for s, w in self._pending_requests if s != sid]
@@ -306,6 +332,15 @@ class Server(Logger):
             self.info("slave %s dropped", sid)
             with self._update_lock:
                 self.workflow.drop_slave(slave)
+            if self.respawn_manager is not None \
+                    and not self._stopped.is_set() \
+                    and getattr(slave, "respawn_recipe", None) \
+                    and slave.mid not in self.blacklist \
+                    and self.workflow.has_more_jobs():
+                self.respawn_manager.schedule(
+                    getattr(slave, "peer_host", "127.0.0.1"),
+                    slave.respawn_recipe,
+                    key=slave.mid if slave.mid != "?" else sid)
         self._maybe_finished()
 
     def _maybe_finished(self):

@@ -21,8 +21,9 @@ class Launcher(Logger):
 
     def __init__(self, listen_address=None, master_address=None,
                  result_file=None, slave_power=1.0, async_slave=False,
-                 slave_death_probability=0.0, **kwargs):
+                 slave_death_probability=0.0, respawn=False, **kwargs):
         super().__init__(logger_name="Launcher")
+        self.respawn = respawn
         self.listen_address = listen_address
         self.master_address = master_address
         self.result_file = result_file
@@ -84,7 +85,8 @@ class Launcher(Logger):
             from veles_tpu.fleet.server import Server
             self.agent = Server(
                 self.listen_address, self.workflow,
-                job_timeout=root.common.fleet.get("job_timeout", 120.0))
+                job_timeout=root.common.fleet.get("job_timeout", 120.0),
+                respawn=self.respawn)
             self.agent.on_finished = self._on_agent_finished
             self.agent.start()
         elif self.is_slave:
@@ -93,6 +95,7 @@ class Launcher(Logger):
                 self.master_address, self.workflow,
                 power=self.slave_power, async_mode=self.async_slave,
                 death_probability=self.slave_death_probability,
+                enable_respawn=self.respawn,
                 max_reconnect_attempts=root.common.fleet.get(
                     "max_reconnect_attempts", 7))
             self.agent.on_finished = self._on_agent_finished

@@ -51,9 +51,16 @@ class SoundDecoderMixin:
         else:
             raise ValueError("%s: unsupported sample width %d"
                              % (path, width))
-        data = data.astype(numpy.float32).reshape(frames, channels)
-        return {"data": data, "sampling_rate": rate, "samples": frames,
-                "channels": channels, "name": path}
+        # derive frames from the DECODED length: a truncated data chunk
+        # must not crash an opaque reshape against the header count
+        data = data.astype(numpy.float32).reshape(-1, channels)
+        if len(data) != frames:
+            import logging
+            logging.getLogger("SoundDecoder").warning(
+                "%s: header says %d frames, decoded %d (truncated?)",
+                path, frames, len(data))
+        return {"data": data, "sampling_rate": rate,
+                "samples": len(data), "channels": channels, "name": path}
 
 
 @register_loader("sound_file")
@@ -70,6 +77,7 @@ class SoundFileLoader(SoundDecoderMixin, FileScannerMixin,
         self.window_stride = int(kwargs.pop("window_stride",
                                             self.window_size))
         self.mono = kwargs.pop("mono", True)
+        self._expected_channels = None
         FileScannerMixin.__init__(
             self, **{k: kwargs.pop(k) for k in
                      ("test_paths", "validation_paths", "train_paths")
@@ -91,6 +99,16 @@ class SoundFileLoader(SoundDecoderMixin, FileScannerMixin,
         data = decoded["data"]  # (frames, channels)
         if self.mono and decoded["channels"] > 1:
             data = data.mean(axis=1, keepdims=True)
+        elif not self.mono:
+            # mixed mono/stereo datasets would produce ragged windows and
+            # die in numpy.stack with no filename — fail HERE with one
+            if self._expected_channels is None:
+                self._expected_channels = data.shape[1]
+            elif data.shape[1] != self._expected_channels:
+                raise ValueError(
+                    "%s has %d channels but the dataset started with %d "
+                    "(use mono=True to mix)" % (
+                        path, data.shape[1], self._expected_channels))
         frames = len(data)
         out = []
         for start in range(0, frames - self.window_size + 1,
