@@ -1,0 +1,71 @@
+"""Two-PROCESS CLI fleet tests.
+
+The in-process loopback tests (test_fleet.py) share one interpreter, so a
+launcher/shutdown-routing bug can hide: the round-2 slave-exits-after-
+first-job bug passed every in-process test because nothing stopped the
+agent thread early. These tests run the real ``python -m veles_tpu`` CLI
+for master and slave as subprocesses — the actual product invocation."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+WF = """
+import numpy
+from veles_tpu.models.mlp import MLPWorkflow
+
+def run(load, main):
+    rng = numpy.random.RandomState(0)
+    X = rng.rand(300, 8).astype(numpy.float32)
+    y = (X[:, 0] > 0.5).astype(numpy.int32)
+    load(MLPWorkflow, layers=(8, 2),
+         loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 60, 240],
+                            minibatch_size=60),
+         learning_rate=0.3, max_epochs=2)
+    main()
+"""
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no 8-device mesh needed; faster startup
+    env["VELES_TPU_FLEET_SECRET"] = "cli-test"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.slow
+def test_cli_master_slave_roundtrip(tmp_path):
+    """Regression: a CLI slave must serve jobs until the MASTER is done,
+    not exit after its first job's on_workflow_finished."""
+    wf_file = tmp_path / "wf.py"
+    wf_file.write_text(WF)
+    result_file = tmp_path / "res.json"
+    env = _env()
+    port = 37001
+    master = subprocess.Popen(
+        [sys.executable, "-m", "veles_tpu", str(wf_file), "-",
+         "-l", "127.0.0.1:%d" % port,
+         "--result-file", str(result_file)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        time.sleep(5)
+        slave = subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu", str(wf_file), "-",
+             "-m", "127.0.0.1:%d" % port],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        assert master.wait(timeout=180) == 0
+        assert slave.wait(timeout=60) == 0
+    finally:
+        for proc in (master, locals().get("slave")):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+    results = json.loads(result_file.read_text())
+    assert results["epochs"] == 2
+    assert results["best_validation_errors"] is not None
