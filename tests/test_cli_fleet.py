@@ -48,7 +48,12 @@ def test_cli_master_slave_roundtrip(tmp_path):
     wf_file.write_text(WF)
     result_file = tmp_path / "res.json"
     env = _env()
-    port = 37001
+    # a kernel-assigned free port: a constant would collide across
+    # concurrent runs (in-process tests bind :0 for the same reason)
+    import socket
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
     master = subprocess.Popen(
         [sys.executable, "-m", "veles_tpu", str(wf_file), "-",
          "-l", "127.0.0.1:%d" % port,

@@ -1,0 +1,121 @@
+"""Expert parallelism over the ``expert`` mesh axis.
+
+Additive beyond the reference (no model sharding of any kind, SURVEY
+§2.5): a GShard-style top-1 mixture-of-experts feed-forward, sharded so
+each device group holds one slice of the experts and tokens move to
+their expert via ``lax.all_to_all`` over ICI — the canonical TPU MoE
+dataflow:
+
+    gate (replicated) → top-1 route → capacity-bounded dense dispatch
+    (static shapes: XLA cannot compile data-dependent token counts) →
+    all_to_all(tokens → expert shards) → expert FFN (batched matmul on
+    the MXU) → all_to_all back → combine weighted by gate probability.
+
+Tokens over an expert's capacity are dropped (standard GShard
+semantics); size capacity by ``capacity_factor`` to trade padding FLOPs
+for drop rate.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(rng, n_experts, d_model, d_hidden):
+    """Gate + per-expert FFN weights (host numpy in, pytree out)."""
+    scale = 1.0 / (d_model ** 0.5)
+    return {
+        "gate": (rng.randn(d_model, n_experts) * scale).astype("float32"),
+        "w1": (rng.randn(n_experts, d_model, d_hidden) * scale).astype(
+            "float32"),
+        "b1": jnp.zeros((n_experts, d_hidden), jnp.float32),
+        "w2": (rng.randn(n_experts, d_hidden, d_model) * scale).astype(
+            "float32"),
+        "b2": jnp.zeros((n_experts, d_model), jnp.float32),
+    }
+
+
+def shard_moe_params(params, mesh):
+    """Experts sharded over 'expert'; the gate replicated."""
+    def put(name, a):
+        spec = P() if name == "gate" else P("expert")
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+    return {k: put(k, v) for k, v in params.items()}
+
+
+def make_moe_ffn(mesh, n_experts, capacity_factor=2.0):
+    """Compile ``moe(params, x) -> (y, aux)`` over the mesh.
+
+    ``x`` is (tokens, d_model) sharded over 'expert' (the token dim acts
+    as the data dim of this axis); ``aux`` carries the dropped-token
+    fraction for load-balancing diagnostics.
+    """
+    ep = mesh.shape["expert"]
+    assert n_experts % ep == 0, "n_experts must divide the expert axis"
+    e_local = n_experts // ep
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=({"gate": P(), "w1": P("expert"), "b1": P("expert"),
+                        "w2": P("expert"), "b2": P("expert")},
+                       P("expert")),
+             out_specs=(P("expert"), P()), check_vma=False)
+    def moe(p, x_local):
+        t_local, d_model = x_local.shape
+        capacity = max(1, int(t_local * capacity_factor / n_experts))
+        # --- route (every device computes its own tokens' gates) -----
+        logits = x_local @ p["gate"]                     # (T, E)
+        probs = jax.nn.softmax(logits, axis=1)
+        choice = jnp.argmax(probs, axis=1)               # (T,)
+        gate_val = jnp.max(probs, axis=1)                # (T,)
+        onehot = jax.nn.one_hot(choice, n_experts)       # (T, E)
+        # position of each token within its expert's queue
+        position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+        kept = (position < capacity) * onehot            # (T, E)
+        dropped = 1.0 - kept.sum(axis=1)
+        pos = (position * kept).sum(axis=1).astype(jnp.int32)
+        # dense dispatch tensor (T, E, C): static shapes for XLA
+        dispatch = kept[:, :, None] * jax.nn.one_hot(pos, capacity)[
+            :, None, :]
+        # (E, C, D): each expert's padded token buffer from THIS shard
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x_local)
+        # --- all_to_all: experts gather their tokens from all shards --
+        # (E, C, D) -> (e_local, ep*C, D): split the expert dim across
+        # the axis, concatenate the shard dim into the token dim
+        expert_in = expert_in.reshape(ep, e_local, capacity, d_model)
+        expert_in = lax.all_to_all(expert_in, "expert", 0, 0,
+                                   tiled=False)           # (ep, eL, C, D)
+        expert_in = expert_in.transpose(1, 0, 2, 3).reshape(
+            e_local, ep * capacity, d_model)
+        # --- expert FFN (batched matmul on the MXU) -------------------
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, p["w1"])
+                        + p["b1"][:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h, p["w2"]) + p["b2"][:, None, :]
+        # --- all_to_all back ------------------------------------------
+        out = out.reshape(e_local, ep, capacity, d_model).transpose(
+            1, 0, 2, 3)
+        out = lax.all_to_all(out, "expert", 0, 0, tiled=False)
+        out = out.reshape(n_experts, capacity, d_model)   # (E, C, D)
+        # --- combine ---------------------------------------------------
+        y = jnp.einsum("tec,ecd->td", dispatch, out) * gate_val[:, None]
+        return y, lax.pmean(jnp.mean(dropped), "expert")
+
+    return moe
+
+
+def reference_moe(params, x):
+    """Dense single-device reference (no capacity drops) for parity
+    tests: every token goes through its argmax expert exactly."""
+    logits = x @ params["gate"]
+    probs = jax.nn.softmax(logits, axis=1)
+    choice = jnp.argmax(probs, axis=1)
+    gate_val = jnp.max(probs, axis=1)
+    w1 = params["w1"][choice]                   # (T, D, H)
+    b1 = params["b1"][choice]
+    w2 = params["w2"][choice]
+    b2 = params["b2"][choice]
+    h = jax.nn.relu(jnp.einsum("td,tdh->th", x, w1) + b1)
+    out = jnp.einsum("th,thd->td", h, w2) + b2
+    return out * gate_val[:, None]
