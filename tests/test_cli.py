@@ -10,13 +10,15 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cli(*args, timeout=300):
+def run_cli(*args, timeout=300, cwd=REPO):
     env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
                VELES_TPU_HOME=os.environ.get("VELES_TPU_HOME",
-                                             "/tmp/veles_cli_test"))
+                                             "/tmp/veles_cli_test"),
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
     return subprocess.run(
         [sys.executable, "-m", "veles_tpu"] + list(args),
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=timeout)
 
 
 @pytest.mark.slow
@@ -96,16 +98,8 @@ def test_ensemble_train_and_test_cli(tmp_path):
         "root.tiny.lr", "0.3"))
     # the CLI writes ensemble.json into ITS cwd: run the subprocess in
     # tmp_path so no artifact touches the repository tree
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               VELES_TPU_HOME=os.environ.get("VELES_TPU_HOME",
-                                             "/tmp/veles_cli_test"),
-               PYTHONPATH=REPO + os.pathsep
-               + os.environ.get("PYTHONPATH", ""))
-    proc = subprocess.run(
-        [sys.executable, "-m", "veles_tpu", str(wf), "-",
-         "--ensemble-train", "2:0.8"],
-        cwd=str(tmp_path), env=env, capture_output=True, text=True,
-        timeout=600)
+    proc = run_cli(str(wf), "-", "--ensemble-train", "2:0.8",
+                   timeout=600, cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stderr[-2000:]
     ensemble_file = tmp_path / "ensemble.json"
     assert ensemble_file.is_file()
@@ -113,11 +107,8 @@ def test_ensemble_train_and_test_cli(tmp_path):
     assert len(payload["instances"]) == 2
     assert all(e["returncode"] == 0 for e in payload["instances"])
     # --ensemble-test re-evaluates the stored snapshots
-    proc = subprocess.run(
-        [sys.executable, "-m", "veles_tpu", str(wf), "-",
-         "--ensemble-test", str(ensemble_file)],
-        cwd=str(tmp_path), env=env, capture_output=True, text=True,
-        timeout=600)
+    proc = run_cli(str(wf), "-", "--ensemble-test", str(ensemble_file),
+                   timeout=600, cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "{" in proc.stdout, proc.stderr[-2000:]
     tested = json.loads(proc.stdout[proc.stdout.index("{"):])

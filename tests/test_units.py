@@ -274,3 +274,67 @@ class TestDistributedAggregation:
         type(w).jobs = 3
         assert wf.generate_data_for_slave("s") is None
         assert not wf.has_more_jobs()
+
+
+class TestInterfaceVerification:
+    """Reference verified.py role: structural interface checks at
+    workflow initialize."""
+
+    def test_valid_units_pass(self):
+        from veles_tpu.core.verified import IUNIT, verify_interface
+        from veles_tpu.core.units import Unit
+        verify_interface(Unit(DummyWorkflow()), IUNIT, "IUnit")
+
+    def test_missing_method_reported(self):
+        from veles_tpu.core.verified import (InterfaceError, IUNIT,
+                                             verify_interface)
+
+        class Broken:
+            name = "broken"
+            initialize = None
+
+        try:
+            verify_interface(Broken(), IUNIT, "IUnit")
+        except InterfaceError as exc:
+            assert "initialize" in str(exc) and "run" in str(exc)
+        else:
+            raise AssertionError("no InterfaceError raised")
+
+    def test_arity_checked(self):
+        from veles_tpu.core.verified import (ILOADER, InterfaceError,
+                                             verify_interface)
+
+        class BadLoader:
+            name = "bad"
+
+            def load_data(self):
+                pass
+
+            def create_minibatch_data(self):
+                pass
+
+            def fill_minibatch(self):  # needs (indices, valid)
+                pass
+
+        try:
+            verify_interface(BadLoader(), ILOADER, "ILoader")
+        except InterfaceError as exc:
+            assert "fill_minibatch" in str(exc)
+        else:
+            raise AssertionError("no InterfaceError raised")
+
+    def test_workflow_initialize_verifies(self):
+        from veles_tpu.core.verified import InterfaceError
+        from veles_tpu.core.workflow import Workflow
+        from veles_tpu.core.units import Unit
+        from veles_tpu.dummy import DummyLauncher
+
+        wf = Workflow(DummyLauncher(), name="verify-wf")
+        unit = Unit(wf)
+        unit.run = None  # sabotage
+        try:
+            wf.initialize()
+        except InterfaceError as exc:
+            assert "run" in str(exc)
+        else:
+            raise AssertionError("no InterfaceError raised")

@@ -141,7 +141,16 @@ class Workflow(Container):
     # -- lifecycle ------------------------------------------------------------
     def initialize(self, **kwargs):
         """Initialize units in dependency order, re-queueing partial
-        initializers (reference ``workflow.py:299-345``)."""
+        initializers (reference ``workflow.py:299-345``). Every unit is
+        interface-verified first — IUnit always, IDistributable when the
+        run is not standalone (reference ``verified.py:36-66`` +
+        ``workflow.py:322`` semantics)."""
+        from veles_tpu.core.verified import (IDISTRIBUTABLE, IUNIT,
+                                             verify_interface)
+        for unit in self._units:
+            verify_interface(unit, IUNIT, "IUnit")
+            if not self.is_standalone:
+                verify_interface(unit, IDISTRIBUTABLE, "IDistributable")
         queue = self.units_in_dependency_order()
         max_rounds = len(queue) + 1
         for _ in range(max_rounds):

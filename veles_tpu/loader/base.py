@@ -139,6 +139,8 @@ class Loader(Unit):
 
     # -- lifecycle ------------------------------------------------------------
     def initialize(self, **kwargs):
+        from veles_tpu.core.verified import ILOADER, verify_interface
+        verify_interface(self, ILOADER, "ILoader")
         self.load_data()
         if self.total_samples == 0:
             raise ValueError("%s loaded an empty dataset" % self.name)
