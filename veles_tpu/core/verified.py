@@ -26,12 +26,14 @@ class InterfaceError(VelesError):
 #: method -> minimum positional parameters AFTER self
 IUNIT = {"initialize": 0, "run": 0, "stop": 0}
 
+#: arities are the CALL-SITE arg counts (workflow.py fleet paths), so an
+#: implementation missing the slave parameter fails HERE, not mid-update
 IDISTRIBUTABLE = {
     "generate_data_for_master": 0,
-    "generate_data_for_slave": 0,   # (slave=None)
+    "generate_data_for_slave": 1,   # (slave)
     "apply_data_from_master": 1,    # (data)
-    "apply_data_from_slave": 1,     # (data, slave=None)
-    "drop_slave": 0,                # (slave=None)
+    "apply_data_from_slave": 2,     # (data, slave)
+    "drop_slave": 1,                # (slave)
 }
 
 ILOADER = {"load_data": 0, "create_minibatch_data": 0,

@@ -43,8 +43,21 @@ from veles_tpu.loader.normalization import normalizer_registry
 #: forward-unit class name → fused layer kind
 _DENSE = "dense"
 _CONV = "conv"
+_ATTN = "attention"
+_NORM = "layer_norm"
 _POOL_KINDS = {"MaxPooling": "max", "AvgPooling": "avg",
                "MaxAbsPooling": "maxabs"}
+
+
+#: per-leaf update policy: (leaf key, forward attr, gd velocity attr,
+#: uses learning_rate_bias, gets l2/l1 decay) — encodes each graph-mode
+#: GD unit's exact update math so fused results match bit-for-bit logic
+_WB_LEAVES = (("w", "weights", "_velocity_w", False, True),
+              ("b", "bias", "_velocity_b", True, False))
+_ATTN_LEAVES = (("w", "weights", "_velocity_w", False, True),
+                ("b", "bias", "_velocity_b", True, True),
+                ("ow", "out_weights", "_velocity_ow", False, True),
+                ("ob", "out_bias", "_velocity_ob", True, True))
 
 
 def extract_model_spec(workflow):
@@ -52,6 +65,7 @@ def extract_model_spec(workflow):
     Returns a spec list, or None when a layer type is not fusible (the
     caller then stays on graph mode)."""
     from veles_tpu.nn.all2all import All2All
+    from veles_tpu.nn.attention import LayerNorm, SelfAttention
     from veles_tpu.nn.conv import Conv
     from veles_tpu.nn.pooling import Pooling
 
@@ -59,10 +73,17 @@ def extract_model_spec(workflow):
     for i, fwd in enumerate(workflow.forwards):
         gd = workflow.gds[i] if workflow.gds else None
         if isinstance(fwd, All2All):
-            spec = {"kind": _DENSE, "activation": fwd.ACTIVATION}
+            spec = {"kind": _DENSE, "activation": fwd.ACTIVATION,
+                    "leaves": _WB_LEAVES}
         elif isinstance(fwd, Conv):
             spec = {"kind": _CONV, "activation": fwd.ACTIVATION,
-                    "sliding": fwd.sliding, "padding": fwd.padding}
+                    "sliding": fwd.sliding, "padding": fwd.padding,
+                    "leaves": _WB_LEAVES}
+        elif isinstance(fwd, SelfAttention):
+            spec = {"kind": _ATTN, "heads": fwd.heads,
+                    "causal": fwd.causal, "leaves": _ATTN_LEAVES}
+        elif isinstance(fwd, LayerNorm):
+            spec = {"kind": _NORM, "eps": fwd.eps, "leaves": _WB_LEAVES}
         elif isinstance(fwd, Pooling):
             spec = {"kind": _POOL_KINDS.get(type(fwd).__name__),
                     "window": (fwd.ky, fwd.kx), "sliding": fwd.sliding}
@@ -70,7 +91,7 @@ def extract_model_spec(workflow):
                 return None
         else:
             return None
-        if spec["kind"] in (_DENSE, _CONV):
+        if "leaves" in spec:
             if gd is None or not hasattr(gd, "learning_rate"):
                 return None
             spec["has_params"] = True
@@ -87,39 +108,38 @@ def get_hypers(workflow):
             for fwd, gd in zip(workflow.forwards, workflow.gds)]
 
 
-def get_params(workflow):
-    """Snapshot the unit chain's weights into the per-layer pytree."""
+def get_params(workflow, specs):
+    """Snapshot the unit chain's weights into the per-layer pytree:
+    ``{"p": {leaf: tensor}, "v": {leaf: velocity}}`` per layer, leaves
+    named by each spec's update-policy table."""
     params = []
-    for i, fwd in enumerate(workflow.forwards):
-        if getattr(fwd, "weights", None) is None:
+    for fwd, gd, spec in zip(workflow.forwards, workflow.gds, specs):
+        if not spec.get("has_params"):
             params.append({})
             continue
-        gd = workflow.gds[i]
-        params.append({
-            "w": fwd.weights.data,
-            "b": fwd.bias.data,
-            "vw": (gd._velocity_w.data if gd._velocity_w.data is not None
-                   else jnp.zeros_like(fwd.weights.data)),
-            "vb": (gd._velocity_b.data if gd._velocity_b.data is not None
-                   else jnp.zeros_like(fwd.bias.data)),
-        })
+        p, v = {}, {}
+        for leaf, fwd_attr, vel_attr, _, _ in spec["leaves"]:
+            p[leaf] = getattr(fwd, fwd_attr).data
+            vel = getattr(gd, vel_attr).data
+            v[leaf] = vel if vel is not None else jnp.zeros_like(p[leaf])
+        params.append({"p": p, "v": v})
     return params
 
 
-def set_params(workflow, params):
+def set_params(workflow, params, specs):
     """Write fused-step results back into the shared unit Array slots (so
     the Snapshotter, exporters, and graph mode all see current weights).
 
     COPIES, not aliases: the train step donates its params argument, so an
     alias stored in a unit Array would be a deleted buffer one tick later
     (and the Snapshotter may read it concurrently from a pool thread)."""
-    for fwd, gd, p in zip(workflow.forwards, workflow.gds, params):
+    for fwd, gd, p, spec in zip(workflow.forwards, workflow.gds, params,
+                                specs):
         if not p:
             continue
-        fwd.weights.data = jnp.copy(p["w"])
-        fwd.bias.data = jnp.copy(p["b"])
-        gd._velocity_w.data = jnp.copy(p["vw"])
-        gd._velocity_b.data = jnp.copy(p["vb"])
+        for leaf, fwd_attr, vel_attr, _, _ in spec["leaves"]:
+            getattr(fwd, fwd_attr).data = jnp.copy(p["p"][leaf])
+            getattr(gd, vel_attr).data = jnp.copy(p["v"][leaf])
 
 
 def _layer_forward(spec):
@@ -143,6 +163,30 @@ def _layer_forward(spec):
                 precision=lax.Precision.DEFAULT,
                 preferred_element_type=jnp.float32)
             return act(out + p["b"])
+        return fwd
+    if kind == _ATTN:
+        from veles_tpu.ops.attention import attention as attn_op
+        heads, causal = spec["heads"], spec["causal"]
+
+        def fwd(p, x):
+            # mirrors nn.attention.SelfAttention._forward exactly
+            batch, t, embed = x.shape
+            head_dim = embed // heads
+            qkv = x @ p["w"] + p["b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shape = (batch, t, heads, head_dim)
+            out = attn_op(q.reshape(shape), k.reshape(shape),
+                          v.reshape(shape), causal=causal)
+            return out.reshape(batch, t, embed) @ p["ow"] + p["ob"]
+        return fwd
+    if kind == _NORM:
+        eps = spec["eps"]
+
+        def fwd(p, x):
+            # mirrors nn.attention.LayerNorm._forward exactly
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            return (x - mean) * lax.rsqrt(var + eps) * p["w"] + p["b"]
         return fwd
     # pooling (mirrors nn.pooling semantics exactly)
     ky, kx = spec["window"]
@@ -236,7 +280,7 @@ def build_tick(specs, norm_type="none", mesh=None):
     def core_train(params, hypers, norm, data, labels, indices, valid):
         batch, lab = gather_norm(data, labels, indices, norm)
         mask = local_mask(indices.shape[0], valid)
-        wb = [{"w": p["w"], "b": p["b"]} if p else {} for p in params]
+        wb = [p["p"] if p else {} for p in params]
 
         def loss_fn(wb):
             loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
@@ -249,23 +293,30 @@ def build_tick(specs, norm_type="none", mesh=None):
             loss_sum = lax.psum(loss_sum, "data")
             n_err = lax.psum(n_err, "data")
         new = []
-        for p, g, hyper in zip(params, grads, hypers):
+        for p, g, hyper, spec in zip(params, grads, hypers, specs):
             if not p:
                 new.append({})
                 continue
             lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2],
                                         hyper[3], hyper[4])
-            gw = g["w"] + l2 * p["w"] + l1 * jnp.sign(p["w"])
-            vw = moment * p["vw"] - lr * gw
-            vb = moment * p["vb"] - lr_b * g["b"]
-            new.append({"w": p["w"] + vw, "b": p["b"] + vb,
-                        "vw": vw, "vb": vb})
+            new_p, new_v = {}, {}
+            # per-leaf policy from the spec table: which rate applies
+            # and whether l2/l1 decay does — matching each graph-mode GD
+            # unit's exact update math
+            for leaf, _, _, use_lr_b, decay in spec["leaves"]:
+                w, gw, vel = p["p"][leaf], g[leaf], p["v"][leaf]
+                if decay:
+                    gw = gw + l2 * w + l1 * jnp.sign(w)
+                v2 = moment * vel - (lr_b if use_lr_b else lr) * gw
+                new_p[leaf] = w + v2
+                new_v[leaf] = v2
+            new.append({"p": new_p, "v": new_v})
         return new, (loss_sum, n_err)
 
     def core_eval(params, norm, data, labels, indices, valid):
         batch, lab = gather_norm(data, labels, indices, norm)
         mask = local_mask(indices.shape[0], valid)
-        wb = [{"w": p["w"], "b": p["b"]} if p else {} for p in params]
+        wb = [p["p"] if p else {} for p in params]
         loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
         if data_ax > 1:
             loss_sum = lax.psum(loss_sum, "data")
@@ -392,6 +443,7 @@ class FusedTick(Unit):
         self._params_ = None
         self._steps_ = None
         self._norm_ = None
+        self._specs_ = None
 
     def initialize(self, **kwargs):
         wf = self.workflow
@@ -407,11 +459,11 @@ class FusedTick(Unit):
             weights = getattr(fwd, "weights", None)
             if weights is not None and weights.data is None:
                 return True  # retry after the forwards initialize
-        specs = extract_model_spec(wf)
+        self._specs_ = extract_model_spec(wf)
         self._norm_ = {k: jnp.asarray(v) for k, v in
                        loader.normalizer.jit_state().items()}
-        self._steps_ = build_tick(specs, loader.normalization_type,
-                                  self.mesh_)
+        self._steps_ = build_tick(self._specs_,
+                                  loader.normalization_type, self.mesh_)
 
     def run(self):
         import numpy
@@ -420,7 +472,8 @@ class FusedTick(Unit):
         if self._params_ is None:
             # copy: the unit Arrays keep their own buffers — ours get
             # donated through the train step
-            self._params_ = jax.tree.map(jnp.copy, get_params(wf))
+            self._params_ = jax.tree.map(
+                jnp.copy, get_params(wf, self._specs_))
         train_step, eval_step, train_sweep, eval_sweep = self._steps_
         norm = self._norm_
         data = loader.original_data.data
@@ -453,4 +506,4 @@ class FusedTick(Unit):
         evaluator.n_err.data = n_err
         self.ticks += 1
         if loader.epoch_ended:
-            set_params(wf, self._params_)
+            set_params(wf, self._params_, self._specs_)
