@@ -225,3 +225,51 @@ def test_fused_transformer_matches_graph_mode():
             numpy.testing.assert_allclose(
                 numpy.asarray(ag.data), numpy.asarray(af.data),
                 atol=1e-2)
+
+
+def test_fused_eval_publishes_confusion():
+    """Fused eval passes emit the confusion increment; the Decision
+    accumulates the whole VALID sweep (MatrixPlotter feed parity with
+    graph mode)."""
+    wf = _train(_build_mlp(fused=True, max_epochs=2))
+    assert wf.fused_tick is not None
+    cm = wf.decision.last_epoch_confusion
+    assert cm is not None and cm.shape == (10, 10)
+    assert int(cm.sum()) == 297  # every VALID row accounted
+    graph = _train(_build_mlp(fused=False, max_epochs=2))
+    graph_cm = numpy.asarray(graph.decision.last_epoch_confusion)
+    # the modes' weights drift ~1e-5/tick (fp reassociation), flipping a
+    # few borderline argmaxes: totals must match, cells near-match
+    assert int(graph_cm.sum()) == 297
+    delta = numpy.abs(numpy.asarray(cm) - graph_cm).sum()
+    assert delta <= 8, "confusion matrices differ by %d entries" % delta
+
+
+def test_fused_confusion_per_tick_and_dp():
+    """The per-tick eval path AND the shard_mapped DP path publish the
+    psum-merged confusion (the sweep test above covers only the scan
+    path)."""
+    import jax
+    from veles_tpu.parallel.mesh import build_mesh
+
+    # per-tick engine (sweep off)
+    wf = _train(_build_mlp(fused=True, max_epochs=1, sweep=False))
+    cm = wf.decision.last_epoch_confusion
+    assert cm is not None and int(cm.sum()) == 297
+
+    # data-parallel engine: cm must be the psum over shards
+    mesh = build_mesh(devices=jax.devices()[:4], data=4)
+    dp = _train(_build_mlp(fused=True, max_epochs=1, mesh=mesh))
+    cm_dp = dp.decision.last_epoch_confusion
+    assert cm_dp is not None and int(cm_dp.sum()) == 297
+
+
+def test_fused_confusion_disabled_flag(monkeypatch):
+    """compute_confusion=False skips the fused cm publish (parity with
+    the graph evaluator's opt-out)."""
+    wf = _build_mlp(fused=True, max_epochs=1)
+    wf.evaluator.compute_confusion = False
+    wf.initialize()
+    assert wf.fused_tick is not None
+    wf.run()
+    assert wf.decision.last_epoch_confusion is None

@@ -162,8 +162,8 @@ class StandardWorkflow(Workflow):
         self.plotters.append(err)
         if confusion:
             # the decision accumulates the VALID confusion over each
-            # epoch in graph mode; under the fused tick it stays None
-            # and the plotter renders nothing
+            # epoch; both graph mode and the fused tick's eval passes
+            # publish the per-pass increments
             cm = MatrixPlotter(self, name="%s: confusion" % self.name)
             cm.link_attrs(self.decision, ("input", "last_epoch_confusion"))
             cm.link_attrs(self.loader, "reversed_labels_mapping")

@@ -81,8 +81,9 @@ class DecisionGD(Unit):
         self.epoch_samples[klass] += size
         self.epoch_loss[klass] = (self.epoch_loss[klass]
                                   + self.evaluator.loss.data * size)
-        # accumulate the VALID confusion matrix over the epoch (graph
-        # mode publishes it per minibatch; fused mode leaves it unset)
+        # accumulate the VALID confusion matrix over the epoch (the
+        # graph evaluator publishes per minibatch; the fused tick per
+        # eval pass — unset when compute_confusion is off)
         if klass == VALID:
             cm = getattr(self.evaluator, "confusion_matrix", None)
             cm_data = getattr(cm, "data", None)

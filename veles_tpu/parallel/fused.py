@@ -219,7 +219,8 @@ def _freeze(obj):
 _TICK_CACHE = {}
 
 
-def build_tick(specs, norm_type="none", mesh=None):
+def build_tick(specs, norm_type="none", mesh=None,
+               with_confusion=True):
     """Compile the fused engine.
 
     Returns ``(train_step, eval_step, train_sweep, eval_sweep)``:
@@ -242,7 +243,7 @@ def build_tick(specs, norm_type="none", mesh=None):
       class per epoch instead of one per minibatch;
     - ``eval_sweep(...)`` likewise without updates.
     """
-    key = (_freeze(specs), norm_type,
+    key = (_freeze(specs), norm_type, with_confusion,
            None if mesh is None else id(mesh))
     cached = _TICK_CACHE.get(key)
     if cached is not None:
@@ -273,7 +274,7 @@ def build_tick(specs, norm_type="none", mesh=None):
         logits = model_forward(wb, batch)
         _, loss_sum, n_err, _ = losses.masked_softmax_xent(
             logits, lab, mask, valid)
-        return loss_sum, n_err
+        return loss_sum, n_err, logits
 
     # cores return the UNNORMALIZED loss_sum; wrappers divide by the
     # relevant valid count (per minibatch or per sweep)
@@ -283,7 +284,7 @@ def build_tick(specs, norm_type="none", mesh=None):
         wb = [p["p"] if p else {} for p in params]
 
         def loss_fn(wb):
-            loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
+            loss_sum, n_err, _ = metrics_of(wb, batch, lab, mask, valid)
             return loss_sum / valid, (loss_sum, n_err)
 
         (_, (loss_sum, n_err)), grads = jax.value_and_grad(
@@ -314,14 +315,20 @@ def build_tick(specs, norm_type="none", mesh=None):
         return new, (loss_sum, n_err)
 
     def core_eval(params, norm, data, labels, indices, valid):
+        """Eval additionally emits the confusion-matrix increment (when
+        the evaluator asked for it), so the MatrixPlotter / Decision
+        accumulation work in fused mode too."""
         batch, lab = gather_norm(data, labels, indices, norm)
         mask = local_mask(indices.shape[0], valid)
         wb = [p["p"] if p else {} for p in params]
-        loss_sum, n_err = metrics_of(wb, batch, lab, mask, valid)
+        loss_sum, n_err, logits = metrics_of(wb, batch, lab, mask, valid)
+        cm = (losses.confusion_matrix(logits, lab, logits.shape[-1], mask)
+              if with_confusion else jnp.zeros((1, 1), jnp.int32))
         if data_ax > 1:
             loss_sum = lax.psum(loss_sum, "data")
             n_err = lax.psum(n_err, "data")
-        return loss_sum, n_err
+            cm = lax.psum(cm, "data")
+        return loss_sum, n_err, cm
 
     def local_train(params, hypers, norm, data, labels, indices, valid):
         new, (loss_sum, n_err) = core_train(params, hypers, norm, data,
@@ -329,9 +336,9 @@ def build_tick(specs, norm_type="none", mesh=None):
         return new, (loss_sum / valid, n_err)
 
     def local_eval(params, norm, data, labels, indices, valid):
-        loss_sum, n_err = core_eval(params, norm, data, labels, indices,
-                                    valid)
-        return loss_sum / valid, n_err
+        loss_sum, n_err, cm = core_eval(params, norm, data, labels,
+                                        indices, valid)
+        return loss_sum / valid, n_err, cm
 
     def local_train_sweep(params, hypers, norm, data, labels,
                           index_matrix, valid_sizes, total_valid):
@@ -354,9 +361,10 @@ def build_tick(specs, norm_type="none", mesh=None):
             return carry, core_eval(params, norm, data, labels, indices,
                                     valid.astype(jnp.float32))
 
-        _, (loss_sums, n_errs) = lax.scan(
+        _, (loss_sums, n_errs, cms) = lax.scan(
             body, 0, (index_matrix, valid_sizes))
-        return jnp.sum(loss_sums) / total_valid, jnp.sum(n_errs)
+        return (jnp.sum(loss_sums) / total_valid, jnp.sum(n_errs),
+                jnp.sum(cms, axis=0))
 
     if data_ax == 1:
         steps = (jax.jit(local_train, donate_argnums=(0,)),
@@ -372,13 +380,14 @@ def build_tick(specs, norm_type="none", mesh=None):
     train = jax.shard_map(local_train, mesh=mesh, in_specs=train_specs,
                           out_specs=(P(), (P(), P())), check_vma=False)
     evaluate = jax.shard_map(local_eval, mesh=mesh, in_specs=eval_specs,
-                             out_specs=(P(), P()), check_vma=False)
+                             out_specs=(P(), P(), P()),
+                             check_vma=False)
     train_sweep = jax.shard_map(
         local_train_sweep, mesh=mesh, in_specs=train_sweep_specs,
         out_specs=(P(), (P(), P())), check_vma=False)
     eval_sweep = jax.shard_map(
         local_eval_sweep, mesh=mesh, in_specs=eval_sweep_specs,
-        out_specs=(P(), P()), check_vma=False)
+        out_specs=(P(), P(), P()), check_vma=False)
     steps = (jax.jit(train, donate_argnums=(0,)), jax.jit(evaluate),
              jax.jit(train_sweep, donate_argnums=(0,)),
              jax.jit(eval_sweep))
@@ -462,8 +471,10 @@ class FusedTick(Unit):
         self._specs_ = extract_model_spec(wf)
         self._norm_ = {k: jnp.asarray(v) for k, v in
                        loader.normalizer.jit_state().items()}
-        self._steps_ = build_tick(self._specs_,
-                                  loader.normalization_type, self.mesh_)
+        self._steps_ = build_tick(
+            self._specs_, loader.normalization_type, self.mesh_,
+            with_confusion=getattr(wf.evaluator, "compute_confusion",
+                                   True))
 
     def run(self):
         import numpy
@@ -489,21 +500,24 @@ class FusedTick(Unit):
                     self._params_, get_hypers(wf), norm, data, labels,
                     indices, sizes, valid)
             else:
-                loss, n_err = eval_sweep(self._params_, norm, data,
-                                         labels, indices, sizes, valid)
+                loss, n_err, cm = eval_sweep(self._params_, norm, data,
+                                             labels, indices, sizes,
+                                             valid)
         elif training:
             self._params_, (loss, n_err) = train_step(
                 self._params_, get_hypers(wf), norm, data, labels,
                 indices, valid)
         else:
-            loss, n_err = eval_step(self._params_, norm, data, labels,
-                                    indices, valid)
+            loss, n_err, cm = eval_step(self._params_, norm, data,
+                                        labels, indices, valid)
         evaluator = wf.evaluator
-        # NOTE: the fused step publishes loss + n_err only; the confusion
-        # matrix (MatrixPlotter feed) is populated by the graph-mode
-        # evaluator — run with fused=False when you need it live
         evaluator.loss.data = loss
         evaluator.n_err.data = n_err
+        if not training and getattr(evaluator, "compute_confusion",
+                                    True):
+            # eval passes also emit the confusion increment, so the
+            # Decision accumulation + MatrixPlotter work in fused mode
+            evaluator.confusion_matrix.data = cm
         self.ticks += 1
         if loader.epoch_ended:
             set_params(wf, self._params_, self._specs_)
