@@ -227,6 +227,37 @@ def test_fused_transformer_matches_graph_mode():
                 atol=1e-2)
 
 
+def test_fused_snapshot_on_improved_holds_evaluated_weights(tmp_path):
+    """The deferred sweep materialization fires ``improved`` on the
+    epoch-end tick — the unit Arrays must still hold the weights the
+    validation metric was MEASURED on (eval-tick write-back), so the
+    snapshot re-evaluates to exactly the recorded best error."""
+    from veles_tpu.snapshotter import Snapshotter, SnapshotterToFile
+
+    wf = _build_mlp(fused=True, max_epochs=5)
+    snap = Snapshotter(wf, prefix="sem", directory=str(tmp_path),
+                       time_interval=0)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    wf.end_point.unlink_from(wf.decision)
+    wf.end_point.link_from(snap)
+    wf.initialize()
+    wf.run()
+    best = wf.decision.best_n_err[VALID]
+    restored = SnapshotterToFile.import_(snap.destination)
+    X, y = _digits_dataset()
+    w0, b0 = restored.forwards[0].weights.data, restored.forwards[0].bias.data
+    w1, b1 = restored.forwards[1].weights.data, restored.forwards[1].bias.data
+    Xv = jnp.asarray(X[:297])
+    dmin = Xv.min(axis=1, keepdims=True)
+    dmax = Xv.max(axis=1, keepdims=True)
+    Xn = (Xv - dmin) * (2.0 / (dmax - dmin)) - 1.0  # linear normalizer
+    h = 1.7159 * jnp.tanh(0.6666 * (Xn @ w0 + b0))  # Znicz scaled tanh
+    n_err = int((jnp.argmax(h @ w1 + b1, 1) != jnp.asarray(y[:297])).sum())
+    assert n_err == best, \
+        "snapshot re-evaluates to %d but recorded best is %d" % (n_err, best)
+
+
 def test_fused_eval_publishes_confusion():
     """Fused eval passes emit the confusion increment; the Decision
     accumulates the whole VALID sweep (MatrixPlotter feed parity with

@@ -171,12 +171,31 @@ class StandardWorkflow(Workflow):
             cm.link_from(self.decision)
             self.plotters.append(cm)
         if weights:
+            # at the epoch tick the unit Arrays hold the weights the
+            # epoch's metrics were MEASURED on (the eval-tick write-back
+            # in fused sweep mode) — so this histogram is consistent
+            # with the error/confusion plots of the same tick
             wh = MultiHistogram(self, name="%s: weights" % self.name)
             wh.link_attrs(self.forwards[0], ("input", "weights"))
             wh.gate_skip = ~self.decision.epoch_ended
             wh.link_from(self.decision)
             self.plotters.append(wh)
         return self.plotters
+
+    def on_workflow_finished(self):
+        # fused mode writes unit-Array weights back on EVAL ticks (the
+        # evaluated state, for snapshot-on-improved parity); the final
+        # post-train state lands here so exports/results see it
+        if self.fused_tick is not None:
+            try:
+                self.fused_tick.sync_params()
+            except Exception:
+                # also reached via on_error: a failed train step leaves
+                # _params_ pointing at donated (deleted) buffers — a
+                # raise here would swallow _sync_event_.set() and hang
+                # run() forever, masking the original failure
+                self.exception("final fused param sync failed")
+        super().on_workflow_finished()
 
     def _disable_fused(self):
         """Reverse the FusedTick splice (e.g. the loader's HBM-OOM host

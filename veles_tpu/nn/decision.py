@@ -53,6 +53,10 @@ class DecisionGD(Unit):
         self._epoch_confusion = None
         self._epochs_without_improvement = 0
         self._epochs_done = 0
+        # sweep serving: classes whose sweep finished but whose
+        # accumulators are still lazy device values (materialized in one
+        # batched transfer at the epoch boundary)
+        self._pending_classes = []
 
     def link_from_workflow(self, loader, evaluator):
         self.loader = loader
@@ -94,12 +98,38 @@ class DecisionGD(Unit):
                                          + cm_data)
         if not self.loader.epoch_ended_for_class:
             return
+        if getattr(self.loader, "sweep_serving", False):
+            # sweep mode: a host read here would block on the in-flight
+            # sweep once per class — a full device round trip each (the
+            # dominant per-epoch cost on a tunneled TPU). Defer ALL
+            # materialization to the epoch boundary and fetch every
+            # accumulator in ONE batched transfer instead.
+            self._pending_classes.append(klass)
+            if self.loader.epoch_ended:
+                self._materialize_epoch()
+            return
         # one sample-class sweep finished: sync its accumulators to host
         self.epoch_n_err[klass] = int(self.epoch_n_err[klass])
         self.epoch_loss[klass] = float(self.epoch_loss[klass])
         self._on_class_ended(klass)
         if self.loader.epoch_ended:
             self._on_epoch_ended()
+
+    def _materialize_epoch(self):
+        """One batched device->host transfer for the whole epoch's
+        accumulators (error counts, loss sums, confusion), then the
+        class summaries in serving order and the epoch summary."""
+        import jax
+        n_errs, losses, cm = jax.device_get(
+            (self.epoch_n_err, self.epoch_loss, self._epoch_confusion))
+        self.epoch_n_err = [int(v) for v in n_errs]
+        self.epoch_loss = [float(v) for v in losses]
+        if cm is not None:
+            self._epoch_confusion = cm
+        for klass in self._pending_classes:
+            self._on_class_ended(klass)
+        self._pending_classes = []
+        self._on_epoch_ended()
 
     # -- epoch boundary logic -------------------------------------------------
     def _class_summary(self, klass, n_err, samples, loss_sum, epoch):
@@ -201,6 +231,8 @@ class DecisionGD(Unit):
         super().init_unpickled()
         if not hasattr(self, "_epoch_buckets"):
             self._epoch_buckets = {}
+        if not hasattr(self, "_pending_classes"):
+            self._pending_classes = []
 
     def apply_data_from_slave(self, data, slave=None):
         klass = data["klass"]

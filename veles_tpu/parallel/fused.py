@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from veles_tpu.core.units import Unit
-from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.base import TRAIN, VALID
 from veles_tpu.ops import activations as act_lib, losses
 from veles_tpu.ops.gather import gather_minibatch
 from veles_tpu.ops.gemm import matmul
@@ -453,6 +453,7 @@ class FusedTick(Unit):
         self._steps_ = None
         self._norm_ = None
         self._specs_ = None
+        self._wrote_eval_params_ = False
 
     def initialize(self, **kwargs):
         wf = self.workflow
@@ -519,5 +520,32 @@ class FusedTick(Unit):
             # Decision accumulation + MatrixPlotter work in fused mode
             evaluator.confusion_matrix.data = cm
         self.ticks += 1
-        if loader.epoch_ended:
+        if not training and loader.epoch_ended_for_class:
+            # write the EVALUATED weights into the unit Arrays now —
+            # they stay untouched through the upcoming train sweep, so a
+            # Snapshotter firing on ``improved`` captures exactly the
+            # weights that scored the validation metric (the reference's
+            # snapshot-on-improved semantics; with the decision's
+            # deferred sweep materialization ``improved`` fires on the
+            # epoch-end tick, after this epoch's training)
             set_params(wf, self._params_, self._specs_)
+            self._wrote_eval_params_ = True
+        if loader.epoch_ended:
+            # the eval-tick write stands in for the epoch-end one ONLY
+            # when a VALID class exists — improvement then tracks the
+            # eval metric. Without VALID samples the Decision tracks
+            # THIS epoch's train error, so the Arrays must follow the
+            # post-train state (a TEST-only eval write would pin them
+            # one epoch behind the tracked metric)
+            eval_covers = (getattr(self, "_wrote_eval_params_", False)
+                           and loader.effective_class_lengths[VALID] > 0)
+            if training and not eval_covers:
+                set_params(wf, self._params_, self._specs_)
+            self._wrote_eval_params_ = False
+
+    def sync_params(self):
+        """Write the CURRENT (post-train) params into the unit Arrays —
+        called when the workflow finishes so exports, results and the
+        final snapshot see the last training state."""
+        if self._params_ is not None and self._specs_ is not None:
+            set_params(self.workflow, self._params_, self._specs_)
