@@ -1,0 +1,8 @@
+"""Config for samples/mnist_conv.py — executable Python mutating ``root``.
+
+Switch topologies from the CLI:  root.mnist_conv.topology=caffe
+"""
+
+root.mnist_conv.update({  # noqa: F821  (root is injected by the CLI)
+    "max_epochs": 50,
+})

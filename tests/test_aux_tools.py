@@ -207,3 +207,67 @@ def run(load, main):
         names = {entry["unit"] for entry in lines}
         assert any("Loader" in entry["type"] for entry in lines)
         assert len(names) >= 5
+
+
+class TestBBoxer:
+    """The bounding-box labeling tool (reference scripts/bboxer.py):
+    discovery, selection save/load, path containment."""
+
+    @pytest.fixture
+    def served(self, tmp_path):
+        import numpy
+        from PIL import Image
+        from veles_tpu.scripts.bboxer import serve
+
+        (tmp_path / "sub").mkdir()
+        for rel in ("a.png", "sub/b.png"):
+            arr = numpy.zeros((10, 10, 3), numpy.uint8)
+            Image.fromarray(arr).save(str(tmp_path / rel))
+        (tmp_path / "notes.txt").write_text("not an image")
+        server = serve(str(tmp_path), port=0, block=False)
+        yield "http://127.0.0.1:%d" % server.server_port, tmp_path
+        server.shutdown()
+
+    def test_list_save_roundtrip(self, served):
+        import json
+        import urllib.request
+
+        base, tree = served
+        with urllib.request.urlopen(base + "/list") as resp:
+            items = json.loads(resp.read())
+        assert [i["path"] for i in items] == ["a.png", "sub/b.png"]
+        assert not any(i["labeled"] for i in items)
+        boxes = [{"x": 1, "y": 2, "width": 3, "height": 4,
+                  "label": "cat"}]
+        req = urllib.request.Request(
+            base + "/selections",
+            data=json.dumps({"path": "sub/b.png",
+                             "bboxes": boxes}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert json.loads(resp.read())["saved"] == "sub/b.png"
+        sidecar = tree / "sub" / "b.png.json"
+        assert json.loads(sidecar.read_text())["bboxes"] == boxes
+        with urllib.request.urlopen(base + "/selections/sub/b.png") as r:
+            assert json.loads(r.read())["bboxes"] == boxes
+        with urllib.request.urlopen(base + "/list") as resp:
+            items = {i["path"]: i["labeled"]
+                     for i in json.loads(resp.read())}
+        assert items == {"a.png": False, "sub/b.png": True}
+
+    def test_path_containment(self, served):
+        import json
+        import urllib.error
+        import urllib.request
+
+        base, tree = served
+        (tree.parent / "outside.png").write_bytes(b"x")
+        req = urllib.request.Request(
+            base + "/selections",
+            data=json.dumps({"path": "../outside.png",
+                             "bboxes": []}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 404
+        assert not (tree.parent / "outside.png.json").exists()

@@ -111,6 +111,38 @@ def test_mnist_loader_and_training(tmp_path):
     assert wf.decision.best_n_err[VALID] is not None
 
 
+@pytest.mark.parametrize("topology", ["conv", "caffe"])
+def test_mnist_conv_sample_topologies(tmp_path, topology):
+    """The mnist_conv sample's topologies (reference mnist_conv /
+    mnist_caffe configs, anchors 0.73%/0.86%) train end-to-end over the
+    NHWC idx pipeline (flat=False)."""
+    import sys
+    sys.path.insert(0, "samples")
+    try:
+        from mnist_conv import TOPOLOGIES
+    finally:
+        sys.path.pop(0)
+    from veles_tpu.core import prng
+    from veles_tpu.models.standard import StandardWorkflow
+
+    data_dir = str(tmp_path / "mnist")
+    _fake_mnist(data_dir)
+    prng.get("default").seed(1)
+    prng.get("loader").seed(1)
+    wf = StandardWorkflow(
+        DummyLauncher(), layers=TOPOLOGIES[topology],
+        loader_cls=MNISTLoader,
+        loader_kwargs=dict(directory=data_dir, minibatch_size=20,
+                           normalization_type="linear", flat=False),
+        learning_rate=0.03, decision_kwargs=dict(max_epochs=1),
+        name="mnist-%s" % topology)
+    wf.initialize()
+    assert wf.loader.original_data.shape == (160, 28, 28, 1)
+    assert wf.fused_tick is not None, "conv chain must fuse"
+    wf.run()
+    assert wf.decision._epochs_done == 1
+
+
 def test_mnist_loader_missing_files(tmp_path):
     wf = DummyWorkflow()
     loader = MNISTLoader(wf, directory=str(tmp_path / "nope"))

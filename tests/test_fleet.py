@@ -3,6 +3,7 @@ reference's key distributed-test pattern, ``test_network.py:111-137`` /
 ``test_launcher.py:91-118``)."""
 
 import asyncio
+import os
 import threading
 
 import numpy
@@ -119,6 +120,109 @@ class TestProtocol:
 
     def test_machine_id_stable(self):
         assert machine_id() == machine_id()
+
+
+class TestSharedIO:
+    """Same-host shared-memory data plane (reference txzmq SharedIO)."""
+
+    def _read(self, frame):
+        from veles_tpu.fleet.protocol import read_frame
+        return asyncio.get_event_loop().run_until_complete(
+            read_frame(FakeReader(frame), KEY))
+
+    @staticmethod
+    def _segments():
+        from veles_tpu.fleet import sharedio
+        return {n for n in os.listdir(sharedio.shm_dir())
+                if n.startswith(sharedio._PREFIX)}
+
+    def test_shm_frame_roundtrip(self):
+        msg = {"type": "job", "job": numpy.arange(50000)}
+        before = self._segments()
+        frame = encode_frame(msg, KEY, shm_threshold=0)
+        # only the descriptor rode the wire
+        assert len(frame) < 1024
+        created = self._segments() - before
+        assert len(created) == 1, "no segment created"
+        out = self._read(frame)
+        numpy.testing.assert_array_equal(out["job"], numpy.arange(50000))
+        assert not created & self._segments(), "segment not unlinked"
+
+    def test_shm_tamper_rejected(self):
+        from veles_tpu.fleet import sharedio
+        from veles_tpu.fleet.protocol import ProtocolError
+        before = self._segments()
+        frame = encode_frame({"x": numpy.zeros(9000)}, KEY,
+                             shm_threshold=0)
+        name = (self._segments() - before).pop()
+        path = os.path.join(sharedio.shm_dir(), name)
+        with open(path, "r+b") as f:
+            f.write(b"\xff")
+        with pytest.raises(ProtocolError):
+            self._read(frame)
+        # left in place on failed verification
+        assert name in self._segments()
+        os.unlink(path)
+
+    def test_shm_path_containment(self):
+        """A descriptor must not be able to point outside the segment
+        namespace (authenticated-peer unlink/read primitive)."""
+        import pickle
+        from veles_tpu.fleet.protocol import ProtocolError
+        for name in ("../../etc/passwd", "/etc/passwd", "evil"):
+            bad = {"__shm__": {"name": name, "size": 1, "mac": "0"}}
+            frame = encode_frame(bad, KEY)
+            with pytest.raises(ProtocolError):
+                self._read(frame)
+
+    def test_negotiated_on_loopback_fleet(self):
+        """Same machine id -> the welcome negotiates shm; a big job
+        payload moves via a segment end-to-end."""
+        from veles_tpu.fleet import sharedio
+        from veles_tpu.fleet.server import Server
+
+        class BigJobWorkflow:
+            checksum = "shm-test"
+            applied = []
+
+            def generate_initial_data_for_slave(self, slave):
+                return None
+
+            def generate_data_for_slave(self, slave):
+                if self.applied:
+                    return None
+                return numpy.ones(200000, numpy.float32)  # 800KB
+
+            def apply_data_from_slave(self, update, slave):
+                self.applied.append(numpy.asarray(update).sum())
+
+            def apply_initial_data_from_master(self, initial):
+                pass
+
+            def do_job(self, job, callback):
+                callback(numpy.asarray(job) * 2)
+
+            def drop_slave(self, slave):
+                pass
+
+            def has_more_jobs(self):
+                return not self.applied
+
+        from veles_tpu.fleet.client import Client
+        wf = BigJobWorkflow()
+        server = Server("127.0.0.1:0", wf, secret="shm-test").start()
+        done = threading.Event()
+        server.on_finished = done.set
+        client = Client(server.address, BigJobWorkflow(),
+                        secret="shm-test").start()
+        try:
+            assert done.wait(timeout=20), "fleet job did not complete"
+            assert wf.applied and wf.applied[0] == 400000.0
+            slave = next(iter(server.slaves.values()), None)
+            assert slave is None or slave.shm_threshold is not None
+        finally:
+            client.stop()
+            server.stop()
 
 
 @pytest.mark.slow

@@ -177,6 +177,82 @@ class TestFileListImageLoader:
         assert m.get_label_from_filename(files[0]) == "red"
 
 
+class TestImageMSE:
+    def _tree(self, tmp_path, n=6, labeled=False):
+        rng = numpy.random.RandomState(5)
+        (tmp_path / "in").mkdir()
+        (tmp_path / "targets").mkdir()
+        for i in range(n):
+            color = tuple(int(c) for c in rng.randint(0, 255, 3))
+            write_png(str(tmp_path / "in" / ("s%02d.png" % i)), color)
+            write_png(str(tmp_path / "targets" / ("t%02d.png" % i)),
+                      tuple(255 - c for c in color))
+        return tmp_path
+
+    def test_unlabeled_pairs_by_sorted_order(self, tmp_path):
+        """i-th sample <-> i-th sorted target (reference image_mse.py
+        unlabeled contract); targets ride the device gather."""
+        from veles_tpu.loader.image import FileImageLoaderMSE
+
+        tree = self._tree(tmp_path)
+        wf = DummyWorkflow()
+        loader = FileImageLoaderMSE(
+            wf, train_paths=[str(tree / "in")],
+            target_paths=[str(tree / "targets")],
+            size=(12, 12), minibatch_size=3,
+            target_normalization_type="none")
+        loader.initialize()
+        assert loader.class_lengths == [0, 0, 6]
+        assert loader.original_targets.shape == (6, 12, 12, 3)
+        loader.run()
+        assert loader.minibatch_targets.shape == (3, 12, 12, 3)
+        # the served target rows match the stored per-sample targets
+        idx = numpy.asarray(loader.minibatch_indices.data)[:3]
+        numpy.testing.assert_allclose(
+            numpy.asarray(loader.minibatch_targets.data),
+            numpy.asarray(loader.original_targets.data)[idx])
+
+    def test_labeled_maps_by_label(self, tmp_path):
+        """Labeled datasets look targets up by label (target_label_map
+        role); duplicate target labels are rejected."""
+        from veles_tpu.loader.image import FileImageLoaderMSE
+
+        tree = self._tree(tmp_path, n=4)
+
+        class Labeled(FileImageLoaderMSE):
+            def get_label_from_filename(self, filename):
+                # s00/t00 -> 0 ... pairs by trailing number
+                return int(os.path.basename(filename)[1:3]) % 4
+
+        wf = DummyWorkflow()
+        loader = Labeled(
+            wf, train_paths=[str(tree / "in")],
+            target_paths=[str(tree / "targets")],
+            size=(8, 8), minibatch_size=2,
+            target_normalization_type="none")
+        loader.initialize()
+        assert loader.original_targets.shape == (4, 8, 8, 3)
+        # sample i carries label i -> target row must be target t0i
+        t2 = decode_image(str(tree / "targets" / "t02.png"))
+        t2 = scale_image(t2, (8, 8))
+        numpy.testing.assert_allclose(
+            numpy.asarray(loader.original_targets.data)[2], t2)
+
+    def test_count_mismatch_rejected(self, tmp_path):
+        from veles_tpu.loader.image import FileImageLoaderMSE
+
+        tree = self._tree(tmp_path)
+        os.unlink(str(tree / "targets" / "t05.png"))
+        wf = DummyWorkflow()
+        loader = FileImageLoaderMSE(
+            wf, train_paths=[str(tree / "in")],
+            target_paths=[str(tree / "targets")],
+            size=(12, 12), minibatch_size=3,
+            target_normalization_type="none")
+        with pytest.raises(ValueError):
+            loader.initialize()
+
+
 @pytest.mark.slow
 class TestConvnetEndToEnd:
     def test_convnet_trains_through_image_pipeline(self, image_tree):

@@ -165,6 +165,10 @@ class Client(Logger):
             return True
         self._handshaked_ = True
         self.sid = welcome["id"]
+        # master confirmed the same-host shared-memory data plane
+        from veles_tpu.fleet.protocol import COMPRESS_THRESHOLD
+        self._shm_thr_ = (COMPRESS_THRESHOLD if welcome.get("shm")
+                          else None)
         initial = welcome.get("initial")
         if initial:
             self.workflow.apply_initial_data_from_master(initial)
@@ -186,14 +190,17 @@ class Client(Logger):
                         and random.random() < self.death_probability:
                     self.warning("fault injection: dying mid-job")
                     os._exit(1)
+                shm_thr = getattr(self, "_shm_thr_", None)
                 if self.async_mode:
                     # pipelined: next request goes out with the update
                     await write_frame(writer, {"type": "update",
-                                               "update": update}, self._secret)
+                                               "update": update},
+                                      self._secret, shm_threshold=shm_thr)
                     await write_frame(writer, {"type": "job_request"}, self._secret)
                 else:
                     await write_frame(writer, {"type": "update",
-                                               "update": update}, self._secret)
+                                               "update": update},
+                                      self._secret, shm_threshold=shm_thr)
             elif mtype == "update_ack":
                 if not self.async_mode:
                     await write_frame(writer, {"type": "job_request"}, self._secret)

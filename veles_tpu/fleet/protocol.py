@@ -70,8 +70,17 @@ def _mac(key, codec, payload):
                         hashlib.sha256).digest()
 
 
-def encode_frame(message, key):
+def encode_frame(message, key, shm_threshold=None):
+    """``shm_threshold``: when set (same-host connections, negotiated at
+    handshake by machine id — reference ``server.py:721-732``), payloads
+    at least that large move through a shared-memory segment
+    (``fleet/sharedio.py``) and only a descriptor frame hits the wire."""
     payload = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+    if shm_threshold is not None and len(payload) >= shm_threshold:
+        from veles_tpu.fleet import sharedio
+        desc = sharedio.put(payload, key)
+        payload = pickle.dumps({"__shm__": desc},
+                               protocol=pickle.HIGHEST_PROTOCOL)
     codec = 0
     if len(payload) >= COMPRESS_THRESHOLD:
         compressed = gzip.compress(payload, compresslevel=1)
@@ -103,11 +112,19 @@ async def read_frame(reader, key, max_frame=MAX_FRAME):
         raise ProtocolError("frame failed HMAC authentication")
     if codec == 1:
         payload = gzip.decompress(payload)
-    return pickle.loads(payload)
+    message = pickle.loads(payload)
+    if isinstance(message, dict) and "__shm__" in message:
+        from veles_tpu.fleet import sharedio
+        try:
+            payload = sharedio.get(message["__shm__"], key)
+        except (OSError, ValueError) as exc:
+            raise ProtocolError("bad shared-memory frame: %s" % exc)
+        message = pickle.loads(payload)
+    return message
 
 
-async def write_frame(writer, message, key):
-    writer.write(encode_frame(message, key))
+async def write_frame(writer, message, key, shm_threshold=None):
+    writer.write(encode_frame(message, key, shm_threshold))
     await writer.drain()
 
 

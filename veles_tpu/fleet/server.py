@@ -21,9 +21,11 @@ import asyncio
 import threading
 import time
 
+from veles_tpu.core.config import root
 from veles_tpu.core.logger import Logger
 from veles_tpu.fleet.protocol import (
-    ProtocolError, read_frame, resolve_secret, write_frame)
+    COMPRESS_THRESHOLD, ProtocolError, machine_id, read_frame,
+    resolve_secret, write_frame)
 
 
 class SlaveDescription:
@@ -127,6 +129,11 @@ class Server(Logger):
                                         name="fleet-server")
         self._thread.start()
         ready.wait()
+        # GC shm segments orphaned by crashed receivers of PREVIOUS runs
+        from veles_tpu.fleet import sharedio
+        stale = sharedio.cleanup_stale()
+        if stale:
+            self.info("removed %d stale shared-memory segments", stale)
         self.info("master listening on %s:%d", self.host, self.port)
         return self
 
@@ -199,12 +206,19 @@ class Server(Logger):
             slave.respawn_recipe = hello.get("respawn")
             peer = writer.get_extra_info("peername")
             slave.peer_host = peer[0] if peer else "127.0.0.1"
+            # same-host fast path (reference SharedIO, server.py:721-732):
+            # matching machine ids move big payloads via /dev/shm segments
+            shm_ok = (slave.mid != "?" and slave.mid == machine_id()
+                      and root.common.fleet.get("shm", True))
+            slave.shm_threshold = COMPRESS_THRESHOLD if shm_ok else None
             self.slaves[sid] = slave
             self._writers[sid] = writer
             initial = await self._in_thread(
                 self.workflow.generate_initial_data_for_slave, slave)
             await write_frame(writer, {"type": "welcome", "id": sid,
-                                       "initial": initial}, self._secret)
+                                       "shm": shm_ok,
+                                       "initial": initial}, self._secret,
+                              shm_threshold=slave.shm_threshold)
             self.info("slave %s connected (mid=%s power=%.1f)", sid,
                       slave.mid, slave.power)
             while not self._stopped.is_set():
@@ -253,7 +267,9 @@ class Server(Logger):
             return
         slave.state = "WORK"
         slave.job_started = time.time()
-        await write_frame(writer, {"type": "job", "job": job}, self._secret)
+        await write_frame(writer, {"type": "job", "job": job}, self._secret,
+                          shm_threshold=getattr(slave, "shm_threshold",
+                                                None))
         self._watch_hang(slave)
 
     async def _apply_update(self, slave, writer, msg):

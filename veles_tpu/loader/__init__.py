@@ -7,5 +7,5 @@ from veles_tpu.loader.fullbatch import (  # noqa: F401
 from veles_tpu.loader.normalization import (  # noqa: F401
     make_normalizer, normalizer_registry)
 from veles_tpu.loader.image import (  # noqa: F401
-    AutoLabelFileImageLoader, FileImageLoader, FileListImageLoader,
-    FullBatchImageLoader)
+    AutoLabelFileImageLoader, FileImageLoader, FileImageLoaderMSE,
+    FileListImageLoader, FullBatchImageLoader, FullBatchImageLoaderMSE)

@@ -78,7 +78,11 @@ class FileScannerMixin:
     def scan_files(self, pathname):
         self.info("scanning %s...", pathname)
         files = []
-        for basedir, _, filelist in os.walk(pathname):
+        for basedir, dirs, filelist in os.walk(pathname):
+            # deterministic traversal: os.walk's directory order is
+            # filesystem-dependent; reproducible sample order (and MSE
+            # sample<->target pairing) needs a stable scan
+            dirs.sort()
             for name in sorted(filelist):
                 full_name = os.path.join(basedir, name)
                 if self.is_valid_filename(full_name):

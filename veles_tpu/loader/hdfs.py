@@ -1,0 +1,84 @@
+"""HDFS text streaming loader (reference ``loader/hdfs_loader.py:48-77``).
+
+The reference used the snakebite native-protocol client; that requires a
+protobuf RPC stack. The TPU rebuild speaks **WebHDFS** — the REST API
+every Hadoop namenode serves — via stdlib ``urllib`` only, so the loader
+works in any environment without extra dependencies.
+
+Contract (matching the reference unit exactly):
+
+- ``HDFSTextLoader(wf, file="/path", address="namenode:50070",
+  chunk=1000)`` streams the file as text lines;
+- each ``run()`` fills ``output`` (a list of ``chunk`` lines) with the
+  next chunk and raises the ``finished`` Bool at EOF;
+- ``initialize()`` stats the file (existence/permission check up front).
+
+The namenode may redirect OPEN to a datanode (standard WebHDFS flow);
+``urllib`` follows it automatically.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+from veles_tpu.core.distributable import TriviallyDistributable
+from veles_tpu.core.mutable import Bool
+from veles_tpu.core.units import Unit
+
+
+class HDFSTextLoader(Unit, TriviallyDistributable):
+    """Streams a text file from HDFS in fixed-size line chunks."""
+
+    def __init__(self, workflow, **kwargs):
+        self.file_name = kwargs.pop("file")
+        self.chunk_lines_number = kwargs.pop("chunk", 1000)
+        address = kwargs.pop("address", "localhost:9870")
+        self.user = kwargs.pop("user", None)
+        self.encoding = kwargs.pop("encoding", "utf-8")
+        super().__init__(workflow, **kwargs)
+        self.base_url = ("http://%s/webhdfs/v1" % address
+                         if "://" not in address
+                         else address.rstrip("/") + "/webhdfs/v1")
+        self.output = [""] * self.chunk_lines_number
+        self.finished = Bool(False)
+
+    def _url(self, op):
+        query = {"op": op}
+        if self.user:
+            query["user.name"] = self.user
+        return "%s%s?%s" % (self.base_url,
+                            urllib.parse.quote(self.file_name),
+                            urllib.parse.urlencode(query))
+
+    def stat(self):
+        """GETFILESTATUS — size/type/permission metadata."""
+        with urllib.request.urlopen(self._url("GETFILESTATUS")) as resp:
+            return json.loads(resp.read().decode("utf-8"))["FileStatus"]
+
+    def initialize(self, **kwargs):
+        status = self.stat()
+        self.debug("opened %s (%d bytes)", self.file_name,
+                   status.get("length", -1))
+        self._response_ = urllib.request.urlopen(self._url("OPEN"))
+        self._generator_ = (line.rstrip("\n") for line in
+                            (raw.decode(self.encoding)
+                             for raw in self._response_))
+
+    def init_unpickled(self):
+        super().init_unpickled()
+        self._response_ = None
+        self._generator_ = None
+
+    def run(self):
+        assert not self.finished
+        filled = 0
+        try:
+            for i in range(self.chunk_lines_number):
+                self.output[i] = next(self._generator_)
+                filled += 1
+        except StopIteration:
+            # truncate to the valid lines: the stale tail of the previous
+            # chunk must not be served as data (consumers iterate output)
+            del self.output[filled:]
+            self.finished.set()
+            self._response_.close()

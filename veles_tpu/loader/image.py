@@ -34,7 +34,7 @@ from veles_tpu.loader.base import TEST, VALID, TRAIN, register_loader
 from veles_tpu.loader.file_loader import (AutoLabelMixin, FileFilter,
                                           FileListScannerMixin,
                                           FileScannerMixin)
-from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 from veles_tpu.core import prng
 
 #: PIL modes for the supported color spaces.
@@ -185,7 +185,9 @@ class FullBatchImageLoader(FullBatchLoader):
         return arr
 
     def load_data(self):
-        keys = [self.get_keys(klass) for klass in (TEST, VALID, TRAIN)]
+        keys = getattr(self, "_prescanned_keys_", None) \
+            or [self.get_keys(klass) for klass in (TEST, VALID, TRAIN)]
+        self._prescanned_keys_ = None
         self.class_keys = keys
         total = sum(len(k) for k in keys)
         if not total:
@@ -235,6 +237,71 @@ class FullBatchImageLoader(FullBatchLoader):
                 self.minibatch_data.data, seed)
 
 
+class ImageLoaderMSEMixin:
+    """Target-IMAGE regression tier (reference ``loader/image_mse.py:47-158``
+    ImageLoaderMSEMixin): each sample's MSE target is itself an image.
+
+    Target matching follows the reference contract:
+
+    - labeled datasets: every target key carries a unique label
+      (``get_image_label``); a sample's target is the target image with
+      the SAME label (reference ``target_label_map``);
+    - unlabeled datasets: the i-th sample (over TEST+VALID+TRAIN, serving
+      order) maps to the i-th sorted target key — counts must match.
+
+    Targets are decoded through the same scale/crop pipeline as the
+    samples, so ``targets_shape`` equals the sample shape. Design note:
+    the reference gathered target rows per minibatch on the host; here
+    the per-sample target matrix is materialized once and rides the
+    device-resident full-batch gather (labels sharing a target duplicate
+    its rows — the HBM cost of a zero-host-work training loop).
+
+    Host classes provide :meth:`get_target_keys` and the usual image
+    source contract.
+    """
+
+    def get_target_keys(self):
+        raise NotImplementedError
+
+    def load_data(self):
+        tkeys = sorted(self.get_target_keys())
+        if len(set(tkeys)) < len(tkeys):
+            raise ValueError("%s: duplicate target keys" % self.name)
+        if not tkeys:
+            raise ValueError("%s: no target images found" % self.name)
+        targets = numpy.stack([self._load_one(k) for k in tkeys])
+        tlabels = [self.get_image_label(k) for k in tkeys]
+        has_tlabels = any(l is not None for l in tlabels)
+        # scan ONCE and stash: FullBatchImageLoader.load_data reuses this
+        # list, so the target rows stay aligned with the exact sample
+        # serving order (a second walk could see filesystem changes)
+        self._prescanned_keys_ = [self.get_keys(klass)
+                                  for klass in (TEST, VALID, TRAIN)]
+        sample_keys = [k for klass_keys in self._prescanned_keys_
+                       for k in klass_keys]
+        sample_labels = [self.get_image_label(k) for k in sample_keys]
+        if any(l is not None for l in sample_labels) and has_tlabels:
+            if len(set(tlabels)) < len(tlabels):
+                raise ValueError("%s: targets have duplicate labels"
+                                 % self.name)
+            label_row = {l: i for i, l in enumerate(tlabels)}
+            try:
+                rows = [label_row[l] for l in sample_labels]
+            except KeyError as e:
+                raise ValueError("%s: no target image labeled %r"
+                                 % (self.name, e.args[0])) from None
+        else:
+            if len(tkeys) != len(sample_keys):
+                raise ValueError(
+                    "%s: unlabeled MSE needs one target per sample "
+                    "(%d targets, %d samples)"
+                    % (self.name, len(tkeys), len(sample_keys)))
+            rows = list(range(len(sample_keys)))
+        self._provided_targets = targets[rows]
+        self.targets_shape = targets.shape[1:]
+        super().load_data()
+
+
 @register_loader("file_image")
 class FileImageLoader(FileFilter, FileScannerMixin, FullBatchImageLoader):
     """Images from recursive directory scans with MIME filtering
@@ -276,6 +343,53 @@ class AutoLabelFileImageLoader(AutoLabelMixin, FileImageLoader):
             self, **{k: kwargs.pop(k) for k in ("label_regexp",)
                      if k in kwargs})
         FileImageLoader.__init__(self, workflow, **kwargs)
+
+
+class FullBatchImageLoaderMSE(ImageLoaderMSEMixin, FullBatchImageLoader,
+                              FullBatchLoaderMSE):
+    """Device-resident image dataset with image targets (reference
+    ``ImageLoaderMSE``, ``image_mse.py:119-124``). Subclasses provide the
+    image source contract plus :meth:`get_target_keys`."""
+
+    hide_from_registry = True
+
+
+@register_loader("file_image_mse")
+class FileImageLoaderMSE(FileFilter, FileScannerMixin,
+                         FullBatchImageLoaderMSE):
+    """Directory-scanned images with directory-scanned image targets
+    (reference ``FileImageLoaderMSE``, ``image_mse.py:126-158``):
+    ``target_paths`` roots are scanned with the same MIME filter."""
+
+    def __init__(self, workflow, **kwargs):
+        self.target_paths = kwargs.pop("target_paths")
+        FileScannerMixin._check_paths(self.target_paths)
+        kwargs.setdefault("file_type", "image")
+        kwargs.setdefault("file_subtypes", ["png", "jpeg", "bmp"])
+        FileFilter.__init__(
+            self, **{k: kwargs.pop(k) for k in
+                     ("ignored_files", "included_files", "file_type",
+                      "file_subtypes") if k in kwargs})
+        FileScannerMixin.__init__(
+            self, **{k: kwargs.pop(k) for k in
+                     ("test_paths", "validation_paths", "train_paths")
+                     if k in kwargs})
+        FullBatchImageLoaderMSE.__init__(self, workflow, **kwargs)
+
+    def get_keys(self, klass):
+        paths = (self.test_paths, self.validation_paths,
+                 self.train_paths)[klass]
+        return self.collect_keys(paths)
+
+    def get_target_keys(self):
+        return self.collect_keys(self.target_paths)
+
+    def get_image_label(self, key):
+        try:
+            return self.get_label_from_filename(key)
+        except NotImplementedError:
+            # autoencoder-style unlabeled MSE: i-th sample <-> i-th target
+            return None
 
 
 @register_loader("file_list_image")

@@ -49,12 +49,17 @@ def read_idx(path):
 class MNISTLoader(FullBatchLoader):
     """MNIST via idx files (the MNIST784 data pipeline)."""
 
-    def __init__(self, workflow, directory=None, url_base=None, **kwargs):
+    def __init__(self, workflow, directory=None, url_base=None, flat=True,
+                 **kwargs):
         kwargs.setdefault("normalization_type", "linear")
         super().__init__(workflow, **kwargs)
         self.directory = directory or os.path.join(
             root.common.dirs.get("datasets"), "mnist")
         self.url_base = url_base
+        #: flat=True serves (N, 784) rows (the MNIST784 MLP form);
+        #: flat=False serves (N, 28, 28, 1) NHWC for conv topologies
+        #: (the reference's mnist_conv/mnist_caffe configs)
+        self.flat = flat
 
     def _resolve(self, stem):
         for name in (stem, stem + ".gz"):
@@ -79,9 +84,10 @@ class MNISTLoader(FullBatchLoader):
         test_x = read_idx(self._resolve(FILES["test_images"]))
         test_y = read_idx(self._resolve(FILES["test_labels"]))
         n_valid, n_train = len(test_x), len(train_x)
+        shape = (-1,) if self.flat else (28, 28, 1)
         data = numpy.concatenate([
-            test_x.reshape(n_valid, -1).astype(numpy.float32),
-            train_x.reshape(n_train, -1).astype(numpy.float32)])
+            test_x.reshape((n_valid,) + shape).astype(numpy.float32),
+            train_x.reshape((n_train,) + shape).astype(numpy.float32)])
         labels = numpy.concatenate([
             test_y.astype(numpy.int32), train_y.astype(numpy.int32)])
         self._provided_data = data

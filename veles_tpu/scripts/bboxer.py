@@ -1,0 +1,203 @@
+"""bboxer: collaborative bounding-box image labeling
+(reference ``veles/scripts/bboxer.py`` — Tornado + pyinotify there;
+stdlib ``http.server`` here, same artifact format).
+
+Serves a canvas annotator over a directory tree of images; selections
+are saved next to each image as ``<image>.json``:
+
+    {"bboxes": [{"x": .., "y": .., "width": .., "height": ..,
+                 "label": ".."}, ...]}
+
+— the side-car files the file/image loaders can consume as labels.
+
+Run:  python -m veles_tpu.scripts.bboxer <image-root> [--port N]
+"""
+
+import argparse
+import json
+import mimetypes
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+IMAGE_EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"}
+
+PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu bboxer</title><style>
+body { font-family: sans-serif; margin: 1em; background: #222; color: #eee }
+#images a { display: block; color: #8cf }
+#work { position: relative; display: inline-block }
+#boxes div { position: absolute; border: 2px solid #f33;
+             color: #ff0; font-size: 11px }
+input, button { margin: 0.3em }
+</style></head><body>
+<h2>bboxer</h2>
+<div id="images"></div>
+<div>
+  <label>label: <input id="label" value="object"></label>
+  <button onclick="save()">save</button>
+  <button onclick="clearBoxes()">clear</button>
+  <span id="status"></span>
+</div>
+<div id="work"><img id="img" draggable="false"><div id="boxes"></div></div>
+<script>
+let current = null, boxes = [], drag = null;
+const img = document.getElementById('img');
+fetch('list').then(r => r.json()).then(items => {
+  const c = document.getElementById('images');
+  items.forEach(it => {
+    const a = document.createElement('a');
+    a.textContent = (it.labeled ? '[x] ' : '[ ] ') + it.path;
+    a.href = '#'; a.onclick = () => { load(it.path); return false; };
+    c.appendChild(a);
+  });
+});
+function load(path) {
+  current = path; img.src = 'image/' + path;
+  fetch('selections/' + path).then(r => r.json())
+    .then(d => { boxes = d.bboxes || []; render(); });
+}
+function render() {
+  const c = document.getElementById('boxes'); c.innerHTML = '';
+  boxes.forEach(b => {
+    const d = document.createElement('div');
+    d.style.left = b.x + 'px'; d.style.top = b.y + 'px';
+    d.style.width = b.width + 'px'; d.style.height = b.height + 'px';
+    d.textContent = b.label; c.appendChild(d);
+  });
+}
+img.onmousedown = e => {
+  const r = img.getBoundingClientRect();
+  drag = {x: e.clientX - r.left, y: e.clientY - r.top};
+};
+img.onmouseup = e => {
+  if (!drag) return;
+  const r = img.getBoundingClientRect();
+  const x2 = e.clientX - r.left, y2 = e.clientY - r.top;
+  boxes.push({x: Math.min(drag.x, x2), y: Math.min(drag.y, y2),
+              width: Math.abs(x2 - drag.x), height: Math.abs(y2 - drag.y),
+              label: document.getElementById('label').value});
+  drag = null; render();
+};
+function clearBoxes() { boxes = []; render(); }
+function save() {
+  fetch('selections', {method: 'POST',
+    body: JSON.stringify({path: current, bboxes: boxes})})
+    .then(r => document.getElementById('status').textContent =
+          r.ok ? 'saved' : 'error');
+}
+</script></body></html>"""
+
+
+def discover_images(rootdir):
+    """All images under the root, as /-separated relative paths."""
+    found = []
+    for base, _, files in os.walk(rootdir):
+        for name in sorted(files):
+            if os.path.splitext(name)[1].lower() in IMAGE_EXTS:
+                rel = os.path.relpath(os.path.join(base, name), rootdir)
+                found.append(rel.replace(os.sep, "/"))
+    return found
+
+
+class BBoxerHandler(BaseHTTPRequestHandler):
+    rootdir = "."
+
+    def _resolve(self, rel):
+        """Contain every path under the image root."""
+        path = os.path.realpath(os.path.join(self.rootdir, rel))
+        if not path.startswith(os.path.realpath(self.rootdir) + os.sep):
+            return None
+        return path
+
+    def _send(self, body, ctype="application/json", code=200):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        elif isinstance(body, str):
+            body = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        import urllib.parse
+        path = urllib.parse.unquote(self.path.lstrip("/"))
+        if path in ("", "index.html"):
+            return self._send(PAGE, "text/html")
+        if path == "list":
+            items = []
+            for p in discover_images(self.rootdir):
+                full = self._resolve(p)
+                if full is None:  # e.g. a symlink escaping the root
+                    continue
+                items.append({"path": p,
+                              "labeled": os.path.exists(full + ".json")})
+            return self._send(items)
+        if path.startswith("image/"):
+            full = self._resolve(path[len("image/"):])
+            if full is None or not os.path.isfile(full):
+                return self._send({"error": "not found"}, code=404)
+            with open(full, "rb") as fin:
+                body = fin.read()
+            ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+            return self._send(body, ctype)
+        if path.startswith("selections/"):
+            full = self._resolve(path[len("selections/"):])
+            if full is None:
+                return self._send({"error": "bad path"}, code=400)
+            if not os.path.exists(full + ".json"):
+                return self._send({"bboxes": []})
+            with open(full + ".json") as fin:
+                return self._send(fin.read())
+        return self._send({"error": "not found"}, code=404)
+
+    def do_POST(self):
+        import urllib.parse
+        if urllib.parse.unquote(self.path.lstrip("/")) != "selections":
+            return self._send({"error": "not found"}, code=404)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            rel = payload["path"]
+            bboxes = payload["bboxes"]
+            if not isinstance(bboxes, list):
+                raise ValueError("bboxes must be a list")
+        except (ValueError, KeyError, json.JSONDecodeError) as exc:
+            return self._send({"error": str(exc)}, code=400)
+        full = self._resolve(rel)
+        if full is None or not os.path.isfile(full):
+            return self._send({"error": "no such image"}, code=404)
+        with open(full + ".json", "w") as out:
+            json.dump({"bboxes": bboxes}, out, indent=1)
+        return self._send({"saved": rel})
+
+    def log_message(self, *args):
+        pass
+
+
+def serve(rootdir, port=8193, block=True):
+    handler = type("Handler", (BBoxerHandler,),
+                   {"rootdir": os.path.abspath(rootdir)})
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    if block:
+        print("bboxer on http://127.0.0.1:%d over %s"
+              % (server.server_port, rootdir))
+        server.serve_forever()
+        return server
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("root", help="image directory to label")
+    parser.add_argument("--port", type=int, default=8193)
+    args = parser.parse_args(argv)
+    serve(args.root, args.port)
+
+
+if __name__ == "__main__":
+    main()
