@@ -86,3 +86,90 @@ class TestPublisher:
                         directory=str(tmp_path))
         pub.run()
         assert pub.published
+
+    def test_confluence_backend_uploads(self, trained_wf, tmp_path):
+        """ConfluenceBackend stores the page over XML-RPC (reference
+        confluence_backend.py role), with unique-title suffixing."""
+        import threading
+        from xmlrpc.server import SimpleXMLRPCServer
+
+        store = {"pages": {"exp": {"id": "1", "version": 2,
+                                   "content": "old"}},
+                 "calls": []}
+
+        class Confluence2:
+            def login(self, user, password):
+                store["calls"].append(("login", user))
+                assert password == "hunter2"
+                return "tok"
+
+            def getPage(self, token, space, title):
+                assert token == "tok" and space == "TPU"
+                page = store["pages"].get(title)
+                if page is None:
+                    import xmlrpc.client
+                    raise xmlrpc.client.Fault(500, "no such page")
+                return dict(page, title=title)
+
+            def storePage(self, token, page):
+                store["pages"][page["title"]] = dict(page)
+                store["calls"].append(("store", page["title"]))
+                return dict(page, url="http://wiki/x/%s" % page["title"])
+
+            def logout(self, token):
+                store["calls"].append(("logout",))
+                return True
+
+        class Root:
+            confluence2 = Confluence2()
+
+        from xmlrpc.server import SimpleXMLRPCRequestHandler
+
+        class Handler(SimpleXMLRPCRequestHandler):
+            rpc_paths = ("/rpc/xmlrpc",)  # the Confluence endpoint path
+
+        server = SimpleXMLRPCServer(("127.0.0.1", 0), logRequests=False,
+                                    allow_none=True,
+                                    requestHandler=Handler)
+        server.register_instance(Root(), allow_dotted_names=True)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            url = "http://127.0.0.1:%d" % server.server_address[1]
+            trained_wf.name = "exp"  # collides -> suffixed title
+            pub = Publisher(
+                trained_wf,
+                backends=[("confluence",
+                           dict(server=url, username="bob",
+                                password="hunter2", space="TPU"))],
+                directory=str(tmp_path))
+            pub.publish()
+            assert ("store", "exp (1)") in store["calls"]
+            assert ("logout",) in store["calls"]
+            assert "<h1>exp</h1>" in store["pages"]["exp (1)"]["content"]
+            # the local artifact copy matches the uploaded body
+            artifact = open(pub.published["confluence"]).read()
+            assert artifact == store["pages"]["exp (1)"]["content"]
+        finally:
+            server.shutdown()
+
+    def test_pdf_and_ipynb_backends(self, trained_wf, tmp_path):
+        """The PDF writer emits a loadable PDF; the ipynb backend a valid
+        notebook (reference pdf/ipynb backend roles)."""
+        import json as json_lib
+
+        pub = Publisher(trained_wf, backends=("pdf", "ipynb"),
+                        directory=str(tmp_path))
+        pub.publish()
+        pdf = open(pub.published["pdf"], "rb").read()
+        assert pdf.startswith(b"%PDF-1.4")
+        assert b"%%EOF" in pdf and b"/Courier" in pdf
+        # xref offsets must point at actual object headers
+        xref_at = int(pdf.rsplit(b"startxref", 1)[1].split()[0])
+        assert pdf[xref_at:xref_at + 4] == b"xref"
+        first_obj = int(pdf[xref_at:].split(b"\n")[3].split()[0])
+        assert pdf[first_obj:first_obj + 7] == b"1 0 obj"
+        nb = json_lib.load(open(pub.published["ipynb"]))
+        assert nb["nbformat"] == 4
+        assert any("Results" in "".join(c["source"])
+                   for c in nb["cells"])

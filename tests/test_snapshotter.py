@@ -102,3 +102,37 @@ def test_skip_bool(tmp_path):
     snap.skip.set()
     snap.run()
     assert snap.destination is None
+
+
+def test_snapshotter_to_db_roundtrip(tmp_path):
+    """DB-backed snapshot store (reference SnapshotterToDB role over
+    sqlite3): export rows, import newest by prefix, exact by suffix."""
+    from veles_tpu.core import prng
+    from veles_tpu.snapshotter import SnapshotterToDB
+
+    db = str(tmp_path / "snaps.sqlite3")
+    prng.get("default").seed(7)
+    prng.get("loader").seed(7)
+    wf = make_wf(max_epochs=1)
+    snap = Snapshotter(wf, database=db, prefix="dbtest",
+                       interval=1, time_interval=0)
+    assert isinstance(snap, SnapshotterToDB)
+    snap.link_from(wf.decision)
+    wf.end_point.unlink_from(wf.decision)
+    wf.end_point.link_from(snap)
+    wf.initialize()
+    wf.run()
+    assert snap.destination.startswith("sqlite://")
+    restored = SnapshotterToDB.import_(snap.destination)
+    assert numpy.asarray(restored.forwards[0].weights.data).shape \
+        == numpy.asarray(wf.forwards[0].weights.data).shape
+    assert restored.decision._epochs_done == wf.decision._epochs_done
+    assert restored._restored_from_snapshot_
+    # exact-suffix addressing
+    suffix = snap.suffix or "current"
+    again = SnapshotterToDB.import_(
+        "sqlite://%s#dbtest/%s" % (db, suffix))
+    assert again.decision._epochs_done == restored.decision._epochs_done
+    # missing prefix -> clear error
+    with pytest.raises(FileNotFoundError):
+        SnapshotterToDB.import_("sqlite://%s#nope" % db)

@@ -210,7 +210,12 @@ class Main(Logger):
         snapshot_loaded = False
         if self.snapshot_path:
             self.info("resuming from %s", self.snapshot_path)
-            self.workflow = SnapshotterToFile.import_(self.snapshot_path)
+            if self.snapshot_path.startswith("sqlite://"):
+                from veles_tpu.snapshotter import SnapshotterToDB
+                self.workflow = SnapshotterToDB.import_(self.snapshot_path)
+            else:
+                self.workflow = SnapshotterToFile.import_(
+                    self.snapshot_path)
             self.workflow.workflow = self.launcher
             snapshot_loaded = True
         else:

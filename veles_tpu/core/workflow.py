@@ -51,6 +51,9 @@ class Workflow(Container):
         self._sync_event_ = threading.Event()
         self._job_callback_ = None
         self._restored_from_snapshot_ = False
+        # a mid-run snapshot pickles a live _run_start; that stamp is
+        # another process's perf_counter epoch — meaningless after resume
+        self._run_start = None
 
     def __getstate__(self):
         state = super().__getstate__()

@@ -182,6 +182,18 @@ class StandardWorkflow(Workflow):
             self.plotters.append(wh)
         return self.plotters
 
+    def run(self):
+        if bool(self.decision.complete):
+            # e.g. a FINISHED snapshot was restored: the loader gate is
+            # blocked, so firing the start point would hang forever —
+            # finish cleanly instead (raise decision.max_epochs and
+            # unset decision.complete to continue training)
+            self.warning("workflow is already complete; nothing to run")
+            self._finished = False
+            self.on_workflow_finished()
+            return self
+        return super().run()
+
     def on_workflow_finished(self):
         # fused mode writes unit-Array weights back on EVAL ticks (the
         # evaluated state, for snapshot-on-improved parity); the final

@@ -32,10 +32,12 @@ def register_backend(cls):
 
 
 class Backend:
-    """One output format; ``render(bundle) -> text``."""
+    """One output format; ``render(bundle) -> text`` (or bytes when
+    ``BINARY`` — the Publisher then writes the file in binary mode)."""
 
     MAPPING = None
     EXTENSION = "txt"
+    BINARY = False
 
     def __init__(self, **kwargs):
         self.options = kwargs
@@ -123,6 +125,170 @@ class JSONBackend(Backend):
         return json.dumps(payload, indent=1, default=str)
 
 
+@register_backend
+class ConfluenceBackend(HTMLBackend):
+    """Publish the report to a Confluence wiki over XML-RPC (reference
+    ``publishing/confluence_backend.py:42`` + ``confluence.py:45`` —
+    stdlib ``xmlrpc.client`` here, no requests/jinja2 needed).
+
+    Options: ``server`` (base URL), ``username``, ``password``,
+    ``space``; optional ``page`` (defaults to the workflow name, made
+    unique with " (N)" suffixes like the reference) and ``parent``.
+    ``render`` returns the page body, so the Publisher's local file is
+    the artifact copy of what was uploaded."""
+
+    MAPPING = "confluence"
+    EXTENSION = "xml"
+
+    def render(self, bundle):
+        import xmlrpc.client
+        content = self._page_body(bundle)
+        opts = self.options
+        proxy = xmlrpc.client.ServerProxy(
+            opts["server"].rstrip("/") + "/rpc/xmlrpc")
+        token = proxy.confluence2.login(opts["username"],
+                                        opts["password"])
+        try:
+            space = opts["space"]
+            title = opts.get("page") or bundle["name"]
+            existing = self._get_page(proxy, token, space, title)
+            if not opts.get("page"):
+                index = 1
+                while existing is not None:  # make the title unique
+                    title = "%s (%d)" % (bundle["name"], index)
+                    index += 1
+                    existing = self._get_page(proxy, token, space, title)
+            page = {"space": space, "title": title, "content": content}
+            if existing is not None:
+                page["id"] = existing["id"]
+                page["version"] = existing["version"]
+            parent = opts.get("parent")
+            if parent:
+                parent_page = self._get_page(proxy, token, space, parent)
+                if parent_page is not None:
+                    page["parentId"] = parent_page["id"]
+            stored = proxy.confluence2.storePage(token, page)
+            self.url = stored.get("url")
+        finally:
+            try:
+                proxy.confluence2.logout(token)
+            except Exception:
+                pass
+        return content
+
+    def _page_body(self, bundle):
+        # Confluence storage format is XHTML: the HTML backend's body is
+        # valid content; strip the full-document envelope
+        html = super().render(bundle)
+        start = html.index("<body>") + len("<body>")
+        end = html.index("</body>")
+        return html[start:end]
+
+    def _get_page(self, proxy, token, space, title):
+        import xmlrpc.client
+        try:
+            return proxy.confluence2.getPage(token, space, title)
+        except xmlrpc.client.Fault:
+            return None
+
+
+@register_backend
+class IpynbBackend(Backend):
+    """Jupyter-notebook report (the reference's jinja2 ipynb template
+    role, ``publishing/ipynb_template.ipynb``): one markdown cell per
+    report section — a notebook is plain JSON, no jinja2 needed."""
+
+    MAPPING = "ipynb"
+    EXTENSION = "ipynb"
+
+    def render(self, bundle):
+        md = MarkdownBackend().render(bundle)
+        cells = []
+        for section in md.split("\n## "):
+            text = section if section.startswith("#") \
+                else "## " + section
+            cells.append({
+                "cell_type": "markdown", "metadata": {},
+                "source": text.splitlines(keepends=True)})
+        return json.dumps({
+            "cells": cells,
+            "metadata": {"language_info": {"name": "python"}},
+            "nbformat": 4, "nbformat_minor": 5}, indent=1)
+
+
+@register_backend
+class PDFBackend(Backend):
+    """Text PDF report (reference ``publishing/pdf_backend.py:48`` went
+    through pandoc/latex; this is a dependency-free PDF 1.4 writer —
+    monospace text pages, enough for the metric/config report)."""
+
+    MAPPING = "pdf"
+    EXTENSION = "pdf"
+    BINARY = True  # byte-exact write: xref offsets are byte positions
+
+    LINES_PER_PAGE = 60
+    CHARS_PER_LINE = 95
+
+    def render(self, bundle):
+        md = MarkdownBackend().render(bundle)
+        lines = []
+        for raw in md.splitlines():
+            while len(raw) > self.CHARS_PER_LINE:
+                lines.append(raw[:self.CHARS_PER_LINE])
+                raw = raw[self.CHARS_PER_LINE:]
+            lines.append(raw)
+        pages = [lines[i:i + self.LINES_PER_PAGE]
+                 for i in range(0, len(lines), self.LINES_PER_PAGE)] or [[]]
+        return self._assemble(pages)
+
+    @staticmethod
+    def _escape(text):
+        return (text.replace("\\", r"\\").replace("(", r"\(")
+                .replace(")", r"\)").encode("ascii", "replace")
+                .decode("ascii"))
+
+    def _assemble(self, pages):
+        # objects: 1 catalog, 2 page tree, 3 font, then per page:
+        # page object + content stream
+        objects = {}
+        kids = []
+        next_id = 4
+        for page in pages:
+            page_id, content_id = next_id, next_id + 1
+            next_id += 2
+            kids.append("%d 0 R" % page_id)
+            text = ["BT", "/F1 10 Tf", "1 0 0 1 40 800 Tm", "12 TL"]
+            for line in page:
+                text.append("(%s) '" % self._escape(line))
+            text.append("ET")
+            stream = "\n".join(text)
+            objects[content_id] = ("<< /Length %d >>\nstream\n%s\n"
+                                   "endstream" % (len(stream), stream))
+            objects[page_id] = (
+                "<< /Type /Page /Parent 2 0 R /MediaBox [0 0 595 842] "
+                "/Contents %d 0 R /Resources << /Font << /F1 3 0 R >> >> "
+                ">>" % content_id)
+        objects[1] = "<< /Type /Catalog /Pages 2 0 R >>"
+        objects[2] = ("<< /Type /Pages /Kids [%s] /Count %d >>"
+                      % (" ".join(kids), len(pages)))
+        objects[3] = ("<< /Type /Font /Subtype /Type1 "
+                      "/BaseFont /Courier >>")
+        out = bytearray(b"%PDF-1.4\n")
+        offsets = {}
+        for oid in sorted(objects):
+            offsets[oid] = len(out)
+            out += ("%d 0 obj\n%s\nendobj\n"
+                    % (oid, objects[oid])).encode("latin-1")
+        xref_at = len(out)
+        count = max(objects) + 1
+        out += ("xref\n0 %d\n0000000000 65535 f \n" % count).encode()
+        for oid in range(1, count):
+            out += ("%010d 00000 n \n" % offsets[oid]).encode()
+        out += ("trailer\n<< /Size %d /Root 1 0 R >>\nstartxref\n%d\n"
+                "%%%%EOF\n" % (count, xref_at)).encode()
+        return bytes(out)
+
+
 class Publisher(Unit):
     """Report-rendering unit (reference ``publishing/publisher.py:57``).
 
@@ -179,8 +345,15 @@ class Publisher(Unit):
         for name, backend in self.backends.items():
             path = os.path.join(self.directory, "%s_report.%s"
                                 % (safe, backend.EXTENSION))
-            with open(path, "w") as fout:
-                fout.write(backend.render(bundle))
+            try:
+                rendered = backend.render(bundle)
+                with open(path, "wb" if backend.BINARY else "w") as fout:
+                    fout.write(rendered)
+            except Exception:
+                # a failed backend (e.g. the wiki is down) must not kill
+                # the remaining reports — or fail the finished training
+                self.exception("%s backend failed", name)
+                continue
             self.published[name] = path
             self.info("published %s report: %s", name, path)
         return dict(self.published)

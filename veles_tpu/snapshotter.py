@@ -83,6 +83,37 @@ class SnapshotterBase(Unit):
     def export(self):
         raise NotImplementedError
 
+    def _quiesced(self, write):
+        """Run ``write(payload_dict)`` while every sibling unit's run
+        lock is held, so the snapshot can't tear mid-update or race a
+        mutating run() (the reference paused its thread pool around
+        export). Deferred notifications pile up as run tokens, drained
+        after release. The SINGLE copy of this subtle ordering — both
+        stores go through it."""
+        held = [u for u in self.workflow
+                if u is not self and getattr(u, "_run_lock_", None)]
+        for unit in held:
+            unit._run_lock_.acquire()
+        try:
+            return write({
+                "workflow": self.workflow,
+                "prng": prng.streams_state(),
+                "timestamp": time.time(),
+            })
+        finally:
+            for unit in held:
+                unit._run_lock_.release()
+            for unit in held:
+                unit._drain_run_tokens()
+
+    @staticmethod
+    def _restore(payload):
+        """Shared resume tail: rebind PRNG streams, flag the workflow."""
+        workflow = payload["workflow"]
+        prng.restore_streams(payload.get("prng", {}))
+        workflow._restored_from_snapshot_ = True
+        return workflow
+
     def get_metric_names(self):
         return ["Snapshot"]
 
@@ -102,31 +133,16 @@ class SnapshotterToFile(SnapshotterBase):
             ("." + ext) if ext else "")
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, name)
-        # quiesce: hold every sibling unit's run lock while pickling so the
-        # snapshot can't tear mid-update or race a mutating run() (the
-        # reference paused its thread pool around export). Deferred
-        # notifications pile up as run tokens, drained after release.
-        held = [u for u in self.workflow
-                if u is not self and getattr(u, "_run_lock_", None)]
-        for unit in held:
-            unit._run_lock_.acquire()
-        try:
-            payload = {
-                "workflow": self.workflow,
-                "prng": prng.streams_state(),
-                "timestamp": time.time(),
-            }
+
+        def write(payload):
             # write-then-rename: a reader (or a crash) must never see a
             # partially-written snapshot
             tmp = path + ".tmp%d" % os.getpid()
             with CODECS[ext](tmp, "w") as fout:
                 pickle.dump(payload, fout, protocol=self.WRITE_PROTOCOL)
             os.replace(tmp, path)
-        finally:
-            for unit in held:
-                unit._run_lock_.release()
-            for unit in held:
-                unit._drain_run_tokens()
+
+        self._quiesced(write)
         self.destination = path
         size = os.path.getsize(path)
         if size > 200 * 1024 * 1024:  # reference 200MB warning threshold
@@ -152,10 +168,7 @@ class SnapshotterToFile(SnapshotterBase):
                 ext = candidate
         with CODECS[ext](path, "r") as fin:
             payload = pickle.load(fin)
-        workflow = payload["workflow"]
-        prng.restore_streams(payload.get("prng", {}))
-        workflow._restored_from_snapshot_ = True
-        return workflow
+        return SnapshotterBase._restore(payload)
 
     def export_weights(self, path=None):
         """Plain pytree interchange dump (.npz of every ForwardUnit's
@@ -174,7 +187,97 @@ class SnapshotterToFile(SnapshotterBase):
         return path
 
 
+class SnapshotterToDB(SnapshotterBase):
+    """Database-backed snapshot store (reference ``SnapshotterToDB``,
+    ``snapshotter.py:428-518`` — ODBC there; sqlite3 is the stdlib DB,
+    and a sqlite file on shared storage serves the same role).
+
+    Rows: (prefix, suffix, protocol, timestamp, codec, pickle BLOB
+    compressed per the ``compression`` kwarg). ``destination`` is a
+    ``sqlite://<db-path>#<prefix>`` URI accepted by :meth:`import_` and
+    the CLI's ``-w`` flag; import picks the newest row for the prefix
+    (or an exact ``#prefix/suffix``)."""
+
+    WRITE_PROTOCOL = pickle.HIGHEST_PROTOCOL
+    TABLE = "veles_snapshots"
+
+    #: blob codecs (the file CODECS table works on paths, not bytes)
+    _BLOB_CODECS = {
+        "": (lambda b: b, lambda b: b),
+        "gz": (lambda b: gzip.compress(b, 6), gzip.decompress),
+        "bz2": (lambda b: bz2.compress(b, 6), bz2.decompress),
+        "xz": (lambda b: lzma.compress(b, preset=6), lzma.decompress),
+    }
+
+    def __init__(self, workflow, **kwargs):
+        self.database = kwargs.pop("database")
+        kwargs.setdefault("compression", "gz")
+        super().__init__(workflow, **kwargs)
+        if (self.compression or "") not in self._BLOB_CODECS:
+            raise ValueError("unsupported DB snapshot compression %r"
+                             % self.compression)
+
+    @classmethod
+    def _ensure_table(cls, conn):
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS %s ("
+            "id INTEGER PRIMARY KEY AUTOINCREMENT, "
+            "prefix TEXT, suffix TEXT, protocol INTEGER, "
+            "timestamp REAL, codec TEXT DEFAULT 'gz', payload BLOB)"
+            % cls.TABLE)
+
+    def export(self):
+        import sqlite3
+        payload = self._quiesced(
+            lambda p: pickle.dumps(p, protocol=self.WRITE_PROTOCOL))
+        codec = self.compression or ""
+        blob = self._BLOB_CODECS[codec][0](payload)
+        os.makedirs(os.path.dirname(os.path.abspath(self.database)),
+                    exist_ok=True)
+        with sqlite3.connect(self.database) as conn:
+            self._ensure_table(conn)
+            conn.execute(
+                "INSERT INTO %s (prefix, suffix, protocol, timestamp, "
+                "codec, payload) VALUES (?, ?, ?, ?, ?, ?)" % self.TABLE,
+                (self.prefix, self.suffix or "current",
+                 self.WRITE_PROTOCOL, time.time(), codec, blob))
+        self.destination = "sqlite://%s#%s" % (self.database, self.prefix)
+        self.info("snapshot: %s (%d KB)", self.destination,
+                  len(blob) >> 10)
+
+    @staticmethod
+    def import_(uri):
+        """Load the newest snapshot for ``sqlite://db#prefix`` (or the
+        exact ``sqlite://db#prefix/suffix``)."""
+        import sqlite3
+        if uri.startswith("sqlite://"):
+            uri = uri[len("sqlite://"):]
+        database, _, selector = uri.partition("#")
+        prefix, _, suffix = selector.partition("/")
+        query = ("SELECT payload, codec FROM %s WHERE prefix = ?"
+                 % SnapshotterToDB.TABLE)
+        args = [prefix]
+        if suffix:
+            query += " AND suffix = ?"
+            args.append(suffix)
+        query += " ORDER BY timestamp DESC LIMIT 1"
+        with sqlite3.connect(database) as conn:
+            SnapshotterToDB._ensure_table(conn)
+            row = conn.execute(query, args).fetchone()
+        if row is None:
+            raise FileNotFoundError(
+                "no snapshot for prefix %r in %s" % (prefix, database))
+        blob, codec = row
+        payload = pickle.loads(
+            SnapshotterToDB._BLOB_CODECS[codec or ""][1](blob))
+        return SnapshotterBase._restore(payload)
+
+
 def Snapshotter(workflow, **kwargs):
     """Dispatching constructor (reference ``snapshotter.py:521-535``
-    dispatched file vs odbc by prefix)."""
+    dispatched file vs odbc by target): the ``database=`` kwarg (a
+    sqlite file path) selects :class:`SnapshotterToDB`, otherwise
+    :class:`SnapshotterToFile`."""
+    if kwargs.get("database"):
+        return SnapshotterToDB(workflow, **kwargs)
     return SnapshotterToFile(workflow, **kwargs)
