@@ -29,7 +29,11 @@ class Optimizer(Unit):
         x = root.test.x
         y = root.test.y
         value = (x - 0.33) ** 2 * (y - 0.27) ** 2
-        self.fitness = -value  # GA maximizes; we seek the minimum
+        # positive and maximized at the optimum: roulette selection is
+        # fitness-proportionate, so a negative fitness (the reference
+        # sample returned -value) would clamp to ~0 and remove all
+        # selection pressure
+        self.fitness = 1.0 / (1.0 + value)
 
     def get_metric_names(self):
         return ["EvaluationFitness"]

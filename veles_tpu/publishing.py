@@ -204,11 +204,12 @@ class IpynbBackend(Backend):
     def render(self, bundle):
         md = MarkdownBackend().render(bundle)
         cells = []
-        for section in md.split("\n## "):
+        for i, section in enumerate(md.split("\n## ")):
             text = section if section.startswith("#") \
                 else "## " + section
             cells.append({
                 "cell_type": "markdown", "metadata": {},
+                "id": "cell-%d" % i,  # mandatory since nbformat 4.5
                 "source": text.splitlines(keepends=True)})
         return json.dumps({
             "cells": cells,

@@ -254,13 +254,18 @@ class SnapshotterToDB(SnapshotterBase):
             uri = uri[len("sqlite://"):]
         database, _, selector = uri.partition("#")
         prefix, _, suffix = selector.partition("/")
+        if not os.path.exists(database):
+            # sqlite3.connect would CREATE an empty db here, leaving a
+            # junk file and a misleading "no snapshot for prefix" error
+            raise FileNotFoundError("no such database: %s" % database)
         query = ("SELECT payload, codec FROM %s WHERE prefix = ?"
                  % SnapshotterToDB.TABLE)
         args = [prefix]
         if suffix:
             query += " AND suffix = ?"
             args.append(suffix)
-        query += " ORDER BY timestamp DESC LIMIT 1"
+        # insert order, not wall clock: shared-storage writers may skew
+        query += " ORDER BY id DESC LIMIT 1"
         with sqlite3.connect(database) as conn:
             SnapshotterToDB._ensure_table(conn)
             row = conn.execute(query, args).fetchone()
