@@ -27,7 +27,10 @@ def _digits_dataset():
     return X[perm], y[perm]
 
 
-def _build_mlp(fused, mesh=None, max_epochs=3, sweep=True):
+def _build_mlp(fused, mesh=None, max_epochs=3, sweep=True,
+               pipeline=False, fail_iterations=50):
+    # pipeline=False by default HERE: the identity tests compare the
+    # plain engine against graph mode / explicit pipelined builds
     prng.get("default").seed(4321)
     prng.get("loader").seed(8765)
     X, y = _digits_dataset()
@@ -38,7 +41,8 @@ def _build_mlp(fused, mesh=None, max_epochs=3, sweep=True):
                            minibatch_size=100,
                            normalization_type="linear"),
         learning_rate=0.1, max_epochs=max_epochs, fused=fused, mesh=mesh,
-        fused_sweep=sweep, name="fused-identity")
+        fused_sweep=sweep, fused_pipeline=pipeline,
+        fail_iterations=fail_iterations, name="fused-identity")
 
 
 def _train(wf):
@@ -227,14 +231,95 @@ def test_fused_transformer_matches_graph_mode():
                 atol=1e-2)
 
 
-def test_fused_snapshot_on_improved_holds_evaluated_weights(tmp_path):
+def test_pipelined_is_the_default_product_path():
+    """StandardWorkflow defaults to the pipelined fused engine in
+    standalone sweep mode (the path `python -m veles_tpu` executes)."""
+    prng.get("default").seed(1)
+    prng.get("loader").seed(1)
+    X, y = _digits_dataset()
+    wf = MLPWorkflow(
+        DummyLauncher(), layers=(16, 10),
+        loader_kwargs=dict(data=X, labels=y,
+                           class_lengths=[0, 297, 1500],
+                           minibatch_size=100),
+        learning_rate=0.1, max_epochs=1, name="default-pipeline")
+    wf.initialize()
+    assert wf.fused_tick is not None and wf.fused_tick.pipelined
+    assert wf.decision.pipeline_depth == 1
+    wf.run()
+    assert wf.decision._epochs_done == 1
+
+
+def test_pipelined_identical_on_max_epochs_stop():
+    """Pipelined epoch mode (metrics one epoch late, sync overlapped)
+    must produce EXACTLY the plain sweep mode's outputs when max_epochs
+    stops the run — same epochs, same best error, same final weights."""
+    plain = _train(_build_mlp(fused=True, max_epochs=4))
+    piped = _train(_build_mlp(fused=True, max_epochs=4, pipeline=True))
+    assert piped.fused_tick is not None and piped.fused_tick.pipelined
+    assert piped.decision._epochs_done == plain.decision._epochs_done
+    assert piped.decision.best_n_err[VALID] == plain.decision.best_n_err[
+        VALID]
+    assert piped.decision.best_epoch == plain.decision.best_epoch
+    for fp, fq in zip(plain.forwards, piped.forwards):
+        numpy.testing.assert_array_equal(
+            numpy.asarray(fp.weights.data), numpy.asarray(fq.weights.data))
+
+
+def test_pipelined_identical_on_no_improvement_stop():
+    """A fail_iterations stop is discovered one epoch LATE in pipelined
+    mode; the speculative epoch must be dropped and the params rolled
+    back so outputs match the plain run exactly. lr=0 freezes learning:
+    epoch 1 cannot improve on epoch 0, forcing the stop path."""
+    def build(pipeline):
+        wf = _build_mlp(fused=True, max_epochs=50, pipeline=pipeline,
+                        fail_iterations=1)
+        wf.initialize()
+        for gd in wf.gds:
+            gd.set_learning_rate(0.0)
+        wf.run()
+        return wf
+
+    plain = build(False)
+    piped = build(True)
+    assert piped.fused_tick.pipelined
+    assert plain.decision._epochs_done < 50, "stop path not exercised"
+    assert piped.decision._epochs_done == plain.decision._epochs_done
+    assert piped.decision.best_n_err[VALID] == plain.decision.best_n_err[
+        VALID]
+    for fp, fq in zip(plain.forwards, piped.forwards):
+        numpy.testing.assert_array_equal(
+            numpy.asarray(fp.weights.data), numpy.asarray(fq.weights.data))
+
+
+def test_pipelined_rollback_restores_pre_speculation_weights():
+    """With real learning and a tight improvement budget, the rolled-back
+    weights must equal the plain run's final weights (the speculative
+    epoch's training must leave no trace)."""
+    plain = _train(_build_mlp(fused=True, max_epochs=50,
+                              fail_iterations=2))
+    piped = _train(_build_mlp(fused=True, max_epochs=50, pipeline=True,
+                              fail_iterations=2))
+    assert plain.decision._epochs_done < 50, "stop path not exercised"
+    assert piped.decision._epochs_done == plain.decision._epochs_done
+    for fp, fq in zip(plain.forwards, piped.forwards):
+        numpy.testing.assert_array_equal(
+            numpy.asarray(fp.weights.data), numpy.asarray(fq.weights.data))
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_fused_snapshot_on_improved_holds_evaluated_weights(tmp_path,
+                                                            pipeline):
     """The deferred sweep materialization fires ``improved`` on the
     epoch-end tick — the unit Arrays must still hold the weights the
     validation metric was MEASURED on (eval-tick write-back), so the
-    snapshot re-evaluates to exactly the recorded best error."""
+    snapshot re-evaluates to exactly the recorded best error. The
+    pipelined case exercises the final max_epochs drain, where TWO
+    epochs materialize on one tick (digits improves monotonically, so
+    the final epoch takes 'improved' there)."""
     from veles_tpu.snapshotter import Snapshotter, SnapshotterToFile
 
-    wf = _build_mlp(fused=True, max_epochs=5)
+    wf = _build_mlp(fused=True, max_epochs=5, pipeline=pipeline)
     snap = Snapshotter(wf, prefix="sem", directory=str(tmp_path),
                        time_interval=0)
     snap.link_from(wf.decision)

@@ -39,8 +39,16 @@ def test_snapshot_resume_roundtrip(tmp_path):
     snap = Snapshotter(wf, directory=str(tmp_path), prefix="digits",
                        interval=1, time_interval=0)
     snap.link_from(wf.decision)
-    snap.gate_block = ~wf.decision.improved
-    # snapshotter must not hold up the repeater loop: it has no consumers
+    # gate_SKIP (not block): a skipped unit still propagates the tick,
+    # which the serialized end point depends on
+    snap.gate_skip = ~wf.decision.improved
+    # serialize the snapshotter BEFORE the end point (the reference
+    # samples' wiring): decision dependents run concurrently, so a
+    # parallel end point could finish the workflow before a same-tick
+    # snapshot starts — pipelined mode always materializes the last
+    # improvement on the final tick, making that race deterministic
+    wf.end_point.unlink_from(wf.decision)
+    wf.end_point.link_from(snap)
     wf.initialize()
     wf.run()
     files = glob.glob(os.path.join(str(tmp_path), "digits_*.pickle*"))

@@ -70,6 +70,13 @@ class StandardWorkflow(Workflow):
         # sweep serving: one XLA dispatch per class sweep (lax.scan over
         # the minibatches) instead of one per minibatch
         self.fused_sweep = kwargs.pop("fused_sweep", True)
+        # pipelined epochs (default): metrics materialize one epoch late
+        # with their device->host copies prefetched, so the per-epoch
+        # sync overlaps the next epoch's compute — outputs are proven
+        # identical incl. the stop paths (tests/test_fused.py); log
+        # lines/plotters lag one epoch. Disable with
+        # fused_pipeline=False. (see parallel/fused.py FusedTick docs)
+        self.fused_pipeline = kwargs.pop("fused_pipeline", True)
         self.mesh_ = kwargs.pop("mesh", None)
         self.fused_tick = None
         super().__init__(workflow, **kwargs)
@@ -121,8 +128,10 @@ class StandardWorkflow(Workflow):
                 raise ValueError(
                     "fused=True but the topology/loader is not fusible")
             return
-        self.fused_tick = fused.FusedTick(self, mesh=mesh,
-                                          name="fused_tick")
+        self.fused_tick = fused.FusedTick(
+            self, mesh=mesh, name="fused_tick",
+            pipelined=bool(getattr(self, "fused_pipeline", False)
+                           and getattr(self, "fused_sweep", True)))
         # detach the graph-mode compute chain from the control path
         self.forwards[0].unlink_from(self.loader)
         self.decision.unlink_from(self.evaluator)

@@ -57,6 +57,12 @@ class DecisionGD(Unit):
         # accumulators are still lazy device values (materialized in one
         # batched transfer at the epoch boundary)
         self._pending_classes = []
+        # pipelined fused mode: materialize each epoch's metrics this
+        # many epochs LATE — by then the device has finished computing
+        # them, so the batched read never stalls the dispatch pipeline.
+        # 0 = read at the epoch's own boundary (the default)
+        self.pipeline_depth = 0
+        self._lagged_epochs_ = []
 
     def link_from_workflow(self, loader, evaluator):
         self.loader = loader
@@ -103,10 +109,12 @@ class DecisionGD(Unit):
             # sweep once per class — a full device round trip each (the
             # dominant per-epoch cost on a tunneled TPU). Defer ALL
             # materialization to the epoch boundary and fetch every
-            # accumulator in ONE batched transfer instead.
+            # accumulator in ONE batched transfer instead (and, in
+            # pipelined mode, a further ``pipeline_depth`` epochs late).
             self._pending_classes.append(klass)
             if self.loader.epoch_ended:
-                self._materialize_epoch()
+                self._queue_epoch()
+                self._drain_epochs()
             return
         # one sample-class sweep finished: sync its accumulators to host
         self.epoch_n_err[klass] = int(self.epoch_n_err[klass])
@@ -115,20 +123,84 @@ class DecisionGD(Unit):
         if self.loader.epoch_ended:
             self._on_epoch_ended()
 
-    def _materialize_epoch(self):
-        """One batched device->host transfer for the whole epoch's
+    def _queue_epoch(self):
+        """Park the finished epoch's (still-lazy) accumulators and reset
+        the live ones for the next epoch."""
+        entry = {
+            "n_err": self.epoch_n_err, "loss": self.epoch_loss,
+            "samples": self.epoch_samples,
+            "confusion": self._epoch_confusion,
+            "classes": self._pending_classes,
+        }
+        if self.pipeline_depth:
+            # start the device->host copies NOW: they complete during
+            # the next epoch's compute, so the lagged materialization
+            # pays neither the compute wait nor the transfer round trip
+            for value in (*entry["n_err"], *entry["loss"],
+                          entry["confusion"]):
+                if hasattr(value, "copy_to_host_async"):
+                    value.copy_to_host_async()
+        self._lagged_epochs_.append(entry)
+        self.epoch_n_err = [0, 0, 0]
+        self.epoch_loss = [0.0, 0.0, 0.0]
+        self.epoch_samples = [0, 0, 0]
+        self._epoch_confusion = None
+        self._pending_classes = []
+
+    def _drain_epochs(self):
+        """Materialize queued epochs down to ``pipeline_depth`` — or ALL
+        of them when the serving side has reached ``max_epochs`` (an
+        exact stop: nothing speculative is in flight then). A lagged
+        no-improvement stop drops the younger, speculatively-trained
+        epochs and rolls the fused params back, making the run's outputs
+        identical to the unpipelined ones."""
+        served = self._epochs_done + len(self._lagged_epochs_)
+        drain_all = (self.max_epochs is not None
+                     and served >= self.max_epochs)
+        tick = getattr(self.workflow, "fused_tick", None)
+        first = True
+        while self._lagged_epochs_ and (
+                drain_all
+                or len(self._lagged_epochs_) > self.pipeline_depth):
+            entry = self._lagged_epochs_.pop(0)
+            if not first and tick is not None:
+                # two epochs materialize on this tick but the tick's
+                # one-slot params history rotated only once: if the
+                # SECOND epoch is about to take 'improved' (peek its
+                # prefetched valid error), advance the unit Arrays to
+                # the params it evaluated so a snapshot-on-improved
+                # stays exact; if not, leave them on the older epoch's
+                # evaluated state — the improvement that stands
+                import jax
+                n_err = int(jax.device_get(entry["n_err"][VALID]))
+                best = self.best_n_err[VALID]
+                if best is None or n_err < best:
+                    tick.advance_eval_params()
+            first = False
+            self._materialize_entry(entry)
+            if self.complete and self._lagged_epochs_:
+                dropped = len(self._lagged_epochs_)
+                self._lagged_epochs_ = []
+                tick = getattr(self.workflow, "fused_tick", None)
+                if tick is not None:
+                    tick.rollback_speculative()
+                self.info("dropped %d speculative epoch(s) after the "
+                          "lagged stop decision", dropped)
+                break
+
+    def _materialize_entry(self, entry):
+        """One batched device->host transfer for one epoch's
         accumulators (error counts, loss sums, confusion), then the
         class summaries in serving order and the epoch summary."""
         import jax
         n_errs, losses, cm = jax.device_get(
-            (self.epoch_n_err, self.epoch_loss, self._epoch_confusion))
+            (entry["n_err"], entry["loss"], entry["confusion"]))
         self.epoch_n_err = [int(v) for v in n_errs]
         self.epoch_loss = [float(v) for v in losses]
-        if cm is not None:
-            self._epoch_confusion = cm
-        for klass in self._pending_classes:
+        self.epoch_samples = list(entry["samples"])
+        self._epoch_confusion = cm
+        for klass in entry["classes"]:
             self._on_class_ended(klass)
-        self._pending_classes = []
         self._on_epoch_ended()
 
     # -- epoch boundary logic -------------------------------------------------
@@ -233,6 +305,9 @@ class DecisionGD(Unit):
             self._epoch_buckets = {}
         if not hasattr(self, "_pending_classes"):
             self._pending_classes = []
+        if not hasattr(self, "pipeline_depth"):
+            self.pipeline_depth = 0
+        self._lagged_epochs_ = []
 
     def apply_data_from_slave(self, data, slave=None):
         klass = data["klass"]

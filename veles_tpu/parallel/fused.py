@@ -433,12 +433,21 @@ class FusedTick(Unit):
     hide_from_registry = True
     VIEW_GROUP = "WORKER"
 
-    def __init__(self, workflow, mesh=None, **kwargs):
+    def __init__(self, workflow, mesh=None, pipelined=False, **kwargs):
         super().__init__(workflow, **kwargs)
         # trailing underscore: a jax Mesh holds Device objects and cannot
         # be pickled — a resumed pod-mode snapshot falls back to the
         # single-device fused tick unless the caller re-supplies a mesh
         self.mesh_ = mesh
+        #: pipelined epoch mode: the Decision materializes each epoch's
+        #: metrics one epoch late (pipeline_depth=1) so the per-epoch
+        #: device sync overlaps the next epoch's compute. The tick then
+        #: keeps a one-slot params history so (a) the unit Arrays always
+        #: hold the weights the CURRENTLY-ATTRIBUTED metrics scored and
+        #: (b) a lagged no-improvement stop can roll back the one
+        #: speculatively-trained epoch — outputs stay identical to the
+        #: unpipelined run.
+        self.pipelined = pipelined
         self.ticks = 0
 
     @property
@@ -454,6 +463,10 @@ class FusedTick(Unit):
         self._norm_ = None
         self._specs_ = None
         self._wrote_eval_params_ = False
+        if not hasattr(self, "pipelined"):
+            self.pipelined = False
+        self._eval_stash_ = None  # params evaluated one epoch ago
+        self._stashed_this_epoch_ = False
 
     def initialize(self, **kwargs):
         wf = self.workflow
@@ -469,6 +482,16 @@ class FusedTick(Unit):
             weights = getattr(fwd, "weights", None)
             if weights is not None and weights.data is None:
                 return True  # retry after the forwards initialize
+        if self.pipelined:
+            if (not getattr(loader, "sweep_serving", False)
+                    or loader.effective_class_lengths[VALID] == 0):
+                # lagged improvement tracking needs a VALID sweep; and
+                # without sweep serving there is no per-epoch sync to
+                # hide in the first place
+                self.warning("pipelined mode needs sweep serving and a "
+                             "validation split: disabling")
+                self.pipelined = False
+            wf.decision.pipeline_depth = 1 if self.pipelined else 0
         self._specs_ = extract_model_spec(wf)
         self._norm_ = {k: jnp.asarray(v) for k, v in
                        loader.normalizer.jit_state().items()}
@@ -528,7 +551,19 @@ class FusedTick(Unit):
             # snapshot-on-improved semantics; with the decision's
             # deferred sweep materialization ``improved`` fires on the
             # epoch-end tick, after this epoch's training)
-            set_params(wf, self._params_, self._specs_)
+            if self.pipelined:
+                # metrics are attributed one epoch late: the Arrays must
+                # lag the same way. Rotate the one-slot history — write
+                # the params the PREVIOUS epoch evaluated, stash the
+                # ones this epoch's eval sweep is scoring right now.
+                if not self._stashed_this_epoch_:
+                    current = jax.tree.map(jnp.copy, self._params_)
+                    if self._eval_stash_ is not None:
+                        set_params(wf, self._eval_stash_, self._specs_)
+                    self._eval_stash_ = current
+                    self._stashed_this_epoch_ = True
+            else:
+                set_params(wf, self._params_, self._specs_)
             self._wrote_eval_params_ = True
         if loader.epoch_ended:
             # the eval-tick write stands in for the epoch-end one ONLY
@@ -542,6 +577,25 @@ class FusedTick(Unit):
             if training and not eval_covers:
                 set_params(wf, self._params_, self._specs_)
             self._wrote_eval_params_ = False
+            self._stashed_this_epoch_ = False
+
+    def advance_eval_params(self):
+        """Write the one-slot history's evaluated params into the unit
+        Arrays — the Decision calls this when a multi-epoch drain is
+        about to attribute an improvement to the NEWER epoch, whose
+        evaluated weights sit in the stash (see _drain_epochs)."""
+        if self._eval_stash_ is not None:
+            set_params(self.workflow, self._eval_stash_, self._specs_)
+            self._eval_stash_ = None
+
+    def rollback_speculative(self):
+        """A lagged stop decision arrived AFTER one more epoch was
+        speculatively dispatched: restore the params to the stopping
+        epoch's post-train state (the one-slot stash holds exactly it —
+        pipeline depth is 1)."""
+        if self._eval_stash_ is not None:
+            self._params_ = self._eval_stash_
+            self._eval_stash_ = None
 
     def sync_params(self):
         """Write the CURRENT (post-train) params into the unit Arrays —
