@@ -38,6 +38,7 @@ class Json {
     return it == object.end() ? fallback : it->second;
   }
   int as_int() const { return static_cast<int>(number); }
+  double as_double() const { return number; }
   const std::string& as_str() const { return str; }
 };
 

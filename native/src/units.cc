@@ -269,6 +269,158 @@ class PoolingUnit : public Unit {
   int in_h_ = 0, in_w_ = 0, channels_ = 0, out_h_ = 0, out_w_ = 0;
 };
 
+class LayerNormUnit : public Unit {
+ public:
+  LayerNormUnit(const Json& config, std::map<std::string, Tensor>* arrays,
+                const Json& spec)
+      : eps_(static_cast<float>(config.at("eps").as_double())) {
+    scale_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "weights")));
+    shift_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "bias")));
+  }
+
+  const char* type() const override { return "layer_norm"; }
+
+  Shape Infer(const Shape& in) override {
+    dim_ = static_cast<int>(in.dims.back());
+    if (dim_ != static_cast<int>(scale_.shape[0]) ||
+        static_cast<int64_t>(shift_.data.size()) < dim_)
+      throw std::runtime_error("layer_norm scale/shift dim mismatch");
+    rows_ = static_cast<int>(in.count() / dim_);
+    return in;
+  }
+
+  void Run(const float* in, float* out, int batch) const override {
+    const float* g = scale_.data.data();
+    const float* b = shift_.data.data();
+    for (int64_t r = 0; r < static_cast<int64_t>(batch) * rows_; ++r) {
+      const float* x = in + r * dim_;
+      float* y = out + r * dim_;
+      float mean = 0.f;
+      for (int c = 0; c < dim_; ++c) mean += x[c];
+      mean /= dim_;
+      float var = 0.f;
+      for (int c = 0; c < dim_; ++c) {
+        float d = x[c] - mean;
+        var += d * d;
+      }
+      var /= dim_;
+      float inv = 1.0f / std::sqrt(var + eps_);
+      for (int c = 0; c < dim_; ++c)
+        y[c] = (x[c] - mean) * inv * g[c] + b[c];
+    }
+  }
+
+ private:
+  float eps_;
+  Tensor scale_, shift_;
+  int dim_ = 0, rows_ = 0;
+};
+
+// Multi-head self attention over (T, E) samples: qkv projection,
+// per-head softmax(QK^T/sqrt(D))V (optionally causal), output
+// projection — the transformer tier of the exported-package op library
+// (additive vs libZnicz, which predates attention).
+class SelfAttentionUnit : public Unit {
+ public:
+  SelfAttentionUnit(const Json& config,
+                    std::map<std::string, Tensor>* arrays,
+                    const Json& spec)
+      : heads_(config.at("heads").as_int()),
+        causal_(config.at("causal").as_int() != 0) {
+    w_qkv_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "weights")));
+    b_qkv_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "bias")));
+    w_out_ =
+        std::move((*arrays).at(All2AllUnit::RefKey(spec, "out_weights")));
+    b_out_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "out_bias")));
+  }
+
+  const char* type() const override { return "self_attention"; }
+
+  Shape Infer(const Shape& in) override {
+    if (in.dims.size() != 2)
+      throw std::runtime_error("self_attention expects (T, E) input");
+    t_ = static_cast<int>(in.dims[0]);
+    embed_ = static_cast<int>(in.dims[1]);
+    if (embed_ != static_cast<int>(w_qkv_.shape[0]) ||
+        3 * embed_ != static_cast<int>(w_qkv_.shape[1]))
+      throw std::runtime_error("self_attention qkv weight mismatch");
+    // every array the Run loop reads gets validated up front — a
+    // malformed package must fail loudly, not read out of bounds
+    if (static_cast<int64_t>(b_qkv_.data.size()) < 3 * embed_)
+      throw std::runtime_error("self_attention qkv bias too small");
+    if (w_out_.shape.size() != 2 ||
+        static_cast<int>(w_out_.shape[0]) != embed_ ||
+        static_cast<int>(w_out_.shape[1]) != embed_)
+      throw std::runtime_error("self_attention out weight mismatch");
+    if (static_cast<int64_t>(b_out_.data.size()) < embed_)
+      throw std::runtime_error("self_attention out bias too small");
+    if (heads_ <= 0 || embed_ % heads_)
+      throw std::runtime_error("bad head count for embed dim");
+    return in;
+  }
+
+  void Run(const float* in, float* out, int batch) const override {
+    int d = embed_ / heads_;
+    float scale = 1.0f / std::sqrt(static_cast<float>(d));
+    int64_t sample = static_cast<int64_t>(t_) * embed_;
+    std::vector<float> qkv(static_cast<int64_t>(t_) * 3 * embed_);
+    std::vector<float> scores(t_);
+    std::vector<float> mixed(sample);
+    for (int b = 0; b < batch; ++b) {
+      const float* x = in + b * sample;
+      float* y = out + b * sample;
+      // qkv projection rows preset with bias
+      for (int r = 0; r < t_; ++r)
+        std::memcpy(qkv.data() + static_cast<int64_t>(r) * 3 * embed_,
+                    b_qkv_.data.data(), 3 * embed_ * sizeof(float));
+      Gemm(x, w_qkv_.data.data(), qkv.data(), t_, embed_, 3 * embed_);
+      const float* q = qkv.data();
+      const float* k = qkv.data() + embed_;
+      const float* v = qkv.data() + 2 * embed_;
+      int64_t stride = 3 * embed_;
+      for (int h = 0; h < heads_; ++h) {
+        int off = h * d;
+        for (int i = 0; i < t_; ++i) {
+          int jmax = causal_ ? i + 1 : t_;
+          float mx = -1e30f;
+          for (int j = 0; j < jmax; ++j) {
+            float s = 0.f;
+            const float* qi = q + i * stride + off;
+            const float* kj = k + j * stride + off;
+            for (int c = 0; c < d; ++c) s += qi[c] * kj[c];
+            scores[j] = s * scale;
+            mx = std::max(mx, scores[j]);
+          }
+          float sum = 0.f;
+          for (int j = 0; j < jmax; ++j) {
+            scores[j] = std::exp(scores[j] - mx);
+            sum += scores[j];
+          }
+          float* dst = mixed.data() + static_cast<int64_t>(i) * embed_ +
+                       off;
+          std::fill(dst, dst + d, 0.f);
+          for (int j = 0; j < jmax; ++j) {
+            float wj = scores[j] / sum;
+            const float* vj = v + j * stride + off;
+            for (int c = 0; c < d; ++c) dst[c] += wj * vj[c];
+          }
+        }
+      }
+      // output projection rows preset with bias
+      for (int r = 0; r < t_; ++r)
+        std::memcpy(y + static_cast<int64_t>(r) * embed_,
+                    b_out_.data.data(), embed_ * sizeof(float));
+      Gemm(mixed.data(), w_out_.data.data(), y, t_, embed_, embed_);
+    }
+  }
+
+ private:
+  int heads_;
+  bool causal_;
+  Tensor w_qkv_, b_qkv_, w_out_, b_out_;
+  int t_ = 0, embed_ = 0;
+};
+
 // Static registrations (reference RegisterUnit<T> statics).
 struct Registrar {
   Registrar() {
@@ -299,6 +451,18 @@ struct Registrar {
                      [](const Json& spec, std::map<std::string, Tensor>*) {
                        return std::make_unique<PoolingUnit>(
                            spec.at("config"), PoolingUnit::Mode::kMaxAbs);
+                     });
+    factory.Register("layer_norm",
+                     [](const Json& spec,
+                        std::map<std::string, Tensor>* arrays) {
+                       return std::make_unique<LayerNormUnit>(
+                           spec.at("config"), arrays, spec);
+                     });
+    factory.Register("self_attention",
+                     [](const Json& spec,
+                        std::map<std::string, Tensor>* arrays) {
+                       return std::make_unique<SelfAttentionUnit>(
+                           spec.at("config"), arrays, spec);
                      });
   }
 } registrar;

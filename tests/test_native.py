@@ -133,3 +133,74 @@ def test_native_convnet(native_lib, tmp_path):
         -1, keepdims=True)
     numpy.testing.assert_allclose(native_out, jax_probs, rtol=2e-2,
                                   atol=2e-4)
+
+
+def test_native_transformer(native_lib, tmp_path):
+    """layer_norm + self_attention + softmax head export path: the C++
+    runtime's transformer tier must match the JAX units' forward."""
+    rng = numpy.random.RandomState(0)
+    n, t, e = 400, 6, 16
+    X = rng.randn(n, t, e).astype(numpy.float32) * 0.2
+    y = rng.randint(0, 2, n).astype(numpy.int32)
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        layers=[
+            {"type": "layer_norm"},
+            {"type": "self_attention", "heads": 4},
+            {"type": "softmax", "output_sample_shape": (2,)},
+        ],
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 100, 300],
+                           minibatch_size=100),
+        learning_rate=0.05, decision_kwargs=dict(max_epochs=1),
+        name="attn-export")
+    wf.initialize()
+    wf.run()
+    package = str(tmp_path / "attn.tar")
+    package_export(wf, package)
+    rt = NativeWorkflow(package)
+    assert rt.unit_count == 3
+
+    batch = X[:8]
+    native_out = rt.run(batch)
+    wf.loader.minibatch_data.data = jnp.asarray(batch)
+    for fwd in wf.forwards:
+        fwd.run()
+    jax_logits = numpy.asarray(wf.forwards[-1].output.mem)[:8]
+    jax_probs = numpy.exp(jax_logits) / numpy.exp(jax_logits).sum(
+        -1, keepdims=True)
+    numpy.testing.assert_allclose(native_out, jax_probs, rtol=2e-2,
+                                  atol=2e-4)
+
+
+def test_native_causal_attention(native_lib, tmp_path):
+    """The causal mask must match (build an untrained causal stack and
+    compare raw forwards)."""
+    rng = numpy.random.RandomState(1)
+    n, t, e = 300, 5, 8
+    X = rng.randn(n, t, e).astype(numpy.float32) * 0.3
+    y = rng.randint(0, 2, n).astype(numpy.int32)
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        layers=[
+            {"type": "self_attention", "heads": 2, "causal": True},
+            {"type": "softmax", "output_sample_shape": (2,)},
+        ],
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 100, 200],
+                           minibatch_size=100),
+        learning_rate=0.0, decision_kwargs=dict(max_epochs=1),
+        name="causal-export")
+    wf.initialize()
+    wf.run()
+    package = str(tmp_path / "causal.tar")
+    package_export(wf, package)
+    rt = NativeWorkflow(package)
+    batch = X[:4]
+    native_out = rt.run(batch)
+    wf.loader.minibatch_data.data = jnp.asarray(batch)
+    for fwd in wf.forwards:
+        fwd.run()
+    jax_logits = numpy.asarray(wf.forwards[-1].output.mem)[:4]
+    jax_probs = numpy.exp(jax_logits) / numpy.exp(jax_logits).sum(
+        -1, keepdims=True)
+    numpy.testing.assert_allclose(native_out, jax_probs, rtol=2e-2,
+                                  atol=2e-4)

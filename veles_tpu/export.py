@@ -75,8 +75,24 @@ def _unit_spec(unit, arrays):
                           "stride_y": unit.sliding[0],
                           "stride_x": unit.sliding[1]}
     else:
-        raise ValueError("cannot export unit %r (%s)"
-                         % (unit.name, type(unit).__name__))
+        from veles_tpu.nn.attention import LayerNorm, SelfAttention
+        if isinstance(unit, SelfAttention):
+            spec["type"] = "self_attention"
+            # causal as 0/1: the runtime's mini JSON reader is numeric
+            spec["config"] = {"heads": unit.heads,
+                              "causal": int(unit.causal)}
+            ref("weights", unit.weights)
+            ref("bias", unit.bias)
+            ref("out_weights", unit.out_weights)
+            ref("out_bias", unit.out_bias)
+        elif isinstance(unit, LayerNorm):
+            spec["type"] = "layer_norm"
+            spec["config"] = {"eps": unit.eps}
+            ref("weights", unit.weights)
+            ref("bias", unit.bias)
+        else:
+            raise ValueError("cannot export unit %r (%s)"
+                             % (unit.name, type(unit).__name__))
     return spec
 
 

@@ -24,9 +24,17 @@ LIB_PATH = os.path.join(BUILD_DIR, "libveles_rt.so")
 
 
 def build_native(force=False):
-    """Compile the native runtime; returns the library path."""
+    """Compile the native runtime; returns the library path. A cached
+    library older than any native source is rebuilt."""
     if os.path.exists(LIB_PATH) and not force:
-        return LIB_PATH
+        import glob
+        sources = (glob.glob(os.path.join(NATIVE_DIR, "src", "*"))
+                   + glob.glob(os.path.join(NATIVE_DIR, "include",
+                                            "veles_rt", "*"))
+                   + glob.glob(os.path.join(NATIVE_DIR, "CMakeLists.txt")))
+        newest = max((os.path.getmtime(p) for p in sources), default=0.0)
+        if os.path.getmtime(LIB_PATH) >= newest:
+            return LIB_PATH
     os.makedirs(BUILD_DIR, exist_ok=True)
     subprocess.run(["cmake", "-S", NATIVE_DIR, "-B", BUILD_DIR,
                     "-DCMAKE_BUILD_TYPE=Release"],

@@ -173,15 +173,13 @@ class DecisionGD(Unit):
                 # evaluated state — the improvement that stands
                 import jax
                 n_err = int(jax.device_get(entry["n_err"][VALID]))
-                best = self.best_n_err[VALID]
-                if best is None or n_err < best:
+                if self._is_improvement(VALID, n_err):
                     tick.advance_eval_params()
             first = False
             self._materialize_entry(entry)
             if self.complete and self._lagged_epochs_:
                 dropped = len(self._lagged_epochs_)
                 self._lagged_epochs_ = []
-                tick = getattr(self.workflow, "fused_tick", None)
                 if tick is not None:
                     tick.rollback_speculative()
                 self.info("dropped %d speculative epoch(s) after the "
@@ -216,9 +214,14 @@ class DecisionGD(Unit):
             self._track_improvement(VALID, n_err, epoch,
                                     "validation_%.2fpt" % error_pct)
 
-    def _track_improvement(self, klass, n_err, epoch, suffix):
+    def _is_improvement(self, klass, n_err):
+        """THE improvement predicate — _track_improvement and the
+        pipelined drain's advance-peek must never diverge."""
         best = self.best_n_err[klass]
-        if best is None or n_err < best:
+        return best is None or n_err < best
+
+    def _track_improvement(self, klass, n_err, epoch, suffix):
+        if self._is_improvement(klass, n_err):
             self.best_n_err[klass] = n_err
             self.best_epoch = epoch
             self.improved.set()
