@@ -282,7 +282,8 @@ class LayerNormUnit : public Unit {
 
   Shape Infer(const Shape& in) override {
     dim_ = static_cast<int>(in.dims.back());
-    if (dim_ != static_cast<int>(scale_.shape[0]) ||
+    if (scale_.shape.empty() ||
+        dim_ != static_cast<int>(scale_.shape[0]) ||
         static_cast<int64_t>(shift_.data.size()) < dim_)
       throw std::runtime_error("layer_norm scale/shift dim mismatch");
     rows_ = static_cast<int>(in.count() / dim_);
@@ -341,7 +342,8 @@ class SelfAttentionUnit : public Unit {
       throw std::runtime_error("self_attention expects (T, E) input");
     t_ = static_cast<int>(in.dims[0]);
     embed_ = static_cast<int>(in.dims[1]);
-    if (embed_ != static_cast<int>(w_qkv_.shape[0]) ||
+    if (w_qkv_.shape.size() != 2 ||
+        embed_ != static_cast<int>(w_qkv_.shape[0]) ||
         3 * embed_ != static_cast<int>(w_qkv_.shape[1]))
       throw std::runtime_error("self_attention qkv weight mismatch");
     // every array the Run loop reads gets validated up front — a

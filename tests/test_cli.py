@@ -33,6 +33,22 @@ def test_sample_workflow_end_to_end(tmp_path):
     assert results["best_validation_errors"] < 297
 
 
+@pytest.mark.slow
+def test_transformer_sample_end_to_end(tmp_path):
+    """The transformer sample trains, exports, and the native runtime
+    loads the package (attention tier of the C++ op library)."""
+    result_file = str(tmp_path / "results.json")
+    package = str(tmp_path / "tx.tar")
+    proc = run_cli("samples/transformer_digits.py", "-",
+                   "root.transformer.epochs=2",
+                   "root.transformer.export=%s" % package,
+                   "--result-file", result_file)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert json.load(open(result_file))["epochs"] == 2
+    from veles_tpu.inference import NativeWorkflow
+    assert NativeWorkflow(package).unit_count == 5
+
+
 def test_dry_run_init():
     proc = run_cli("samples/digits_mlp.py", "-", "--dry-run", "init")
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -33,6 +33,7 @@ def _npy_bytes(array):
 def _unit_spec(unit, arrays):
     """Describe one forward unit; register its arrays."""
     from veles_tpu.nn.all2all import All2All
+    from veles_tpu.nn.attention import LayerNorm, SelfAttention
     from veles_tpu.nn.conv import Conv
     from veles_tpu.nn.pooling import AvgPooling, MaxPooling, Pooling
 
@@ -74,25 +75,23 @@ def _unit_spec(unit, arrays):
         spec["config"] = {"kx": unit.kx, "ky": unit.ky,
                           "stride_y": unit.sliding[0],
                           "stride_x": unit.sliding[1]}
+    elif isinstance(unit, SelfAttention):
+        spec["type"] = "self_attention"
+        # causal as 0/1: the runtime's mini JSON reader is numeric
+        spec["config"] = {"heads": unit.heads,
+                          "causal": int(unit.causal)}
+        ref("weights", unit.weights)
+        ref("bias", unit.bias)
+        ref("out_weights", unit.out_weights)
+        ref("out_bias", unit.out_bias)
+    elif isinstance(unit, LayerNorm):
+        spec["type"] = "layer_norm"
+        spec["config"] = {"eps": unit.eps}
+        ref("weights", unit.weights)
+        ref("bias", unit.bias)
     else:
-        from veles_tpu.nn.attention import LayerNorm, SelfAttention
-        if isinstance(unit, SelfAttention):
-            spec["type"] = "self_attention"
-            # causal as 0/1: the runtime's mini JSON reader is numeric
-            spec["config"] = {"heads": unit.heads,
-                              "causal": int(unit.causal)}
-            ref("weights", unit.weights)
-            ref("bias", unit.bias)
-            ref("out_weights", unit.out_weights)
-            ref("out_bias", unit.out_bias)
-        elif isinstance(unit, LayerNorm):
-            spec["type"] = "layer_norm"
-            spec["config"] = {"eps": unit.eps}
-            ref("weights", unit.weights)
-            ref("bias", unit.bias)
-        else:
-            raise ValueError("cannot export unit %r (%s)"
-                             % (unit.name, type(unit).__name__))
+        raise ValueError("cannot export unit %r (%s)"
+                         % (unit.name, type(unit).__name__))
     return spec
 
 
