@@ -307,6 +307,22 @@ def test_pipelined_rollback_restores_pre_speculation_weights():
             numpy.asarray(fp.weights.data), numpy.asarray(fq.weights.data))
 
 
+def _attach_snapshotter(wf, directory, **kwargs):
+    """Snapshot-on-improved wiring: gate_SKIP (skip still propagates the
+    tick) and serialized BEFORE the end point — a parallel end point
+    could race a same-tick final snapshot (see tests/test_snapshotter.py
+    for the full rationale)."""
+    from veles_tpu.snapshotter import Snapshotter
+
+    snap = Snapshotter(wf, directory=str(directory), time_interval=0,
+                       **kwargs)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    wf.end_point.unlink_from(wf.decision)
+    wf.end_point.link_from(snap)
+    return snap
+
+
 @pytest.mark.parametrize("pipeline", [False, True])
 def test_fused_snapshot_on_improved_holds_evaluated_weights(tmp_path,
                                                             pipeline):
@@ -317,15 +333,10 @@ def test_fused_snapshot_on_improved_holds_evaluated_weights(tmp_path,
     pipelined case exercises the final max_epochs drain, where TWO
     epochs materialize on one tick (digits improves monotonically, so
     the final epoch takes 'improved' there)."""
-    from veles_tpu.snapshotter import Snapshotter, SnapshotterToFile
+    from veles_tpu.snapshotter import SnapshotterToFile
 
     wf = _build_mlp(fused=True, max_epochs=5, pipeline=pipeline)
-    snap = Snapshotter(wf, prefix="sem", directory=str(tmp_path),
-                       time_interval=0)
-    snap.link_from(wf.decision)
-    snap.gate_skip = ~wf.decision.improved
-    wf.end_point.unlink_from(wf.decision)
-    wf.end_point.link_from(snap)
+    snap = _attach_snapshotter(wf, tmp_path, prefix="sem")
     wf.initialize()
     wf.run()
     best = wf.decision.best_n_err[VALID]
@@ -389,3 +400,32 @@ def test_fused_confusion_disabled_flag(monkeypatch):
     assert wf.fused_tick is not None
     wf.run()
     assert wf.decision.last_epoch_confusion is None
+
+
+def test_pipelined_snapshot_resume_continues(tmp_path):
+    """A snapshot taken by the PIPELINED engine (improved fires on the
+    epoch-end tick) must resume and continue training: the lagged-epoch
+    queue and the tick's params history are session state, rebuilt
+    empty on unpickle."""
+    from veles_tpu.snapshotter import SnapshotterToFile
+
+    wf = _build_mlp(fused=True, max_epochs=3, pipeline=True)
+    snap = _attach_snapshotter(wf, tmp_path, prefix="pr")
+    wf.initialize()
+    assert wf.fused_tick.pipelined
+    wf.run()
+    best_before = wf.decision.best_n_err[VALID]
+
+    restored = SnapshotterToFile.import_(snap.destination)
+    restored.workflow = DummyLauncher()
+    restored.decision.max_epochs = 6
+    restored.decision.complete.unset()
+    restored.decision.train_ended.unset()
+    restored.initialize()
+    assert restored.fused_tick is not None and restored.fused_tick.pipelined
+    restored.run()
+    assert restored.decision._epochs_done == 6
+    # STRICT improvement: the pickled best alone would satisfy <=; three
+    # more epochs on digits reliably lower the error, so a broken resume
+    # (e.g. garbage params after restore) fails here
+    assert restored.decision.best_n_err[VALID] < best_before
