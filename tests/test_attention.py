@@ -60,3 +60,43 @@ class TestRing:
         # sharded over seq: 8 addressable shards of 32 tokens
         assert len(out.addressable_shards) == 8
         assert out.addressable_shards[0].data.shape == (1, 32, 2, 16)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_single_device(self, causal):
+        """Ulysses all-to-all SP over an 8-way seq mesh == plain
+        attention (drop-in alternative to the ring)."""
+        from veles_tpu.ops.attention import make_ulysses_attention
+
+        q, k, v = _qkv(b=2, t=128, h=8, d=16)
+        mesh = build_mesh(data=1, seq=8)
+        ulysses = make_ulysses_attention(mesh, causal=causal)
+        out = ulysses(q, k, v)
+        ref = naive_attention(q, k, v, causal=causal)
+        numpy.testing.assert_allclose(
+            numpy.asarray(out), numpy.asarray(ref), rtol=2e-2, atol=2e-3)
+        # output stays sequence-sharded like the ring's
+        assert len(out.addressable_shards) == 8
+        assert out.addressable_shards[0].data.shape == (2, 16, 8, 16)
+
+    def test_matches_ring(self):
+        """The two SP strategies agree with each other."""
+        from veles_tpu.ops.attention import make_ulysses_attention
+
+        q, k, v = _qkv(b=1, t=128, h=8, d=16, seed=3)
+        mesh = build_mesh(data=1, seq=8)
+        ring = make_ring_attention(mesh, causal=True)
+        ulysses = make_ulysses_attention(mesh, causal=True)
+        numpy.testing.assert_allclose(
+            numpy.asarray(ring(q, k, v)),
+            numpy.asarray(ulysses(q, k, v)), rtol=2e-2, atol=2e-3)
+
+    def test_heads_divisibility_required(self):
+        from veles_tpu.ops.attention import make_ulysses_attention
+
+        q, k, v = _qkv(b=1, t=64, h=6, d=8)  # 6 heads, 8 devices
+        mesh = build_mesh(data=1, seq=8)
+        ulysses = make_ulysses_attention(mesh)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses(q, k, v)

@@ -117,3 +117,49 @@ def make_ring_attention(mesh, axis_name="seq", causal=False):
     return jax.jit(jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))
+
+
+# -- Ulysses (all-to-all) sequence parallelism --------------------------------
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses pattern)
+    inside shard_map: ``q/k/v`` are LOCAL sequence blocks
+    (B, T_local, H, D). One ``all_to_all`` swaps the sequence sharding
+    for HEAD sharding — each device then holds the FULL sequence for
+    ``H / axis_size`` heads and runs ordinary fused attention locally —
+    and the inverse all_to_all restores the sequence layout.
+
+    Trade-off vs :func:`ring_attention`: four collectives per call
+    (q/k/v in, output back) instead of ``2 * axis_size`` ppermute
+    rounds (better for fat ICI all-to-all and moderate sequence
+    lengths), but it requires
+    ``heads % axis_size == 0`` and materializes the full sequence per
+    device for its head slice (HBM scales with T, not T/n)."""
+    n = lax.axis_size(axis_name)
+    heads = q.shape[2]
+    if heads % n:
+        raise ValueError("ulysses needs heads (%d) divisible by the "
+                         "%r axis size (%d)" % (heads, axis_name, n))
+
+    def seq_to_heads(x):  # (B, T/n, H, D) -> (B, T, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    out = attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+                    causal=causal, scale=scale)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def make_ulysses_attention(mesh, axis_name="seq", causal=False):
+    """shard_map-wrapped Ulysses attention over ``mesh``: takes/returns
+    sequence-sharded (B, T, H, D) arrays (same contract as
+    :func:`make_ring_attention` — the two are drop-in alternatives)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
