@@ -144,6 +144,33 @@ class TestSequenceParallelTraining:
                                           numpy.asarray(b),
                                           rtol=1e-4, atol=1e-5)
 
+    def test_ring_strategy_matches_ulysses(self):
+        """Ring attention is scan-based and differentiable: a ring-SP
+        train step must match the Ulysses one on identical inputs."""
+        from veles_tpu.parallel.mesh import build_mesh
+        from veles_tpu.parallel.transformer_step import (
+            build_transformer_train_step, init_transformer_params,
+            shard_tokens)
+
+        rng, x, labels = self._data(seed=5)
+        params = init_transformer_params(rng, n_blocks=1, embed=16,
+                                         heads=4, vocab=11)
+        mesh = build_mesh(data=2, seq=4)
+        xs, ls = shard_tokens([x, labels], mesh)
+        outs = {}
+        for strategy in ("ulysses", "ring"):
+            step = build_transformer_train_step(heads=4, mesh=mesh,
+                                                sp_strategy=strategy)
+            outs[strategy] = step(params, xs, ls)
+        pu, (lu, eu) = outs["ulysses"]
+        pr, (lr, er) = outs["ring"]
+        assert float(lu) == pytest.approx(float(lr), rel=1e-4)
+        assert int(eu) == int(er)
+        for a, b in zip(jax.tree.leaves(pu), jax.tree.leaves(pr)):
+            numpy.testing.assert_allclose(
+                numpy.asarray(a), numpy.asarray(b), rtol=1e-3,
+                atol=1e-4)
+
     def test_training_reduces_loss(self):
         from veles_tpu.parallel.mesh import build_mesh
         from veles_tpu.parallel.transformer_step import (

@@ -79,11 +79,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
 
     batch, _, heads, _ = q.shape
     o = jnp.zeros(q.shape, jnp.float32)
-    m = jnp.full((batch, heads, t_local), -jnp.inf, jnp.float32)
+    m = jnp.full((batch, heads, t_local), mask_value, jnp.float32)
     l = jnp.zeros((batch, heads, t_local), jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def body(step, carry):
+    def body(carry, step):
         o, m, l, k_blk, v_blk = carry
         src_index = (my_index - step) % axis_size
         kv_pos = src_index * t_local + jnp.arange(t_local)
@@ -99,9 +99,15 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         # rotate K/V around the ring (overlaps with next block's compute)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return o, m_new, l, k_blk, v_blk
+        return (o, m_new, l, k_blk, v_blk), None
 
-    o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
+    # lax.scan, not fori_loop: scan is reverse-differentiable, so ring
+    # attention works inside jax.grad (ring-parallel TRAINING) at the
+    # cost of per-step residuals. The running max starts at mask_value
+    # (not -inf): a -inf start makes exp(m - m_new) produce inf*0=nan
+    # in the backward pass for fully-masked first blocks.
+    (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v),
+                                  jnp.arange(axis_size))
     l = jnp.maximum(l, 1e-20)  # fully-masked rows (causal first block)
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 

@@ -3,12 +3,12 @@
 
 The long-context training integration: activations are sharded over BOTH
 the batch (``data``) and the sequence (``seq``) axes; attention runs
-sequence-parallel via :func:`veles_tpu.ops.attention.ulysses_attention`
-(the all-to-all strategy — chosen for training because it is plain
-differentiable composition, whereas the ring's ``fori_loop`` online
-softmax is a forward-only construct); every other sublayer (layer norm,
-MLP, residuals, the per-token head) is token-local, so only the
-attention pays collectives. Gradients ``psum`` over both axes.
+sequence-parallel via either SP strategy — Ulysses all-to-all (default;
+plain differentiable composition) or ring attention (``lax.scan``-based
+online softmax, reverse-differentiable, HBM per device scales with T/n);
+every other sublayer (layer norm, MLP, residuals, the per-token head) is
+token-local, so only the attention pays collectives. Gradients ``psum``
+over both axes.
 
 No reference counterpart (VELES predates attention; SURVEY §5
 "Long-context: absent") — this is the additive tier the build brief makes
@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from veles_tpu.ops.attention import attention, ulysses_attention
+from veles_tpu.ops.attention import (attention, ring_attention,
+                                     ulysses_attention)
 
 
 def init_transformer_params(rng, n_blocks, embed, heads, vocab,
@@ -54,7 +55,7 @@ def _ln(x, w, b, eps=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
 
 
-def _forward(params, x, heads, seq_ax):
+def _forward(params, x, heads, seq_ax, sp_strategy):
     batch, t, embed = x.shape
     head_dim = embed // heads
     for blk in params["blocks"]:
@@ -63,7 +64,9 @@ def _forward(params, x, heads, seq_ax):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (batch, t, heads, head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
-        if seq_ax > 1:
+        if seq_ax > 1 and sp_strategy == "ring":
+            att = ring_attention(q, k, v, "seq", causal=True)
+        elif seq_ax > 1:
             att = ulysses_attention(q, k, v, "seq", causal=True)
         else:
             att = attention(q, k, v, causal=True)
@@ -74,10 +77,15 @@ def _forward(params, x, heads, seq_ax):
     return _ln(x, params["lnf_w"], params["lnf_b"]) @ params["head"]
 
 
-def build_transformer_train_step(heads, mesh=None, learning_rate=0.1):
+def build_transformer_train_step(heads, mesh=None, learning_rate=0.1,
+                                 sp_strategy="ulysses"):
     """Compile ``step(params, x, labels) -> (params, (loss, n_err))``:
     per-token causal-LM softmax xent, SGD update. With a mesh, ``x`` and
-    ``labels`` shard over (data, seq) and gradients psum over both."""
+    ``labels`` shard over (data, seq) and gradients psum over both;
+    ``sp_strategy`` picks "ulysses" (all-to-all) or "ring" attention."""
+    if sp_strategy not in ("ulysses", "ring"):
+        raise ValueError("sp_strategy must be 'ulysses' or 'ring', got %r"
+                         % (sp_strategy,))
     data_ax = mesh.shape.get("data", 1) if mesh is not None else 1
     seq_ax = mesh.shape.get("seq", 1) if mesh is not None else 1
 
@@ -87,7 +95,7 @@ def build_transformer_train_step(heads, mesh=None, learning_rate=0.1):
             x.shape[0] * x.shape[1] * data_ax * seq_ax)
 
         def loss_fn(params):
-            logits = _forward(params, x, heads, seq_ax)
+            logits = _forward(params, x, heads, seq_ax, sp_strategy)
             logp = jax.nn.log_softmax(logits, axis=-1)
             picked = jnp.take_along_axis(
                 logp, labels[..., None], axis=-1)[..., 0]
