@@ -209,7 +209,12 @@ _apply_site_overrides()
 
 def _enable_xla_compilation_cache():
     """Point jax at the persistent compilation cache directory. Must run
-    before the first compilation; importing veles_tpu does it."""
+    before the first compilation; importing veles_tpu does it.
+    ``VELES_TPU_NO_XLA_CACHE=1`` opts out (e.g. the multichip dryrun's
+    virtual-CPU child, where AOT entries compiled for other machine
+    types spam feature-mismatch warnings)."""
+    if os.environ.get("VELES_TPU_NO_XLA_CACHE"):
+        return
     try:
         import jax
         path = root.common.dirs.get("xla_cache")
