@@ -281,3 +281,74 @@ class TestConvnetEndToEnd:
         best = wf.decision.best_n_err[1]
         assert best is not None and best <= 4, \
             "convnet at %s/16 validation errors" % best
+
+
+class TestFusedAugmentation:
+    """In-jit mirror augmentation ON the fused path: the tick applies
+    the loader's transform itself, seeded identically to graph mode."""
+
+    def _build(self, image_tree, fused):
+        from veles_tpu.core import prng
+        from veles_tpu.models.standard import StandardWorkflow
+
+        prng.get("default").seed(42)
+        prng.get("loader").seed(24)
+        return StandardWorkflow(
+            DummyLauncher(),
+            loader_cls=AutoLabelFileImageLoader,
+            loader_kwargs=dict(
+                train_paths=[str(image_tree / "train")],
+                validation_paths=[str(image_tree / "validation")],
+                size=(12, 12), minibatch_size=8, mirror="random",
+                normalization_type="internal_mean"),
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 2},
+            ],
+            learning_rate=0.05, fused=fused,
+            decision_kwargs=dict(max_epochs=3), name="aug-fused")
+
+    def test_mirror_loader_fuses_and_matches_graph_mode(self, image_tree):
+        """If the fused tick silently dropped the augmentation, the
+        graph run (which DOES augment) would diverge — this identity IS
+        the dead-augmentation guard."""
+        graph = self._build(image_tree, fused=False)
+        graph.initialize()
+        assert graph.fused_tick is None, "fused=False must not splice"
+        graph.run()
+
+        fused = self._build(image_tree, fused=True)
+        fused.initialize()
+        assert fused.fused_tick is not None, \
+            "mirror loader must fuse now (jit_transform)"
+        fused.run()
+        # identical seeds -> identical augmentation -> identical metrics
+        assert fused.decision.best_n_err[1] == graph.decision.best_n_err[1]
+        assert fused.decision.last_epoch_n_err == \
+            graph.decision.last_epoch_n_err
+        numpy.testing.assert_allclose(
+            numpy.asarray(fused.forwards[0].weights.data),
+            numpy.asarray(graph.forwards[0].weights.data), atol=2e-2)
+
+    def test_shared_mirror_math(self):
+        """Both engines trace ops.augment.mirror_batch: check its
+        semantics directly — per-sample flip over the W axis, seeded."""
+        from veles_tpu.ops.augment import mirror_batch
+
+        rng = numpy.random.RandomState(0)
+        batch = rng.rand(16, 4, 6, 3).astype(numpy.float32)
+        out = numpy.asarray(mirror_batch(batch, 7))
+        flipped = batch[:, :, ::-1]
+        per_sample = [numpy.array_equal(out[i], flipped[i])
+                      or numpy.array_equal(out[i], batch[i])
+                      for i in range(16)]
+        assert all(per_sample), "samples must be kept or W-flipped"
+        n_flipped = sum(numpy.array_equal(out[i], flipped[i])
+                        and not numpy.array_equal(out[i], batch[i])
+                        for i in range(16))
+        assert 0 < n_flipped < 16, "seeded bernoulli must mix"
+        # deterministic per seed, different across seeds
+        numpy.testing.assert_array_equal(
+            out, numpy.asarray(mirror_batch(batch, 7)))
+        assert not numpy.array_equal(
+            out, numpy.asarray(mirror_batch(batch, 8)))

@@ -384,6 +384,14 @@ class Loader(Unit):
                                 last_of_epoch, epoch)
             self.minibatch_indices.data = matrix
             self.sweep_valid_sizes = valid_sizes
+            # per-minibatch augmentation seeds for the fused tick, drawn
+            # in the same stream order graph mode would (one per TRAIN
+            # minibatch at fill time)
+            if klass == TRAIN and getattr(self, "jit_transform", None):
+                self.sweep_transform_seeds = self.draw_transform_seeds(
+                    len(matrix))
+            else:
+                self.sweep_transform_seeds = None
             self._account_served(total, last_of_epoch)
             return
         (klass, indices, valid, last_of_class,
@@ -422,6 +430,11 @@ class Loader(Unit):
             # the loader only publishes the served indices (host numpy —
             # the transfer rides the fused step's dispatch)
             self.minibatch_indices.data = padded
+            if klass == TRAIN and getattr(self, "jit_transform", None):
+                self.minibatch_transform_seed = int(
+                    self.draw_transform_seeds(1)[0])
+            else:
+                self.minibatch_transform_seed = 0
         self._account_served(valid, last_of_epoch)
 
     def _pad_indices(self, indices):

@@ -20,9 +20,13 @@ TPU design decisions:
   fixed distortion once per epoch; transforming in-jit costs zero extra
   HBM and samples fresh distortions forever.
 
-Loaders that declare in-fill transforms set ``has_fill_transforms`` so the
-fused-tick engine (whose gather skips ``fill_minibatch``) declines and the
-graph path — which does run the transform — executes instead.
+Loaders that declare in-fill transforms set ``has_fill_transforms``; when
+the transform is one the fused engine replicates in-tick
+(``jit_transform`` — currently the random mirror, via the SHARED
+``ops.augment.mirror_batch``), fusion stays on with loader-drawn seeds
+and identical numerics; any other fill-time transform makes the fused
+engine decline so the graph path — which does run the transform —
+executes instead.
 """
 
 import numpy
@@ -146,10 +150,26 @@ class FullBatchImageLoader(FullBatchLoader):
         super().__init__(workflow, **kwargs)
 
     #: the fused tick's in-XLA gather bypasses fill_minibatch; loaders
-    #: with fill-time transforms must run the graph path
+    #: with fill-time transforms must run the graph path — UNLESS the
+    #: transform is one the fused engine replicates in-tick
+    #: (``jit_transform``), in which case fusion stays on
     @property
     def has_fill_transforms(self):
         return self.mirror == "random"
+
+    @property
+    def jit_transform(self):
+        """Name of the fill transform the fused tick can apply itself
+        (seeded identically, so fused == graph numerics)."""
+        return "mirror" if self.mirror == "random" else None
+
+    def draw_transform_seeds(self, n):
+        """``n`` augmentation seeds in the SAME stream order graph-mode
+        ``fill_minibatch`` draws them — one per TRAIN minibatch."""
+        gen = prng.get(self.prng_key)
+        return numpy.asarray(
+            [int(gen.randint(0, 2 ** 31 - 1)) for _ in range(n)],
+            numpy.int64)
 
     # -- image source contract ----------------------------------------------
     def get_keys(self, klass):
@@ -219,20 +239,14 @@ class FullBatchImageLoader(FullBatchLoader):
     @property
     def _augment_jit(self):
         if self._augment_jit_ is None:
-            @jax.jit
-            def augment(batch, seed):
-                key = jax.random.key(seed)
-                flip = jax.random.bernoulli(key, 0.5, (batch.shape[0],))
-                mirrored = jnp.flip(batch, axis=2)  # horizontal (W axis)
-                return jnp.where(flip[:, None, None, None], mirrored, batch)
-
-            self._augment_jit_ = augment
+            from veles_tpu.ops.augment import mirror_batch
+            self._augment_jit_ = jax.jit(mirror_batch)
         return self._augment_jit_
 
     def fill_minibatch(self, indices, valid):
         super().fill_minibatch(indices, valid)
         if self.mirror == "random" and self.minibatch_class == TRAIN:
-            seed = int(prng.get(self.prng_key).randint(0, 2 ** 31 - 1))
+            seed = int(self.draw_transform_seeds(1)[0])
             self.minibatch_data.data = self._augment_jit(
                 self.minibatch_data.data, seed)
 

@@ -1,0 +1,20 @@
+"""In-jit data augmentation transforms.
+
+Single source of the augmentation math: BOTH execution engines — the
+graph path's ``FullBatchImageLoader._augment_jit`` and the fused tick's
+``apply_augment`` — trace these functions, so "fused == graph numerics"
+is structural, not a comment to keep in sync.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def mirror_batch(batch, seed):
+    """Per-sample random horizontal mirror of an NHWC batch, keyed by a
+    scalar ``seed`` (the loader draws seeds host-side in graph-mode
+    order; replaces the reference's N-fold ``samples_inflation``)."""
+    key = jax.random.key(seed)
+    flip = jax.random.bernoulli(key, 0.5, (batch.shape[0],))
+    mirrored = jnp.flip(batch, axis=2)  # horizontal (W axis)
+    return jnp.where(flip[:, None, None, None], mirrored, batch)

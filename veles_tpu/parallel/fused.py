@@ -220,17 +220,21 @@ _TICK_CACHE = {}
 
 
 def build_tick(specs, norm_type="none", mesh=None,
-               with_confusion=True):
+               with_confusion=True, augment="none"):
     """Compile the fused engine.
 
     Returns ``(train_step, eval_step, train_sweep, eval_sweep)``:
 
-    - ``train_step(params, hypers, norm, data, labels, indices, valid) ->
-      (params, (loss, n_err))`` — one minibatch: gather → normalize →
-      forward → masked softmax xent → grad → per-layer momentum/decay
-      update. ``hypers`` (per-layer 5-vectors from :func:`get_hypers`)
-      and ``norm`` (normalizer-state dict) are traced inputs so annealing
-      and dataset changes never retrace;
+    - ``train_step(params, hypers, norm, data, labels, indices, valid,
+      seed) -> (params, (loss, n_err))`` — one minibatch: gather →
+      normalize → [augment] → forward → masked softmax xent → grad →
+      per-layer momentum/decay update. ``hypers`` (per-layer 5-vectors
+      from :func:`get_hypers`) and ``norm`` (normalizer-state dict) are
+      traced inputs so annealing and dataset changes never retrace;
+      ``augment="mirror"`` applies the loader's in-jit random-mirror
+      transform to TRAIN batches, keyed by the loader-drawn ``seed`` —
+      the exact math of ``FullBatchImageLoader._augment_jit``, so fused
+      and graph mode stay numerically identical;
     - ``eval_step(params, norm, data, labels, indices, valid) ->
       (loss, n_err)`` — forward + metrics only (VALID/TEST sweeps, GD
       skipped exactly as the Decision unit's ``gd_skipped`` gate does in
@@ -243,7 +247,7 @@ def build_tick(specs, norm_type="none", mesh=None,
       class per epoch instead of one per minibatch;
     - ``eval_sweep(...)`` likewise without updates.
     """
-    key = (_freeze(specs), norm_type, with_confusion,
+    key = (_freeze(specs), norm_type, with_confusion, augment,
            None if mesh is None else id(mesh))
     cached = _TICK_CACHE.get(key)
     if cached is not None:
@@ -258,6 +262,14 @@ def build_tick(specs, norm_type="none", mesh=None,
     def gather_norm(data, labels, indices, norm):
         batch, lab = gather_minibatch(data, indices, labels)
         return norm_cls.apply_state(jnp, batch, norm), lab
+
+    def apply_augment(batch, seed):
+        if augment != "mirror":
+            return batch
+        # the SAME traced function the graph path jits — numeric parity
+        # with FullBatchImageLoader.fill_minibatch is structural
+        from veles_tpu.ops.augment import mirror_batch
+        return mirror_batch(batch, seed)
 
     def model_forward(wb, x):
         for fwd, p in zip(layer_fwds, wb):
@@ -278,8 +290,10 @@ def build_tick(specs, norm_type="none", mesh=None,
 
     # cores return the UNNORMALIZED loss_sum; wrappers divide by the
     # relevant valid count (per minibatch or per sweep)
-    def core_train(params, hypers, norm, data, labels, indices, valid):
+    def core_train(params, hypers, norm, data, labels, indices, valid,
+                   seed):
         batch, lab = gather_norm(data, labels, indices, norm)
+        batch = apply_augment(batch, seed)
         mask = local_mask(indices.shape[0], valid)
         wb = [p["p"] if p else {} for p in params]
 
@@ -330,9 +344,10 @@ def build_tick(specs, norm_type="none", mesh=None,
             cm = lax.psum(cm, "data")
         return loss_sum, n_err, cm
 
-    def local_train(params, hypers, norm, data, labels, indices, valid):
+    def local_train(params, hypers, norm, data, labels, indices, valid,
+                    seed):
         new, (loss_sum, n_err) = core_train(params, hypers, norm, data,
-                                            labels, indices, valid)
+                                            labels, indices, valid, seed)
         return new, (loss_sum / valid, n_err)
 
     def local_eval(params, norm, data, labels, indices, valid):
@@ -341,16 +356,17 @@ def build_tick(specs, norm_type="none", mesh=None,
         return loss_sum / valid, n_err, cm
 
     def local_train_sweep(params, hypers, norm, data, labels,
-                          index_matrix, valid_sizes, total_valid):
+                          index_matrix, valid_sizes, total_valid,
+                          seeds):
         def body(carry, xs):
-            indices, valid = xs
+            indices, valid, seed = xs
             new, (loss_sum, n_err) = core_train(
                 carry, hypers, norm, data, labels, indices,
-                valid.astype(jnp.float32))
+                valid.astype(jnp.float32), seed)
             return new, (loss_sum, n_err)
 
         params, (loss_sums, n_errs) = lax.scan(
-            body, params, (index_matrix, valid_sizes))
+            body, params, (index_matrix, valid_sizes, seeds))
         return params, (jnp.sum(loss_sums) / total_valid,
                         jnp.sum(n_errs))
 
@@ -374,9 +390,9 @@ def build_tick(specs, norm_type="none", mesh=None,
         _TICK_CACHE[key] = steps
         return steps
     eval_specs = (P(), P(), P(), P(), P("data"), P())
-    train_specs = (P(),) + eval_specs
+    train_specs = (P(),) + eval_specs + (P(),)  # + seed
     eval_sweep_specs = (P(), P(), P(), P(), P(None, "data"), P(), P())
-    train_sweep_specs = (P(),) + eval_sweep_specs
+    train_sweep_specs = (P(),) + eval_sweep_specs + (P(),)  # + seeds
     train = jax.shard_map(local_train, mesh=mesh, in_specs=train_specs,
                           out_specs=(P(), (P(), P())), check_vma=False)
     evaluate = jax.shard_map(local_eval, mesh=mesh, in_specs=eval_specs,
@@ -404,9 +420,13 @@ def supports(workflow, mesh=None):
     if not isinstance(loader, FullBatchLoader) or not loader.on_device:
         return False
     if getattr(loader, "has_fill_transforms", False):
-        # the fused gather bypasses fill_minibatch, which would silently
-        # drop the loader's augmentation (e.g. random mirror)
-        return False
+        # the fused gather bypasses fill_minibatch — fusion stays on
+        # only for transforms the tick replicates in-jit itself
+        # (single-device: per-sample randomness draws over the GLOBAL
+        # minibatch, which a data-sharded tick could not reproduce)
+        if getattr(loader, "jit_transform", None) != "mirror" \
+                or mesh is not None:
+            return False
     if not isinstance(getattr(workflow, "evaluator", None),
                       EvaluatorSoftmax):
         return False
@@ -498,7 +518,8 @@ class FusedTick(Unit):
         self._steps_ = build_tick(
             self._specs_, loader.normalization_type, self.mesh_,
             with_confusion=getattr(wf.evaluator, "compute_confusion",
-                                   True))
+                                   True),
+            augment=getattr(loader, "jit_transform", None) or "none")
 
     def run(self):
         import numpy
@@ -520,17 +541,22 @@ class FusedTick(Unit):
         if getattr(loader, "sweep_serving", False):
             sizes = loader.sweep_valid_sizes
             if training:
+                seeds = getattr(loader, "sweep_transform_seeds", None)
+                if seeds is None:
+                    seeds = numpy.zeros(len(sizes), numpy.int64)
                 self._params_, (loss, n_err) = train_sweep(
                     self._params_, get_hypers(wf), norm, data, labels,
-                    indices, sizes, valid)
+                    indices, sizes, valid, seeds)
             else:
                 loss, n_err, cm = eval_sweep(self._params_, norm, data,
                                              labels, indices, sizes,
                                              valid)
         elif training:
+            seed = numpy.int64(getattr(loader, "minibatch_transform_seed",
+                                       0))
             self._params_, (loss, n_err) = train_step(
                 self._params_, get_hypers(wf), norm, data, labels,
-                indices, valid)
+                indices, valid, seed)
         else:
             loss, n_err, cm = eval_step(self._params_, norm, data,
                                         labels, indices, valid)
