@@ -204,3 +204,125 @@ def test_native_causal_attention(native_lib, tmp_path):
         -1, keepdims=True)
     numpy.testing.assert_allclose(native_out, jax_probs, rtol=2e-2,
                                   atol=2e-4)
+
+
+class TestMalformedPackages:
+    """The runtime consumes arbitrary packages: malformed input must
+    produce a clean Python error (the C API catches std::exception),
+    never a crash or an out-of-bounds read."""
+
+    def _load(self, path):
+        from veles_tpu.inference import NativeWorkflow
+        return NativeWorkflow(path)
+
+    def _tar_with(self, tmp_path, members):
+        path = str(tmp_path / "pkg.tar")
+        with tarfile.open(path, "w") as tar:
+            for name, payload in members.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+        return path
+
+    @staticmethod
+    def _all2all_contents():
+        """One shared minimal all2all package manifest — the schema under
+        test lives in one place."""
+        import json
+        return json.dumps({
+            "workflow": "x", "input_shape": [4],
+            "units": [{"name": "u0", "type": "all2all",
+                       "config": {"activation": "tanh",
+                                  "out_features": 2},
+                       "arrays": {"weights": "@w.npy",
+                                  "bias": "@b.npy"}}]}).encode()
+
+    def test_not_a_tar(self, native_lib, tmp_path):
+        bad = tmp_path / "junk.tar"
+        bad.write_bytes(os.urandom(512))
+        with pytest.raises(RuntimeError):
+            self._load(str(bad))
+
+    def test_missing_contents(self, native_lib, tmp_path):
+        path = self._tar_with(tmp_path, {"other.npy": b"\x00" * 16})
+        with pytest.raises(RuntimeError):
+            self._load(path)
+
+    def test_broken_json(self, native_lib, tmp_path):
+        path = self._tar_with(tmp_path, {"contents.json": b"{unclosed"})
+        with pytest.raises(RuntimeError):
+            self._load(path)
+
+    def test_unknown_unit_type(self, native_lib, tmp_path):
+        import json
+        contents = json.dumps({
+            "workflow": "x", "input_shape": [4],
+            "units": [{"name": "u0", "type": "quantum_flux",
+                       "config": {}, "arrays": {}}]}).encode()
+        path = self._tar_with(tmp_path, {"contents.json": contents})
+        with pytest.raises(RuntimeError, match="quantum_flux"):
+            self._load(path)
+
+    def test_missing_array_member(self, native_lib, tmp_path):
+        path = self._tar_with(
+            tmp_path, {"contents.json": self._all2all_contents()})
+        with pytest.raises(RuntimeError):
+            self._load(path)
+
+    def test_truncated_npy(self, native_lib, tmp_path):
+        path = self._tar_with(tmp_path, {
+            "contents.json": self._all2all_contents(),
+            "w.npy": b"\x93NUMPY garbage",
+            "b.npy": b"\x00" * 8})
+        with pytest.raises(RuntimeError):
+            self._load(path)
+
+    def test_shape_mismatch_rejected(self, native_lib, tmp_path):
+        """weights rows != input size must throw at load/infer time."""
+        def npy(arr):
+            buf = io.BytesIO()
+            numpy.save(buf, arr)
+            return buf.getvalue()
+
+        path = self._tar_with(tmp_path, {
+            "contents.json": self._all2all_contents(),
+            "w.npy": npy(numpy.zeros((7, 2), numpy.float32)),  # 7 != 4
+            "b.npy": npy(numpy.zeros(2, numpy.float32))})
+        with pytest.raises(RuntimeError):
+            self._load(path)
+
+    def test_random_mutations_never_crash(self, native_lib, tmp_path):
+        """Byte-flip fuzzing of a VALID package: every mutation loads
+        or errors cleanly (no SIGSEGV/SIGFPE would mean pytest dies)."""
+        from sklearn.datasets import load_digits
+        d = load_digits()
+        X = d.data.astype(numpy.float32)[:60]
+        y = d.target.astype(numpy.int32)[:60]
+        wf = MLPWorkflow(
+            DummyLauncher(), layers=(4, 10),
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 10, 50],
+                               minibatch_size=10),
+            learning_rate=0.1, max_epochs=1, name="fuzz-base")
+        wf.initialize()
+        wf.run()
+        base = str(tmp_path / "base.tar")
+        package_export(wf, base)
+        assert self._load(base).unit_count == 2  # the base itself loads
+        blob = bytearray(open(base, "rb").read())
+        rng = numpy.random.RandomState(0)
+        outcomes = {"loaded": 0, "rejected": 0}
+        for trial in range(40):
+            mutated = bytearray(blob)
+            for _ in range(rng.randint(1, 8)):
+                mutated[rng.randint(0, len(mutated))] = rng.randint(256)
+            path = str(tmp_path / "mut.tar")
+            open(path, "wb").write(bytes(mutated))
+            try:
+                self._load(path)
+                outcomes["loaded"] += 1  # harmless flip (padding bytes)
+            except RuntimeError:
+                outcomes["rejected"] += 1
+        # reaching here alive is the crash-free property; every mutation
+        # must have resolved to exactly one clean outcome
+        assert outcomes["loaded"] + outcomes["rejected"] == 40

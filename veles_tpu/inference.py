@@ -79,8 +79,9 @@ class NativeWorkflow:
         self._handle = lib.veles_rt_load(
             os.fsencode(os.path.abspath(package_path)))
         if not self._handle:
-            raise RuntimeError("native load failed: %s"
-                               % lib.veles_rt_last_error().decode())
+            raise RuntimeError(
+                "native load failed: %s"
+                % lib.veles_rt_last_error().decode(errors="replace"))
         self.input_size = lib.veles_rt_input_size(self._handle)
         self.output_size = lib.veles_rt_output_size(self._handle)
         self.unit_count = lib.veles_rt_unit_count(self._handle)
@@ -100,8 +101,9 @@ class NativeWorkflow:
             flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if rc != 0:
-            raise RuntimeError("native run failed: %s"
-                               % self._lib.veles_rt_last_error().decode())
+            raise RuntimeError(
+                "native run failed: %s"
+                % self._lib.veles_rt_last_error().decode(errors="replace"))
         return out
 
     def __del__(self):

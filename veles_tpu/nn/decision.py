@@ -117,8 +117,13 @@ class DecisionGD(Unit):
                 self._drain_epochs()
             return
         # one sample-class sweep finished: sync its accumulators to host
-        self.epoch_n_err[klass] = int(self.epoch_n_err[klass])
-        self.epoch_loss[klass] = float(self.epoch_loss[klass])
+        # in ONE batched transfer (sequential int()/float() reads pay a
+        # device round trip each)
+        import jax
+        n_err, loss = jax.device_get((self.epoch_n_err[klass],
+                                      self.epoch_loss[klass]))
+        self.epoch_n_err[klass] = int(n_err)
+        self.epoch_loss[klass] = float(loss)
         self._on_class_ended(klass)
         if self.loader.epoch_ended:
             self._on_epoch_ended()
