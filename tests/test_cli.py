@@ -178,3 +178,18 @@ def test_snapshot_resume_from_url(tmp_path):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+@pytest.mark.slow
+def test_profile_flag_writes_trace(tmp_path):
+    """--profile captures a jax profiler trace of the run (the timeline
+    role of the reference's Mongo event spans, done the TPU way)."""
+    trace_dir = str(tmp_path / "trace")
+    proc = run_cli("samples/digits_mlp.py", "samples/digits_config.py",
+                   "root.digits.max_epochs=1", "--profile", trace_dir)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    found = []
+    for base, _, files in os.walk(trace_dir):
+        found.extend(f for f in files
+                     if f.endswith((".xplane.pb", ".json.gz")))
+    assert found, "no trace artifacts under %s" % trace_dir

@@ -319,9 +319,12 @@ class TestMalformedPackages:
             path = str(tmp_path / "mut.tar")
             open(path, "wb").write(bytes(mutated))
             try:
-                self._load(path)
+                # a mutant that loads must also RUN cleanly: payload
+                # flips that dodge the shape checks exercise inference
+                rt = self._load(path)
+                rt.run(X[:2])
                 outcomes["loaded"] += 1  # harmless flip (padding bytes)
-            except RuntimeError:
+            except (RuntimeError, ValueError):
                 outcomes["rejected"] += 1
         # reaching here alive is the crash-free property; every mutation
         # must have resolved to exactly one clean outcome

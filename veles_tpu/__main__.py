@@ -38,6 +38,7 @@ class Main(Logger):
         self.snapshot_path = None
         self.visualize = None
         self.dump_unit_attributes = False
+        self.profile_dir = None
 
     @staticmethod
     def init_parser():
@@ -100,6 +101,9 @@ class Main(Logger):
         parser.add_argument("--dump-unit-attributes", action="store_true",
                             help="print every unit's post-init state as "
                                  "JSON lines")
+        parser.add_argument("--profile", default=None, metavar="DIR",
+                            help="capture a jax profiler trace of the "
+                                 "run (view in TensorBoard/Perfetto)")
         parser.add_argument("--dump-config", action="store_true")
         parser.add_argument("-b", "--background", action="store_true",
                             help="daemonize: run detached with stdio "
@@ -236,7 +240,17 @@ class Main(Logger):
             self._dump_unit_attributes()
         if self.dry_run == "init":
             return
-        self.launcher.run()
+        if self.profile_dir:
+            # device-level timeline (the reference's Mongo event spans /
+            # web timeline role, done the TPU way): a jax profiler trace
+            # viewable in TensorBoard / Perfetto
+            import jax
+            self.info("profiling to %s (open with tensorboard or "
+                      "ui.perfetto.dev)", self.profile_dir)
+            with jax.profiler.trace(self.profile_dir):
+                self.launcher.run()
+        else:
+            self.launcher.run()
         self.launcher.stop()
 
     def _dump_unit_attributes(self):
@@ -267,6 +281,7 @@ class Main(Logger):
         self.snapshot_path = self._resolve_snapshot(args.snapshot)
         self.visualize = args.visualize
         self.dump_unit_attributes = args.dump_unit_attributes
+        self.profile_dir = args.profile
         # module FIRST (its import-time root.* updates are defaults), then
         # the config file, then CLI overrides — the reference's layering
         # (__main__.py:396,426-481)
