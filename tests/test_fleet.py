@@ -76,7 +76,7 @@ class TestProtocol:
         msg = {"type": "job", "job": [numpy.arange(5), {"a": 1}]}
         frame = encode_frame(msg, KEY)
         from veles_tpu.fleet.protocol import read_frame
-        out = asyncio.get_event_loop().run_until_complete(
+        out = asyncio.run(
             read_frame(FakeReader(frame), KEY))
         assert out["type"] == "job"
         numpy.testing.assert_array_equal(out["job"][0], numpy.arange(5))
@@ -92,7 +92,7 @@ class TestProtocol:
         from veles_tpu.fleet.protocol import ProtocolError, read_frame
         frame = encode_frame({"type": "hello"}, b"attacker-key")
         with pytest.raises(ProtocolError):
-            asyncio.get_event_loop().run_until_complete(
+            asyncio.run(
                 read_frame(FakeReader(frame), KEY))
 
     def test_tampered_frame_rejected(self):
@@ -100,7 +100,7 @@ class TestProtocol:
         frame = bytearray(encode_frame({"type": "hello"}, KEY))
         frame[-1] ^= 0xFF
         with pytest.raises(ProtocolError):
-            asyncio.get_event_loop().run_until_complete(
+            asyncio.run(
                 read_frame(FakeReader(bytes(frame)), KEY))
 
     def test_secret_defaults_to_workflow_checksum(self, monkeypatch):
@@ -127,7 +127,7 @@ class TestSharedIO:
 
     def _read(self, frame):
         from veles_tpu.fleet.protocol import read_frame
-        return asyncio.get_event_loop().run_until_complete(
+        return asyncio.run(
             read_frame(FakeReader(frame), KEY))
 
     @staticmethod
