@@ -74,3 +74,61 @@ def test_cli_master_slave_roundtrip(tmp_path):
     results = json.loads(result_file.read_text())
     assert results["epochs"] == 2
     assert results["best_validation_errors"] is not None
+
+
+@pytest.mark.slow
+def test_cli_nodes_spawns_local_slave(tmp_path):
+    """-n localhost: the master spawns its own slave at startup
+    (reference SSH slave launch; localhost runs a detached subprocess)
+    and training completes without any manual slave invocation."""
+    wf_file = tmp_path / "wf.py"
+    wf_file.write_text(WF)
+    result_file = tmp_path / "res.json"
+    import socket
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    master = subprocess.Popen(
+        [sys.executable, "-m", "veles_tpu", str(wf_file), "-",
+         "-l", "127.0.0.1:%d" % port, "-n", "localhost",
+         "--result-file", str(result_file)],
+        env=_env(), stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        cwd=str(tmp_path))
+    try:
+        assert master.wait(timeout=240) == 0
+    finally:
+        if master.poll() is None:
+            master.kill()
+    results = json.loads(result_file.read_text())
+    assert results["epochs"] == 2
+
+
+def test_nodes_argv_transform_edge_forms():
+    """The master->slave argv transform must strip --opt=value and fused
+    -lVALUE forms too — a surviving --listen would make the 'slave' a
+    second master that recursively spawns and never connects."""
+    from unittest import mock
+    from veles_tpu.launcher import Launcher
+
+    lau = Launcher(listen_address="127.0.0.1:0", nodes=["localhost"])
+
+    class FakeAgent:
+        host, port = "127.0.0.1", 5050
+
+    lau.agent = FakeAgent()
+    with mock.patch("veles_tpu.fleet.respawn.respawn_recipe") as rec, \
+            mock.patch("veles_tpu.fleet.respawn.default_spawner") as sp:
+        rec.return_value = {
+            "executable": "/usr/bin/python3",
+            "argv": ["-m", "veles_tpu", "wf.py", "--listen=0.0.0.0:5050",
+                     "--nodes=host1", "-l127.0.0.1:1",
+                     "--result-file=r.json", "--respawn", "-b"],
+            "cwd": "/tmp", "pythonpath": ""}
+        lau._launch_nodes()
+        cmd = sp.call_args[0][1]
+    assert "--listen" not in cmd and "--nodes" not in cmd
+    assert "-l127" not in cmd and "--result-file" not in cmd
+    # --respawn KEPT (the slave must ship its relaunch recipe); -b
+    # dropped (the spawner already detaches)
+    assert "--respawn" in cmd and " -b" not in cmd
+    assert cmd.endswith("-m 127.0.0.1:5050")

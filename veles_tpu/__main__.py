@@ -87,6 +87,11 @@ class Main(Logger):
                                  "ensemble")
         parser.add_argument("--async-slave", action="store_true",
                             help="pipelined slave mode")
+        parser.add_argument("-n", "--nodes", action="append",
+                            metavar="HOST[,HOST...]",
+                            help="master mode: spawn a slave on each "
+                                 "host at startup (ssh; localhost runs "
+                                 "a detached subprocess)")
         parser.add_argument("--respawn", action="store_true",
                             help="master: relaunch dead slaves on their "
                                  "hosts; slave: ship the relaunch recipe")
@@ -313,6 +318,8 @@ class Main(Logger):
             result_file=args.result_file,
             async_slave=args.async_slave,
             respawn=args.respawn,
+            nodes=[h for spec in (args.nodes or [])
+                   for h in spec.split(",") if h],
             slave_death_probability=args.slave_death_probability)
         module.run(self._load, self._main)
         return 0

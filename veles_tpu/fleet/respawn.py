@@ -44,6 +44,18 @@ def default_spawner(host, command, cwd=None, env=None):
         start_new_session=True)
 
 
+def build_command(executable, argv):
+    """One shell-quoted command line — THE quoting/join used by every
+    spawn path (respawn and ``-n`` startup launch)."""
+    return "%s %s" % (shlex.quote(executable),
+                      " ".join(shlex.quote(a) for a in argv))
+
+
+def spawn_env(pythonpath):
+    """Env dict a spawned slave needs, or None when nothing does."""
+    return {"PYTHONPATH": pythonpath} if pythonpath else None
+
+
 def respawn_recipe():
     """The slave-side handshake payload (reference ``client.py:362-373``
     shipped argv/cwd/PYTHONPATH for exactly this). A ``python -m
@@ -86,8 +98,7 @@ class RespawnManager(Logger):
             # detach, like the reference; after the script/module part
             at = 2 if argv[0] == "-m" and len(argv) > 1 else 1
             argv.insert(at, "-b")
-        return "%s %s" % (shlex.quote(executable),
-                          " ".join(shlex.quote(a) for a in argv))
+        return build_command(executable, argv)
 
     def schedule(self, host, recipe, key=None):
         """Respawn the slave described by ``recipe`` on ``host`` after the
@@ -108,9 +119,7 @@ class RespawnManager(Logger):
         delay = self.base_delay * (2 ** attempt)
         self.info("respawning slave on %s in %.0fs (attempt %d/%d)",
                   host, delay, attempt + 1, self.max_attempts)
-        env = {}
-        if recipe.get("pythonpath"):
-            env["PYTHONPATH"] = recipe["pythonpath"]
+        env = spawn_env(recipe.get("pythonpath")) or {}
         timer = threading.Timer(
             delay, self._spawn, (host, command, recipe.get("cwd"), env))
         timer.daemon = True

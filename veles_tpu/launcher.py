@@ -21,9 +21,13 @@ class Launcher(Logger):
 
     def __init__(self, listen_address=None, master_address=None,
                  result_file=None, slave_power=1.0, async_slave=False,
-                 slave_death_probability=0.0, respawn=False, **kwargs):
+                 slave_death_probability=0.0, respawn=False, nodes=None,
+                 **kwargs):
         super().__init__(logger_name="Launcher")
         self.respawn = respawn
+        #: hosts to spawn slaves on at master startup (reference
+        #: ``-n host`` specs, ``launcher.py:617-660``)
+        self.nodes = list(nodes or [])
         self.listen_address = listen_address
         self.master_address = master_address
         self.result_file = result_file
@@ -89,6 +93,8 @@ class Launcher(Logger):
                 respawn=self.respawn)
             self.agent.on_finished = self._on_agent_finished
             self.agent.start()
+            if self.nodes:
+                self._launch_nodes()
         elif self.is_slave:
             from veles_tpu.fleet.client import Client
             self.agent = Client(
@@ -100,6 +106,54 @@ class Launcher(Logger):
                     "max_reconnect_attempts", 7))
             self.agent.on_finished = self._on_agent_finished
         return self
+
+    def _launch_nodes(self):
+        """Spawn a slave on every ``-n`` host at master startup
+        (reference SSH slave launch, ``launcher.py:617-660``): this
+        process's argv is transformed from master form to slave form
+        (drop ``-l``/``-n``, add ``-m <master>``) and launched through
+        the respawn spawner — ssh for remote hosts, a detached local
+        subprocess for ``localhost``/``127.0.0.1``."""
+        import socket
+        from veles_tpu.fleet.respawn import (build_command,
+                                             default_spawner,
+                                             respawn_recipe, spawn_env)
+
+        recipe = respawn_recipe()
+        host_part = self.agent.host
+        if host_part in ("", "0.0.0.0", "::"):
+            host_part = socket.gethostname()
+        master = "%s:%d" % (host_part, self.agent.port)
+        # master->slave argv transform. Dropped (both the space- and
+        # =/fused-separated forms): -l/--listen (the slave must not be
+        # a second master), -n/--nodes (no recursive spawning),
+        # --result-file (results belong to the master), -b (the spawner
+        # already detaches). --respawn is KEPT: it makes the slave ship
+        # its relaunch recipe so the master can respawn it on death.
+        drop_with_value = ("-l", "--listen", "-n", "--nodes",
+                           "--result-file")
+        argv = []
+        skip = False
+        for arg in recipe["argv"]:
+            if skip:
+                skip = False
+                continue
+            if arg in drop_with_value:
+                skip = True
+                continue
+            if arg.startswith(tuple(o + "=" for o in drop_with_value)) \
+                    or (arg[:2] in ("-l", "-n") and len(arg) > 2
+                        and not arg.startswith("--")):
+                continue  # --opt=value / fused -lVALUE forms
+            if arg in ("-b", "--background"):
+                continue
+            argv.append(arg)
+        argv += ["-m", master]
+        command = build_command(recipe["executable"], argv)
+        env = spawn_env(recipe["pythonpath"])
+        for host in self.nodes:
+            self.info("launching slave on %s", host)
+            default_spawner(host, command, cwd=recipe["cwd"], env=env)
 
     def run(self):
         """Blocks until the workflow completes (reference ran the reactor
