@@ -87,6 +87,23 @@ class Main(Logger):
                                  "ensemble")
         parser.add_argument("--async-slave", action="store_true",
                             help="pipelined slave mode")
+        parser.add_argument("--mesh", default=None,
+                            metavar="AXIS=N[,AXIS=N...]",
+                            help="pod mode: shard the workflow tick over "
+                                 "a device mesh, e.g. --mesh data=8 or "
+                                 "--mesh data=4,model=2 (axes: pipe, "
+                                 "data, expert, seq, model; -1 absorbs "
+                                 "the remaining devices)")
+        parser.add_argument("--coordinator", default=None,
+                            metavar="HOST:PORT",
+                            help="multi-host pod: jax.distributed "
+                                 "coordination service address (run the "
+                                 "same command on every host)")
+        parser.add_argument("--num-processes", type=int, default=None,
+                            help="multi-host pod: total process count")
+        parser.add_argument("--process-id", type=int, default=None,
+                            help="multi-host pod: this process's index "
+                                 "(0 owns snapshots/plots/results)")
         parser.add_argument("-n", "--nodes", action="append",
                             metavar="HOST[,HOST...]",
                             help="master mode: spawn a slave on each "
@@ -282,6 +299,18 @@ class Main(Logger):
         args = parser.parse_args(argv)
         import logging
         setup_logging(level=logging.DEBUG if args.verbose else logging.INFO)
+        if args.coordinator:
+            # BEFORE the workflow module import (whose jax use would
+            # initialize the backend single-process)
+            if args.num_processes is None or args.process_id is None:
+                parser.error("--coordinator requires --num-processes "
+                             "and --process-id")
+            from veles_tpu.parallel.mesh import initialize_distributed
+            self.info("joining pod: coordinator %s, process %d/%d",
+                      args.coordinator, args.process_id,
+                      args.num_processes)
+            initialize_distributed(args.coordinator, args.num_processes,
+                                   args.process_id)
         self.dry_run = args.dry_run
         self.snapshot_path = self._resolve_snapshot(args.snapshot)
         self.visualize = args.visualize
@@ -293,6 +322,21 @@ class Main(Logger):
         module = self.load_module(args.workflow)
         self.apply_config(args.config)
         self.override_config(args.overrides)
+        if args.mesh:
+            # after the config layering: the flag wins over config files
+            from veles_tpu.parallel.mesh import AXIS_ORDER
+            for part in args.mesh.split(","):
+                axis, _, size = part.partition("=")
+                axis = axis.strip()
+                if axis not in AXIS_ORDER:
+                    parser.error("--mesh: unknown axis %r (valid: %s)"
+                                 % (axis, ", ".join(AXIS_ORDER)))
+                try:
+                    size = int(size)
+                except ValueError:
+                    parser.error("--mesh expects AXIS=N[,AXIS=N...], "
+                                 "got %r" % args.mesh)
+                setattr(root.common.mesh.axes, axis, size)
         if args.background:
             # AFTER config layering: daemon.log must honor a cache dir
             # set by the config file or CLI overrides

@@ -56,6 +56,12 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
         self.gate_block = Bool(False)
         self.gate_skip = Bool(False)
         self.ignores_gate = Bool(False)
+        # birth gates: lets the partial-fusion engine distinguish a
+        # unit's untouched default gates from workflow-assigned control
+        # Bools (identity comparison; pickling preserves the identity
+        # through the memo table)
+        self._born_gate_skip = self.gate_skip
+        self._born_gate_block = self.gate_block
         self._demanded = []
         self._initialized = False
         self._stopped = False

@@ -57,6 +57,11 @@ class Client(Logger):
         return self
 
     def _run(self):
+        # GC segments a crashed master of a PREVIOUS run never consumed —
+        # long-lived clients are senders too (updates ride shm) and must
+        # not rely on some future Server.start() to clean /dev/shm
+        from veles_tpu.fleet import sharedio
+        sharedio.cleanup_stale()
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
         try:
@@ -149,9 +154,14 @@ class Client(Logger):
                 writer.close()
 
     async def _work(self, reader, writer):
+        from veles_tpu.fleet import sharedio
         hello = {
             "type": "hello", "power": self.power, "mid": machine_id(),
             "pid": os.getpid(), "backend": "tpu",
+            # shm eligibility facts: the master enables the /dev/shm data
+            # plane only when uid and shm directory match too — a
+            # same-machine different-user peer cannot read 0o600 segments
+            "uid": sharedio.owner_uid(), "shm_dir": sharedio.shm_dir(),
             "checksum": getattr(self.workflow, "checksum", None)}
         if self.enable_respawn:
             # relaunch recipe for the master's --respawn (reference

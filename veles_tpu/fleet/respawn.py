@@ -21,6 +21,10 @@ from veles_tpu.core.logger import Logger
 
 LOCAL_HOSTS = ("127.0.0.1", "localhost", "::1")
 
+#: env keys that must NEVER ride a remote command line — `ps` on either
+#: end of the ssh session would expose them to any local user
+SENSITIVE_ENV = ("VELES_TPU_FLEET_SECRET",)
+
 
 def default_spawner(host, command, cwd=None, env=None):
     """ssh for remote hosts, a detached subprocess for local ones."""
@@ -32,16 +36,38 @@ def default_spawner(host, command, cwd=None, env=None):
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             start_new_session=True)
     parts = ["ssh", "-o", "BatchMode=yes", host]
+    env = dict(env or {})
+    secret_items = [(k, env.pop(k)) for k in list(env)
+                    if k in SENSITIVE_ENV]
     # env assignments must sit INSIDE the cd && chain — prefixed outside
     # they would scope to the `cd` builtin only
-    for key, value in (env or {}).items():
+    for key, value in env.items():
         command = "%s=%s %s" % (key, shlex.quote(value), command)
     if cwd:
         command = "cd %s && %s" % (shlex.quote(cwd), command)
+    stdin_data = None
+    if secret_items:
+        # secrets are piped over the (encrypted) ssh stdin and exported
+        # by the remote shell before exec — never visible in argv
+        command = ('while IFS="=" read -r __k __v; do export '
+                   '"$__k"="$__v"; done; ' + command)
+        stdin_data = "".join("%s=%s\n" % item
+                             for item in secret_items).encode()
     parts.append(command)
-    return subprocess.Popen(
-        parts, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    proc = subprocess.Popen(
+        parts, stdin=subprocess.PIPE if stdin_data else None,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         start_new_session=True)
+    if stdin_data:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.close()
+        except OSError:
+            # ssh died before reading (unreachable host, BatchMode
+            # refusal): losing this one slave must not abort the caller
+            # (the -n startup path has no catch of its own)
+            pass
+    return proc
 
 
 def build_command(executable, argv):
@@ -78,11 +104,16 @@ def respawn_recipe():
 class RespawnManager(Logger):
     """Master-side relauncher with per-host backoff + attempt budget."""
 
-    def __init__(self, spawner=None, max_attempts=5, base_delay=2.0):
+    def __init__(self, spawner=None, max_attempts=5, base_delay=2.0,
+                 extra_env=None):
         super().__init__()
         self.spawner = spawner or default_spawner
         self.max_attempts = max_attempts
         self.base_delay = base_delay
+        #: forwarded to every spawned slave (e.g. the fleet secret when
+        #: it came from the master's environment — a slave without it
+        #: would fail every HMAC and never join)
+        self.extra_env = dict(extra_env or {})
         self._attempts = {}
         self._lock = threading.Lock()
         self._timers = []
@@ -120,6 +151,7 @@ class RespawnManager(Logger):
         self.info("respawning slave on %s in %.0fs (attempt %d/%d)",
                   host, delay, attempt + 1, self.max_attempts)
         env = spawn_env(recipe.get("pythonpath")) or {}
+        env.update(self.extra_env)
         timer = threading.Timer(
             delay, self._spawn, (host, command, recipe.get("cwd"), env))
         timer.daemon = True

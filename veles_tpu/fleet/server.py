@@ -70,12 +70,6 @@ class Server(Logger):
     def __init__(self, address, workflow, job_timeout=120.0, secret=None,
                  respawn=False, spawner=None):
         super().__init__(logger_name="fleet.Server")
-        # --respawn: relaunch dead slaves on their hosts (reference
-        # server.py:637-655); see fleet/respawn.py
-        self.respawn_manager = None
-        if respawn:
-            from veles_tpu.fleet.respawn import RespawnManager
-            self.respawn_manager = RespawnManager(spawner=spawner)
         host, _, port = address.rpartition(":")
         # loopback by default: an exposed master means remote code
         # execution for anyone with the secret — opt in explicitly
@@ -84,6 +78,14 @@ class Server(Logger):
         self.workflow = workflow
         self._secret, source = resolve_secret(workflow, secret,
                                               with_source=True)
+        self.secret_source = source
+        # --respawn: relaunch dead slaves on their hosts (reference
+        # server.py:637-655); see fleet/respawn.py
+        self.respawn_manager = None
+        if respawn:
+            from veles_tpu.fleet.respawn import RespawnManager
+            self.respawn_manager = RespawnManager(
+                spawner=spawner, extra_env=self.secret_spawn_env())
         if source == "checksum" \
                 and self.host not in ("127.0.0.1", "localhost", "::1"):
             self.warning(
@@ -120,6 +122,10 @@ class Server(Logger):
             if not self.port:
                 self.port = self._server.sockets[0].getsockname()[1]
             ready.set()
+            # periodic shm GC: sender-side orphans (peer died between
+            # segment creation and descriptor delivery) accumulate in
+            # long runs unless someone sweeps mid-run
+            self._loop.call_later(900.0, self._periodic_shm_gc)
             self._loop.run_forever()
             self._server.close()
             self._loop.run_until_complete(self._server.wait_closed())
@@ -175,6 +181,24 @@ class Server(Logger):
     def address(self):
         return "%s:%d" % (self.host, self.port)
 
+    def secret_spawn_env(self):
+        """Env vars a spawned slave needs to authenticate. When the
+        secret came from the master's environment or an explicit
+        ``secret=``, a remote slave cannot re-derive it (config and
+        checksum travel with the workflow source; env does not) — every
+        frame would fail HMAC and the slave could never join."""
+        if self.secret_source not in ("env", "explicit"):
+            return {}
+        try:
+            value = self._secret.decode("utf-8")
+        except UnicodeDecodeError:
+            self.warning(
+                "fleet secret is not UTF-8 text; cannot forward it to "
+                "spawned slaves via VELES_TPU_FLEET_SECRET — remote "
+                "-n/--respawn slaves will fail to authenticate")
+            return {}
+        return {"VELES_TPU_FLEET_SECRET": value}
+
     # -- per-slave protocol ---------------------------------------------------
     async def _handle_slave(self, reader, writer):
         sid = None
@@ -207,8 +231,14 @@ class Server(Logger):
             peer = writer.get_extra_info("peername")
             slave.peer_host = peer[0] if peer else "127.0.0.1"
             # same-host fast path (reference SharedIO, server.py:721-732):
-            # matching machine ids move big payloads via /dev/shm segments
+            # matching machine ids move big payloads via /dev/shm
+            # segments — but only when uid and shm directory match too
+            # (0o600 segments are unreadable across users; differing
+            # shm_dir fallbacks would 404 every descriptor)
+            from veles_tpu.fleet import sharedio
             shm_ok = (slave.mid != "?" and slave.mid == machine_id()
+                      and hello.get("uid") == sharedio.owner_uid()
+                      and hello.get("shm_dir") == sharedio.shm_dir()
                       and root.common.fleet.get("shm", True))
             slave.shm_threshold = COMPRESS_THRESHOLD if shm_ok else None
             self.slaves[sid] = slave
@@ -366,6 +396,22 @@ class Server(Logger):
                 self.on_finished()
 
     # -- helpers --------------------------------------------------------------
+    def _periodic_shm_gc(self):
+        if self._stopped.is_set():
+            return
+
+        def sweep():
+            # off the event loop: a large /dev/shm walk must not stall
+            # the frame-serving coroutines
+            from veles_tpu.fleet import sharedio
+            stale = sharedio.cleanup_stale()
+            if stale:
+                self.info("removed %d stale shared-memory segments",
+                          stale)
+
+        self._loop.run_in_executor(None, sweep)
+        self._loop.call_later(900.0, self._periodic_shm_gc)
+
     async def _in_thread(self, fn, *args):
         return await self._loop.run_in_executor(None, fn, *args)
 

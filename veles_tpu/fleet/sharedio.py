@@ -37,6 +37,13 @@ def shm_dir():
             return d
 
 
+def owner_uid():
+    """Segments are 0o600: peers running as different users on the same
+    host cannot read each other's segments, so the handshake negotiates
+    shm only between same-uid peers."""
+    return os.getuid() if hasattr(os, "getuid") else -1
+
+
 def _mac(key, payload):
     return hmac_lib.new(key, payload, hashlib.sha256).hexdigest()
 

@@ -75,13 +75,50 @@ class Launcher(Logger):
         if self.workflow is None:
             raise ValueError("no workflow attached to the launcher")
         self.info("launcher mode: %s", self.mode)
+        from veles_tpu.parallel.mesh import is_primary, mesh_configured
+        primary = is_primary()
         if not root.common.disable.get("plotting", False) \
-                and not self.is_slave:
+                and not self.is_slave and primary:
             from veles_tpu.plotting.server import GraphicsServer
             self.graphics_server = GraphicsServer()
-        if root.common.web.get("enabled", False) and not self.is_slave:
+        if root.common.web.get("enabled", False) and not self.is_slave \
+                and primary:
             from veles_tpu.web_status import StatusNotifier
             self.status_notifier = StatusNotifier(self).start()
+        if mesh_configured() and not self.is_standalone:
+            self.warning(
+                "a device mesh is configured (--mesh / "
+                "root.common.mesh.axes) but %s mode does not shard the "
+                "tick yet — the mesh is ignored", self.mode)
+        elif mesh_configured() and self.is_standalone:
+            # pod mode is a PRODUCT mode: --mesh / root.common.mesh.axes
+            # builds the mesh into the workflow before initialize (the
+            # fused-tick splice reads it there). In a multi-host pod
+            # (jax.distributed) the device list already spans every
+            # process. A workflow "supports a mesh" when it carries the
+            # mesh_ slot — or, after a snapshot resume, a fused_tick
+            # (mesh_ ends in '_' and is stripped by the pickle).
+            wf = self.workflow
+            supports_mesh = (hasattr(wf, "mesh_")
+                             or hasattr(wf, "fused_tick"))
+            if not supports_mesh:
+                self.warning("a device mesh is configured but %s has no "
+                             "mesh support — the mesh is ignored",
+                             type(wf).__name__)
+            elif getattr(wf, "mesh_", None) is None:
+                import jax
+                from veles_tpu.parallel.mesh import build_mesh
+                mesh = build_mesh()
+                wf.mesh_ = mesh
+                tick = getattr(wf, "fused_tick", None)
+                if tick is not None:
+                    # resumed snapshot: the tick rebuilds its compiled
+                    # steps at initialize from this mesh
+                    tick.mesh_ = mesh
+                self.info(
+                    "pod mode: mesh %s over %d devices (%d process(es))",
+                    dict(zip(mesh.axis_names, mesh.devices.shape)),
+                    mesh.devices.size, jax.process_count())
         self.workflow.initialize(**kwargs)
         if self.is_master:
             from veles_tpu.nn.gd import fleet_merge_mode
@@ -150,7 +187,11 @@ class Launcher(Logger):
             argv.append(arg)
         argv += ["-m", master]
         command = build_command(recipe["executable"], argv)
-        env = spawn_env(recipe["pythonpath"])
+        env = spawn_env(recipe["pythonpath"]) or {}
+        # env-/explicitly-sourced secrets don't travel with the workflow
+        # source the way config/checksum ones do — forward them
+        # (getattr: test fakes implement only the Server surface they use)
+        env.update(getattr(self.agent, "secret_spawn_env", dict)())
         for host in self.nodes:
             self.info("launching slave on %s", host)
             default_spawner(host, command, cwd=recipe["cwd"], env=env)
@@ -196,6 +237,9 @@ class Launcher(Logger):
     def _write_results(self):
         if not self.result_file or self.is_slave:
             return
+        from veles_tpu.parallel.mesh import is_primary
+        if not is_primary():
+            return  # one result file per pod, owned by process 0
         results = self.workflow.gather_results()
         with open(self.result_file, "w") as fout:
             json.dump(results, fout, indent=1, default=str)

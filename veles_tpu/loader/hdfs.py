@@ -35,7 +35,13 @@ class HDFSTextLoader(Unit, TriviallyDistributable):
         address = kwargs.pop("address", "localhost:9870")
         self.user = kwargs.pop("user", None)
         self.encoding = kwargs.pop("encoding", "utf-8")
+        #: a hung namenode/datanode must not block the workflow forever
+        self.timeout = kwargs.pop("timeout", 60.0)
         super().__init__(workflow, **kwargs)
+        #: lines already served — pickled with the unit so a snapshot
+        #: resume re-opens the stream past the consumed prefix instead
+        #: of re-serving it from offset 0
+        self.lines_consumed = 0
         self.base_url = ("http://%s/webhdfs/v1" % address
                          if "://" not in address
                          else address.rstrip("/") + "/webhdfs/v1")
@@ -52,17 +58,24 @@ class HDFSTextLoader(Unit, TriviallyDistributable):
 
     def stat(self):
         """GETFILESTATUS — size/type/permission metadata."""
-        with urllib.request.urlopen(self._url("GETFILESTATUS")) as resp:
+        with urllib.request.urlopen(self._url("GETFILESTATUS"),
+                                    timeout=self.timeout) as resp:
             return json.loads(resp.read().decode("utf-8"))["FileStatus"]
 
     def initialize(self, **kwargs):
         status = self.stat()
         self.debug("opened %s (%d bytes)", self.file_name,
                    status.get("length", -1))
-        self._response_ = urllib.request.urlopen(self._url("OPEN"))
+        self._response_ = urllib.request.urlopen(self._url("OPEN"),
+                                                 timeout=self.timeout)
         self._generator_ = (line.rstrip("\n") for line in
                             (raw.decode(self.encoding)
                              for raw in self._response_))
+        for _ in range(self.lines_consumed):
+            # skip the prefix a restored snapshot already served (OPEN
+            # has a byte offset= parameter, but line counting is what
+            # the unit actually tracks)
+            next(self._generator_, None)
 
     def init_unpickled(self):
         super().init_unpickled()
@@ -76,6 +89,7 @@ class HDFSTextLoader(Unit, TriviallyDistributable):
             for i in range(self.chunk_lines_number):
                 self.output[i] = next(self._generator_)
                 filled += 1
+                self.lines_consumed += 1
         except StopIteration:
             # truncate to the valid lines: the stale tail of the previous
             # chunk must not be served as data (consumers iterate output)

@@ -16,15 +16,15 @@ defaults apply otherwise.
 
 from veles_tpu.core.workflow import Workflow
 from veles_tpu.core.plumbing import Repeater
-from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 from veles_tpu.nn.all2all import (
     All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
     All2AllStrictRELU, All2AllTanh)
 from veles_tpu.nn.conv import (
     Conv, ConvRELU, ConvStrictRELU, ConvTanh, GDConv, GDConvRELU,
     GDConvStrictRELU, GDConvTanh)
-from veles_tpu.nn.decision import DecisionGD
-from veles_tpu.nn.evaluator import EvaluatorSoftmax
+from veles_tpu.nn.decision import DecisionGD, DecisionMSE
+from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.nn.gd import (
     GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh, GradientDescent,
     link_err_output)
@@ -78,9 +78,19 @@ class StandardWorkflow(Workflow):
         # fused_pipeline=False. (see parallel/fused.py FusedTick docs)
         self.fused_pipeline = kwargs.pop("fused_pipeline", True)
         self.mesh_ = kwargs.pop("mesh", None)
+        #: "softmax" (classification) or "mse" (regression): selects the
+        #: evaluator/decision pair and the default loader (the Znicz
+        #: model families both existed — EvaluatorMSE + DecisionMSE
+        #: drove the approximator/autoencoder workflows)
+        self.evaluator_kind = kwargs.pop("evaluator", "softmax")
+        if self.evaluator_kind not in ("softmax", "mse"):
+            raise ValueError("evaluator must be 'softmax' or 'mse', got "
+                             "%r" % self.evaluator_kind)
         self.fused_tick = None
         super().__init__(workflow, **kwargs)
-        loader_cls = loader_cls or FullBatchLoader
+        loader_cls = loader_cls or (
+            FullBatchLoaderMSE if self.evaluator_kind == "mse"
+            else FullBatchLoader)
         self.repeater = Repeater(self)
         self.repeater.link_from(self.start_point)
         self.loader = loader_cls(self, **(loader_kwargs or {}))
@@ -127,6 +137,17 @@ class StandardWorkflow(Workflow):
             if self.fused is True:
                 raise ValueError(
                     "fused=True but the topology/loader is not fusible")
+            if mesh is not None:
+                # the user explicitly asked for pod mode (--mesh /
+                # config); a silent single-device fallback would look
+                # like a pod run at 1/Nth speed
+                self.warning(
+                    "a device mesh is configured but this topology/"
+                    "loader cannot run the sharded fused tick "
+                    "(minibatch size must divide by the data axis; see "
+                    "parallel/fused.py supports()) — falling back to "
+                    "partial fusion on one device")
+            self._enable_segments()
             return
         self.fused_tick = fused.FusedTick(
             self, mesh=mesh, name="fused_tick",
@@ -148,6 +169,22 @@ class StandardWorkflow(Workflow):
         self.info("fused tick mode: %d-layer chain compiled into one "
                   "XLA computation per %s", len(self.forwards),
                   "class sweep" if self.loader.sweep_serving else "tick")
+
+    def _enable_segments(self):
+        """Second fusion tier (the graph-mode-cliff fix): when the full
+        fused engine declines — an unrecognized layer type, a custom
+        unit spliced into the chain, an MSE evaluator — collapse every
+        run of consecutive JitUnits into one composite dispatch instead
+        of falling all the way to per-unit graph mode. See
+        parallel/segments.py."""
+        from veles_tpu.parallel import segments as seg_mod
+
+        if any(isinstance(u, seg_mod.FusedSegment) for u in self.units):
+            return  # resumed snapshot: the splice is already in place
+        created = seg_mod.enable(self)
+        if created:
+            self.info("partial fusion: %d segment(s) — %s",
+                      len(created), ", ".join(s.name for s in created))
 
     def add_standard_plotters(self, confusion=True, weights=False):
         """Attach the stock live-training plotters (the reference model
@@ -256,13 +293,24 @@ class StandardWorkflow(Workflow):
             src = fwd
 
     def _build_evaluator_and_decision(self, decision_kwargs):
-        self.evaluator = EvaluatorSoftmax(self)
-        self.evaluator.link_from(self.forwards[-1])
-        self.evaluator.link_attrs(self.forwards[-1], ("input", "output"))
-        self.evaluator.link_attrs(self.loader,
-                                  ("labels", "minibatch_labels"),
-                                  "sample_mask")
-        self.decision = DecisionGD(self, **decision_kwargs)
+        if self.evaluator_kind == "mse":
+            self.evaluator = EvaluatorMSE(self)
+            self.evaluator.link_from(self.forwards[-1])
+            self.evaluator.link_attrs(self.forwards[-1],
+                                      ("input", "output"))
+            self.evaluator.link_attrs(self.loader,
+                                      ("target", "minibatch_targets"),
+                                      "sample_mask")
+            self.decision = DecisionMSE(self, **decision_kwargs)
+        else:
+            self.evaluator = EvaluatorSoftmax(self)
+            self.evaluator.link_from(self.forwards[-1])
+            self.evaluator.link_attrs(self.forwards[-1],
+                                      ("input", "output"))
+            self.evaluator.link_attrs(self.loader,
+                                      ("labels", "minibatch_labels"),
+                                      "sample_mask")
+            self.decision = DecisionGD(self, **decision_kwargs)
         self.decision.link_from(self.evaluator)
         self.decision.loader = self.loader
         self.decision.evaluator = self.evaluator

@@ -16,4 +16,4 @@ from veles_tpu.nn.all2all import (  # noqa: F401
 from veles_tpu.nn.evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa: F401
 from veles_tpu.nn.gd import (  # noqa: F401
     GradientDescent, GDTanh, GDRELU, GDStrictRELU, GDSigmoid, GDSoftmax)
-from veles_tpu.nn.decision import DecisionGD  # noqa: F401
+from veles_tpu.nn.decision import DecisionGD, DecisionMSE  # noqa: F401

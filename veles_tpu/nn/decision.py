@@ -86,8 +86,12 @@ class DecisionGD(Unit):
         # block the async XLA dispatch pipeline every minibatch; conversion
         # to Python numbers happens only at class/epoch boundaries
         size = int(self.loader.minibatch_valid_size)
-        self.epoch_n_err[klass] = (self.epoch_n_err[klass]
-                                   + self.evaluator.n_err.data)
+        # MSE evaluators publish no n_err — the error count stays 0 and
+        # improvement tracks the loss metric (DecisionMSE._metric)
+        n_err_slot = getattr(self.evaluator, "n_err", None)
+        if n_err_slot is not None:
+            self.epoch_n_err[klass] = (self.epoch_n_err[klass]
+                                       + n_err_slot.data)
         self.epoch_samples[klass] += size
         self.epoch_loss[klass] = (self.epoch_loss[klass]
                                   + self.evaluator.loss.data * size)
@@ -176,9 +180,7 @@ class DecisionGD(Unit):
                 # the params it evaluated so a snapshot-on-improved
                 # stays exact; if not, leave them on the older epoch's
                 # evaluated state — the improvement that stands
-                import jax
-                n_err = int(jax.device_get(entry["n_err"][VALID]))
-                if self._is_improvement(VALID, n_err):
+                if self._is_improvement(VALID, self._peek_metric(entry)):
                     tick.advance_eval_params()
             first = False
             self._materialize_entry(entry)
@@ -207,6 +209,21 @@ class DecisionGD(Unit):
         self._on_epoch_ended()
 
     # -- epoch boundary logic -------------------------------------------------
+    def _metric(self, n_err, samples, loss_sum):
+        """The tracked improvement metric for one class sweep: the error
+        COUNT here, the average loss in DecisionMSE. Smaller is better
+        in both."""
+        return n_err
+
+    def _peek_metric(self, entry):
+        """The VALID metric of a still-lazy epoch entry (the pipelined
+        drain's advance-peek)."""
+        import jax
+        return int(jax.device_get(entry["n_err"][VALID]))
+
+    def _improvement_suffix(self, metric, n_err, samples):
+        return "validation_%.2fpt" % (100.0 * n_err / max(samples, 1))
+
     def _class_summary(self, klass, n_err, samples, loss_sum, epoch):
         """One sample-class sweep of one epoch finished."""
         samples = max(samples, 1)
@@ -216,18 +233,20 @@ class DecisionGD(Unit):
             epoch, CLASS_NAMES[klass], n_err, samples, error_pct,
             loss_sum / samples)
         if klass == VALID:
-            self._track_improvement(VALID, n_err, epoch,
-                                    "validation_%.2fpt" % error_pct)
+            metric = self._metric(n_err, samples, loss_sum)
+            self._track_improvement(
+                VALID, metric, epoch,
+                self._improvement_suffix(metric, n_err, samples))
 
-    def _is_improvement(self, klass, n_err):
+    def _is_improvement(self, klass, metric):
         """THE improvement predicate — _track_improvement and the
         pipelined drain's advance-peek must never diverge."""
         best = self.best_n_err[klass]
-        return best is None or n_err < best
+        return best is None or metric < best
 
-    def _track_improvement(self, klass, n_err, epoch, suffix):
-        if self._is_improvement(klass, n_err):
-            self.best_n_err[klass] = n_err
+    def _track_improvement(self, klass, metric, epoch, suffix):
+        if self._is_improvement(klass, metric):
+            self.best_n_err[klass] = metric
             self.best_epoch = epoch
             self.improved.set()
             self._epochs_without_improvement = 0
@@ -254,9 +273,9 @@ class DecisionGD(Unit):
         self._epochs_done += 1
         # when there is no validation set, improvement tracks train error
         if stats[VALID][1] == 0 and stats[TRAIN][1] > 0:
-            n_err, samples, _ = stats[TRAIN]
+            n_err, samples, loss_sum = stats[TRAIN]
             self._track_improvement(
-                TRAIN, n_err, epoch,
+                TRAIN, self._metric(n_err, samples, loss_sum), epoch,
                 "train_%.2fpt" % (100.0 * n_err / max(samples, 1)))
         stop = False
         if self.max_epochs is not None \
@@ -303,7 +322,9 @@ class DecisionGD(Unit):
             "klass": self.loader.minibatch_class,
             "epoch": self.loader.minibatch_epoch,
             "valid": int(self.loader.minibatch_valid_size),
-            "n_err": int(self.evaluator.n_err.data),
+            "n_err": (int(self.evaluator.n_err.data)
+                      if getattr(self.evaluator, "n_err", None)
+                      is not None else 0),
             "loss": float(self.evaluator.loss.data),
         }
 
@@ -346,3 +367,41 @@ class DecisionGD(Unit):
         return [self.best_n_err[VALID] if self.best_n_err[VALID] is not None
                 else self.best_n_err[TRAIN],
                 self.best_epoch, self._epochs_done]
+
+
+class DecisionMSE(DecisionGD):
+    """Decision for regression workflows: improvement tracks the minimum
+    validation MSE instead of the error count (the Znicz DecisionMSE
+    role — its ``minimum_mse``/``min_validation_mse`` contract). Works
+    with :class:`~veles_tpu.nn.evaluator.EvaluatorMSE`, which publishes
+    ``loss``/``max_err`` but no ``n_err``."""
+
+    def _metric(self, n_err, samples, loss_sum):
+        return loss_sum / max(samples, 1)
+
+    def _peek_metric(self, entry):
+        import jax
+        loss_sum = float(jax.device_get(entry["loss"][VALID]))
+        return loss_sum / max(entry["samples"][VALID], 1)
+
+    def _improvement_suffix(self, metric, n_err, samples):
+        return "validation_mse_%.6f" % metric
+
+    def _class_summary(self, klass, n_err, samples, loss_sum, epoch):
+        samples = max(samples, 1)
+        self.info("epoch %d %s: avg mse %.6f", epoch,
+                  CLASS_NAMES[klass], loss_sum / samples)
+        if klass == VALID:
+            metric = self._metric(n_err, samples, loss_sum)
+            self._track_improvement(
+                VALID, metric, epoch,
+                self._improvement_suffix(metric, n_err, samples))
+
+    @property
+    def best_mse(self):
+        """Alias: ``best_n_err`` stores the tracked metric, which for
+        this decision is the average MSE."""
+        return self.best_n_err
+
+    def get_metric_names(self):
+        return ["best_validation_mse", "best_epoch", "epochs"]

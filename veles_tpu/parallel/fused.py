@@ -220,7 +220,8 @@ _TICK_CACHE = {}
 
 
 def build_tick(specs, norm_type="none", mesh=None,
-               with_confusion=True, augment="none"):
+               with_confusion=True, augment="none",
+               loss_kind="softmax"):
     """Compile the fused engine.
 
     Returns ``(train_step, eval_step, train_sweep, eval_sweep)``:
@@ -248,12 +249,13 @@ def build_tick(specs, norm_type="none", mesh=None,
     - ``eval_sweep(...)`` likewise without updates.
     """
     key = (_freeze(specs), norm_type, with_confusion, augment,
-           None if mesh is None else id(mesh))
+           loss_kind, None if mesh is None else id(mesh))
     cached = _TICK_CACHE.get(key)
     if cached is not None:
         return cached
     layer_fwds = [_layer_forward(s) for s in specs]
     data_ax = mesh.shape.get("data", 1) if mesh is not None else 1
+    with_confusion = with_confusion and loss_kind == "softmax"
 
     # normalizer coefficients ride in through the traced ``norm`` dict
     # (``jit_state()``), so re-analyzed datasets never retrace the tick
@@ -283,7 +285,12 @@ def build_tick(specs, norm_type="none", mesh=None,
         return (pos < valid).astype(jnp.float32)
 
     def metrics_of(wb, batch, lab, mask, valid):
+        """``lab`` is int labels (softmax) or float targets (mse) — both
+        gathered from the device-resident originals by the same indices."""
         logits = model_forward(wb, batch)
+        if loss_kind == "mse":
+            _, loss_sum, _ = losses.masked_mse(logits, lab, mask, valid)
+            return loss_sum, jnp.int32(0), logits
         _, loss_sum, n_err, _ = losses.masked_softmax_xent(
             logits, lab, mask, valid)
         return loss_sum, n_err, logits
@@ -413,11 +420,20 @@ def build_tick(specs, norm_type="none", mesh=None,
 
 def supports(workflow, mesh=None):
     """True when the workflow's compute chain can run as a fused tick."""
-    from veles_tpu.loader.fullbatch import FullBatchLoader
-    from veles_tpu.nn.evaluator import EvaluatorSoftmax
+    from veles_tpu.loader.fullbatch import (FullBatchLoader,
+                                            FullBatchLoaderMSE)
+    from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
 
     loader = getattr(workflow, "loader", None)
     if not isinstance(loader, FullBatchLoader) or not loader.on_device:
+        return False
+    evaluator = getattr(workflow, "evaluator", None)
+    if isinstance(evaluator, EvaluatorMSE):
+        # regression tick: targets gathered from the device-resident
+        # original_targets exactly like labels
+        if not isinstance(loader, FullBatchLoaderMSE):
+            return False
+    elif not isinstance(evaluator, EvaluatorSoftmax):
         return False
     if getattr(loader, "has_fill_transforms", False):
         # the fused gather bypasses fill_minibatch — fusion stays on
@@ -427,10 +443,18 @@ def supports(workflow, mesh=None):
         if getattr(loader, "jit_transform", None) != "mirror" \
                 or mesh is not None:
             return False
-    if not isinstance(getattr(workflow, "evaluator", None),
-                      EvaluatorSoftmax):
-        return False
     if extract_model_spec(workflow) is None:
+        return False
+    # the control chain must be EXACTLY the standard topology: a custom
+    # unit spliced into the cycle (it wouldn't appear in .forwards/.gds)
+    # must not be silently dropped by the fused splice — such chains
+    # belong to the partial-fusion tier (parallel/segments.py)
+    from veles_tpu.parallel.segments import chain_of
+    chain = chain_of(workflow)
+    expected = (list(workflow.forwards) + [workflow.evaluator,
+                                           workflow.decision]
+                + list(reversed(workflow.gds)))
+    if chain != expected:
         return False
     if mesh is not None:
         data_ax = mesh.shape.get("data", 1)
@@ -498,6 +522,16 @@ class FusedTick(Unit):
             self.warning("dataset fell back to host: disabling fused mode")
             wf._disable_fused()
             return
+        if self.mesh_ is not None:
+            # a resumed snapshot can acquire a mesh the original build
+            # never validated (supports() runs before the splice only)
+            data_ax = self.mesh_.shape.get("data", 1)
+            if loader.max_minibatch_size % data_ax:
+                self.warning(
+                    "minibatch size %d does not divide by the mesh data "
+                    "axis %d — running the fused tick single-device",
+                    loader.max_minibatch_size, data_ax)
+                self.mesh_ = None
         for fwd in wf.forwards:
             weights = getattr(fwd, "weights", None)
             if weights is not None and weights.data is None:
@@ -512,6 +546,10 @@ class FusedTick(Unit):
                              "validation split: disabling")
                 self.pipelined = False
             wf.decision.pipeline_depth = 1 if self.pipelined else 0
+        from veles_tpu.nn.evaluator import EvaluatorMSE
+        self._loss_kind_ = ("mse" if isinstance(wf.evaluator,
+                                                EvaluatorMSE)
+                            else "softmax")
         self._specs_ = extract_model_spec(wf)
         self._norm_ = {k: jnp.asarray(v) for k, v in
                        loader.normalizer.jit_state().items()}
@@ -519,7 +557,8 @@ class FusedTick(Unit):
             self._specs_, loader.normalization_type, self.mesh_,
             with_confusion=getattr(wf.evaluator, "compute_confusion",
                                    True),
-            augment=getattr(loader, "jit_transform", None) or "none")
+            augment=getattr(loader, "jit_transform", None) or "none",
+            loss_kind=self._loss_kind_)
 
     def run(self):
         import numpy
@@ -533,8 +572,14 @@ class FusedTick(Unit):
         train_step, eval_step, train_sweep, eval_sweep = self._steps_
         norm = self._norm_
         data = loader.original_data.data
-        labels = (loader.original_labels.data if loader.original_labels
-                  else jnp.zeros(len(loader.original_data), jnp.int32))
+        if getattr(self, "_loss_kind_", "softmax") == "mse":
+            # regression: the "labels" lane carries the float targets
+            labels = loader.original_targets.data
+        else:
+            labels = (loader.original_labels.data
+                      if loader.original_labels
+                      else jnp.zeros(len(loader.original_data),
+                                     jnp.int32))
         indices = loader.minibatch_indices.data
         valid = numpy.float32(max(loader.minibatch_valid_size, 1))
         training = loader.minibatch_class == TRAIN
@@ -562,9 +607,11 @@ class FusedTick(Unit):
                                         labels, indices, valid)
         evaluator = wf.evaluator
         evaluator.loss.data = loss
-        evaluator.n_err.data = n_err
-        if not training and getattr(evaluator, "compute_confusion",
-                                    True):
+        if getattr(evaluator, "n_err", None) is not None:
+            evaluator.n_err.data = n_err
+        if not training \
+                and getattr(self, "_loss_kind_", "softmax") != "mse" \
+                and getattr(evaluator, "compute_confusion", True):
             # eval passes also emit the confusion increment, so the
             # Decision accumulation + MatrixPlotter work in fused mode
             evaluator.confusion_matrix.data = cm

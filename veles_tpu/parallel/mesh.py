@@ -29,6 +29,52 @@ def mesh_axes():
     return {name: int(cfg.get(name, 1)) for name in AXIS_ORDER}
 
 
+def mesh_configured():
+    """True when the config asks for a non-trivial mesh (any axis != 1,
+    including a -1 absorb-the-devices wildcard). This is what makes pod
+    mode CLI-reachable: ``--mesh data=8`` / ``root.common.mesh.axes``
+    sets it, and the launcher then builds the mesh into the workflow."""
+    return any(v != 1 for v in mesh_axes().values())
+
+
+def initialize_distributed(coordinator, num_processes, process_id,
+                           local_device_count=None):
+    """Multi-host pod bring-up: ``jax.distributed.initialize`` so every
+    process sees the GLOBAL device list and ``build_mesh`` spans hosts.
+
+    The reference reached across hosts by SSH-spawning slaves and
+    selecting per-host endpoints (``launcher.py:617-660``,
+    ``server.py:721-732``); the TPU-idiomatic equivalent is one SPMD
+    program per host joined through the JAX coordination service, with
+    XLA collectives riding ICI/DCN. Must run before any jax backend
+    initializes (i.e. before the first ``jax.devices()`` call).
+
+    ``local_device_count`` (CPU testing only) forces this process's
+    virtual device count via XLA_FLAGS — on real TPU hosts leave unset.
+    """
+    import os
+    if local_device_count:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=%d"
+                     % local_device_count)
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=int(num_processes),
+                               process_id=int(process_id))
+
+
+def is_primary():
+    """True on the process that owns singleton side effects (snapshots,
+    plots, web status, result files) in a multi-process pod. Single
+    process → trivially True; does not force jax backend init order
+    beyond what any device query would."""
+    try:
+        return jax.process_index() == 0
+    except RuntimeError:
+        return True
+
+
 def build_mesh(devices=None, **overrides):
     """Build a Mesh over ``devices`` with configured axis sizes.
 

@@ -20,6 +20,7 @@ pytree ``.npz`` for interchange with non-veles consumers (the orbax-style
 role)."""
 
 import bz2
+import contextlib
 import gzip
 import lzma
 import os
@@ -71,6 +72,9 @@ class SnapshotterBase(Unit):
         if self.is_slave or bool(self.skip) \
                 or root.common.disable.get("snapshotting", False):
             return
+        from veles_tpu.parallel.mesh import is_primary
+        if not is_primary():
+            return  # one snapshot per pod, written by process 0
         self._counter += 1
         if self._counter < self.interval:
             return
@@ -234,7 +238,10 @@ class SnapshotterToDB(SnapshotterBase):
         blob = self._BLOB_CODECS[codec][0](payload)
         os.makedirs(os.path.dirname(os.path.abspath(self.database)),
                     exist_ok=True)
-        with sqlite3.connect(self.database) as conn:
+        # closing() as well: `with connection` only manages the
+        # transaction — without it every snapshot tick leaks a handle
+        with contextlib.closing(sqlite3.connect(self.database)) as conn, \
+                conn:
             self._ensure_table(conn)
             conn.execute(
                 "INSERT INTO %s (prefix, suffix, protocol, timestamp, "
@@ -266,7 +273,7 @@ class SnapshotterToDB(SnapshotterBase):
             args.append(suffix)
         # insert order, not wall clock: shared-storage writers may skew
         query += " ORDER BY id DESC LIMIT 1"
-        with sqlite3.connect(database) as conn:
+        with contextlib.closing(sqlite3.connect(database)) as conn, conn:
             SnapshotterToDB._ensure_table(conn)
             row = conn.execute(query, args).fetchone()
         if row is None:
