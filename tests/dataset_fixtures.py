@@ -1,0 +1,12 @@
+"""Shared dataset fixtures (NOT a test module).
+
+THE canonical digits split used by the fusion/pod/fleet parity tests and
+the two-process pod child lives in ``veles_tpu.parity`` (the accuracy
+harness consumes the same bytes on the product path) — this module just
+re-exports it for the tests. Several assertions (validation error counts
+out of 297, bit-for-bit child-vs-parent comparisons) depend on every
+consumer using the exact same split — change it THERE only.
+"""
+
+from veles_tpu.parity import (  # noqa: F401  (re-export)
+    DIGITS_CLASS_LENGTHS, digits_dataset)

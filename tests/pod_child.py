@@ -50,17 +50,14 @@ root.common.mesh.axes.data = 2  # the product pod-mode switch
 prng.get("default").seed(4321)
 prng.get("loader").seed(8765)
 
-from sklearn.datasets import load_digits  # noqa: E402
+from dataset_fixtures import digits_dataset  # noqa: E402
 
-digits = load_digits()
-X = digits.data.astype(numpy.float32)
-y = digits.target.astype(numpy.int32)
-perm = numpy.random.RandomState(0).permutation(len(X))
+X, y = digits_dataset()
 
 launcher = Launcher()
 wf = MLPWorkflow(
     launcher, layers=(32, 10),
-    loader_kwargs=dict(data=X[perm], labels=y[perm],
+    loader_kwargs=dict(data=X, labels=y,
                        class_lengths=[0, 297, 1500], minibatch_size=100,
                        normalization_type="linear"),
     learning_rate=0.1, max_epochs=3, name="pod-child")

@@ -111,3 +111,76 @@ class TestDataOps:
         numpy.testing.assert_array_equal(ops.reduce_sum(x, 0),
                                          [12.0, 15.0, 18.0, 21.0])
         assert float(ops.reduce_max(x, None)) == 11.0
+
+
+class TestDenseEpilogue:
+    """Fused matmul+bias+activation kernel (the Pallas product consumer,
+    VERDICT r2 #7) — forward parity in interpret mode, and the custom
+    VJP against jax.grad of the XLA path."""
+
+    def test_pallas_dense_interpret_matches_xla(self):
+        import numpy
+        from veles_tpu.ops.gemm import pallas_dense
+
+        rng = numpy.random.RandomState(0)
+        x = rng.randn(96, 80).astype(numpy.float32)
+        w = rng.randn(80, 64).astype(numpy.float32)
+        b = rng.randn(64).astype(numpy.float32)
+        got = pallas_dense(jnp.asarray(x), jnp.asarray(w),
+                           jnp.asarray(b), activation="tanh",
+                           bm=32, bn=32, bk=16, interpret=True)
+        # the library "tanh" is Znicz's scaled 1.7159*tanh(0.6666x)
+        from veles_tpu.ops import activations as act_lib
+        want = act_lib.ACTIVATIONS["tanh"][0](jnp.asarray(x @ w + b))
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(want),
+                                      rtol=2e-5, atol=2e-5)
+
+    def test_dense_layer_custom_vjp_matches_xla_grads(self, monkeypatch):
+        import numpy
+        from veles_tpu.core.config import root
+        from veles_tpu.ops import gemm
+
+        rng = numpy.random.RandomState(1)
+        x = jnp.asarray(rng.randn(64, 48).astype(numpy.float32))
+        w = jnp.asarray(rng.randn(48, 32).astype(numpy.float32))
+        b = jnp.asarray(rng.randn(32).astype(numpy.float32))
+
+        # force the pallas path through interpret-mode (CPU) by
+        # monkeypatching eligibility + the kernel call
+        monkeypatch.setattr(gemm, "_pallas_eligible",
+                            lambda a, bb: True)
+        real = gemm.pallas_dense
+
+        def interp(a, bb, bias, activation="linear", **kw):
+            kw.update(bm=32, bn=32, bk=16, interpret=True)
+            return real(a, bb, bias, activation=activation, **kw)
+
+        monkeypatch.setattr(gemm, "pallas_dense", interp)
+        real_mm = gemm.pallas_matmul
+
+        def interp_mm(a, bb, **kw):
+            # the custom bwd's matmuls hit the patched eligibility too
+            kw.update(bm=32, bn=32, bk=16, interpret=True)
+            return real_mm(a, bb, **kw)
+
+        monkeypatch.setattr(gemm, "pallas_matmul", interp_mm)
+        monkeypatch.setattr(root.common.engine, "precision_level", 1,
+                            raising=False)
+        gemm._dense_with_vjp.cache_clear()
+
+        def loss_pallas(x, w, b):
+            return jnp.sum(gemm.dense_layer(x, w, b, activation="tanh",
+                                            use_pallas=True) ** 2)
+
+        def loss_xla(x, w, b):
+            return jnp.sum(gemm.dense_layer(x, w, b, activation="tanh",
+                                            use_pallas=False) ** 2)
+
+        got = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, w, b)
+        want = jax.grad(loss_xla, argnums=(0, 1, 2))(x, w, b)
+        for g, e in zip(got, want):
+            numpy.testing.assert_allclose(numpy.asarray(g),
+                                          numpy.asarray(e),
+                                          rtol=2e-4, atol=2e-4)
+        gemm._dense_with_vjp.cache_clear()

@@ -192,3 +192,162 @@ class TestSequenceParallelTraining:
         assert float(loss) < first * 0.7, \
             "loss %.4f -> %.4f: sp training not learning" % (first,
                                                              float(loss))
+
+
+class TestPipelineTraining:
+    """Differentiable pipeline (VERDICT r2 #4): the train step's grads
+    must match the sequential single-device reference, and training
+    must actually reduce the loss."""
+
+    def _setup(self, n_stages=4, n_micro=8, d=8, dp=1):
+        from veles_tpu.parallel.pipeline import shard_stage_weights
+        mesh = build_mesh(devices=jax.devices()[:n_stages * dp],
+                          data=dp, pipe=n_stages)
+        rng = numpy.random.RandomState(0)
+        weights = {
+            "w": jnp.asarray(rng.randn(n_stages, d, d).astype(
+                numpy.float32) * 0.3),
+            "b": jnp.asarray(rng.randn(n_stages, d).astype(
+                numpy.float32) * 0.1)}
+        batch = jnp.asarray(rng.randn(n_micro * 4 * dp, d).astype(
+            numpy.float32))
+        targets = jnp.asarray(rng.randn(batch.shape[0], d).astype(
+            numpy.float32))
+
+        def stage(w, x):
+            return jnp.tanh(x @ w["w"] + w["b"])
+
+        return mesh, stage, weights, batch, targets
+
+    @staticmethod
+    def _mse(outputs, targets):
+        return jnp.mean((outputs - targets) ** 2)
+
+    def _sequential_step(self, stage, weights, batch, targets, lr):
+        from veles_tpu.parallel.pipeline import sequential_reference
+
+        def loss_fn(w):
+            return self._mse(sequential_reference(stage, w, batch),
+                             targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(weights)
+        new = jax.tree.map(lambda w, g: w - lr * g, weights, grads)
+        return new, loss
+
+    @pytest.mark.parametrize("n_stages,n_micro", [(4, 8), (8, 4)])
+    def test_train_step_matches_sequential(self, n_stages, n_micro):
+        from veles_tpu.parallel.pipeline import (
+            make_pipeline_train_step, shard_stage_weights)
+
+        mesh, stage, weights, batch, targets = self._setup(
+            n_stages, n_micro)
+        step = make_pipeline_train_step(mesh, stage, n_micro, self._mse,
+                                        learning_rate=0.1)
+        got_w, got_loss = step(shard_stage_weights(weights, mesh),
+                               batch, targets)
+        want_w, want_loss = self._sequential_step(stage, weights, batch,
+                                                  targets, 0.1)
+        numpy.testing.assert_allclose(float(got_loss), float(want_loss),
+                                      rtol=1e-5)
+        for key in ("w", "b"):
+            numpy.testing.assert_allclose(
+                numpy.asarray(got_w[key]), numpy.asarray(want_w[key]),
+                rtol=2e-4, atol=2e-5)
+
+    def test_pp_dp_composition_matches(self):
+        """pp4 x dp2: sharded batch + psum-merged grads must equal the
+        single-device sequential step on the SAME global batch."""
+        from veles_tpu.parallel.pipeline import (
+            make_pipeline_train_step, shard_stage_weights)
+
+        mesh, stage, weights, batch, targets = self._setup(
+            n_stages=4, n_micro=4, dp=2)
+        step = make_pipeline_train_step(mesh, stage, 4, self._mse,
+                                        learning_rate=0.1)
+        got_w, got_loss = step(shard_stage_weights(weights, mesh),
+                               batch, targets)
+        want_w, want_loss = self._sequential_step(stage, weights, batch,
+                                                  targets, 0.1)
+        numpy.testing.assert_allclose(float(got_loss), float(want_loss),
+                                      rtol=1e-5)
+        for key in ("w", "b"):
+            numpy.testing.assert_allclose(
+                numpy.asarray(got_w[key]), numpy.asarray(want_w[key]),
+                rtol=2e-4, atol=2e-5)
+
+    def test_training_reduces_loss(self):
+        from veles_tpu.parallel.pipeline import (
+            make_pipeline_train_step, shard_stage_weights)
+
+        mesh, stage, weights, batch, targets = self._setup()
+        # a learnable objective: match the output of a "teacher" with
+        # different weights
+        rng = numpy.random.RandomState(7)
+        targets = jnp.tanh(batch @ jnp.asarray(
+            rng.randn(8, 8).astype(numpy.float32) * 0.3))
+        step = make_pipeline_train_step(mesh, stage, 8, self._mse,
+                                        learning_rate=0.2)
+        w = shard_stage_weights(weights, mesh)
+        losses = []
+        for _ in range(30):
+            w, loss = step(w, batch, targets)
+            losses.append(float(loss))
+        # grads are proven exact against the sequential reference above;
+        # this asserts the optimization loop actually descends
+        assert losses[-1] < losses[0] * 0.6, losses
+        assert all(b <= a + 1e-4 for a, b in zip(losses, losses[1:])), \
+            losses
+
+
+class TestExpertTraining:
+    """Differentiable MoE (VERDICT r2 #4): grads through dispatch,
+    all_to_all and the gate-probability combine."""
+
+    def _setup(self, n_experts=8, ep=8, tokens=64, d=16, h=32):
+        from veles_tpu.parallel.expert import (init_moe_params,
+                                               shard_moe_params)
+        mesh = build_mesh(devices=jax.devices()[:ep], data=1, expert=ep)
+        rng = numpy.random.RandomState(0)
+        params = init_moe_params(rng, n_experts, d, h)
+        x = jnp.asarray(rng.randn(tokens, d).astype(numpy.float32))
+        targets = jnp.asarray(rng.randn(tokens, d).astype(
+            numpy.float32) * 0.1)
+        return mesh, params, shard_moe_params(params, mesh), x, targets
+
+    def test_train_step_matches_dense_reference(self):
+        """With capacity ample enough that nothing drops, one sharded
+        train step must equal the dense single-device reference step."""
+        from veles_tpu.parallel.expert import (make_moe_train_step,
+                                               reference_moe)
+
+        mesh, params, sharded, x, targets = self._setup()
+        step = make_moe_train_step(mesh, 8, capacity_factor=8.0,
+                                   learning_rate=0.05)
+        got_p, got_loss = step(sharded, x, targets)
+
+        def dense_loss(p):
+            return jnp.mean((reference_moe(p, x) - targets) ** 2)
+
+        want_loss, grads = jax.value_and_grad(dense_loss)(
+            jax.tree.map(jnp.asarray, params))
+        want_p = jax.tree.map(lambda w, g: w - 0.05 * g,
+                              jax.tree.map(jnp.asarray, params), grads)
+        numpy.testing.assert_allclose(float(got_loss), float(want_loss),
+                                      rtol=1e-5)
+        for key in ("gate", "w1", "b1", "w2", "b2"):
+            numpy.testing.assert_allclose(
+                numpy.asarray(got_p[key]), numpy.asarray(want_p[key]),
+                rtol=2e-4, atol=2e-5)
+
+    def test_training_reduces_loss(self):
+        from veles_tpu.parallel.expert import make_moe_train_step
+
+        mesh, params, sharded, x, targets = self._setup()
+        step = make_moe_train_step(mesh, 8, capacity_factor=4.0,
+                                   learning_rate=0.1)
+        p = sharded
+        losses = []
+        for _ in range(20):
+            p, loss = step(p, x, targets)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses

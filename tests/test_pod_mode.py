@@ -29,12 +29,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _digits():
-    from sklearn.datasets import load_digits
-    digits = load_digits()
-    X = digits.data.astype(numpy.float32)
-    y = digits.target.astype(numpy.int32)
-    perm = numpy.random.RandomState(0).permutation(len(X))
-    return X[perm], y[perm]
+    from dataset_fixtures import digits_dataset
+    return digits_dataset()
 
 
 def _build(mesh=None, minibatch_size=96):

@@ -17,12 +17,8 @@ from veles_tpu.parallel import segments
 
 
 def _digits():
-    from sklearn.datasets import load_digits
-    digits = load_digits()
-    X = digits.data.astype(numpy.float32)
-    y = digits.target.astype(numpy.int32)
-    perm = numpy.random.RandomState(0).permutation(len(X))
-    return X[perm], y[perm]
+    from dataset_fixtures import digits_dataset
+    return digits_dataset()
 
 
 def _build(max_epochs=3):
@@ -134,3 +130,28 @@ def test_segments_learn():
     seg.run()
     best = seg.decision.best_n_err[VALID]
     assert best is not None and best < 45
+
+
+def test_mid_segment_monitor_still_fires():
+    """A side unit hanging off a MID-segment member (a monitor linked
+    from fwd0) must keep firing after fusion — its provider link is
+    rewired to the segment."""
+    graph = _build()
+    gmon = HostSpy(graph, name="mon")
+    gmon.watched = graph.forwards[0].output
+    gmon.link_from(graph.forwards[0])
+    graph.initialize()
+    graph.run()
+
+    seg = _build()
+    smon = HostSpy(seg, name="mon")
+    smon.watched = seg.forwards[0].output
+    smon.link_from(seg.forwards[0])
+    created = segments.enable(seg)
+    assert len(created) == 2
+    seg.initialize()
+    seg.run()
+
+    assert smon.ticks == gmon.ticks > 0
+    assert seg.decision.best_n_err[VALID] == graph.decision.best_n_err[
+        VALID]

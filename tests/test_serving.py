@@ -229,3 +229,20 @@ class TestWebStatus:
         status = next(iter(statuses.values()))
         assert status["name"] == "notified"
         assert len(status["slaves"]) == 2
+
+    def test_live_plot_viewer_cache_busting(self, server):
+        """The remote live-plot viewer (reference epgm multicast role):
+        plot <img> tags carry an mtime cache-buster so the 3s
+        meta-refresh re-fetches re-rendered figures, and the query
+        string is stripped when serving."""
+        srv, tmp_path = server
+        (tmp_path / "err.png").write_bytes(b"\x89PNG v1")
+        base = "http://127.0.0.1:%d" % srv.port
+        with urllib.request.urlopen(base + "/", timeout=5) as resp:
+            html = resp.read().decode()
+        assert 'src="/plots/err.png?t=' in html
+        # the busted URL must serve the CURRENT bytes
+        import re
+        url = re.search(r'src="(/plots/err\.png\?t=\d+)"', html).group(1)
+        with urllib.request.urlopen(base + url, timeout=5) as resp:
+            assert resp.read() == b"\x89PNG v1"

@@ -338,3 +338,40 @@ class TestInterfaceVerification:
             assert "run" in str(exc)
         else:
             raise AssertionError("no InterfaceError raised")
+
+
+class TestChangeUnit:
+    def test_change_unit_swaps_control_links_and_gates(self):
+        """Live graph surgery (reference workflow.py:973): replace a
+        mid-chain unit; links, gates and execution move to the new
+        unit."""
+        from veles_tpu.core.mutable import Bool
+        from veles_tpu.core.workflow import Workflow
+        from veles_tpu.dummy import DummyLauncher
+
+        wf = Workflow(DummyLauncher(), name="surgery")
+        ran = []
+
+        class Tick(TrivialUnit):
+            def run(self):
+                ran.append(self.name)
+
+        a = Tick(wf, name="a")
+        b = Tick(wf, name="b")
+        c = Tick(wf, name="c")
+        a.link_from(wf.start_point)
+        b.link_from(a)
+        c.link_from(b)
+        wf.end_point.link_from(c)
+        shared_gate = Bool(False)
+        b.gate_skip = shared_gate
+
+        b2 = Tick(wf, name="b2")
+        wf.change_unit("b", b2)
+        assert a in b2.links_from
+        assert b2 in c.links_from and b not in c.links_from
+        assert not b.links_from and not b.links_to
+        assert b2.gate_skip is shared_gate
+        wf.initialize()
+        wf.run()
+        assert ran == ["a", "b2", "c"]

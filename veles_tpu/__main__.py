@@ -423,6 +423,9 @@ def main(argv=None):
     if argv and argv[0] == "autotune":
         from veles_tpu.ops.gemm import autotune_main
         return autotune_main(argv[1:])
+    if argv and argv[0] == "parity":
+        from veles_tpu.parity import main as parity_main
+        return parity_main(argv[1:])
     return Main().run(argv)
 
 

@@ -160,13 +160,21 @@ root.common.update({
         "donate_params": True,
         # pallas kernel toggles; plain lax fallbacks always exist.
         "use_pallas": True,
+        # fused matmul+bias+activation kernel on the product dense path
+        # (ops/gemm.py dense_layer); measured vs XLA's own epilogue
+        # fusion in docs/performance.md
+        "pallas_epilogue": True,
         "pallas_autotune_cache": os.path.join(
             _home, "cache", "pallas_tuning.json"),
     },
     "mesh": {
-        # default logical mesh axes; sizes are resolved against the actual
-        # device count at Mesh build time (parallel/mesh.py).
-        "axes": {"data": -1, "model": 1, "seq": 1, "expert": 1, "pipe": 1},
+        # logical mesh axes; sizes resolve against the actual device
+        # count at Mesh build time (parallel/mesh.py). ALL ones = pod
+        # mode off; any non-1 axis (e.g. --mesh data=-1 to absorb every
+        # device) makes the launcher build the mesh into the workflow —
+        # pod mode is explicit, not ambient (a data=-1 default would put
+        # every standalone run on every visible device silently).
+        "axes": {"data": 1, "model": 1, "seq": 1, "expert": 1, "pipe": 1},
     },
     "trace": {"run": False},
     "timings": False,

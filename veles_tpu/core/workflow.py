@@ -74,6 +74,32 @@ class Workflow(Container):
         if unit in self._units:
             self._units.remove(unit)
 
+    def change_unit(self, old, new):
+        """Live graph surgery (reference ``workflow.py:973``): replace
+        ``old`` with ``new`` in the control graph. All inbound and
+        outbound control links move to ``new``, ``old`` keeps its data
+        and leaves the graph (it stays in the container until
+        ``del_ref``). Gates move too — workflow-assigned control Bools
+        keep gating the successor. Quiesce the workflow first (pause
+        between ticks / hold ``old``'s run lock) when swapping mid-run;
+        data links are the caller's to re-wire (``link_attrs``).
+
+        ``old`` may be a unit or a unit name."""
+        if isinstance(old, str):
+            old = self[old]
+        if new.workflow is not self:
+            new.workflow = self
+        for provider in list(old.links_from):
+            new.link_from(provider)
+        for consumer in list(old.links_to):
+            consumer.link_from(new)
+        old.unlink_all()
+        new.gate_block = old.gate_block
+        new.gate_skip = old.gate_skip
+        new.ignores_gate = old.ignores_gate
+        self.info("change_unit: %s -> %s", old.name, new.name)
+        return new
+
     @property
     def units(self):
         return list(self._units)
@@ -259,8 +285,12 @@ class Workflow(Container):
         """Unit order for job/update payload lists: CONSTRUCTION order, not
         link order — the slave rewires its control links (one-tick graph),
         but both sides build units in the same sequence, so indices align.
+        EPHEMERAL engine splices (FusedTick/FusedSegment) are excluded:
+        they exist on one side only (e.g. a pod slave under a graph-mode
+        master) and carry no distributable state of their own.
         """
-        return list(self._units)
+        return [u for u in self._units
+                if not getattr(u, "EPHEMERAL", False)]
 
     def generate_data_for_slave(self, slave=None):
         """Collect per-unit job payloads. Returns the payload list,
@@ -364,7 +394,12 @@ class Workflow(Container):
         except (OSError, TypeError):
             payload = type(self).__name__.encode()
         sha = hashlib.sha1(payload)
-        sha.update(b"%d" % len(self._units))
+        # EPHEMERAL units (engine splices: FusedTick, FusedSegment) are
+        # execution strategy, not topology — a pod slave whose tick is
+        # fused must still checksum-match a graph-mode master
+        sha.update(b"%d" % sum(
+            1 for u in self._units
+            if not getattr(u, "EPHEMERAL", False)))
         return sha.hexdigest()
 
     # -- graph rendering (reference workflow.py:624-750) ----------------------

@@ -197,6 +197,14 @@ class Server(Logger):
                 "spawned slaves via VELES_TPU_FLEET_SECRET — remote "
                 "-n/--respawn slaves will fail to authenticate")
             return {}
+        if value != value.strip() or "\n" in value or "\r" in value:
+            # the ssh stdin NAME=value line protocol would truncate or
+            # corrupt it (and `read` trims IFS whitespace)
+            self.warning(
+                "fleet secret contains whitespace/newlines; cannot "
+                "forward it to spawned slaves — use a single-line "
+                "secret for remote -n/--respawn")
+            return {}
         return {"VELES_TPU_FLEET_SECRET": value}
 
     # -- per-slave protocol ---------------------------------------------------

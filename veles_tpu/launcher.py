@@ -85,12 +85,15 @@ class Launcher(Logger):
                 and primary:
             from veles_tpu.web_status import StatusNotifier
             self.status_notifier = StatusNotifier(self).start()
-        if mesh_configured() and not self.is_standalone:
+        if mesh_configured() and self.is_master:
             self.warning(
                 "a device mesh is configured (--mesh / "
-                "root.common.mesh.axes) but %s mode does not shard the "
-                "tick yet — the mesh is ignored", self.mode)
-        elif mesh_configured() and self.is_standalone:
+                "root.common.mesh.axes) but the master does not run the "
+                "compute tick — the mesh is ignored here; configure it "
+                "on the slaves (fleet x pod composition)")
+        elif mesh_configured():
+            # standalone pod mode, or fleet x pod: a SLAVE's local tick
+            # runs the shard_map-ped fused step over its own mesh
             # pod mode is a PRODUCT mode: --mesh / root.common.mesh.axes
             # builds the mesh into the workflow before initialize (the
             # fused-tick splice reads it there). In a multi-host pod

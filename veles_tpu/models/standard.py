@@ -110,6 +110,29 @@ class StandardWorkflow(Workflow):
 
     def initialize(self, **kwargs):
         if self.is_slave:
+            # decide fusibility on the INTACT graph (the chain check in
+            # supports() needs the repeater cycle), then rewire
+            from veles_tpu.parallel import fused
+            mesh = getattr(self, "mesh_", None)
+            use_fused = bool(self.fused) and self.fused_tick is None \
+                and fused.supports(self, mesh)
+            if bool(self.fused) and self.fused_tick is None \
+                    and not use_fused:
+                # same contract as the standalone path (_enable_fused):
+                # an explicit fused=True must not silently degrade, and
+                # an explicitly configured mesh must not silently run
+                # the per-unit graph on one device at 1/Nth speed
+                if self.fused is True:
+                    raise ValueError(
+                        "fused=True but the topology/loader is not "
+                        "fusible on this slave")
+                if mesh is not None:
+                    self.warning(
+                        "a device mesh is configured but this slave's "
+                        "topology/loader cannot run the sharded fused "
+                        "tick (see parallel/fused.py supports()) — "
+                        "falling back to per-unit graph mode on one "
+                        "device")
             # a slave executes exactly ONE tick per job: break the repeater
             # loop-back and fire the EndPoint right after the backward chain
             # so the job callback ships the update (reference
@@ -119,9 +142,47 @@ class StandardWorkflow(Workflow):
             self.end_point.link_from(self.gds[0])
             from veles_tpu.core.mutable import Bool
             self.end_point.gate_block = Bool(False)
+            if use_fused:
+                self._enable_fused_slave(mesh)
         elif self.fused and self.is_standalone:
             self._enable_fused()
         return super().initialize(**kwargs)
+
+    def _enable_fused_slave(self, mesh):
+        """Fleet x pod composition (SURVEY §5's stated translation): the
+        slave's one-tick job becomes the fused step — shard_map-ped over
+        the slave's LOCAL mesh when one is configured. Jobs and merged
+        updates ride DCN through the fleet protocol exactly as before;
+        the gradient merge inside the tick psums over ICI. (Reference
+        slave job execution: ``workflow.py:554-569``.)"""
+        from veles_tpu.parallel import fused
+
+        self.fused_tick = fused.FusedTick(self, mesh=mesh,
+                                          name="fused_tick",
+                                          pipelined=False)
+        self.forwards[0].unlink_from(self.loader)
+        self.end_point.unlink_from(self.gds[0])
+        self.fused_tick.link_from(self.loader)
+        self.end_point.link_from(self.fused_tick)
+        self.loader.fill_data = False
+        self.info(
+            "slave fused tick%s",
+            "" if mesh is None else
+            " over local mesh %s" % dict(zip(mesh.axis_names,
+                                             mesh.devices.shape)))
+
+    def _disable_fused_slave(self):
+        """Reverse the slave splice (loader HBM-OOM fallback)."""
+        tick = self.fused_tick
+        if tick is None:
+            return
+        self.fused_tick = None
+        tick.unlink_from(self.loader)
+        self.end_point.unlink_from(tick)
+        self.del_ref(tick)
+        self.forwards[0].link_from(self.loader)
+        self.end_point.link_from(self.gds[0])
+        self.loader.fill_data = True
 
     def _enable_fused(self):
         """Splice the FusedTick in place of the per-unit compute chain:

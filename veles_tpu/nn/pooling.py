@@ -62,15 +62,22 @@ class AvgPooling(Pooling):
 
 
 class MaxAbsPooling(Pooling):
-    """Znicz's max-by-absolute-value pooling variant."""
+    """Znicz's max-by-absolute-value pooling variant.
+
+    Built from the two DIFFERENTIABLE reduce_windows (max and min) — a
+    custom absmax reducer has no reverse-mode rule, and :class:`GDPooling`
+    backprops through ``jax.vjp(self._pool)``. Tie-break (+x vs -x in one
+    window) deterministically prefers the positive value; the fused engine
+    (``parallel/fused.py``) uses the identical expression, so fused and
+    graph modes match bit-for-bit."""
 
     def _pool(self, x):
         window, strides = self._window()
-
-        def absmax(a, b):
-            return lax.select(lax.abs(a) > lax.abs(b), a, b)
-
-        return lax.reduce_window(x, 0.0, absmax, window, strides, "VALID")
+        mx = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                               "VALID")
+        mn = lax.reduce_window(x, jnp.inf, lax.min, window, strides,
+                               "VALID")
+        return jnp.where(jnp.abs(mx) >= jnp.abs(mn), mx, mn)
 
 
 class GDPooling(Unit):

@@ -137,6 +137,142 @@ def pallas_matmul(a, b, out_dtype=jnp.float32, bm=None, bn=None, bk=None,
     return out
 
 
+# -- fused dense epilogue -----------------------------------------------------
+
+def _mm_epilogue_kernel(activation):
+    from veles_tpu.ops import activations as act_lib
+    act = act_lib.ACTIVATIONS[activation][0]
+
+    def kernel(a_ref, b_ref, bias_ref, o_ref, acc_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        precision = (lax.Precision.HIGHEST
+                     if a_ref.dtype == jnp.float32
+                     else lax.Precision.DEFAULT)
+        acc_ref[...] += jnp.dot(a_ref[...], b_ref[...],
+                                preferred_element_type=jnp.float32,
+                                precision=precision)
+
+        @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+        def _flush():
+            # THE epilogue: bias add + activation on the f32 VMEM
+            # accumulator tile, before it ever leaves for HBM
+            o_ref[...] = act(acc_ref[...]
+                             + bias_ref[...]).astype(o_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("activation", "out_dtype", "bm",
+                                    "bn", "bk", "interpret"))
+def pallas_dense(a, b, bias, activation="linear", out_dtype=jnp.float32,
+                 bm=None, bn=None, bk=None, interpret=False):
+    """act(a @ b + bias) as ONE blocked kernel (matmul + epilogue)."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    if bm is None or bn is None or bk is None:
+        bm, bn, bk = _tuned_blocks(m, n, k, str(jnp.dtype(a.dtype)))
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    pm, pn, pk = (-m) % bm, (-n) % bn, (-k) % bk
+    if pm or pk:
+        a = jnp.pad(a, ((0, pm), (0, pk)))
+    if pk or pn:
+        b = jnp.pad(b, ((0, pk), (0, pn)))
+    bias2 = bias.reshape(1, -1).astype(jnp.float32)
+    if pn:
+        bias2 = jnp.pad(bias2, ((0, 0), (0, pn)))
+    mm, nn, kk = m + pm, n + pn, k + pk
+    out = pl.pallas_call(
+        _mm_epilogue_kernel(activation),
+        grid=(mm // bm, nn // bn, kk // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, s: (i, s)),
+            pl.BlockSpec((bk, bn), lambda i, j, s: (s, j)),
+            pl.BlockSpec((1, bn), lambda i, j, s: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, s: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mm, nn), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, b, bias2)
+    if pm or pn:
+        out = out[:m, :n]
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_with_vjp(activation):
+    """The Pallas epilogue forward with a hand-written VJP —
+    ``pallas_call`` has no automatic reverse rule, and the fused tick
+    differentiates straight through the layer. The backward is the
+    SAME math the graph-mode GD units run (activation derivative off
+    the saved OUTPUT, two transposed matmuls, bias row-sum)."""
+    from veles_tpu.ops import activations as act_lib
+    deriv = act_lib.ACTIVATIONS[activation][1]
+
+    @jax.custom_vjp
+    def fn(x, w, b):
+        return pallas_dense(x, w, b, activation=activation,
+                            out_dtype=jnp.float32)
+
+    def fwd(x, w, b):
+        y = fn(x, w, b)
+        return y, (x, w, y)
+
+    def bwd(res, g):
+        x, w, y = res
+        err = g * deriv(y)
+        grad_x = matmul(err, w.T, out_dtype=x.dtype)
+        grad_w = matmul(x.T, err, out_dtype=jnp.float32).astype(w.dtype)
+        return grad_x, grad_w, jnp.sum(err, axis=0)
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+def dense_layer(x, w, bias, activation="linear", precision_level=None,
+                out_dtype=jnp.float32, use_pallas=None):
+    """The product dense-layer forward: ``act(x @ w + b)``.
+
+    When the shapes qualify (and ``root.common.engine.use_pallas`` +
+    ``pallas_epilogue``), the whole layer runs as the fused Pallas
+    kernel above — the autotune cache's block sizes applied ON the
+    product path (the role the reference's per-device GEMM autotune
+    played for every All2All, ``backends.py:623-731``). Otherwise XLA's
+    dot + its own epilogue fusion. ``docs/performance.md`` records the
+    measured comparison between the two."""
+    if precision_level is None:
+        precision_level = root.common.engine.get("precision_level", 0)
+    if use_pallas is None:
+        use_pallas = root.common.engine.get("use_pallas", True) \
+            and root.common.engine.get("pallas_epilogue", True)
+    if precision_level == 0:
+        compute_dtype = jnp.dtype(
+            root.common.engine.get("compute_dtype", "bfloat16"))
+    else:
+        compute_dtype = jnp.float32
+    xc = x.astype(compute_dtype)
+    wc = w.astype(compute_dtype)
+    if use_pallas and _pallas_eligible(xc, wc):
+        return _dense_with_vjp(activation)(xc, wc, bias).astype(
+            out_dtype)
+    from veles_tpu.ops import activations as act_lib
+    act = act_lib.ACTIVATIONS[activation][0]
+    # same dtype contract as the Pallas path: bias add + activation on
+    # the f32 accumulator, ONE final cast to out_dtype
+    out = lax.dot_general(
+        xc, wc, (((xc.ndim - 1,), (0,)), ((), ())),
+        precision=_PRECISIONS[precision_level],
+        preferred_element_type=jnp.float32)
+    return act(out + bias).astype(out_dtype)
+
+
 # -- autotune cache (the device_infos.json descendant) ------------------------
 
 _DEFAULT_BLOCKS = (256, 256, 512)

@@ -105,6 +105,35 @@ def make_moe_ffn(mesh, n_experts, capacity_factor=2.0):
     return moe
 
 
+def make_moe_train_step(mesh, n_experts, capacity_factor=2.0,
+                        learning_rate=0.01):
+    """Compile a TRAIN step through the sharded MoE: grads flow through
+    the dense dispatch/combine tensors and both ``all_to_all``\\ s (their
+    transpose is the reverse all_to_all), and through the top-1 gate the
+    GShard way — the routing argmax is non-differentiable, but the
+    combine is weighted by the gate PROBABILITY, so the gate weights
+    learn from d(loss)/d(gate_val). An MSE objective against per-token
+    targets keeps the step self-contained.
+
+    Returns ``step(params, x, targets) -> (new_params, loss)`` with
+    ``x``/``targets`` sharded over the expert axis (token-major).
+    """
+    moe = make_moe_ffn(mesh, n_experts, capacity_factor)
+
+    def loss_fn(params, x, targets):
+        y, _ = moe(params, x)
+        return jnp.mean((y - targets) ** 2)
+
+    @jax.jit
+    def step(params, x, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, targets)
+        new = jax.tree.map(lambda w, g: w - learning_rate * g,
+                           params, grads)
+        return new, loss
+
+    return step
+
+
 def reference_moe(params, x):
     """Dense single-device reference (no capacity drops) for parity
     tests: every token goes through its argmax expert exactly."""

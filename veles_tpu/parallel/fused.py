@@ -37,7 +37,6 @@ from veles_tpu.core.units import Unit
 from veles_tpu.loader.base import TRAIN, VALID
 from veles_tpu.ops import activations as act_lib, losses
 from veles_tpu.ops.gather import gather_minibatch
-from veles_tpu.ops.gemm import matmul
 from veles_tpu.loader.normalization import normalizer_registry
 
 #: forward-unit class name → fused layer kind
@@ -146,11 +145,16 @@ def _layer_forward(spec):
     """Pure forward for one layer, matching the forward unit's compute."""
     kind = spec["kind"]
     if kind == _DENSE:
-        act = act_lib.ACTIVATIONS[spec["activation"]][0]
+        from veles_tpu.ops.gemm import dense_layer
+        activation = spec["activation"]
 
         def fwd(p, x):
             x = x.reshape(x.shape[0], -1)
-            return act(matmul(x, p["w"], out_dtype=jnp.float32) + p["b"])
+            # one fused kernel (matmul + bias + activation epilogue)
+            # when the shapes qualify for the Pallas path; XLA dot with
+            # its own epilogue fusion otherwise — see ops/gemm.py
+            return dense_layer(x, p["w"], p["b"], activation=activation,
+                               out_dtype=jnp.float32)
         return fwd
     if kind == _CONV:
         act = act_lib.ACTIVATIONS[spec["activation"]][0]
@@ -199,10 +203,16 @@ def _layer_forward(spec):
         return lambda p, x: lax.reduce_window(
             x, 0.0, lax.add, window, strides, "VALID") / (kx * ky)
 
-    def absmax(a, b):
-        return lax.select(lax.abs(a) > lax.abs(b), a, b)
-    return lambda p, x: lax.reduce_window(
-        x, 0.0, absmax, window, strides, "VALID")
+    def maxabs(p, x):
+        # signed value of the max-|x| element, built from the two
+        # DIFFERENTIABLE reduce_windows (a custom absmax reducer has no
+        # reverse-mode rule — the train step must grad through pooling)
+        mx = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                               "VALID")
+        mn = lax.reduce_window(x, jnp.inf, lax.min, window, strides,
+                               "VALID")
+        return jnp.where(jnp.abs(mx) >= jnp.abs(mn), mx, mn)
+    return maxabs
 
 
 def _freeze(obj):
@@ -476,6 +486,9 @@ class FusedTick(Unit):
 
     hide_from_registry = True
     VIEW_GROUP = "WORKER"
+    #: execution strategy, not topology: excluded from the workflow
+    #: checksum so fused slaves pair with graph masters
+    EPHEMERAL = True
 
     def __init__(self, workflow, mesh=None, pipelined=False, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -520,7 +533,10 @@ class FusedTick(Unit):
             # fused gather from host originals would re-transfer the whole
             # dataset every tick; revert to graph mode
             self.warning("dataset fell back to host: disabling fused mode")
-            wf._disable_fused()
+            if wf.is_slave:
+                wf._disable_fused_slave()
+            else:
+                wf._disable_fused()
             return
         if self.mesh_ is not None:
             # a resumed snapshot can acquire a mesh the original build
@@ -564,9 +580,11 @@ class FusedTick(Unit):
         import numpy
         wf = self.workflow
         loader = wf.loader
-        if self._params_ is None:
+        if self._params_ is None or wf.is_slave:
             # copy: the unit Arrays keep their own buffers — ours get
-            # donated through the train step
+            # donated through the train step. A SLAVE refreshes every
+            # tick: the master overwrites the unit Arrays between jobs
+            # (apply_data_from_master)
             self._params_ = jax.tree.map(
                 jnp.copy, get_params(wf, self._specs_))
         train_step, eval_step, train_sweep, eval_sweep = self._steps_
@@ -616,6 +634,13 @@ class FusedTick(Unit):
             # Decision accumulation + MatrixPlotter work in fused mode
             evaluator.confusion_matrix.data = cm
         self.ticks += 1
+        if wf.is_slave:
+            # one tick per job: write the trained weights straight back
+            # so generate_data_for_master ships them; epoch accounting
+            # lives on the master
+            if training:
+                set_params(wf, self._params_, self._specs_)
+            return
         if not training and loader.epoch_ended_for_class:
             # write the EVALUATED weights into the unit Arrays now —
             # they stay untouched through the upcoming train sweep, so a

@@ -4,8 +4,18 @@ Additive beyond the reference (which had no model sharding of any kind,
 SURVEY §2.5): a GPipe-style microbatch pipeline expressed the TPU way —
 one ``shard_map`` over the ``pipe`` axis in ONE jitted computation, with
 ``lax.ppermute`` moving activations between neighbouring stages and a
-``lax.fori_loop`` running the classic ``n_micro + n_stages - 1`` fill +
-drain schedule. Stage weights live only on their stage's devices.
+``lax.scan`` running the classic ``n_micro + n_stages - 1`` fill + drain
+schedule. Stage weights live only on their stage's devices.
+
+The schedule is a ``scan`` (not ``fori_loop``) so the WHOLE pipeline is
+reverse-differentiable: ``jax.grad`` through it yields the backward
+microbatch schedule automatically — the cotangent of each ``ppermute``
+is the reverse ``ppermute``, so gradients flow stage N → stage 0 in the
+mirrored fill/drain order, with ``jax.checkpoint`` on the stage function
+bounding the stored residuals (GPipe's rematerialization). The train
+step (:func:`make_pipeline_train_step`) builds on exactly this; an
+optional ``data`` mesh axis composes pp x dp (batch rows sharded,
+gradients psum-merged).
 
 The stage function is uniform (same shapes per stage — the standard
 pipelined-transformer setup); stage identity selects the local weight
@@ -13,15 +23,54 @@ shard automatically because each device only holds its own stage's
 parameters.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def make_pipeline(mesh, stage_fn, n_microbatches):
+def _pipeline_body(stage_fn, n_stages, n_microbatches, remat):
+    """The shared shard_map-local forward: returns the full pipelined
+    output of this device's batch shard."""
+    staged = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def forward(w_local, batch):
+        stage = lax.axis_index("pipe")
+        w = jax.tree.map(lambda a: a[0], w_local)  # this stage's weights
+        micro = batch.reshape((n_microbatches, -1) + batch.shape[1:])
+        n_steps = n_microbatches + n_stages - 1
+        zero = jnp.zeros_like(micro[0])
+
+        def step(incoming, t):
+            # stage 0 feeds itself from the microbatch queue; others use
+            # the activation handed over by the previous stage
+            feed = lax.dynamic_index_in_dim(
+                micro, jnp.clip(t, 0, n_microbatches - 1), 0,
+                keepdims=False)
+            x = jnp.where(stage == 0, feed, incoming)
+            y = staged(w, x)
+            nxt = lax.ppermute(
+                y, "pipe",
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            # the LAST stage's y at t >= n_stages-1 is microbatch
+            # t-(n_stages-1) finished; stack every step's y and slice
+            # the drain window after the scan (cheaper than an in-loop
+            # masked dynamic update, and scan stacks for free)
+            return nxt, y
+
+        _, ys = lax.scan(step, zero, jnp.arange(n_steps))
+        outputs = ys[n_stages - 1:]  # (n_micro, mb, ...) on last stage
+        # only the last stage holds real outputs; psum of the masked
+        # buffers broadcasts them to every stage in one collective
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), "pipe")
+        return outputs.reshape((batch.shape[0],) + outputs.shape[2:])
+
+    return forward
+
+
+def make_pipeline(mesh, stage_fn, n_microbatches, remat=False):
     """Compile a pipelined forward.
 
     ``stage_fn(w, x) -> y`` is one stage's computation with ``x``/``y``
@@ -35,48 +84,10 @@ def make_pipeline(mesh, stage_fn, n_microbatches):
     """
     n_stages = mesh.shape["pipe"]
 
-    @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P("pipe"), P()), out_specs=P(),
-             check_vma=False)
-    def _pipeline(w_local, batch):
-        stage = lax.axis_index("pipe")
-        w = jax.tree.map(lambda a: a[0], w_local)  # this stage's weights
-        micro = batch.reshape((n_microbatches, -1) + batch.shape[1:])
-        n_steps = n_microbatches + n_stages - 1
-        zero = jnp.zeros_like(micro[0])
-        outputs = jnp.zeros_like(micro)
-
-        def step(t, carry):
-            incoming, outputs = carry
-            # stage 0 feeds itself from the microbatch queue; others use
-            # the activation handed over by the previous stage
-            feed = lax.dynamic_index_in_dim(
-                micro, jnp.clip(t, 0, n_microbatches - 1), 0,
-                keepdims=False)
-            x = jnp.where(stage == 0, feed, incoming)
-            y = stage_fn(w, x)
-            # the LAST stage writes its finished microbatch (index t -
-            # (n_stages-1)); earlier stages pass y to the next stage
-            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
-            write = jnp.logical_and(stage == n_stages - 1,
-                                    t >= n_stages - 1)
-            outputs = lax.cond(
-                write,
-                lambda o: lax.dynamic_update_index_in_dim(
-                    o, y, out_idx, 0),
-                lambda o: o, outputs)
-            nxt = lax.ppermute(
-                y, "pipe",
-                [(i, (i + 1) % n_stages) for i in range(n_stages)])
-            return nxt, outputs
-
-        _, outputs = lax.fori_loop(0, n_steps, step, (zero, outputs))
-        # only the last stage holds real outputs; psum of the masked
-        # buffers broadcasts them to every stage in one collective
-        outputs = lax.psum(
-            jnp.where(stage == n_stages - 1, outputs,
-                      jnp.zeros_like(outputs)), "pipe")
-        return outputs.reshape(batch.shape[:1] + outputs.shape[2:])
+    _pipeline = jax.shard_map(
+        _pipeline_body(stage_fn, n_stages, n_microbatches, remat),
+        mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
+        check_vma=False)
 
     def pipeline(stage_weights, batch):
         # fail HERE with the real constraint names, not deep inside the
@@ -101,3 +112,63 @@ def shard_stage_weights(weights, mesh):
     """Place stage-major weight pytrees on the pipe axis."""
     spec = jax.sharding.NamedSharding(mesh, P("pipe"))
     return jax.tree.map(lambda a: jax.device_put(a, spec), weights)
+
+
+def make_pipeline_train_step(mesh, stage_fn, n_microbatches, loss_fn,
+                             learning_rate=0.01, remat=True):
+    """Compile a pipelined TRAIN step — forward fill/drain, backward
+    microbatch schedule (the reverse ppermute chain ``jax.grad`` derives
+    from the scanned forward, with per-stage rematerialization), SGD
+    update — as ONE jitted computation.
+
+    ``loss_fn(outputs, targets) -> scalar`` consumes the last stage's
+    assembled batch outputs. With a ``data`` axis of size > 1 in the
+    mesh, batch/targets rows are sharded over it and gradients are
+    psum-merged — pp x dp composition.
+
+    Returns ``step(stage_weights, batch, targets) -> (new_weights,
+    loss)``.
+    """
+    n_stages = mesh.shape["pipe"]
+    data_ax = mesh.shape.get("data", 1)
+    forward = _pipeline_body(stage_fn, n_stages, n_microbatches, remat)
+
+    def local_step(w_local, batch, targets):
+        def local_loss(w_local):
+            outputs = forward(w_local, batch)
+            loss = loss_fn(outputs, targets)
+            if data_ax > 1:
+                loss = lax.pmean(loss, "data")
+            return loss
+
+        loss, grads = jax.value_and_grad(local_loss)(w_local)
+        # the loss is REPLICATED over pipe by the masked-psum broadcast,
+        # so under grad every pipe device seeds its own copy and the
+        # psum transpose sums the n_stages seeds — normalize back
+        grads = jax.tree.map(lambda g: g / n_stages, grads)
+        if data_ax > 1:
+            # each data-shard computed grads for ITS rows: merge
+            # (pmean(loss, "data") above makes the data-axis seeds net
+            # out to 1; only the row-shard averaging remains)
+            grads = lax.pmean(grads, "data")
+        new = jax.tree.map(lambda w, g: w - learning_rate * g,
+                           w_local, grads)
+        return new, loss
+
+    batch_spec = P("data") if data_ax > 1 else P()
+    step = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("pipe"), batch_spec, batch_spec),
+        out_specs=(P("pipe"), P()), check_vma=False)
+    return jax.jit(step)
+
+
+def sequential_reference(stage_fn, stage_weights, batch):
+    """Single-device reference of the same pipeline: apply the stages in
+    order (parity oracle for the train-step tests)."""
+    x = batch
+    n_stages = jax.tree.leaves(stage_weights)[0].shape[0]
+    for i in range(n_stages):
+        w = jax.tree.map(lambda a: a[i], stage_weights)
+        x = stage_fn(w, x)
+    return x

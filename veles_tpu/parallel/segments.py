@@ -18,11 +18,11 @@ whose compute units are :class:`~veles_tpu.nn.jit_unit.JitUnit`\\ s:
   (``workflow.py:347-365``) exactly.
 
 The partition rule for gates mirrors the reference's runtime gate
-checks: a segment adopts one ``(gate_skip, gate_block)`` pair; members
-may join only if their gates are the very same Bool objects or
-constant-false defaults. The per-tick gate decision then applies to the
-whole segment at once — identical to graph mode, where the shared Bool
-would have gated every member individually.
+checks: members may join a segment only when they carry the IDENTICAL
+``(gate_skip, gate_block)`` signature — the very same workflow-assigned
+Bool objects, or both untouched birth gates. The per-tick gate decision
+then applies to the whole segment at once — identical to graph mode,
+where the shared Bool would have gated every member individually.
 
 Numerical identity with graph mode is structural: the composite calls
 the same bound ``compute()`` methods on the same inputs in the same
@@ -47,28 +47,26 @@ def chain_of(workflow):
     repeater = getattr(workflow, "repeater", None)
     if loader is None or repeater is None:
         return None
-    reach_memo = {}
-
-    def reaches_repeater(unit, seen):
-        """Can the repeater be reached from ``unit`` along control
-        links without passing through the loader again?"""
-        if unit is repeater:
-            return True
-        if unit in seen:
-            return False
-        if unit in reach_memo:
-            return reach_memo[unit]
-        seen = seen | {unit}
-        result = any(reaches_repeater(nxt, seen) for nxt in unit.links_to
-                     if nxt is not loader)
-        reach_memo[unit] = result
-        return result
+    # "unit can reach the repeater along links_to without passing
+    # through the loader" == one reverse BFS from the repeater over
+    # links_from that never expands THROUGH the loader: O(V+E) once,
+    # instead of a fresh forward DFS per query
+    reaches = {repeater}
+    frontier = [repeater]
+    while frontier:
+        node = frontier.pop()
+        if node is loader:
+            continue  # the loader may start a path, never sit inside one
+        for prev in node.links_from:
+            if prev not in reaches:
+                reaches.add(prev)
+                frontier.append(prev)
 
     chain = []
     current = loader
     while True:
         successors = [u for u in current.links_to
-                      if u is not repeater and reaches_repeater(u, set())]
+                      if u is not repeater and u in reaches]
         if current.links_to.get(repeater) and not successors:
             return chain  # closed the cycle
         if len(successors) != 1:
@@ -129,18 +127,14 @@ def partition(chain):
             result.append(("host", unit))
             continue
         sig = _gate_signature(unit)
-        if run:
-            merged = tuple(a if a is not None else b
-                           for a, b in zip(run_sig, sig))
-            compatible = all(s in (None, m)
-                             for s, m in zip(sig, merged))
-            if not compatible:
-                flush()
-                merged = sig
-        else:
-            merged = sig
+        if run and sig != run_sig:
+            # EXACT signature match only: letting a default-gate unit
+            # join a run that adopts a neighbor's control Bool would
+            # skip/block it when that Bool fires — graph mode would have
+            # run it (correctness beats fusion greed here)
+            flush()
         run.append(unit)
-        run_sig = merged
+        run_sig = sig
     flush()
     return result
 
@@ -161,6 +155,8 @@ class FusedSegment(Unit):
 
     hide_from_registry = True
     VIEW_GROUP = "WORKER"
+    #: execution strategy, not topology (see Workflow.checksum)
+    EPHEMERAL = True
 
     def __init__(self, workflow, members, **kwargs):
         kwargs.setdefault("name", "segment[%s..%s]"
@@ -306,19 +302,28 @@ def enable(workflow):
         if kind != "segment":
             continue
         members = payload
-        first, last = members[0], members[-1]
         member_set = set(members)
         segment = FusedSegment(workflow, members)
         # segment gates = the members' shared (non-default) gates
+        # (partition guarantees every member carries the SAME pair)
         for member in members:
             if not _default_skip(member):
                 segment.gate_skip = member.gate_skip
             if not _default_block(member):
                 segment.gate_block = member.gate_block
-        predecessors = [u for u in first.links_from
-                        if u not in member_set]
-        successors = [u for u in list(last.links_to)
-                      if u not in member_set]
+        # rewire ALL outside links of EVERY member, not just the chain
+        # endpoints: a monitor hanging off a mid-segment member must
+        # still fire (after the segment — its data is final then), and
+        # an outside provider into a mid-segment member still holds the
+        # segment's AND gate
+        predecessors, successors = [], []
+        for member in members:
+            predecessors.extend(u for u in member.links_from
+                                if u not in member_set
+                                and u not in predecessors)
+            successors.extend(u for u in list(member.links_to)
+                              if u not in member_set
+                              and u not in successors)
         segment.link_from(*predecessors)
         for successor in successors:
             successor.link_from(segment)

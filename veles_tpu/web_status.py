@@ -105,6 +105,7 @@ class WebStatusServer(Logger):
                     self.send_error(404)
 
             def _serve_plot(self, name):
+                name = name.partition("?")[0]  # cache-buster query
                 directory = server.plots_directory
                 if not directory or os.path.sep in name or ".." in name:
                     self.send_error(404)
@@ -187,8 +188,17 @@ class WebStatusServer(Logger):
             for path in sorted(glob.glob(
                     os.path.join(self.plots_directory, "*.png"))):
                 name = escape(os.path.basename(path), quote=True)
-                plots.append('<img src="/plots/%s" alt="%s"/>'
-                             % (name, name))
+                # cache-buster (file mtime): the page meta-refreshes
+                # every 3s and the browser must re-fetch a re-rendered
+                # plot, not show its cached copy — this is the live
+                # remote viewer (reference epgm multicast role,
+                # graphics_server.py:100-133)
+                try:
+                    stamp = int(os.stat(path).st_mtime)
+                except OSError:
+                    stamp = 0
+                plots.append('<img src="/plots/%s?t=%d" alt="%s"/>'
+                             % (name, stamp, name))
         return _PAGE % {"rows": "".join(rows) or
                         "<tr><td colspan=5>none</td></tr>",
                         "plots": "".join(plots) or "<p>none</p>"}
