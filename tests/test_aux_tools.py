@@ -271,3 +271,101 @@ class TestBBoxer:
             urllib.request.urlopen(req)
         assert err.value.code == 404
         assert not (tree.parent / "outside.png.json").exists()
+
+
+class TestManhole:
+    """core/manhole.py — the --manhole live debug console."""
+
+    def _drain_until(self, sock, marker, limit=65536):
+        data = b""
+        while marker not in data and len(data) < limit:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        return data
+
+    def test_attach_eval_detach(self, tmp_path):
+        import socket
+
+        from veles_tpu.core.manhole import Manhole
+
+        sentinel = {"value": 41}
+        path = str(tmp_path / "mh.sock")
+        manhole = Manhole(namespace={"sentinel": sentinel},
+                          path=path).start()
+        try:
+            client = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            client.settimeout(10)
+            client.connect(path)
+            self._drain_until(client, b">>> ")
+            # expression result printing + LIVE mutation of process state
+            client.sendall(b"print(sentinel['value'] + 1)\n")
+            out = self._drain_until(client, b">>> ")
+            assert b"42" in out
+            client.sendall(b"sentinel['value'] = 100\n")
+            self._drain_until(client, b">>> ")
+            # multi-line block compiles incrementally (the "... " prompt)
+            client.sendall(b"for i in range(2):\n")
+            out = self._drain_until(client, b"... ")
+            client.sendall(b"    print('x%d' % i)\n\n")
+            out = self._drain_until(client, b">>> ")
+            assert b"x0" in out and b"x1" in out
+            # errors are reported, connection survives
+            client.sendall(b"1/0\n")
+            out = self._drain_until(client, b">>> ")
+            assert b"ZeroDivisionError" in out
+            client.sendall(b"exit\n")
+            out = self._drain_until(client, b"detached")
+            assert b"detached" in out
+            client.close()
+            assert sentinel["value"] == 100  # the process really mutated
+            # a SECOND connection is served after the first detaches
+            client2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            client2.settimeout(10)
+            client2.connect(path)
+            self._drain_until(client2, b">>> ")
+            client2.sendall(b"print(sentinel['value'])\n")
+            assert b"100" in self._drain_until(client2, b">>> ")
+            client2.close()
+        finally:
+            manhole.stop()
+
+    def test_socket_permissions(self, tmp_path):
+        import os
+        import stat
+
+        from veles_tpu.core.manhole import Manhole
+
+        path = str(tmp_path / "mh.sock")
+        manhole = Manhole(path=path).start()
+        try:
+            mode = stat.S_IMODE(os.stat(path).st_mode)
+            assert mode == 0o600
+        finally:
+            manhole.stop()
+        assert not os.path.exists(path)
+
+    def test_restart_after_stop(self, tmp_path):
+        """stop() then start() must serve again (regression: _closing
+        stayed True, the fresh serve loop exited instantly and clients
+        hung on the kernel backlog forever)."""
+        import socket
+
+        from veles_tpu.core.manhole import Manhole
+
+        path = str(tmp_path / "mh.sock")
+        manhole = Manhole(namespace={"x": 7}, path=path)
+        manhole.start()
+        manhole.stop()
+        manhole.start()
+        try:
+            client = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            client.settimeout(10)
+            client.connect(path)
+            self._drain_until(client, b">>> ")
+            client.sendall(b"print(x * 6)\n")
+            assert b"42" in self._drain_until(client, b">>> ")
+            client.close()
+        finally:
+            manhole.stop()

@@ -126,6 +126,11 @@ class Main(Logger):
         parser.add_argument("--profile", default=None, metavar="DIR",
                             help="capture a jax profiler trace of the "
                                  "run (view in TensorBoard/Perfetto)")
+        parser.add_argument("--manhole", action="store_true",
+                            help="serve a live debug console on a unix "
+                                 "socket (<dirs.run>/manhole-<pid>.sock;"
+                                 " attach: python -m "
+                                 "veles_tpu.core.manhole <path>)")
         parser.add_argument("--dump-config", action="store_true")
         parser.add_argument("-b", "--background", action="store_true",
                             help="daemonize: run detached with stdio "
@@ -262,6 +267,23 @@ class Main(Logger):
             self._dump_unit_attributes()
         if self.dry_run == "init":
             return
+        manhole = None
+        if getattr(self, "manhole_requested", False):
+            # live debug console (reference --manhole,
+            # thread_pool.py:137): attach to THIS running process
+            from veles_tpu.core.manhole import Manhole
+            manhole = Manhole(namespace=dict(
+                main=self, launcher=self.launcher,
+                workflow=self.workflow)).start()
+        try:
+            self._run_launcher()
+        finally:
+            # always reclaim the socket file — a crashed run's pid never
+            # comes back, so nothing else would ever unlink it
+            if manhole is not None:
+                manhole.stop()
+
+    def _run_launcher(self):
         if self.profile_dir:
             # device-level timeline (the reference's Mongo event spans /
             # web timeline role, done the TPU way): a jax profiler trace
@@ -312,6 +334,7 @@ class Main(Logger):
             initialize_distributed(args.coordinator, args.num_processes,
                                    args.process_id)
         self.dry_run = args.dry_run
+        self.manhole_requested = args.manhole
         self.snapshot_path = self._resolve_snapshot(args.snapshot)
         self.visualize = args.visualize
         self.dump_unit_attributes = args.dump_unit_attributes

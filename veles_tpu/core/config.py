@@ -147,6 +147,8 @@ root.common.update({
         # here (the TPU-era descendant of the reference's kernel binary
         # cache, accelerated_units.py:605-673)
         "xla_cache": os.path.join(_home, "cache", "xla"),
+        # runtime sockets (manhole) live here, one per pid
+        "run": os.path.join(_home, "run"),
     },
     "engine": {
         # compute dtype policy: matmuls/convs run in bfloat16 on the MXU with

@@ -1,0 +1,188 @@
+"""Manhole: attach a live REPL to a RUNNING process over a unix socket.
+
+TPU-native re-design of the reference's ``--manhole`` embedded debug
+shell (``veles/thread_pool.py:137`` + the vendored ``external/manhole``
+package): instead of a vendored signal-activated library, a small
+daemon thread listens on a per-pid unix domain socket (0600, under
+``root.common.dirs.run``) and serves a stdlib ``codeop``-based console
+with the launcher/workflow in scope. Attach with::
+
+    python -m veles_tpu.core.manhole ~/.veles_tpu/run/manhole-<pid>.sock
+
+or any unix-socket client (``socat - UNIX:<path>``). Multiple sequential
+connections are fine; one connection is served at a time (the REPL
+mutates live state — two concurrent hands in the process would be a
+footgun the reference avoided the same way).
+
+During statement execution stdout/stderr are redirected to the socket
+process-wide (the cost of a zero-dependency console, same trade the
+reference's manhole made); log handlers hold their own stream references
+and are unaffected.
+"""
+
+import codeop
+import io
+import os
+import socket
+import threading
+import traceback
+from contextlib import redirect_stderr, redirect_stdout
+
+from veles_tpu.core.logger import Logger
+
+BANNER = ("veles_tpu manhole (pid %d) — the process is LIVE; "
+          "objects in scope: %s\n")
+
+
+class Manhole(Logger):
+    """Unix-socket console server.
+
+    ``namespace`` is exposed to the console (conventionally ``launcher``,
+    ``workflow``, ``root``). ``path`` defaults to
+    ``<root.common.dirs.run>/manhole-<pid>.sock``.
+    """
+
+    def __init__(self, namespace=None, path=None):
+        super().__init__()
+        from veles_tpu.core.config import root
+        self.namespace = dict(namespace or {})
+        self.namespace.setdefault("root", root)
+        if path is None:
+            run_dir = root.common.dirs.run
+            os.makedirs(run_dir, mode=0o700, exist_ok=True)
+            path = os.path.join(run_dir, "manhole-%d.sock" % os.getpid())
+        self.path = path
+        self._sock = None
+        self._thread = None
+        self._closing = False
+
+    def start(self):
+        if self._sock is not None:
+            return self
+        self._closing = False
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(self.path)
+        os.chmod(self.path, 0o600)
+        sock.listen(1)
+        self._sock = sock
+        self._thread = threading.Thread(
+            target=self._serve, args=(sock,), name="manhole", daemon=True)
+        self._thread.start()
+        self.info("manhole listening on %s", self.path)
+        return self
+
+    def stop(self):
+        self._closing = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- server loop ----------------------------------------------------------
+
+    def _serve(self, sock):
+        # `sock` is a local reference: stop() clears self._sock while
+        # this thread may sit between the loop check and accept()
+        while not self._closing:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return  # closed
+            try:
+                self._console(conn)
+            except Exception:
+                if not self._closing:
+                    self.exception("manhole console crashed")
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _console(self, conn):
+        out = conn.makefile("w", encoding="utf-8", newline="\n")
+        inp = conn.makefile("r", encoding="utf-8")
+        out.write(BANNER % (os.getpid(),
+                            ", ".join(sorted(self.namespace)) or "(none)"))
+        compiler = codeop.CommandCompiler()
+        buffer = []
+        out.write(">>> ")
+        out.flush()
+        for line in inp:
+            buffer.append(line.rstrip("\n"))
+            source = "\n".join(buffer)
+            if source.strip() in ("exit", "exit()", "quit", "quit()"):
+                out.write("detached (process keeps running)\n")
+                out.flush()
+                return
+            try:
+                compiled = compiler(source, "<manhole>", "single")
+            except (SyntaxError, OverflowError, ValueError):
+                buffer = []
+                out.write(traceback.format_exc(limit=0))
+                out.write(">>> ")
+                out.flush()
+                continue
+            if compiled is None:  # incomplete statement: keep reading
+                out.write("... ")
+                out.flush()
+                continue
+            buffer = []
+            sink = io.StringIO()
+            try:
+                with redirect_stdout(sink), redirect_stderr(sink):
+                    exec(compiled, self.namespace)
+            except SystemExit:
+                out.write("SystemExit ignored — use exit to detach\n")
+            except BaseException:
+                sink.write(traceback.format_exc())
+            out.write(sink.getvalue())
+            out.write(">>> ")
+            out.flush()
+        # EOF: client hung up
+
+
+def attach(path):
+    """Tiny client: bridge the local terminal to a manhole socket."""
+    import sys
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(path)
+    sock_file = sock.makefile("rw", encoding="utf-8")
+
+    def pump():
+        while True:
+            data = sock.recv(4096)
+            if not data:
+                break
+            sys.stdout.write(data.decode("utf-8", "replace"))
+            sys.stdout.flush()
+
+    reader = threading.Thread(target=pump, daemon=True)
+    reader.start()
+    try:
+        for line in sys.stdin:
+            sock_file.write(line)
+            sock_file.flush()
+    except (KeyboardInterrupt, BrokenPipeError):
+        pass
+    finally:
+        sock.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) != 2:
+        sys.exit("usage: python -m veles_tpu.core.manhole <socket-path>")
+    attach(sys.argv[1])

@@ -1,9 +1,9 @@
 """Convolutional units (Znicz Conv/GradientDescentConv equivalents).
 
-Forward: NHWC activations × HWIO weights through ``lax.conv_general_dilated``
-— the layout XLA maps straight onto the MXU (the reference hand-tiled
-OpenCL/CUDA conv kernels in libZnicz; on TPU the compiler's conv emitter is
-the fast path, in bf16 with f32 accumulation per the engine dtype policy).
+Forward: NHWC activations × HWIO weights through ``ops.gemm.conv2d`` —
+the layout XLA maps straight onto the MXU (the reference hand-tiled
+OpenCL/CUDA conv kernels in libZnicz; on TPU the compiler's conv emitter
+is the fast path, under the shared engine precision policy).
 
 Backward: ``jax.vjp`` of the pre-activation forward *inside the jitted
 compute* — exact gradients with zero hand-derived transpose-conv code, fully
@@ -15,15 +15,12 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from veles_tpu.core.prng import get as get_rng
 from veles_tpu.memory import Array
 from veles_tpu.nn.jit_unit import ForwardUnit
 from veles_tpu.nn.gd import GradientDescent
 from veles_tpu.ops import activations
-
-DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
 
 
 class Conv(ForwardUnit):
@@ -75,14 +72,8 @@ class Conv(ForwardUnit):
             self.output.data = jnp.zeros(shape, jnp.float32)
 
     def _pre_activation(self, x, weights, bias):
-        # f32 operands with DEFAULT precision: XLA emits bf16 MXU passes on
-        # TPU (explicit bf16 casts here would break the conv transpose rule
-        # under jax.vjp, which requires uniform dtypes)
-        out = lax.conv_general_dilated(
-            x, weights, window_strides=self.sliding, padding=self.padding,
-            dimension_numbers=DIMENSION_NUMBERS,
-            precision=lax.Precision.DEFAULT,
-            preferred_element_type=jnp.float32)
+        from veles_tpu.ops.gemm import conv2d
+        out = conv2d(x, weights, self.sliding, self.padding)
         return out + bias
 
     def compute(self, x, weights, bias):

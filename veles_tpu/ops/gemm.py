@@ -48,20 +48,60 @@ def matmul(a, b, precision_level=None, out_dtype=None, use_pallas=None):
         out_dtype = a.dtype
     if use_pallas is None:
         use_pallas = root.common.engine.get("use_pallas", True)
+    (a, b), precision = compute_operands(
+        a, b, precision_level=precision_level)
+    if use_pallas and _pallas_eligible(a, b):
+        return pallas_matmul(a, b, out_dtype=out_dtype)
+    return lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    ).astype(out_dtype)
+
+
+def compute_operands(*arrays, precision_level=None):
+    """Apply the engine compute-dtype policy to MXU operands: returns
+    ``(cast_arrays, lax_precision)``. Level 0 casts to
+    ``root.common.engine.compute_dtype`` (bf16 — halves the HBM bytes of
+    every materialized operand feeding the MXU); levels 1/2 keep float32
+    with HIGH/HIGHEST passes. The dense path (``matmul``/``dense_layer``)
+    and the conv paths (``nn/conv.py``, ``parallel/fused.py``) all route
+    through this one policy."""
+    if precision_level is None:
+        precision_level = root.common.engine.get("precision_level", 0)
     if precision_level == 0:
         compute_dtype = jnp.dtype(
             root.common.engine.get("compute_dtype", "bfloat16"))
     else:
         compute_dtype = jnp.float32
-    a = a.astype(compute_dtype)
-    b = b.astype(compute_dtype)
-    if use_pallas and _pallas_eligible(a, b):
-        return pallas_matmul(a, b, out_dtype=out_dtype)
-    return lax.dot_general(
-        a, b, (((a.ndim - 1,), (0,)), ((), ())),
-        precision=_PRECISIONS[precision_level],
-        preferred_element_type=jnp.float32,
-    ).astype(out_dtype)
+    return (tuple(a.astype(compute_dtype) for a in arrays),
+            _PRECISIONS[precision_level])
+
+
+def conv2d(x, w, sliding, padding, precision_level=None):
+    """NHWC x HWIO convolution under the engine precision policy, f32
+    result. Level 0 casts the operands to ``compute_dtype`` and runs the
+    conv in that dtype end-to-end (the transpose rule under ``jax.vjp``
+    requires uniform operand dtypes, so a mixed bf16-operand /
+    f32-accumulator conv is not reverse-differentiable — the MXU still
+    accumulates f32 internally; only the materialized output rounds
+    through bf16), then casts the result back to f32 for the bias +
+    activation epilogue. Levels 1/2 keep f32 operands with HIGH/HIGHEST
+    passes and a f32 accumulator type. Both the graph conv unit
+    (``nn/conv.py``) and the fused engine (``parallel/fused.py``) call
+    THIS function, so the two modes stay bit-identical."""
+    if precision_level is None:
+        precision_level = root.common.engine.get("precision_level", 0)
+    (xc, wc), precision = compute_operands(
+        x, w, precision_level=precision_level)
+    kwargs = {}
+    if precision_level != 0:
+        kwargs["preferred_element_type"] = jnp.float32
+    out = lax.conv_general_dilated(
+        xc, wc, window_strides=tuple(sliding), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision, **kwargs)
+    return out.astype(jnp.float32)
 
 
 def _pallas_eligible(a, b):
@@ -247,18 +287,11 @@ def dense_layer(x, w, bias, activation="linear", precision_level=None,
     played for every All2All, ``backends.py:623-731``). Otherwise XLA's
     dot + its own epilogue fusion. ``docs/performance.md`` records the
     measured comparison between the two."""
-    if precision_level is None:
-        precision_level = root.common.engine.get("precision_level", 0)
     if use_pallas is None:
         use_pallas = root.common.engine.get("use_pallas", True) \
             and root.common.engine.get("pallas_epilogue", True)
-    if precision_level == 0:
-        compute_dtype = jnp.dtype(
-            root.common.engine.get("compute_dtype", "bfloat16"))
-    else:
-        compute_dtype = jnp.float32
-    xc = x.astype(compute_dtype)
-    wc = w.astype(compute_dtype)
+    (xc, wc), precision = compute_operands(
+        x, w, precision_level=precision_level)
     if use_pallas and _pallas_eligible(xc, wc):
         return _dense_with_vjp(activation)(xc, wc, bias).astype(
             out_dtype)
@@ -268,7 +301,7 @@ def dense_layer(x, w, bias, activation="linear", precision_level=None,
     # the f32 accumulator, ONE final cast to out_dtype
     out = lax.dot_general(
         xc, wc, (((xc.ndim - 1,), (0,)), ((), ())),
-        precision=_PRECISIONS[precision_level],
+        precision=precision,
         preferred_element_type=jnp.float32)
     return act(out + bias).astype(out_dtype)
 

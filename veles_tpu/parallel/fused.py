@@ -157,16 +157,14 @@ def _layer_forward(spec):
                                out_dtype=jnp.float32)
         return fwd
     if kind == _CONV:
+        from veles_tpu.ops.gemm import conv2d
         act = act_lib.ACTIVATIONS[spec["activation"]][0]
         sliding, padding = spec["sliding"], spec["padding"]
 
         def fwd(p, x):
-            out = lax.conv_general_dilated(
-                x, p["w"], window_strides=sliding, padding=padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                precision=lax.Precision.DEFAULT,
-                preferred_element_type=jnp.float32)
-            return act(out + p["b"])
+            # same precision-policy conv as the graph unit (bit-identical
+            # by construction — one shared implementation)
+            return act(conv2d(x, p["w"], sliding, padding) + p["b"])
         return fwd
     if kind == _ATTN:
         from veles_tpu.ops.attention import attention as attn_op

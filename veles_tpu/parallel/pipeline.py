@@ -90,22 +90,31 @@ def make_pipeline(mesh, stage_fn, n_microbatches, remat=False):
         check_vma=False)
 
     def pipeline(stage_weights, batch):
-        # fail HERE with the real constraint names, not deep inside the
-        # shard_map trace: (a) exactly one weight row per stage — a
-        # multiple would shard cleanly but silently run every k-th
-        # stage's weights; (b) the batch must split into microbatches
-        for leaf in jax.tree.leaves(stage_weights):
-            if leaf.shape[0] != n_stages:
-                raise ValueError(
-                    "stage weights leading dim %d != pipe axis %d"
-                    % (leaf.shape[0], n_stages))
-        if batch.shape[0] % n_microbatches:
-            raise ValueError(
-                "batch size %d does not divide into %d microbatches"
-                % (batch.shape[0], n_microbatches))
+        _validate(stage_weights, batch, n_stages, n_microbatches)
         return _pipeline(stage_weights, batch)
 
     return pipeline
+
+
+def _validate(stage_weights, batch, n_stages, n_microbatches, data_ax=1):
+    """Fail HERE with the real constraint names, not deep inside the
+    shard_map trace: (a) exactly one weight row per stage — a multiple
+    would shard cleanly but silently run every k-th stage's weights;
+    (b) the (per-data-shard) batch must split into microbatches."""
+    for leaf in jax.tree.leaves(stage_weights):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                "stage weights leading dim %d != pipe axis %d"
+                % (leaf.shape[0], n_stages))
+    rows = batch.shape[0]
+    if rows % data_ax:
+        raise ValueError(
+            "batch size %d does not shard over data axis %d"
+            % (rows, data_ax))
+    if (rows // data_ax) % n_microbatches:
+        raise ValueError(
+            "batch size %d (per data shard: %d) does not divide into "
+            "%d microbatches" % (rows, rows // data_ax, n_microbatches))
 
 
 def shard_stage_weights(weights, mesh):
@@ -156,11 +165,17 @@ def make_pipeline_train_step(mesh, stage_fn, n_microbatches, loss_fn,
         return new, loss
 
     batch_spec = P("data") if data_ax > 1 else P()
-    step = jax.shard_map(
+    step = jax.jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P("pipe"), batch_spec, batch_spec),
-        out_specs=(P("pipe"), P()), check_vma=False)
-    return jax.jit(step)
+        out_specs=(P("pipe"), P()), check_vma=False))
+
+    def train_step(stage_weights, batch, targets):
+        _validate(stage_weights, batch, n_stages, n_microbatches,
+                  data_ax)
+        return step(stage_weights, batch, targets)
+
+    return train_step
 
 
 def sequential_reference(stage_fn, stage_weights, batch):
