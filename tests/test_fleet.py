@@ -457,3 +457,139 @@ class TestChecksum:
             master.stop()
             slave.stop()
             thread.join(1)
+
+
+class TestSafeCodec:
+    """fleet/safecodec.py + the codec="safe" wire mode: a leaked secret
+    must not be remote code execution (VERDICT r2 weak #6)."""
+
+    @pytest.fixture
+    def safe_wire(self):
+        from veles_tpu.core.config import root
+        saved = root.common.fleet.get("codec", "pickle")
+        root.common.fleet.codec = "safe"
+        yield
+        root.common.fleet.codec = saved
+
+    def test_roundtrip_structures(self):
+        from veles_tpu.fleet import safecodec
+        import jax.numpy as jnp
+
+        msg = {
+            "type": "job",
+            "n": 7, "f": 1.5, "flag": True, "none": None,
+            "name": "unit", "raw": b"\x00\xffbytes",
+            "list": [1, [2.5, "x"], {"k": (1, 2)}],
+            "tuple": (3, "y"),
+            5: "int-key", (1, "t"): "tuple-key",
+            "arr": numpy.arange(12, dtype=numpy.float32).reshape(3, 4),
+            "i64": numpy.arange(3, dtype=numpy.int64),
+            "jax": jnp.ones((2, 2), jnp.bfloat16),
+            "scalar": numpy.float32(2.25),
+        }
+        out = safecodec.loads(safecodec.dumps(msg))
+        assert out["type"] == "job" and out["n"] == 7
+        assert out["f"] == 1.5 and out["flag"] is True
+        assert out["none"] is None and out["raw"] == b"\x00\xffbytes"
+        assert out["list"] == [1, [2.5, "x"], {"k": (1, 2)}]
+        assert out["tuple"] == (3, "y")
+        assert out[5] == "int-key" and out[(1, "t")] == "tuple-key"
+        numpy.testing.assert_array_equal(out["arr"], msg["arr"])
+        assert out["arr"].dtype == numpy.float32
+        assert out["i64"].dtype == numpy.int64
+        assert out["jax"].dtype == numpy.dtype("bfloat16")
+        numpy.testing.assert_array_equal(
+            out["jax"].astype(numpy.float32), numpy.ones((2, 2)))
+        assert out["scalar"] == numpy.float32(2.25)
+        assert type(out["scalar"]) is numpy.float32  # not a 0-d array
+
+    def test_numpy_keys_coerced_at_encode(self):
+        """Numpy-scalar dict keys (bare or inside tuple keys) must
+        round-trip as working lookups, not explode at the receiver."""
+        from veles_tpu.fleet import safecodec
+
+        msg = {numpy.int64(3): "a", (numpy.int32(1), "t"): "b"}
+        out = safecodec.loads(safecodec.dumps(msg))
+        assert out[3] == "a" and out[(1, "t")] == "b"
+        with pytest.raises(safecodec.UnsupportedType, match="dict key"):
+            safecodec.dumps({frozenset((1,)): "x"})
+
+    def test_malformed_safe_frame_is_protocol_error(self, safe_wire):
+        """A malformed-but-authenticated safe frame must surface as
+        ProtocolError (peer dropped), never a raw KeyError/ValueError
+        that would kill the fleet session loop."""
+        import gzip as gzip_lib
+        import json
+        import struct as struct_lib
+
+        from veles_tpu.fleet.protocol import (
+            ProtocolError, _mac, read_frame)
+
+        for header in ({"x": 1},                       # missing 't'
+                       {"t": "a", "d": "<f4",
+                        "s": [5, 5], "o": 0, "n": 4},  # bad reshape
+                       {"t": "zz"}):                   # unknown node
+            head = json.dumps(header).encode()
+            payload = struct_lib.pack(">I", len(head)) + head + b"\0" * 4
+            if len(payload) >= 64 * 1024:
+                payload = gzip_lib.compress(payload)
+            frame = (struct_lib.pack(">IB", len(payload), 2)
+                     + _mac(KEY, 2, payload) + payload)
+            with pytest.raises(ProtocolError, match="bad safe frame"):
+                asyncio.run(read_frame(FakeReader(frame), KEY))
+
+    def test_unsupported_type_fails_at_encode(self):
+        from veles_tpu.fleet import safecodec
+
+        class Payload:
+            pass
+
+        with pytest.raises(safecodec.UnsupportedType,
+                           match="Payload"):
+            safecodec.dumps({"job": Payload()})
+        with pytest.raises(safecodec.UnsupportedType):
+            safecodec.dumps(numpy.array([object()], dtype=object))
+
+    def test_safe_receiver_rejects_pickle_frames(self, safe_wire):
+        """THE security property: a safe-configured host never reaches
+        pickle.loads, even for a correctly authenticated frame."""
+        from veles_tpu.core.config import root
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+
+        root.common.fleet.codec = "pickle"
+        pickle_frame = encode_frame({"type": "hello"}, KEY)
+        root.common.fleet.codec = "safe"
+        with pytest.raises(ProtocolError, match="safe fleet codec"):
+            asyncio.run(read_frame(FakeReader(pickle_frame), KEY))
+
+    def test_safe_frame_roundtrip_and_compression(self, safe_wire):
+        from veles_tpu.fleet.protocol import read_frame
+
+        msg = {"type": "job",
+               "job": [numpy.zeros(1024 * 1024, numpy.float32),
+                       {"lr": 0.5}]}
+        frame = encode_frame(msg, KEY)
+        assert len(frame) < 1024 * 1024  # gzip applies to safe frames too
+        out = asyncio.run(read_frame(FakeReader(frame), KEY))
+        numpy.testing.assert_array_equal(out["job"][0], msg["job"][0])
+        assert out["job"][1] == {"lr": 0.5}
+
+    def test_fleet_trains_on_safe_codec(self, safe_wire):
+        """The PRODUCT path: master + slave converge identically to the
+        standalone run with zero pickle on the wire."""
+        kw = _kw()
+        _seed()
+        lau = Launcher()
+        wf_sa = MLPWorkflow(lau, name="fleet-t", **kw)
+        lau.initialize()
+        lau.run()
+        expected = wf_sa.decision.best_n_err[VALID]
+
+        master, wf_m, thread = _run_master(kw)
+        slave = _run_slave(master.agent.port, kw)
+        slave.run()
+        thread.join(60)
+        assert not thread.is_alive(), "master did not finish"
+        assert wf_m.decision.best_n_err[VALID] == expected
+        master.stop()
+        slave.stop()

@@ -188,6 +188,11 @@ root.common.update({
         "job_timeout": 120.0,
         "sync_interval": 1.0,
         "max_reconnect_attempts": 7,
+        # wire serialization: "pickle" (default; arbitrary payloads) or
+        # "safe" (pickle-free — a leaked fleet secret is then data
+        # injection at worst, not code execution). Set IDENTICALLY on
+        # every fleet host; see fleet/safecodec.py.
+        "codec": "pickle",
     },
     "forge": {"service_name": "forge", "manifest": "manifest.json",
               "server": "http://127.0.0.1:8190"},

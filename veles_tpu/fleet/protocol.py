@@ -7,19 +7,27 @@ asyncio stream. Frames:
     [4-byte big-endian length][1-byte codec][32-byte HMAC-SHA256][payload]
 
 codec 0 = raw pickle, 1 = gzip pickle (auto-chosen by size, mirroring the
-reference's pluggable chunk compression). Messages are dicts with a "type"
-key; job/update payloads ride inside them as pickled python objects (the
-units' generate/apply contracts define their content).
+reference's pluggable chunk compression), 2 = safe (pickle-free, see
+``fleet/safecodec.py``), 3 = gzip safe. Messages are dicts with a "type"
+key; job/update payloads ride inside them (the units' generate/apply
+contracts define their content).
 
-Security: pickle is required for arbitrary job/update pytrees, so EVERY
-frame — including the pre-handshake hello — is authenticated with a
-shared-secret HMAC verified *before* any decompression or unpickling; a
-peer without the secret cannot reach ``pickle.loads``. The secret comes
-from (in priority order) an explicit argument, ``$VELES_TPU_FLEET_SECRET``,
-``root.common.fleet.secret``, or defaults to the workflow checksum — which
-both sides must share anyway (the reference's compatibility check,
-``workflow.py:847-862``), so possession of the workflow file is the
-minimum bar. Masters bind 127.0.0.1 unless an interface is given.
+Security: EVERY frame — including the pre-handshake hello — is
+authenticated with a shared-secret HMAC verified *before* any
+decompression or deserialization; a peer without the secret cannot reach
+``pickle.loads``. The secret comes from (in priority order) an explicit
+argument, ``$VELES_TPU_FLEET_SECRET``, ``root.common.fleet.secret``, or
+defaults to the workflow checksum — which both sides must share anyway
+(the reference's compatibility check, ``workflow.py:847-862``), so
+possession of the workflow file is the minimum bar. Masters bind
+127.0.0.1 unless an interface is given.
+
+Defense in depth: ``root.common.fleet.codec = "safe"`` (set on EVERY
+host — the wire codec is not negotiable, by design: a negotiation could
+be downgraded) moves the whole wire to the pickle-free codec and makes
+the receiver REJECT pickle frames outright, so even a leaked secret is
+no longer remote code execution — at worst bogus data. The default stays
+"pickle" for payload-generality parity with the reference's wire.
 """
 
 import gzip
@@ -70,22 +78,61 @@ def _mac(key, codec, payload):
                         hashlib.sha256).digest()
 
 
+def _wire_codec():
+    """The configured serialization family: "pickle" (default) or
+    "safe". Read per frame so tests/configs can flip it live."""
+    from veles_tpu.core.config import root
+    codec = root.common.fleet.get("codec", "pickle")
+    if codec not in ("pickle", "safe"):
+        raise ProtocolError(
+            "root.common.fleet.codec must be 'pickle' or 'safe', got %r"
+            % (codec,))
+    return codec
+
+
+def _serialize(message):
+    if _wire_codec() == "safe":
+        from veles_tpu.fleet import safecodec
+        return safecodec.dumps(message), 2
+    return pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL), 0
+
+
+def _deserialize(payload, codec):
+    if codec in (0, 1):
+        if _wire_codec() != "pickle":
+            raise ProtocolError(
+                "received a pickle frame but this host is configured "
+                "with the safe fleet codec — set root.common.fleet."
+                "codec identically on every fleet host")
+        return pickle.loads(payload)
+    from veles_tpu.fleet import safecodec
+    try:
+        return safecodec.loads(payload)
+    except (safecodec.UnsupportedType, KeyError, ValueError, TypeError,
+            IndexError, struct.error) as exc:
+        # ANY malformed-but-authenticated frame must surface as a
+        # protocol violation (the session handlers drop the peer and
+        # keep the fleet alive) — never as a raw exception that would
+        # kill the client/server loop: safe mode's threat model says a
+        # secret holder gets at most bogus data, not a DoS
+        raise ProtocolError("bad safe frame: %s: %s"
+                            % (type(exc).__name__, exc))
+
+
 def encode_frame(message, key, shm_threshold=None):
     """``shm_threshold``: when set (same-host connections, negotiated at
     handshake by machine id — reference ``server.py:721-732``), payloads
     at least that large move through a shared-memory segment
     (``fleet/sharedio.py``) and only a descriptor frame hits the wire."""
-    payload = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+    payload, codec = _serialize(message)
     if shm_threshold is not None and len(payload) >= shm_threshold:
         from veles_tpu.fleet import sharedio
         desc = sharedio.put(payload, key)
-        payload = pickle.dumps({"__shm__": desc},
-                               protocol=pickle.HIGHEST_PROTOCOL)
-    codec = 0
+        payload, codec = _serialize({"__shm__": desc})
     if len(payload) >= COMPRESS_THRESHOLD:
         compressed = gzip.compress(payload, compresslevel=1)
         if len(compressed) < len(payload):
-            payload, codec = compressed, 1
+            payload, codec = compressed, codec + 1
     if len(payload) > MAX_FRAME:
         # fail at the SENDER with a clear message — the receiver would
         # reject it as a protocol violation and misdiagnose the cause
@@ -110,16 +157,19 @@ async def read_frame(reader, key, max_frame=MAX_FRAME):
     payload = await reader.readexactly(length)
     if not hmac_lib.compare_digest(mac, _mac(key, codec, payload)):
         raise ProtocolError("frame failed HMAC authentication")
-    if codec == 1:
+    if codec not in (0, 1, 2, 3):
+        raise ProtocolError("unknown frame codec %d" % codec)
+    if codec in (1, 3):
         payload = gzip.decompress(payload)
-    message = pickle.loads(payload)
+        codec -= 1
+    message = _deserialize(payload, codec)
     if isinstance(message, dict) and "__shm__" in message:
         from veles_tpu.fleet import sharedio
         try:
             payload = sharedio.get(message["__shm__"], key)
         except (OSError, ValueError) as exc:
             raise ProtocolError("bad shared-memory frame: %s" % exc)
-        message = pickle.loads(payload)
+        message = _deserialize(payload, codec)
     return message
 
 
