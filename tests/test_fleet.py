@@ -525,11 +525,14 @@ class TestSafeCodec:
         from veles_tpu.fleet.protocol import (
             ProtocolError, _mac, read_frame)
 
+        deep = b"[" * 50000 + b"1" + b"]" * 50000  # RecursionError bait
         for header in ({"x": 1},                       # missing 't'
                        {"t": "a", "d": "<f4",
                         "s": [5, 5], "o": 0, "n": 4},  # bad reshape
-                       {"t": "zz"}):                   # unknown node
-            head = json.dumps(header).encode()
+                       {"t": "zz"},                    # unknown node
+                       deep):
+            head = (header if isinstance(header, bytes)
+                    else json.dumps(header).encode())
             payload = struct_lib.pack(">I", len(head)) + head + b"\0" * 4
             if len(payload) >= 64 * 1024:
                 payload = gzip_lib.compress(payload)

@@ -448,3 +448,80 @@ def test_pipelined_snapshot_resume_continues(tmp_path):
     # more epochs on digits reliably lower the error, so a broken resume
     # (e.g. garbage params after restore) fails here
     assert restored.decision.best_n_err[VALID] < best_before
+
+
+class TestAdamSolver:
+    """solver="adam" (additive beyond the reference's momentum-only GD):
+    graph and fused modes share gd.make_updater, so they must agree."""
+
+    def _build(self, fused, solver="adam", max_epochs=3, sweep=True):
+        prng.get("default").seed(4321)
+        prng.get("loader").seed(8765)
+        X, y = _digits_dataset()
+        return MLPWorkflow(
+            DummyLauncher(), layers=(32, 10),
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 297, 1500],
+                               minibatch_size=100,
+                               normalization_type="linear"),
+            learning_rate=0.01, solver=solver, max_epochs=max_epochs,
+            fused=fused, fused_sweep=sweep, fused_pipeline=False,
+            fail_iterations=50, name="adam-identity")
+
+    def test_adam_learns_graph_mode(self):
+        wf = _train(self._build(fused=False))
+        assert wf.decision.best_n_err[VALID] is not None
+        assert wf.decision.best_n_err[VALID] < 40  # < ~13.5% on digits
+        # adam state exists and evolved (graph mode really ran)
+        gd = wf.gds[0]
+        assert wf.fused_tick is None
+        assert gd._second_w.data is not None
+        assert float(gd._step.data) > 0
+
+    @pytest.mark.parametrize("sweep", [False, True])
+    def test_adam_fused_matches_graph(self, sweep):
+        graph = _train(self._build(fused=False))
+        fused = _train(self._build(fused=True, sweep=sweep))
+        assert fused.fused_tick is not None, "fused mode did not engage"
+        assert (fused.decision.best_n_err[VALID]
+                == graph.decision.best_n_err[VALID])
+        # weights: LOOSE tolerance by design — adam's first-step update
+        # is lr*sign(g) (bias-corrected m/sqrt(s) with tiny s), which
+        # amplifies fp-reassociation differences between the fused and
+        # per-unit autodiff graphs on near-zero gradients into +-2*lr
+        # jumps. Metric-level equality above is the parity contract;
+        # this bound only catches gross update bugs (wrong lr/sign/
+        # moment wiring would blow past it)
+        for fg, ff in zip(graph.forwards, fused.forwards):
+            numpy.testing.assert_allclose(
+                numpy.asarray(fg.weights.data),
+                numpy.asarray(ff.weights.data), atol=0.05)
+        # step counts advance one per TRAIN tick. Known, pre-existing
+        # one-tick offset: on the stopping tick graph mode's gds sit
+        # BELOW the decision in the cycle and get gate-blocked by
+        # `complete`, while the fused sweep trains its whole last class
+        # sweep before the decision sees the metrics
+        g_step = float(graph.gds[0]._step.data)
+        f_step = float(fused.gds[0]._step.data)
+        assert g_step > 0 and abs(g_step - f_step) <= 1
+
+    def test_adam_adapts_fast(self):
+        """Sanity: the adaptive update is live — two epochs at lr=0.01
+        already put digits validation under 20% error."""
+        wf = _train(self._build(fused=True, max_epochs=2))
+        assert wf.decision.best_n_err[VALID] < 60
+
+    def test_adam_snapshot_roundtrip(self, tmp_path):
+        """Second moments + step survive a snapshot: resumed training
+        continues from the same optimizer state."""
+        import pickle
+
+        wf = _train(self._build(fused=True, max_epochs=2))
+        step_before = float(wf.gds[0]._step.data)
+        blob = pickle.dumps(wf)
+        wf2 = pickle.loads(blob)
+        gd2 = wf2.gds[0]
+        assert float(gd2._step.data) == step_before
+        numpy.testing.assert_array_equal(
+            numpy.asarray(gd2._second_w.data),
+            numpy.asarray(wf.gds[0]._second_w.data))

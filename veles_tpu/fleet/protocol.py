@@ -109,7 +109,7 @@ def _deserialize(payload, codec):
     try:
         return safecodec.loads(payload)
     except (safecodec.UnsupportedType, KeyError, ValueError, TypeError,
-            IndexError, struct.error) as exc:
+            IndexError, RecursionError, struct.error) as exc:
         # ANY malformed-but-authenticated frame must surface as a
         # protocol violation (the session handlers drop the peer and
         # keep the fleet alive) — never as a raw exception that would

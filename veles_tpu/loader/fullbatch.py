@@ -140,6 +140,7 @@ class FullBatchLoader(Loader):
     def init_unpickled(self):
         super().init_unpickled()
         self._fill_jit_ = None
+        self._zero_labels_ = None
 
     @property
     def _fill_jit(self):
@@ -161,10 +162,18 @@ class FullBatchLoader(Loader):
         return self._fill_jit_
 
     def fill_minibatch(self, indices, valid):
-        idx = jnp.asarray(indices)
         data = self.original_data.data
-        labels = (self.original_labels.data if self.original_labels
-                  else jnp.zeros(len(self.original_data), jnp.int32))
+        if self.original_labels:
+            labels = self.original_labels.data
+        else:
+            # label-less (MSE) datasets: build the placeholder ONCE — a
+            # fresh dataset-sized jnp.zeros would be an eager dispatch
+            # plus a full-length allocation per tick
+            if self._zero_labels_ is None \
+                    or len(self._zero_labels_) != len(self.original_data):
+                self._zero_labels_ = jnp.zeros(
+                    len(self.original_data), jnp.int32)
+            labels = self._zero_labels_
         if not self.on_device and not isinstance(data, jax.Array):
             # host gather path
             batch = numpy.take(numpy.asarray(data), indices, axis=0)
@@ -174,13 +183,20 @@ class FullBatchLoader(Loader):
             self.minibatch_data.data = jnp.asarray(batch)
             self.minibatch_labels.data = jnp.asarray(lab)
             self.sample_mask.data = jnp.asarray(mask)
-        else:
-            batch, lab, mask = self._fill_jit(data, labels, idx,
-                                              jnp.int32(valid))
-            self.minibatch_data.data = batch
-            self.minibatch_labels.data = lab
-            self.sample_mask.data = mask
-        self.minibatch_indices.data = idx
+            self.minibatch_indices.data = jnp.asarray(indices)
+            return
+        # the host indices and valid count ride the jit dispatch itself —
+        # eager jnp.asarray/jnp.int32 here would each be a separate
+        # device_put dispatch per tick
+        batch, lab, mask = self._fill_jit(data, labels, indices,
+                                          numpy.int32(valid))
+        self.minibatch_data.data = batch
+        self.minibatch_labels.data = lab
+        self.sample_mask.data = mask
+        # host numpy: consumers (fused tick, snapshot replays) feed it
+        # back into jit calls, where it rides those dispatches — an
+        # eager jnp.asarray here would re-upload it a second time
+        self.minibatch_indices.data = indices
 
 
 @register_loader("full_batch_mse")

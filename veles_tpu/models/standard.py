@@ -52,7 +52,8 @@ FORWARD_TYPES = {
 }
 
 TRAINER_KEYS = ("learning_rate", "learning_rate_bias", "weights_decay",
-                "l1_vs_l2", "gradient_moment")
+                "l1_vs_l2", "gradient_moment", "solver", "adam_beta1",
+                "adam_beta2", "adam_epsilon")
 
 
 class StandardWorkflow(Workflow):

@@ -111,27 +111,29 @@ class GDSelfAttention(GradientDescent):
             self._velocity_b.data = jnp.zeros_like(self.bias.data)
             self._velocity_ow.data = jnp.zeros_like(self.out_weights.data)
             self._velocity_ob.data = jnp.zeros_like(self.out_bias.data)
+        self._init_solver_state()
         self._refresh_hyper()
 
     def compute(self, err_output, x, w_qkv, b_qkv, w_out, b_out,
-                vel_w, vel_b, vel_ow, vel_ob, hyper):
-        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
-                                    hyper[4])
+                vel_w, vel_b, vel_ow, vel_ob, *rest):
+        solver_upd, hyper, secs, extras = self._unpack_solver(
+            rest, n_leaves=4)
+        lr, lr_b, l2, l1 = hyper[0], hyper[1], hyper[2], hyper[3]
         _, vjp = jax.vjp(self.forward_unit._forward, x, w_qkv, b_qkv,
                          w_out, b_out)
         err_input, g_qkv, g_bqkv, g_out, g_bout = vjp(err_output)
 
-        def upd(w, g, v, rate):
+        def upd(w, g, v, sec, rate):
             g = g + l2 * w + l1 * jnp.sign(w)
-            v_new = moment * v - rate * g
-            return w + v_new, v_new
+            return solver_upd(w, g, v, sec, rate)
 
-        w_qkv, vel_w = upd(w_qkv, g_qkv, vel_w, lr)
-        b_qkv, vel_b = upd(b_qkv, g_bqkv, vel_b, lr_b)
-        w_out, vel_ow = upd(w_out, g_out, vel_ow, lr)
-        b_out, vel_ob = upd(b_out, g_bout, vel_ob, lr_b)
+        w_qkv, vel_w, sec_w = upd(w_qkv, g_qkv, vel_w, secs[0], lr)
+        b_qkv, vel_b, sec_b = upd(b_qkv, g_bqkv, vel_b, secs[1], lr_b)
+        w_out, vel_ow, sec_ow = upd(w_out, g_out, vel_ow, secs[2], lr)
+        b_out, vel_ob, sec_ob = upd(b_out, g_bout, vel_ob, secs[3], lr_b)
         return (err_input, w_qkv, b_qkv, w_out, b_out,
-                vel_w, vel_b, vel_ow, vel_ob)
+                vel_w, vel_b, vel_ow, vel_ob) \
+            + extras((sec_w, sec_b, sec_ow, sec_ob))
 
 
 class GDLayerNorm(GradientDescent):
@@ -145,16 +147,19 @@ class GDLayerNorm(GradientDescent):
         link_err_output(self, err_source)
         return self
 
-    def compute(self, err_output, x, y, scale, shift, vel_w, vel_b, hyper):
-        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
-                                    hyper[4])
+    def compute(self, err_output, x, y, scale, shift, vel_w, vel_b,
+                *rest):
+        upd, hyper, (sec_w, sec_b), extras = self._unpack_solver(rest)
+        lr, lr_b, l2, l1 = hyper[0], hyper[1], hyper[2], hyper[3]
         _, vjp = jax.vjp(self.forward_unit._forward, x, scale, shift)
         err_input, g_scale, g_shift = vjp(err_output)
         g_scale = g_scale + l2 * scale + l1 * jnp.sign(scale)
-        new_vel_w = moment * vel_w - lr * g_scale
-        new_vel_b = moment * vel_b - lr_b * g_shift
-        return (err_input, scale + new_vel_w, shift + new_vel_b,
-                new_vel_w, new_vel_b)
+        new_w, new_vel_w, new_sec_w = upd(scale, g_scale, vel_w, sec_w,
+                                          lr)
+        new_b, new_vel_b, new_sec_b = upd(shift, g_shift, vel_b, sec_b,
+                                          lr_b)
+        return (err_input, new_w, new_b, new_vel_w, new_vel_b) \
+            + extras((new_sec_w, new_sec_b))
 
 
 class LayerNorm(ForwardUnit):

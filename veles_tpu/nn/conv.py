@@ -110,18 +110,21 @@ class GDConv(GradientDescent):
         link_err_output(self, err_source)
         return self
 
-    def compute(self, err_output, x, y, weights, bias, vel_w, vel_b, hyper):
-        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
-                                    hyper[4])
+    def compute(self, err_output, x, y, weights, bias, vel_w, vel_b,
+                *rest):
+        upd, hyper, (sec_w, sec_b), extras = self._unpack_solver(rest)
+        lr, lr_b, l2, l1 = hyper[0], hyper[1], hyper[2], hyper[3]
         _, deriv = activations.ACTIVATIONS[self.ACTIVATION]
         err_pre = err_output * deriv(y)
         _, vjp = jax.vjp(self.forward_unit._pre_activation, x, weights, bias)
         err_input, grad_w, grad_b = vjp(err_pre)
         grad_w = grad_w + l2 * weights + l1 * jnp.sign(weights)
-        new_vel_w = moment * vel_w - lr * grad_w
-        new_vel_b = moment * vel_b - lr_b * grad_b
-        return (err_input, weights + new_vel_w, bias + new_vel_b,
-                new_vel_w, new_vel_b)
+        new_w, new_vel_w, new_sec_w = upd(weights, grad_w, vel_w, sec_w,
+                                          lr)
+        new_b, new_vel_b, new_sec_b = upd(bias, grad_b, vel_b, sec_b,
+                                          lr_b)
+        return (err_input, new_w, new_b, new_vel_w, new_vel_b) \
+            + extras((new_sec_w, new_sec_b))
 
 
 class GDConvTanh(GDConv):

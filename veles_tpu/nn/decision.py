@@ -57,6 +57,9 @@ class DecisionGD(Unit):
         # accumulators are still lazy device values (materialized in one
         # batched transfer at the epoch boundary)
         self._pending_classes = []
+        # (the volatile per-tick accumulators — _acc_jit_, _dev_acc_,
+        # _dev_confusion_ — are created in init_unpickled, which
+        # Pickleable.__init__ already ran)
         # pipelined fused mode: materialize each epoch's metrics this
         # many epochs LATE — by then the device has finished computing
         # them, so the batched read never stalls the dispatch pipeline.
@@ -89,26 +92,69 @@ class DecisionGD(Unit):
         # MSE evaluators publish no n_err — the error count stays 0 and
         # improvement tracks the loss metric (DecisionMSE._metric)
         n_err_slot = getattr(self.evaluator, "n_err", None)
-        if n_err_slot is not None:
-            self.epoch_n_err[klass] = (self.epoch_n_err[klass]
-                                       + n_err_slot.data)
+        sweep = getattr(self.loader, "sweep_serving", False)
         self.epoch_samples[klass] += size
-        self.epoch_loss[klass] = (self.epoch_loss[klass]
-                                  + self.evaluator.loss.data * size)
-        # accumulate the VALID confusion matrix over the epoch (the
-        # graph evaluator publishes per minibatch; the fused tick per
-        # eval pass — unset when compute_confusion is off)
+        cm_data = None
         if klass == VALID:
             cm = getattr(self.evaluator, "confusion_matrix", None)
             cm_data = getattr(cm, "data", None)
+        if sweep:
+            # one tick per class sweep: device-side accumulate is one
+            # cheap lazy op and the values ride the epoch pipeline
+            if n_err_slot is not None:
+                self.epoch_n_err[klass] = (self.epoch_n_err[klass]
+                                           + n_err_slot.data)
+            self.epoch_loss[klass] = (self.epoch_loss[klass]
+                                      + self.evaluator.loss.data * size)
             if cm_data is not None:
                 self._epoch_confusion = (cm_data
                                          if self._epoch_confusion is None
                                          else self._epoch_confusion
                                          + cm_data)
+        else:
+            # per-minibatch serving (graph / partial fusion): exactly ONE
+            # jitted dispatch on the tick path — the 3-6 separate eager
+            # accumulate ops this used to run cost ~30 ms/tick through a
+            # tunneled runtime (each eager op is its own dispatch), the
+            # dominant graph-mode cost. The fused accumulator keeps the
+            # running sums on device; ONE device_get settles them at the
+            # class boundary.
+            if self._acc_jit_ is None:
+                import jax
+
+                @jax.jit
+                def acc_fn(n_err_acc, loss_acc, n_err, loss, size):
+                    return n_err_acc + n_err, loss_acc + loss * size
+
+                @jax.jit
+                def acc_cm_fn(n_err_acc, loss_acc, cm_acc,
+                              n_err, loss, size, cm):
+                    return (n_err_acc + n_err, loss_acc + loss * size,
+                            cm_acc + cm)
+                self._acc_jit_ = (acc_fn, acc_cm_fn)
+            import jax.numpy as jnp
+            if self._dev_acc_[klass] is None:
+                self._dev_acc_[klass] = (jnp.zeros((), jnp.int32),
+                                         jnp.zeros((), jnp.float32))
+            n_err_acc, loss_acc = self._dev_acc_[klass]
+            n_err_val = (n_err_slot.data if n_err_slot is not None
+                         else 0)
+            if cm_data is not None:
+                if self._dev_confusion_ is None:
+                    self._dev_confusion_ = jnp.zeros_like(cm_data)
+                n_err_acc, loss_acc, self._dev_confusion_ = \
+                    self._acc_jit_[1](
+                        n_err_acc, loss_acc, self._dev_confusion_,
+                        n_err_val, self.evaluator.loss.data, size,
+                        cm_data)
+            else:
+                n_err_acc, loss_acc = self._acc_jit_[0](
+                    n_err_acc, loss_acc, n_err_val,
+                    self.evaluator.loss.data, size)
+            self._dev_acc_[klass] = (n_err_acc, loss_acc)
         if not self.loader.epoch_ended_for_class:
             return
-        if getattr(self.loader, "sweep_serving", False):
+        if sweep:
             # sweep mode: a host read here would block on the in-flight
             # sweep once per class — a full device round trip each (the
             # dominant per-epoch cost on a tunneled TPU). Defer ALL
@@ -120,14 +166,20 @@ class DecisionGD(Unit):
                 self._queue_epoch()
                 self._drain_epochs()
             return
-        # one sample-class sweep finished: sync its accumulators to host
-        # in ONE batched transfer (sequential int()/float() reads pay a
-        # device round trip each)
+        # one sample class finished: settle the device accumulators in
+        # ONE batched transfer
         import jax
-        n_err, loss = jax.device_get((self.epoch_n_err[klass],
-                                      self.epoch_loss[klass]))
-        self.epoch_n_err[klass] = int(n_err)
-        self.epoch_loss[klass] = float(loss)
+        if self._dev_acc_[klass] is not None:
+            n_err, loss = jax.device_get(self._dev_acc_[klass])
+            self._dev_acc_[klass] = None
+            self.epoch_n_err[klass] += int(n_err)
+            self.epoch_loss[klass] += float(loss)
+        if klass == VALID and self._dev_confusion_ is not None:
+            total = jax.device_get(self._dev_confusion_)
+            self._dev_confusion_ = None
+            self._epoch_confusion = (
+                total if self._epoch_confusion is None
+                else self._epoch_confusion + total)
         self._on_class_ended(klass)
         if self.loader.epoch_ended:
             self._on_epoch_ended()
@@ -337,6 +389,9 @@ class DecisionGD(Unit):
         if not hasattr(self, "pipeline_depth"):
             self.pipeline_depth = 0
         self._lagged_epochs_ = []
+        self._acc_jit_ = None
+        self._dev_acc_ = [None, None, None]
+        self._dev_confusion_ = None
 
     def apply_data_from_slave(self, data, slave=None):
         klass = data["klass"]

@@ -9,7 +9,7 @@ gradient GEMM, error back-GEMM, momentum + weight-decay update — is one
 jitted computation (the reference launched four separate kernels:
 err_y_update, weights_update, bias_update, err_h_update).
 
-Update rule (Znicz GD semantics):
+Update rule (Znicz GD semantics, ``solver="momentum"``, the default):
 
     v    ← μ·v − λ·(∇W + Λ₂·W + Λ₁·sign(W))
     W    ← W + v
@@ -17,6 +17,18 @@ Update rule (Znicz GD semantics):
 with learning_rate λ, gradient_moment μ, l2 Λ₂ (``weights_decay``), l1 Λ₁.
 Hyperparameters are passed into the jitted function as arrays so they can be
 annealed per epoch without retracing.
+
+``solver="adam"`` (additive — the reference had only momentum SGD) keeps
+the same regularized gradient and applies the bias-corrected Adam update:
+
+    m ← β₁·m + (1−β₁)·g        s ← β₂·s + (1−β₂)·g²
+    W ← W − λ·(m/(1−β₁ᵗ)) / (√(s/(1−β₂ᵗ)) + ε)
+
+The first moment lives in the same ``_velocity_*`` slots (so fleet and
+snapshot plumbing is identical); second moments and the shared step
+counter are extra Array slots created only when the solver needs them.
+The fused engine (``parallel/fused.py``) implements the SAME per-leaf
+math, so graph and fused modes stay bit-identical for both solvers.
 """
 
 import jax.numpy as jnp
@@ -25,6 +37,33 @@ from veles_tpu.memory import Array
 from veles_tpu.nn.jit_unit import JitUnit
 from veles_tpu.ops import activations
 from veles_tpu.ops.gemm import matmul
+
+SOLVERS = ("momentum", "adam")
+
+
+def make_updater(solver, hyper, step):
+    """The per-leaf update shared by every GD unit (and mirrored by the
+    fused engine): ``upd(w, grad, vel, second, rate) -> (new_w, new_vel,
+    new_second)``. ``grad`` arrives already regularized (l2/l1 added by
+    the caller where the leaf's policy says so). For momentum the second
+    moment passes through untouched; ``step`` is the ALREADY incremented
+    step count (1-based) for Adam's bias correction."""
+    if solver == "momentum":
+        moment = hyper[4]
+
+        def upd(w, grad, vel, second, rate):
+            v2 = moment * vel - rate * grad
+            return w + v2, v2, second
+        return upd
+    beta1, beta2, eps = hyper[5], hyper[6], hyper[7]
+
+    def upd(w, grad, vel, second, rate):
+        m = beta1 * vel + (1.0 - beta1) * grad
+        s = beta2 * second + (1.0 - beta2) * grad * grad
+        m_hat = m / (1.0 - beta1 ** step)
+        s_hat = s / (1.0 - beta2 ** step)
+        return w - rate * m_hat / (jnp.sqrt(s_hat) + eps), m, s
+    return upd
 
 
 def fleet_merge_mode():
@@ -56,7 +95,28 @@ class GradientDescent(JitUnit):
         self.l1_vs_l2 = kwargs.pop("l1_vs_l2", 0.0)
         self.gradient_moment = kwargs.pop("gradient_moment", 0.0)
         self.include_bias = kwargs.pop("include_bias", True)
+        self.solver = kwargs.pop("solver", "momentum")
+        if self.solver not in SOLVERS:
+            raise ValueError("unknown solver %r (use %s)"
+                             % (self.solver, "/".join(SOLVERS)))
+        self.adam_beta1 = kwargs.pop("adam_beta1", 0.9)
+        self.adam_beta2 = kwargs.pop("adam_beta2", 0.999)
+        self.adam_epsilon = kwargs.pop("adam_epsilon", 1e-8)
         super().__init__(workflow, **kwargs)
+        if self.solver == "adam":
+            # second moments + shared step count, as extra traced slots:
+            # instance INPUTS/OUTPUTS extend the class tuples (jit_unit
+            # and the partial-fusion planner read self.INPUTS)
+            self._second_slots_ = tuple(
+                vel.replace("_velocity", "_second")
+                for vel in type(self).INPUTS if "_velocity" in vel)
+            for name in self._second_slots_:
+                setattr(self, name, Array())
+            self._step = Array()
+            extra = self._second_slots_ + ("_step",)
+            base_in = type(self).INPUTS
+            self.INPUTS = base_in[:-1] + extra + base_in[-1:]
+            self.OUTPUTS = type(self).OUTPUTS + extra
         # linked from the paired forward unit:
         self.input = None
         self.output = None
@@ -82,7 +142,37 @@ class GradientDescent(JitUnit):
         if self._velocity_w.data is None:
             self._velocity_w.data = jnp.zeros_like(self.weights.data)
             self._velocity_b.data = jnp.zeros_like(self.bias.data)
+        self._init_solver_state()
         self._refresh_hyper()
+
+    def _init_solver_state(self):
+        """Zero the Adam second moments (shaped like their velocities)
+        and the step counter; no-op for momentum."""
+        if self.solver != "adam":
+            return
+        for name in self._second_slots_:
+            slot = getattr(self, name)
+            if slot.data is None:
+                vel = getattr(self, name.replace("_second", "_velocity"))
+                slot.data = jnp.zeros_like(vel.data)
+        if self._step.data is None:
+            self._step.data = jnp.zeros((), jnp.float32)
+
+    def _unpack_solver(self, rest, n_leaves=2):
+        """Split a compute()'s trailing args into (updater, hyper,
+        seconds, extra_outputs_fn) — the ONE place that knows the
+        positional layout. Momentum: rest == (hyper,), seconds are
+        Nones. Adam: rest == (*seconds, step, hyper) with the step
+        pre-incremented here."""
+        if self.solver == "adam":
+            *seconds, step, hyper = rest
+            step = step + 1.0
+            return (make_updater("adam", hyper, step), hyper,
+                    tuple(seconds),
+                    lambda new_seconds: tuple(new_seconds) + (step,))
+        (hyper,) = rest
+        return (make_updater("momentum", hyper, None), hyper,
+                (None,) * n_leaves, lambda new_seconds: ())
 
     def _refresh_hyper(self):
         lr_bias = (self.learning_rate_bias
@@ -90,16 +180,18 @@ class GradientDescent(JitUnit):
                    else self.learning_rate)
         self._hyper.data = jnp.asarray(
             [self.learning_rate, lr_bias, self.weights_decay,
-             self.l1_vs_l2, self.gradient_moment], jnp.float32)
+             self.l1_vs_l2, self.gradient_moment, self.adam_beta1,
+             self.adam_beta2, self.adam_epsilon], jnp.float32)
 
     def set_learning_rate(self, value):
         """Anneal without retracing (hyper is a traced input)."""
         self.learning_rate = value
         self._refresh_hyper()
 
-    def compute(self, err_output, x, y, weights, bias, vel_w, vel_b, hyper):
-        lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2], hyper[3],
-                                    hyper[4])
+    def compute(self, err_output, x, y, weights, bias, vel_w, vel_b,
+                *rest):
+        upd, hyper, (sec_w, sec_b), extras = self._unpack_solver(rest)
+        lr, lr_b, l2, l1 = hyper[0], hyper[1], hyper[2], hyper[3]
         _, deriv = activations.ACTIVATIONS[self.ACTIVATION]
         err_pre = (err_output.reshape(err_output.shape[0], -1)
                    * deriv(y.reshape(y.shape[0], -1)))
@@ -108,12 +200,13 @@ class GradientDescent(JitUnit):
         grad_w = grad_w + l2 * weights + l1 * jnp.sign(weights)
         err_input = matmul(err_pre, weights.T,
                            out_dtype=jnp.float32).reshape(x.shape)
-        new_vel_w = moment * vel_w - lr * grad_w
-        new_w = weights + new_vel_w
         grad_b = jnp.sum(err_pre, axis=0)
-        new_vel_b = moment * vel_b - lr_b * grad_b
-        new_b = bias + new_vel_b
-        return err_input, new_w, new_b, new_vel_w, new_vel_b
+        new_w, new_vel_w, new_sec_w = upd(weights, grad_w, vel_w, sec_w,
+                                          lr)
+        new_b, new_vel_b, new_sec_b = upd(bias, grad_b, vel_b, sec_b,
+                                          lr_b)
+        return (err_input, new_w, new_b, new_vel_w, new_vel_b) \
+            + extras((new_sec_w, new_sec_b))
 
     # fleet-mode DP: slaves ship their weight deltas; the master merges.
     # (Pod-mode DP instead all-reduces gradients inside the tick — see

@@ -94,6 +94,9 @@ def extract_model_spec(workflow):
             if gd is None or not hasattr(gd, "learning_rate"):
                 return None
             spec["has_params"] = True
+            # per-layer solver (momentum/adam) — the fused update must
+            # run each GD unit's exact math (gd.py make_updater)
+            spec["solver"] = getattr(gd, "solver", "momentum")
         specs.append(spec)
     return specs
 
@@ -109,7 +112,8 @@ def get_hypers(workflow):
 
 def get_params(workflow, specs):
     """Snapshot the unit chain's weights into the per-layer pytree:
-    ``{"p": {leaf: tensor}, "v": {leaf: velocity}}`` per layer, leaves
+    ``{"p": {leaf: tensor}, "v": {leaf: velocity}}`` per layer (plus
+    ``"s"`` second moments + ``"t"`` step count for adam layers), leaves
     named by each spec's update-policy table."""
     params = []
     for fwd, gd, spec in zip(workflow.forwards, workflow.gds, specs):
@@ -117,11 +121,24 @@ def get_params(workflow, specs):
             params.append({})
             continue
         p, v = {}, {}
+        entry = {"p": p, "v": v}
+        adam = spec.get("solver") == "adam"
+        if adam:
+            entry["s"] = {}
+            step = gd._step.data
+            entry["t"] = (step if step is not None
+                          else jnp.zeros((), jnp.float32))
         for leaf, fwd_attr, vel_attr, _, _ in spec["leaves"]:
             p[leaf] = getattr(fwd, fwd_attr).data
             vel = getattr(gd, vel_attr).data
             v[leaf] = vel if vel is not None else jnp.zeros_like(p[leaf])
-        params.append({"p": p, "v": v})
+            if adam:
+                sec = getattr(gd,
+                              vel_attr.replace("_velocity",
+                                               "_second")).data
+                entry["s"][leaf] = (sec if sec is not None
+                                    else jnp.zeros_like(p[leaf]))
+        params.append(entry)
     return params
 
 
@@ -136,9 +153,15 @@ def set_params(workflow, params, specs):
                                 specs):
         if not p:
             continue
+        adam = spec.get("solver") == "adam"
         for leaf, fwd_attr, vel_attr, _, _ in spec["leaves"]:
             getattr(fwd, fwd_attr).data = jnp.copy(p["p"][leaf])
             getattr(gd, vel_attr).data = jnp.copy(p["v"][leaf])
+            if adam:
+                getattr(gd, vel_attr.replace("_velocity", "_second")
+                        ).data = jnp.copy(p["s"][leaf])
+        if adam:
+            gd._step.data = jnp.copy(p["t"])
 
 
 def _layer_forward(spec):
@@ -327,20 +350,30 @@ def build_tick(specs, norm_type="none", mesh=None,
             if not p:
                 new.append({})
                 continue
-            lr, lr_b, l2, l1, moment = (hyper[0], hyper[1], hyper[2],
-                                        hyper[3], hyper[4])
-            new_p, new_v = {}, {}
+            from veles_tpu.nn.gd import make_updater
+            lr, lr_b, l2, l1 = hyper[0], hyper[1], hyper[2], hyper[3]
+            solver = spec.get("solver", "momentum")
+            step = p["t"] + 1.0 if solver == "adam" else None
+            upd = make_updater(solver, hyper, step)
+            entry = {"p": {}, "v": {}}
+            if solver == "adam":
+                entry["s"], entry["t"] = {}, step
             # per-leaf policy from the spec table: which rate applies
             # and whether l2/l1 decay does — matching each graph-mode GD
-            # unit's exact update math
+            # unit's exact update math (same make_updater)
             for leaf, _, _, use_lr_b, decay in spec["leaves"]:
                 w, gw, vel = p["p"][leaf], g[leaf], p["v"][leaf]
                 if decay:
                     gw = gw + l2 * w + l1 * jnp.sign(w)
-                v2 = moment * vel - (lr_b if use_lr_b else lr) * gw
-                new_p[leaf] = w + v2
-                new_v[leaf] = v2
-            new.append({"p": new_p, "v": new_v})
+                w2, v2, s2 = upd(w, gw, vel,
+                                 p["s"][leaf] if solver == "adam"
+                                 else None,
+                                 lr_b if use_lr_b else lr)
+                entry["p"][leaf] = w2
+                entry["v"][leaf] = v2
+                if solver == "adam":
+                    entry["s"][leaf] = s2
+            new.append(entry)
         return new, (loss_sum, n_err)
 
     def core_eval(params, norm, data, labels, indices, valid):
