@@ -261,7 +261,7 @@ def fused_step_gflops():
 ALEXNET_TRAIN_GFLOP_PER_IMAGE = 4.3
 
 
-def alexnet_throughput(n_valid=128, n_train=1152, epochs=5):
+def alexnet_throughput(n_valid=128, n_train=1152, epochs=8):
     """Full-size AlexNet-227 (single tower, 1000-way) images/sec through
     the fused workflow path — the BASELINE ImageNet-AlexNet axis
     (synthetic pixels; the arithmetic is identical to real ones)."""

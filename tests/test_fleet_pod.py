@@ -137,3 +137,31 @@ class TestFleetPod:
         master.stop()
         s1.stop()
         s2.stop()
+
+    def test_pod_slave_on_safe_codec(self):
+        """Triple composition: pod slave x fleet x pickle-free wire —
+        the sharded tick's jobs/updates must survive the safe codec
+        (arrays-and-scalars payloads only) and converge identically."""
+        from veles_tpu.core.config import root
+
+        saved = root.common.fleet.get("codec", "pickle")
+        root.common.fleet.codec = "safe"
+        master = slave = None
+        try:
+            kw = _kw(max_epochs=2)
+            master, wf_m, thread = _run_master(kw)
+            slave, wf_s = _run_pod_slave(master.agent.port, kw,
+                                         jax.devices()[:2])
+            slave.run()
+            thread.join(120)
+            assert not thread.is_alive(), "master did not finish"
+            assert wf_s.fused_tick.ticks > 0
+            assert wf_m.decision.best_n_err[VALID] is not None
+        finally:
+            # stop in the finally: a failed assert must not leak the
+            # bound listener/threads into the next fleet test
+            root.common.fleet.codec = saved
+            if master is not None:
+                master.stop()
+            if slave is not None:
+                slave.stop()

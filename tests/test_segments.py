@@ -155,3 +155,28 @@ def test_mid_segment_monitor_still_fires():
     assert smon.ticks == gmon.ticks > 0
     assert seg.decision.best_n_err[VALID] == graph.decision.best_n_err[
         VALID]
+
+
+def test_segments_with_adam_solver():
+    """Partial fusion x Adam: the segment planner builds its dataflow
+    plan from the GD units' EXTENDED slot tuples (second moments + step
+    are instance-level INPUTS/OUTPUTS), and training still learns."""
+    prng.get("default").seed(4321)
+    prng.get("loader").seed(8765)
+    X, y = _digits()
+    seg = MLPWorkflow(
+        DummyLauncher(), layers=(32, 10),
+        loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 297, 1500],
+                           minibatch_size=100,
+                           normalization_type="linear"),
+        learning_rate=0.01, solver="adam", max_epochs=6, fused=False,
+        name="segments-adam")
+    _splice_spy(seg)
+    created = segments.enable(seg)
+    assert created, "partial fusion did not engage"
+    seg.initialize()
+    seg.run()
+    best = seg.decision.best_n_err[VALID]
+    assert best is not None and best < 45, best
+    import numpy
+    assert float(numpy.asarray(seg.gds[0]._step.data)) > 0

@@ -550,6 +550,7 @@ class FusedTick(Unit):
         self._steps_ = None
         self._norm_ = None
         self._specs_ = None
+        self._zero_labels_ = None
         self._wrote_eval_params_ = False
         if not hasattr(self, "pipelined"):
             self.pipelined = False
@@ -624,11 +625,16 @@ class FusedTick(Unit):
         if getattr(self, "_loss_kind_", "softmax") == "mse":
             # regression: the "labels" lane carries the float targets
             labels = loader.original_targets.data
+        elif loader.original_labels:
+            labels = loader.original_labels.data
         else:
-            labels = (loader.original_labels.data
-                      if loader.original_labels
-                      else jnp.zeros(len(loader.original_data),
-                                     jnp.int32))
+            # label-less placeholder built ONCE — a fresh dataset-sized
+            # jnp.zeros here would be an eager dispatch per tick
+            if self._zero_labels_ is None or len(self._zero_labels_) \
+                    != len(loader.original_data):
+                self._zero_labels_ = jnp.zeros(
+                    len(loader.original_data), jnp.int32)
+            labels = self._zero_labels_
         indices = loader.minibatch_indices.data
         valid = numpy.float32(max(loader.minibatch_valid_size, 1))
         training = loader.minibatch_class == TRAIN

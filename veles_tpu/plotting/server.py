@@ -54,13 +54,20 @@ class GraphicsServer(Logger):
             self._queue.put(None)
             self._thread.join(timeout=10)
 
-    def flush(self):
-        """Block until everything enqueued so far has rendered."""
+    def flush(self, timeout=180):
+        """Block until everything enqueued so far has rendered. The
+        timeout is generous: a COLD matplotlib (first import + font
+        cache rebuild) can take >30 s on a loaded host, and an expired
+        flush silently loses renders (observed as a flaky missing-plot
+        assertion under the full-suite commit gate)."""
         if self._thread is None or not self._thread.is_alive():
             return
         done = threading.Event()
         self._queue.put(done)
-        done.wait(timeout=30)
+        if not done.wait(timeout=timeout):
+            self.warning(
+                "flush timed out after %.0fs — renders enqueued before "
+                "it may be missing", timeout)
 
     # -- producer side -------------------------------------------------------
     def enqueue(self, plotter):
