@@ -596,3 +596,23 @@ class TestSafeCodec:
         assert wf_m.decision.best_n_err[VALID] == expected
         master.stop()
         slave.stop()
+
+
+def test_lr_decay_reaches_slaves():
+    """Master-side plateau annealing must propagate: the decayed rates
+    ride the job payloads, so the slave that executes the GD ticks
+    anneals too."""
+    kw = _kw(max_epochs=6, minibatch=300)
+    kw["learning_rate"] = 1e-7  # guaranteed plateau after epoch 1
+    master, wf_m, thread = _run_master(kw)
+    wf_m.decision.lr_decay = 0.5
+    wf_m.decision.lr_decay_patience = 2
+    slave = _run_slave(master.agent.port, kw)
+    wf_s = slave.workflow
+    slave.run()
+    thread.join(120)
+    assert not thread.is_alive(), "master did not finish"
+    assert wf_m.gds[0].learning_rate < 1e-7  # master decayed
+    assert wf_s.gds[0].learning_rate < 1e-7  # ...and the slave followed
+    master.stop()
+    slave.stop()

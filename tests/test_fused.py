@@ -525,3 +525,61 @@ class TestAdamSolver:
         numpy.testing.assert_array_equal(
             numpy.asarray(gd2._second_w.data),
             numpy.asarray(wf.gds[0]._second_w.data))
+
+    @pytest.mark.parametrize("fused", [False, True])
+    def test_adagrad_learns(self, fused):
+        """solver="adagrad": same stateful-slot machinery as adam (no
+        first moment, no bias correction), both execution modes."""
+        wf = _train(self._build(fused=fused, solver="adagrad",
+                                max_epochs=4))
+        assert (wf.fused_tick is not None) == fused
+        assert wf.decision.best_n_err[VALID] is not None
+        assert wf.decision.best_n_err[VALID] < 45
+        gd = wf.gds[0]
+        assert float(numpy.asarray(gd._step.data)) > 0
+        assert numpy.asarray(gd._second_w.data).sum() > 0
+
+
+def test_lr_decay_on_plateau():
+    """decision_kwargs lr_decay/lr_decay_patience anneal every GD unit
+    when validation stops improving — in fused mode (traced hypers make
+    set_learning_rate effective without retrace)."""
+    prng.get("default").seed(4321)
+    prng.get("loader").seed(8765)
+    X, y = _digits_dataset()
+    wf = MLPWorkflow(
+        DummyLauncher(), layers=(32, 10),
+        loader_kwargs=dict(data=X, labels=y,
+                           class_lengths=[0, 297, 1500],
+                           minibatch_size=100,
+                           normalization_type="linear"),
+        # lr=0: NOTHING ever improves after epoch 1, so the plateau
+        # counter climbs deterministically
+        learning_rate=0.0, max_epochs=7, fused=True,
+        fused_pipeline=False,
+        decision_kwargs=dict(max_epochs=7, lr_decay=0.5,
+                             lr_decay_patience=2),
+        name="lr-decay")
+    wf.initialize()
+    wf.run()
+    # epochs 2..7 -> >=5 no-improvement epochs -> >=2 decays (at 2, 4, 6)
+    lr = wf.gds[0].learning_rate
+    assert lr == 0.0  # 0 * factor stays 0 — decay applied cleanly
+    assert wf.decision._epochs_without_improvement >= 4
+    # a REAL decay: start from a positive lr and force a plateau
+    prng.get("default").seed(4321)
+    prng.get("loader").seed(8765)
+    wf2 = MLPWorkflow(
+        DummyLauncher(), layers=(32, 10),
+        loader_kwargs=dict(data=X, labels=y,
+                           class_lengths=[0, 297, 1500],
+                           minibatch_size=100,
+                           normalization_type="linear"),
+        learning_rate=1e-7, max_epochs=6, fused=True,
+        fused_pipeline=False,
+        decision_kwargs=dict(max_epochs=6, lr_decay=0.5,
+                             lr_decay_patience=2),
+        name="lr-decay2")
+    wf2.initialize()
+    wf2.run()
+    assert wf2.gds[0].learning_rate < 1e-7  # decayed at least once

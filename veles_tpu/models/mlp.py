@@ -22,12 +22,16 @@ class MLPWorkflow(StandardWorkflow):
                  for w in layers[:-1]]
         specs.append({"type": "softmax",
                       "output_sample_shape": (layers[-1],)})
+        # merge, don't collide: an explicit decision_kwargs (lr_decay,
+        # pipeline knobs...) composes with the convenience shorthands
+        decision_kwargs = dict(kwargs.pop("decision_kwargs", None) or {})
+        decision_kwargs.setdefault("max_epochs", max_epochs)
+        decision_kwargs.setdefault("fail_iterations", fail_iterations)
         super().__init__(
             workflow, layers=specs, loader_kwargs=loader_kwargs,
             loader_cls=loader_cls, learning_rate=learning_rate,
             weights_decay=weights_decay, gradient_moment=gradient_moment,
-            decision_kwargs=dict(max_epochs=max_epochs,
-                                 fail_iterations=fail_iterations),
+            decision_kwargs=decision_kwargs,
             **kwargs)
 
 

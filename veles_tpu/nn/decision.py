@@ -25,6 +25,17 @@ class DecisionGD(Unit):
     def __init__(self, workflow, **kwargs):
         self.max_epochs = kwargs.pop("max_epochs", None)
         self.fail_iterations = kwargs.pop("fail_iterations", 100)
+        # plateau annealing: factor in (0, 1), applied to every GD unit
+        # after each `lr_decay_patience` epochs without improvement
+        self.lr_decay = kwargs.pop("lr_decay", None)
+        self.lr_decay_patience = kwargs.pop("lr_decay_patience", 5)
+        if self.lr_decay is not None \
+                and not 0.0 < self.lr_decay < 1.0:
+            raise ValueError("lr_decay must be in (0, 1), got %r"
+                             % (self.lr_decay,))
+        if self.lr_decay is not None and self.lr_decay_patience < 1:
+            raise ValueError("lr_decay_patience must be >= 1, got %r"
+                             % (self.lr_decay_patience,))
         super().__init__(workflow, **kwargs)
         # linked from the loader:
         self.loader = None
@@ -305,6 +316,29 @@ class DecisionGD(Unit):
             self.snapshot_suffix = suffix
         else:
             self._epochs_without_improvement += 1
+            self._maybe_decay_lr()
+
+    def _maybe_decay_lr(self):
+        """Plateau annealing (the Znicz lr-adjuster role, additive knob):
+        with ``lr_decay`` set, every ``lr_decay_patience`` epochs without
+        improvement multiply each GD unit's learning rate by the factor.
+        Works in every execution mode — ``scale_learning_rate`` refreshes
+        the traced hyper vector (no retrace, gd.py contract), and in
+        fleet mode the decayed rates ride the next job payloads to the
+        slaves (``GradientDescent.generate_data_for_slave``)."""
+        if not self.lr_decay:
+            return
+        if self._epochs_without_improvement % self.lr_decay_patience:
+            return
+        workflow = self.workflow
+        gds = [gd for gd in getattr(workflow, "gds", [])
+               if gd is not None and hasattr(gd, "scale_learning_rate")]
+        for gd in gds:
+            gd.scale_learning_rate(self.lr_decay)
+        lrs = sorted({round(gd.learning_rate, 10) for gd in gds})
+        self.info("no improvement for %d epochs: learning rate decayed "
+                  "x%g (now %s)", self._epochs_without_improvement,
+                  self.lr_decay, lrs)
 
     @property
     def epochs_done(self):
@@ -388,6 +422,9 @@ class DecisionGD(Unit):
             self._pending_classes = []
         if not hasattr(self, "pipeline_depth"):
             self.pipeline_depth = 0
+        if not hasattr(self, "lr_decay"):  # pre-knob snapshots
+            self.lr_decay = None
+            self.lr_decay_patience = 5
         self._lagged_epochs_ = []
         self._acc_jit_ = None
         self._dev_acc_ = [None, None, None]

@@ -38,7 +38,7 @@ from veles_tpu.nn.jit_unit import JitUnit
 from veles_tpu.ops import activations
 from veles_tpu.ops.gemm import matmul
 
-SOLVERS = ("momentum", "adam")
+SOLVERS = ("momentum", "adam", "adagrad")
 
 
 def make_updater(solver, hyper, step):
@@ -47,13 +47,21 @@ def make_updater(solver, hyper, step):
     new_second)``. ``grad`` arrives already regularized (l2/l1 added by
     the caller where the leaf's policy says so). For momentum the second
     moment passes through untouched; ``step`` is the ALREADY incremented
-    step count (1-based) for Adam's bias correction."""
+    step count (1-based) for Adam's bias correction (unused by
+    adagrad, whose accumulator needs no correction)."""
     if solver == "momentum":
         moment = hyper[4]
 
         def upd(w, grad, vel, second, rate):
             v2 = moment * vel - rate * grad
             return w + v2, v2, second
+        return upd
+    if solver == "adagrad":
+        eps = hyper[7]
+
+        def upd(w, grad, vel, second, rate):
+            s = second + grad * grad
+            return w - rate * grad / (jnp.sqrt(s) + eps), vel, s
         return upd
     beta1, beta2, eps = hyper[5], hyper[6], hyper[7]
 
@@ -103,7 +111,7 @@ class GradientDescent(JitUnit):
         self.adam_beta2 = kwargs.pop("adam_beta2", 0.999)
         self.adam_epsilon = kwargs.pop("adam_epsilon", 1e-8)
         super().__init__(workflow, **kwargs)
-        if self.solver == "adam":
+        if self.solver != "momentum":
             # second moments + shared step count, as extra traced slots:
             # instance INPUTS/OUTPUTS extend the class tuples (jit_unit
             # and the partial-fusion planner read self.INPUTS)
@@ -146,9 +154,9 @@ class GradientDescent(JitUnit):
         self._refresh_hyper()
 
     def _init_solver_state(self):
-        """Zero the Adam second moments (shaped like their velocities)
-        and the step counter; no-op for momentum."""
-        if self.solver != "adam":
+        """Zero the adam/adagrad second moments (shaped like their
+        velocities) and the step counter; no-op for momentum."""
+        if self.solver == "momentum":
             return
         for name in self._second_slots_:
             slot = getattr(self, name)
@@ -162,12 +170,12 @@ class GradientDescent(JitUnit):
         """Split a compute()'s trailing args into (updater, hyper,
         seconds, extra_outputs_fn) — the ONE place that knows the
         positional layout. Momentum: rest == (hyper,), seconds are
-        Nones. Adam: rest == (*seconds, step, hyper) with the step
-        pre-incremented here."""
-        if self.solver == "adam":
+        Nones. Adam/adagrad: rest == (*seconds, step, hyper) with the
+        step pre-incremented here."""
+        if self.solver != "momentum":
             *seconds, step, hyper = rest
             step = step + 1.0
-            return (make_updater("adam", hyper, step), hyper,
+            return (make_updater(self.solver, hyper, step), hyper,
                     tuple(seconds),
                     lambda new_seconds: tuple(new_seconds) + (step,))
         (hyper,) = rest
@@ -186,6 +194,14 @@ class GradientDescent(JitUnit):
     def set_learning_rate(self, value):
         """Anneal without retracing (hyper is a traced input)."""
         self.learning_rate = value
+        self._refresh_hyper()
+
+    def scale_learning_rate(self, factor):
+        """Multiply BOTH rates (weights and bias) — the plateau-decay
+        entry point; one hyper refresh, no retrace."""
+        self.learning_rate *= factor
+        if self.learning_rate_bias is not None:
+            self.learning_rate_bias *= factor
         self._refresh_hyper()
 
     def compute(self, err_output, x, y, weights, bias, vel_w, vel_b,
@@ -240,11 +256,22 @@ class GradientDescent(JitUnit):
         self.bias.data = bias
 
     def generate_data_for_slave(self, slave=None):
-        return {"weights": self.weights.mem, "bias": self.bias.mem}
+        # the rates ride every job so master-side annealing (plateau
+        # lr_decay, set_learning_rate) reaches the slaves that execute
+        # the actual GD ticks
+        return {"weights": self.weights.mem, "bias": self.bias.mem,
+                "lr": self.learning_rate,
+                "lr_bias": self.learning_rate_bias}
 
     def apply_data_from_master(self, data):
         self.weights.data = jnp.asarray(data["weights"])
         self.bias.data = jnp.asarray(data["bias"])
+        if "lr" in data and (data["lr"] != self.learning_rate
+                             or data["lr_bias"]
+                             != self.learning_rate_bias):
+            self.learning_rate = data["lr"]
+            self.learning_rate_bias = data["lr_bias"]
+            self._refresh_hyper()
 
 
 def link_err_output(gd_unit, err_source):

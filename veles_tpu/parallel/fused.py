@@ -94,7 +94,7 @@ def extract_model_spec(workflow):
             if gd is None or not hasattr(gd, "learning_rate"):
                 return None
             spec["has_params"] = True
-            # per-layer solver (momentum/adam) — the fused update must
+            # per-layer solver (momentum/adam/adagrad) — the fused update must
             # run each GD unit's exact math (gd.py make_updater)
             spec["solver"] = getattr(gd, "solver", "momentum")
         specs.append(spec)
@@ -113,7 +113,7 @@ def get_hypers(workflow):
 def get_params(workflow, specs):
     """Snapshot the unit chain's weights into the per-layer pytree:
     ``{"p": {leaf: tensor}, "v": {leaf: velocity}}`` per layer (plus
-    ``"s"`` second moments + ``"t"`` step count for adam layers), leaves
+    ``"s"`` second moments + ``"t"`` step count for stateful solvers), leaves
     named by each spec's update-policy table."""
     params = []
     for fwd, gd, spec in zip(workflow.forwards, workflow.gds, specs):
@@ -122,8 +122,8 @@ def get_params(workflow, specs):
             continue
         p, v = {}, {}
         entry = {"p": p, "v": v}
-        adam = spec.get("solver") == "adam"
-        if adam:
+        stateful = spec.get("solver", "momentum") != "momentum"
+        if stateful:
             entry["s"] = {}
             step = gd._step.data
             entry["t"] = (step if step is not None
@@ -132,7 +132,7 @@ def get_params(workflow, specs):
             p[leaf] = getattr(fwd, fwd_attr).data
             vel = getattr(gd, vel_attr).data
             v[leaf] = vel if vel is not None else jnp.zeros_like(p[leaf])
-            if adam:
+            if stateful:
                 sec = getattr(gd,
                               vel_attr.replace("_velocity",
                                                "_second")).data
@@ -153,14 +153,14 @@ def set_params(workflow, params, specs):
                                 specs):
         if not p:
             continue
-        adam = spec.get("solver") == "adam"
+        stateful = spec.get("solver", "momentum") != "momentum"
         for leaf, fwd_attr, vel_attr, _, _ in spec["leaves"]:
             getattr(fwd, fwd_attr).data = jnp.copy(p["p"][leaf])
             getattr(gd, vel_attr).data = jnp.copy(p["v"][leaf])
-            if adam:
+            if stateful:
                 getattr(gd, vel_attr.replace("_velocity", "_second")
                         ).data = jnp.copy(p["s"][leaf])
-        if adam:
+        if stateful:
             gd._step.data = jnp.copy(p["t"])
 
 
@@ -353,10 +353,10 @@ def build_tick(specs, norm_type="none", mesh=None,
             from veles_tpu.nn.gd import make_updater
             lr, lr_b, l2, l1 = hyper[0], hyper[1], hyper[2], hyper[3]
             solver = spec.get("solver", "momentum")
-            step = p["t"] + 1.0 if solver == "adam" else None
+            step = p["t"] + 1.0 if solver != "momentum" else None
             upd = make_updater(solver, hyper, step)
             entry = {"p": {}, "v": {}}
-            if solver == "adam":
+            if solver != "momentum":
                 entry["s"], entry["t"] = {}, step
             # per-leaf policy from the spec table: which rate applies
             # and whether l2/l1 decay does — matching each graph-mode GD
@@ -366,12 +366,12 @@ def build_tick(specs, norm_type="none", mesh=None,
                 if decay:
                     gw = gw + l2 * w + l1 * jnp.sign(w)
                 w2, v2, s2 = upd(w, gw, vel,
-                                 p["s"][leaf] if solver == "adam"
+                                 p["s"][leaf] if solver != "momentum"
                                  else None,
                                  lr_b if use_lr_b else lr)
                 entry["p"][leaf] = w2
                 entry["v"][leaf] = v2
-                if solver == "adam":
+                if solver != "momentum":
                     entry["s"][leaf] = s2
             new.append(entry)
         return new, (loss_sum, n_err)
