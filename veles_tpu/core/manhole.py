@@ -65,7 +65,15 @@ class Manhole(Logger):
         except OSError:
             pass
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.bind(self.path)
+        # bind under a restrictive umask: chmod-after-bind leaves a
+        # window where a permissive umask (in a caller-supplied shared
+        # directory) briefly exposes the exec-capable socket to other
+        # local users
+        old_umask = os.umask(0o177)
+        try:
+            sock.bind(self.path)
+        finally:
+            os.umask(old_umask)
         os.chmod(self.path, 0o600)
         sock.listen(1)
         self._sock = sock

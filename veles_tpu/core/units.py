@@ -40,6 +40,16 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
 
     hide_from_registry = True
 
+    #: Sweep-transparency contract (``parallel/sweep.py``): a host unit
+    #: in the repeater cycle may declare True to promise its ``run()``
+    #: never reads or writes device Array slots — pure host-side
+    #: bookkeeping (counters, logging, triggers). The sweep fusion tier
+    #: then scans the device chain over whole class sweeps and fires
+    #: this unit once per tick between the scanned chunks; without the
+    #: declaration the workflow stays on the per-tick segment tier,
+    #: where the unit sees exact per-minibatch slot state.
+    sweep_transparent = False
+
     def __init__(self, workflow, **kwargs):
         name = kwargs.pop("name", None)
         view_group = kwargs.pop("view_group", None)

@@ -32,6 +32,7 @@ no longer remote code execution — at worst bogus data. The default stays
 
 import gzip
 import hashlib
+import zlib
 import hmac as hmac_lib
 import os
 import pickle
@@ -129,19 +130,44 @@ def encode_frame(message, key, shm_threshold=None):
         from veles_tpu.fleet import sharedio
         desc = sharedio.put(payload, key)
         payload, codec = _serialize({"__shm__": desc})
+    if len(payload) > MAX_FRAME:
+        # bound the UNCOMPRESSED size too: the receiver enforces the
+        # limit on the decompressed payload (_bounded_gunzip), so a
+        # compressible >1 GiB payload that fit on the wire would be
+        # rejected at the far end — fail here with the clear message
+        raise ProtocolError(
+            "outgoing %r frame is %d bytes uncompressed (limit %d): "
+            "shrink the job/update payload"
+            % (message.get("type", "?"), len(payload), MAX_FRAME))
     if len(payload) >= COMPRESS_THRESHOLD:
         compressed = gzip.compress(payload, compresslevel=1)
         if len(compressed) < len(payload):
             payload, codec = compressed, codec + 1
-    if len(payload) > MAX_FRAME:
-        # fail at the SENDER with a clear message — the receiver would
-        # reject it as a protocol violation and misdiagnose the cause
-        raise ProtocolError(
-            "outgoing %r frame is %d bytes (limit %d): shrink the "
-            "job/update payload" % (message.get("type", "?"),
-                                    len(payload), MAX_FRAME))
     return (_HEADER.pack(len(payload), codec) + _mac(key, codec, payload)
             + payload)
+
+
+def _bounded_gunzip(payload, max_frame):
+    """Decompress a gzip member with the frame limit applied to the
+    DECOMPRESSED size too: MAX_FRAME on the wire length alone would let
+    an authenticated peer detonate a ~1000x gzip bomb in memory, which
+    contradicts the safe codec's "a leaked secret yields at most bogus
+    data, not a DoS" threat model. wbits=31 selects the gzip container
+    (the sender uses gzip.compress)."""
+    decompressor = zlib.decompressobj(wbits=31)
+    try:
+        data = decompressor.decompress(payload, max_frame + 1)
+    except zlib.error as exc:
+        raise ProtocolError("bad gzip frame: %s" % exc)
+    if len(data) > max_frame or decompressor.unconsumed_tail:
+        raise ProtocolError(
+            "decompressed frame exceeds limit %d" % max_frame)
+    if not decompressor.eof or decompressor.unused_data:
+        # keep gzip.decompress's strictness: a truncated member or
+        # trailing garbage is a protocol violation, not partial data
+        raise ProtocolError("malformed gzip frame (truncated or "
+                            "trailing data)")
+    return data
 
 
 async def read_frame(reader, key, max_frame=MAX_FRAME):
@@ -160,7 +186,7 @@ async def read_frame(reader, key, max_frame=MAX_FRAME):
     if codec not in (0, 1, 2, 3):
         raise ProtocolError("unknown frame codec %d" % codec)
     if codec in (1, 3):
-        payload = gzip.decompress(payload)
+        payload = _bounded_gunzip(payload, max_frame)
         codec -= 1
     message = _deserialize(payload, codec)
     if isinstance(message, dict) and "__shm__" in message:

@@ -161,19 +161,23 @@ class FullBatchLoader(Loader):
             self._fill_jit_ = fill
         return self._fill_jit_
 
+    def labels_for_gather(self):
+        """The label lane every in-jit gather consumes (the loader's
+        fill, the fused tick, the sweep tier): the device labels, or —
+        for label-less (MSE) datasets — a cached dataset-length zeros
+        placeholder (a fresh jnp.zeros would be an eager dispatch plus
+        a full-length allocation per tick)."""
+        if self.original_labels:
+            return self.original_labels.data
+        if self._zero_labels_ is None \
+                or len(self._zero_labels_) != len(self.original_data):
+            self._zero_labels_ = jnp.zeros(
+                len(self.original_data), jnp.int32)
+        return self._zero_labels_
+
     def fill_minibatch(self, indices, valid):
         data = self.original_data.data
-        if self.original_labels:
-            labels = self.original_labels.data
-        else:
-            # label-less (MSE) datasets: build the placeholder ONCE — a
-            # fresh dataset-sized jnp.zeros would be an eager dispatch
-            # plus a full-length allocation per tick
-            if self._zero_labels_ is None \
-                    or len(self._zero_labels_) != len(self.original_data):
-                self._zero_labels_ = jnp.zeros(
-                    len(self.original_data), jnp.int32)
-            labels = self._zero_labels_
+        labels = self.labels_for_gather()
         if not self.on_device and not isinstance(data, jax.Array):
             # host gather path
             batch = numpy.take(numpy.asarray(data), indices, axis=0)

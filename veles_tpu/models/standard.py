@@ -233,16 +233,35 @@ class StandardWorkflow(Workflow):
                   "class sweep" if self.loader.sweep_serving else "tick")
 
     def _enable_segments(self):
-        """Second fusion tier (the graph-mode-cliff fix): when the full
-        fused engine declines — an unrecognized layer type, a custom
-        unit spliced into the chain, an MSE evaluator — collapse every
-        run of consecutive JitUnits into one composite dispatch instead
-        of falling all the way to per-unit graph mode. See
-        parallel/segments.py."""
-        from veles_tpu.parallel import segments as seg_mod
+        """Lower fusion tiers (the graph-mode-cliff fix) for chains the
+        full fused engine declines — an unrecognized/custom layer type,
+        a custom unit spliced into the chain:
 
-        if any(isinstance(u, seg_mod.FusedSegment) for u in self.units):
+        - sweep tier (``parallel/sweep.py``): the whole cycle scanned
+          over class sweeps when every mid-chain host unit is
+          sweep-transparent — full-engine-class dispatch counts for ANY
+          JitUnit chain;
+        - segment tier (``parallel/segments.py``): runs of consecutive
+          JitUnits collapse into composite per-tick dispatches when a
+          host unit needs true per-tick slot access."""
+        from veles_tpu.parallel import segments as seg_mod
+        from veles_tpu.parallel import sweep as sweep_mod
+
+        if any(isinstance(u, (seg_mod.FusedSegment, sweep_mod.FusedSweep))
+               for u in self.units):
             return  # resumed snapshot: the splice is already in place
+        swept = None
+        if getattr(self, "fused_sweep", True):
+            # fused_sweep=False is the user's opt-out of sweep serving
+            # (per-minibatch decision cadence) — honor it here too
+            swept = sweep_mod.enable(
+                self,
+                pipelined=bool(getattr(self, "fused_pipeline", False)))
+        if swept is not None:
+            self.info("sweep-tier fusion: %d compute unit(s) scanned "
+                      "per class sweep (%d host unit(s) fire per tick)",
+                      len(swept.members), len(swept.hosts))
+            return
         created = seg_mod.enable(self)
         if created:
             self.info("partial fusion: %d segment(s) — %s",
@@ -306,9 +325,10 @@ class StandardWorkflow(Workflow):
         # fused mode writes unit-Array weights back on EVAL ticks (the
         # evaluated state, for snapshot-on-improved parity); the final
         # post-train state lands here so exports/results see it
-        if self.fused_tick is not None:
+        sync_owner = self.fused_tick or getattr(self, "sweep_unit", None)
+        if sync_owner is not None:
             try:
-                self.fused_tick.sync_params()
+                sync_owner.sync_params()
             except Exception:
                 # also reached via on_error: a failed train step leaves
                 # _params_ pointing at donated (deleted) buffers — a

@@ -229,7 +229,10 @@ class DecisionGD(Unit):
         served = self._epochs_done + len(self._lagged_epochs_)
         drain_all = (self.max_epochs is not None
                      and served >= self.max_epochs)
-        tick = getattr(self.workflow, "fused_tick", None)
+        # whichever engine owns the pipelined params history (the fused
+        # tick or the sweep tier) gets the advance/rollback hooks
+        tick = (getattr(self.workflow, "fused_tick", None)
+                or getattr(self.workflow, "sweep_unit", None))
         first = True
         while self._lagged_epochs_ and (
                 drain_all

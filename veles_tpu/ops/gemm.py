@@ -252,7 +252,13 @@ def _dense_with_vjp(activation):
     ``pallas_call`` has no automatic reverse rule, and the fused tick
     differentiates straight through the layer. The backward is the
     SAME math the graph-mode GD units run (activation derivative off
-    the saved OUTPUT, two transposed matmuls, bias row-sum)."""
+    the saved OUTPUT, two transposed matmuls, bias row-sum) — with one
+    caveat: ``grad_w`` accumulates in f32 and is then cast to
+    ``w.dtype`` (bf16 on the Pallas path), one extra bf16 rounding of
+    the weight gradient that graph-mode GD (f32 matmul output) does not
+    apply. CPU tests can't observe it (``_pallas_eligible`` is false
+    off-TPU); on TPU the fused-vs-graph weight comparison needs the
+    looser TPU-tier bound."""
     from veles_tpu.ops import activations as act_lib
     deriv = act_lib.ACTIVATIONS[activation][1]
 

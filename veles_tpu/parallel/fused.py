@@ -63,14 +63,33 @@ def extract_model_spec(workflow):
     """Static per-layer config from the workflow's forwards/gds chains.
     Returns a spec list, or None when a layer type is not fusible (the
     caller then stays on graph mode)."""
-    from veles_tpu.nn.all2all import All2All
-    from veles_tpu.nn.attention import LayerNorm, SelfAttention
-    from veles_tpu.nn.conv import Conv
-    from veles_tpu.nn.pooling import Pooling
+    from veles_tpu.nn.all2all import All2All, All2AllSoftmax
+    from veles_tpu.nn.attention import (GDLayerNorm, GDSelfAttention,
+                                        LayerNorm, SelfAttention)
+    from veles_tpu.nn.conv import Conv, GDConv
+    from veles_tpu.nn.gd import GradientDescent
+    from veles_tpu.nn.pooling import GDPooling, Pooling
+
+    known_computes = {getattr(cls, "compute", None) for cls in (
+        All2All, All2AllSoftmax, Conv, SelfAttention, LayerNorm, Pooling,
+        GradientDescent, GDConv, GDSelfAttention, GDLayerNorm,
+        GDPooling)}
+
+    def modified(unit):
+        """A subclass that overrides compute() carries custom math the
+        spec tables cannot express — fusing it by isinstance would
+        silently run the BASE math (the spec is built from class
+        attributes, not the override). Such chains belong to the
+        sweep/segment tiers, which compose the units' own computes."""
+        return (unit is not None
+                and getattr(type(unit), "compute", None)
+                not in known_computes)
 
     specs = []
     for i, fwd in enumerate(workflow.forwards):
         gd = workflow.gds[i] if workflow.gds else None
+        if modified(fwd) or modified(gd):
+            return None
         if isinstance(fwd, All2All):
             spec = {"kind": _DENSE, "activation": fwd.ACTIVATION,
                     "leaves": _WB_LEAVES}
@@ -550,7 +569,6 @@ class FusedTick(Unit):
         self._steps_ = None
         self._norm_ = None
         self._specs_ = None
-        self._zero_labels_ = None
         self._wrote_eval_params_ = False
         if not hasattr(self, "pipelined"):
             self.pipelined = False
@@ -625,16 +643,8 @@ class FusedTick(Unit):
         if getattr(self, "_loss_kind_", "softmax") == "mse":
             # regression: the "labels" lane carries the float targets
             labels = loader.original_targets.data
-        elif loader.original_labels:
-            labels = loader.original_labels.data
         else:
-            # label-less placeholder built ONCE — a fresh dataset-sized
-            # jnp.zeros here would be an eager dispatch per tick
-            if self._zero_labels_ is None or len(self._zero_labels_) \
-                    != len(loader.original_data):
-                self._zero_labels_ = jnp.zeros(
-                    len(loader.original_data), jnp.int32)
-            labels = self._zero_labels_
+            labels = loader.labels_for_gather()
         indices = loader.minibatch_indices.data
         valid = numpy.float32(max(loader.minibatch_valid_size, 1))
         training = loader.minibatch_class == TRAIN
