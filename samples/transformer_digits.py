@@ -53,5 +53,8 @@ def run(load, main):
     main()
     if cfg.get("export"):
         from veles_tpu.export import package_export
-        package_export(wf, cfg.export)
+        # root.sample.export_precision = 16 halves the package size
+        # (f16 weights; the native runtime widens back to f32)
+        package_export(wf, cfg.export,
+                       precision=cfg.get("export_precision", 32))
         print("exported to", cfg.export)

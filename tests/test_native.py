@@ -291,6 +291,45 @@ class TestMalformedPackages:
         with pytest.raises(RuntimeError):
             self._load(path)
 
+    def test_f16_export_half_size_and_parity(self, native_lib,
+                                             tmp_path):
+        """``precision=16`` (the reference workflow.py:864-975 API):
+        float16 weights, ~half the package size, and the native
+        runtime's f2->f32 widening keeps inference within the f16
+        quantization tolerance of the f32 package."""
+        from sklearn.datasets import load_digits
+        d = load_digits()
+        X = d.data.astype(numpy.float32)
+        y = d.target.astype(numpy.int32)
+        wf = MLPWorkflow(
+            DummyLauncher(), layers=(16, 10),
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 297, 1500],
+                               minibatch_size=300,
+                               normalization_type="linear"),
+            learning_rate=0.1, max_epochs=2, name="f16-export")
+        wf.initialize()
+        wf.run()
+        p32 = str(tmp_path / "w32.tar")
+        p16 = str(tmp_path / "w16.tar")
+        package_export(wf, p32, precision=32)
+        package_export(wf, p16, precision=16)
+        # the .npy members dominate the tar: halving the dtype must
+        # show up in the file size (tar rounds members to 512B blocks)
+        assert os.path.getsize(p16) < 0.65 * os.path.getsize(p32)
+        with tarfile.open(p16) as tar:
+            blob = tar.extractfile("fwd0_weights.npy").read()
+            assert numpy.load(io.BytesIO(blob)).dtype == numpy.float16
+        batch = X[:64] / numpy.abs(X).max()
+        out32 = self._load(p32).run(batch)
+        out16 = self._load(p16).run(batch)
+        numpy.testing.assert_allclose(out16, out32, atol=5e-3)
+        # and the predictions agree
+        numpy.testing.assert_array_equal(out16.argmax(-1),
+                                         out32.argmax(-1))
+        with pytest.raises(ValueError):
+            package_export(wf, str(tmp_path / "bad.tar"), precision=8)
+
     def test_random_mutations_never_crash(self, native_lib, tmp_path):
         """Byte-flip fuzzing of a VALID package: every mutation loads
         or errors cleanly (no SIGSEGV/SIGFPE would mean pytest dies)."""

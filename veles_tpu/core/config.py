@@ -160,12 +160,19 @@ root.common.update({
         # 2 - highest XLA precision (multi-partial tier).
         "precision_level": 0,
         "donate_params": True,
-        # pallas kernel toggles; plain lax fallbacks always exist.
-        "use_pallas": True,
+        # pallas kernel toggles — OFF by default on the train path:
+        # measured on the v5e flagship dense step (fwd+bwd+update,
+        # mb 4096), XLA's dot + its own fusion beats the blocked Pallas
+        # matmul 2.1x and the fused-epilogue kernel 1.8x (numbers in
+        # docs/performance.md "Pallas + autotune"). The kernels remain
+        # the opt-in substrate (autotune cache, custom epilogues,
+        # forward-only tall-skinny shapes where pallas_dense measured
+        # 2.6x FASTER than XLA).
+        "use_pallas": False,
         # fused matmul+bias+activation kernel on the product dense path
         # (ops/gemm.py dense_layer); measured vs XLA's own epilogue
         # fusion in docs/performance.md
-        "pallas_epilogue": True,
+        "pallas_epilogue": False,
         "pallas_autotune_cache": os.path.join(
             _home, "cache", "pallas_tuning.json"),
     },

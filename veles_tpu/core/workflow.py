@@ -433,6 +433,25 @@ class Workflow(Container):
         lines.append("}")
         return "\n".join(lines)
 
+    def graph_snapshot(self):
+        """The unit DAG as plain JSON for the live dashboard
+        (``web_status.py`` renders it as SVG — the reference pushed the
+        same structure to its viz.js page, ``web_status.py:113-165``):
+        ``{"nodes": [{id, label, cls, group, runs}], "edges": [[a,b]]}``
+        with ``runs`` = the unit's run_calls counter, so the viewer can
+        highlight activity between refreshes."""
+        units = [self.start_point, self.end_point] + [
+            u for u in self._units
+            if u not in (self.start_point, self.end_point)]
+        ids = {unit: "u%d" % i for i, unit in enumerate(units)}
+        nodes = [{"id": ids[u], "label": u.name,
+                  "cls": type(u).__name__,
+                  "group": getattr(u, "view_group", "PLUMBING"),
+                  "runs": getattr(u, "run_calls", 0)} for u in units]
+        edges = [[ids[u], ids[c]] for u in units
+                 for c in u.links_to if c in ids]
+        return {"nodes": nodes, "edges": edges}
+
     # -- stats (reference workflow.py:425-450, 763-821) ------------------------
     def print_stats(self, top=5):
         stats = []

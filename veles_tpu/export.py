@@ -8,10 +8,16 @@ the dependency-free C++ runtime (``native/``) — containing:
 
 - ``contents.json``: workflow name/checksum + the forward-unit chain with
   per-unit type, config and array refs (``@name.npy``);
-- one ``.npy`` per parameter array (float32, C-order).
+- one ``.npy`` per parameter array (float32 or — ``precision=16`` —
+  float16, C-order; the native loader's dtype conversion matrix widens
+  f2/f8/i1..i8 to f32 at load, mirroring the reference's
+  ``numpy_array_loader.h:66-116``).
 
 Only ForwardUnits are exported (inference graph), in control-chain order,
-exactly like the reference exported its forward chain.
+exactly like the reference exported its forward chain; ``precision``
+mirrors the reference ``package_export(precision=16|32)``
+(``workflow.py:864-975``) — half-size embedded packages are half the
+point of a native inference runtime.
 """
 
 import io
@@ -24,9 +30,9 @@ import numpy
 from veles_tpu.memory import Array
 
 
-def _npy_bytes(array):
+def _npy_bytes(array, dtype=numpy.float32):
     buf = io.BytesIO()
-    numpy.save(buf, numpy.ascontiguousarray(array, numpy.float32))
+    numpy.save(buf, numpy.ascontiguousarray(array, dtype))
     return buf.getvalue()
 
 
@@ -95,10 +101,18 @@ def _unit_spec(unit, arrays):
     return spec
 
 
-def package_export(workflow, path):
-    """Export ``workflow``'s forward chain to a tar package at ``path``."""
+def package_export(workflow, path, precision=32):
+    """Export ``workflow``'s forward chain to a tar package at ``path``.
+
+    ``precision``: 32 (float32 arrays) or 16 (float16 — ~half the
+    package size; the native runtime widens back to f32 at load, so
+    inference costs one rounding of the parameters)."""
     from veles_tpu.nn.all2all import All2AllSoftmax
 
+    if precision not in (16, 32):
+        raise ValueError("only 16- and 32-bit float export is supported "
+                         "(got %r)" % (precision,))
+    dtype = numpy.float16 if precision == 16 else numpy.float32
     arrays = {}
     units = []
     for unit in workflow.forwards:
@@ -110,6 +124,7 @@ def package_export(workflow, path):
         "workflow": workflow.name,
         "checksum": workflow.checksum,
         "exported": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "precision": precision,
         "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
         "units": units,
     }
@@ -119,7 +134,7 @@ def package_export(workflow, path):
         info.size = len(payload)
         tar.addfile(info, io.BytesIO(payload))
         for key, value in arrays.items():
-            blob = _npy_bytes(value)
+            blob = _npy_bytes(value, dtype)
             info = tarfile.TarInfo("%s.npy" % key)
             info.size = len(blob)
             tar.addfile(info, io.BytesIO(blob))

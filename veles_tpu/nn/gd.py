@@ -227,8 +227,36 @@ class GradientDescent(JitUnit):
     # fleet-mode DP: slaves ship their weight deltas; the master merges.
     # (Pod-mode DP instead all-reduces gradients inside the tick — see
     # veles_tpu/parallel/.)
+    def _param_attrs(self):
+        """Trainable parameter slots, derived from the unit's I/O
+        contract (attrs in both INPUTS and OUTPUTS that are not solver
+        state or the error lanes) — so subclasses with extra leaves
+        (GDSelfAttention's out projection) ship them in fleet payloads
+        automatically instead of silently desynchronizing."""
+        return [name for name in self.OUTPUTS
+                if name in self.INPUTS and not name.startswith("_")
+                and name != "err_input"]
+
+    def _solver_state_attrs(self):
+        """Fleet-payload policy for optimizer state: momentum
+        velocities stay slave-local (reference Znicz parity — its wire
+        never carried them); the ADDITIVE stateful solvers
+        (adam/adagrad) ship first+second moments and the step count so
+        (a) the master's canonical state is resumable — a snapshot of a
+        fleet Adam run restarts with real moments — and (b) a respawned
+        slave continues instead of restarting from zeroed moments. See
+        docs/distributed.md."""
+        if self.solver == "momentum":
+            return []
+        return [n for n in self.OUTPUTS if n.startswith("_velocity")] \
+            + list(self._second_slots_) + ["_step"]
+
     def generate_data_for_master(self):
-        return {"weights": self.weights.mem, "bias": self.bias.mem}
+        data = {attr: getattr(self, attr).mem
+                for attr in self._param_attrs()}
+        for attr in self._solver_state_attrs():
+            data[attr] = getattr(self, attr).mem
+        return data
 
     def apply_data_from_slave(self, data, slave=None):
         """Merge a slave's trained weights into master state.
@@ -241,31 +269,44 @@ class GradientDescent(JitUnit):
         - ``average`` — master keeps the mean of its current state and
           the slave's: N slaves pushing divergent updates blend instead
           of thrashing, an EASGD-flavored option the reference lacked.
+
+        Solver moments (stateful solvers only) are always OVERWRITTEN —
+        they are running estimates, and averaging a second moment
+        against a stale one has no useful semantics.
         """
         mode = fleet_merge_mode()
-        weights = jnp.asarray(data["weights"])
-        bias = jnp.asarray(data["bias"])
-        if mode == "average":
-            # device-resident math: .mem here would serialize two PCIe
-            # round-trips per layer per update under the server's lock
-            if self.weights.data is not None:
-                weights = (self.weights.data + weights) * 0.5
-            if self.bias.data is not None:
-                bias = (self.bias.data + bias) * 0.5
-        self.weights.data = weights
-        self.bias.data = bias
+        for attr in self._param_attrs():
+            if attr not in data:
+                continue
+            slot = getattr(self, attr)
+            value = jnp.asarray(data[attr])
+            if mode == "average" and slot.data is not None:
+                # device-resident math: .mem here would serialize two
+                # PCIe round-trips per layer per update under the
+                # server's lock
+                value = (slot.data + value) * 0.5
+            slot.data = value
+        for attr in self._solver_state_attrs():
+            if attr in data:
+                getattr(self, attr).data = jnp.asarray(data[attr])
 
     def generate_data_for_slave(self, slave=None):
         # the rates ride every job so master-side annealing (plateau
         # lr_decay, set_learning_rate) reaches the slaves that execute
         # the actual GD ticks
-        return {"weights": self.weights.mem, "bias": self.bias.mem,
-                "lr": self.learning_rate,
-                "lr_bias": self.learning_rate_bias}
+        data = {attr: getattr(self, attr).mem
+                for attr in self._param_attrs()}
+        for attr in self._solver_state_attrs():
+            if getattr(self, attr).data is not None:
+                data[attr] = getattr(self, attr).mem
+        data["lr"] = self.learning_rate
+        data["lr_bias"] = self.learning_rate_bias
+        return data
 
     def apply_data_from_master(self, data):
-        self.weights.data = jnp.asarray(data["weights"])
-        self.bias.data = jnp.asarray(data["bias"])
+        for attr in self._param_attrs() + self._solver_state_attrs():
+            if attr in data:
+                getattr(self, attr).data = jnp.asarray(data[attr])
         if "lr" in data and (data["lr"] != self.learning_rate
                              or data["lr_bias"]
                              != self.learning_rate_bias):

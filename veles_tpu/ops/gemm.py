@@ -47,7 +47,7 @@ def matmul(a, b, precision_level=None, out_dtype=None, use_pallas=None):
     if out_dtype is None:
         out_dtype = a.dtype
     if use_pallas is None:
-        use_pallas = root.common.engine.get("use_pallas", True)
+        use_pallas = root.common.engine.get("use_pallas", False)
     (a, b), precision = compute_operands(
         a, b, precision_level=precision_level)
     if use_pallas and _pallas_eligible(a, b):
@@ -286,16 +286,19 @@ def dense_layer(x, w, bias, activation="linear", precision_level=None,
                 out_dtype=jnp.float32, use_pallas=None):
     """The product dense-layer forward: ``act(x @ w + b)``.
 
-    When the shapes qualify (and ``root.common.engine.use_pallas`` +
-    ``pallas_epilogue``), the whole layer runs as the fused Pallas
-    kernel above — the autotune cache's block sizes applied ON the
-    product path (the role the reference's per-device GEMM autotune
-    played for every All2All, ``backends.py:623-731``). Otherwise XLA's
-    dot + its own epilogue fusion. ``docs/performance.md`` records the
-    measured comparison between the two."""
+    Default path: XLA dot + its own epilogue fusion — MEASURED faster
+    than the Pallas kernels on the train composite (fwd+bwd+update,
+    mb 4096: 0.40 vs 0.73 ms/step; docs/performance.md "Pallas +
+    autotune" has the full table). Opt in to the fused Pallas epilogue
+    kernel (``root.common.engine.use_pallas`` + ``pallas_epilogue``,
+    or ``use_pallas=True`` here) for the shapes where it wins —
+    forward-only tall-skinny (m=512, n=k=4096 measured 2.6x faster
+    than XLA) — with the autotune cache's block sizes applied (the
+    role the reference's per-device GEMM autotune played for every
+    All2All, ``backends.py:623-731``)."""
     if use_pallas is None:
-        use_pallas = root.common.engine.get("use_pallas", True) \
-            and root.common.engine.get("pallas_epilogue", True)
+        use_pallas = root.common.engine.get("use_pallas", False) \
+            and root.common.engine.get("pallas_epilogue", False)
     (xc, wc), precision = compute_operands(
         x, w, precision_level=precision_level)
     if use_pallas and _pallas_eligible(xc, wc):

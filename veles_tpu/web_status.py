@@ -26,6 +26,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 import urllib.request
 
 from veles_tpu.core.config import root
@@ -44,8 +45,107 @@ _PAGE = """<!DOCTYPE html>
 <h2>Workflows</h2>
 <table><tr><th>name</th><th>mode</th><th>slaves</th><th>runtime (s)</th>
 <th>updated</th></tr>%(rows)s</table>
+<h2>Workflow graphs</h2>%(graphs)s
 <h2>Plots</h2>%(plots)s
 </body></html>"""
+
+#: view-group fill colors for the live graph (the reference's viz.js
+#: page colored by the same VIEW_GROUP taxonomy)
+_GROUP_FILL = {"LOADER": "#c8e6c9", "WORKER": "#bbdefb",
+               "TRAINER": "#ffe0b2", "EVALUATOR": "#e1bee7",
+               "SERVICE": "#fff9c4", "PLUMBING": "#eeeeee"}
+
+
+def render_graph_svg(graph):
+    """A unit DAG as a self-contained SVG (no graphviz binary, no CDN
+    viz.js — the environment has neither; the DAGs are 10-40 nodes, so
+    a layered BFS layout is plenty). Back-edges (the repeater loop)
+    route around the left side."""
+    from html import escape
+
+    nodes = [n for n in list(graph.get("nodes") or [])[:200]
+             if isinstance(n, dict) and n.get("id") is not None]
+    edges = [e for e in list(graph.get("edges") or [])[:600]
+             if isinstance(e, (list, tuple)) and len(e) == 2]
+    if not nodes:
+        return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+    index = {n.get("id"): n for n in nodes}
+    targets = {e[1] for e in edges}
+    roots = [n.get("id") for n in nodes if n.get("id") not in targets] \
+        or [nodes[0].get("id")]
+    out = {}
+    for a, b in edges:
+        out.setdefault(a, []).append(b)
+    rank = {r: 0 for r in roots}
+    frontier = list(roots)
+    while frontier:  # BFS depth = rank; cycles stop at the visited set
+        node = frontier.pop(0)
+        for nxt in out.get(node, []):
+            if nxt not in rank and nxt in index:
+                rank[nxt] = rank[node] + 1
+                frontier.append(nxt)
+    for n in nodes:  # disconnected nodes park at the bottom
+        rank.setdefault(n.get("id"), max(rank.values()) + 1)
+    by_rank = {}
+    for nid, r in rank.items():
+        by_rank.setdefault(r, []).append(nid)
+    row_h, pad, char_w = 64, 24, 7
+    pos, widths = {}, {}
+    width = pad
+    for r in sorted(by_rank):
+        x = pad + 40  # left gutter for back-edges
+        for nid in by_rank[r]:
+            node = index[nid]
+            w = max(90, char_w * len(str(node.get("label", ""))) + 16)
+            pos[nid] = (x, pad + r * row_h)
+            widths[nid] = w
+            x += w + 18
+        width = max(width, x)
+    height = pad * 2 + (max(by_rank) + 1) * row_h
+    parts = [
+        "<svg xmlns='http://www.w3.org/2000/svg' width='%d' height='%d'"
+        " font-family='sans-serif' font-size='11'>" % (width, height),
+        "<defs><marker id='arr' markerWidth='8' markerHeight='8' "
+        "refX='7' refY='3' orient='auto'>"
+        "<path d='M0,0 L7,3 L0,6 z' fill='#555'/></marker></defs>"]
+    for a, b in edges:
+        if a not in pos or b not in pos:
+            continue
+        ax, ay = pos[a]
+        bx, by = pos[b]
+        ax += widths[a] / 2
+        bx += widths[b] / 2
+        if rank[b] > rank[a]:  # forward: straight line
+            parts.append(
+                "<line x1='%.0f' y1='%.0f' x2='%.0f' y2='%.0f' "
+                "stroke='#555' marker-end='url(#arr)'/>"
+                % (ax, ay + 30, bx, by))
+        else:  # back-edge (repeater loop): route around the gutter
+            parts.append(
+                "<path d='M%.0f,%.0f C %d,%.0f %d,%.0f %.0f,%.0f' "
+                "fill='none' stroke='#999' stroke-dasharray='4,3' "
+                "marker-end='url(#arr)'/>"
+                % (ax, ay + 30, 8, ay + 30, 8, by + 15, bx - 4,
+                   by + 15))
+    for nid, (x, y) in pos.items():
+        node = index[nid]
+        fill = _GROUP_FILL.get(str(node.get("group", "")), "#eeeeee")
+        runs = node.get("runs", 0)
+        label = escape(str(node.get("label", "")))
+        cls = escape(str(node.get("cls", "")))
+        parts.append(
+            "<g><rect x='%d' y='%d' width='%d' height='30' rx='4' "
+            "fill='%s' stroke='%s'/>"
+            "<text x='%d' y='%d' text-anchor='middle'>%s</text>"
+            "<text x='%d' y='%d' text-anchor='middle' fill='#666' "
+            "font-size='9'>%s%s</text></g>"
+            % (x, y, widths[nid], fill,
+               "#1565c0" if runs else "#999",
+               x + widths[nid] / 2, y + 13, label,
+               x + widths[nid] / 2, y + 25, cls,
+               escape(" x%d" % runs) if runs else ""))
+    parts.append("</svg>")
+    return "".join(parts)
 
 
 class WebStatusServer(Logger):
@@ -99,6 +199,24 @@ class WebStatusServer(Logger):
                     reply(self, server.tail_events())
                 elif self.path.startswith("/plots/"):
                     self._serve_plot(self.path[len("/plots/"):])
+                elif self.path.startswith("/graph/"):
+                    key = self.path[len("/graph/"):].partition("?")[0]
+                    if key.endswith(".svg"):
+                        key = key[:-4]
+                    # the page quoted the key into the URL
+                    key = urllib.parse.unquote(key)
+                    graph = server.statuses().get(key, {}).get("graph")
+                    if not isinstance(graph, dict):
+                        self.send_error(404)
+                        return
+                    try:
+                        svg = render_graph_svg(graph)
+                    except Exception:
+                        # /update is unauthenticated: a malformed graph
+                        # payload must 404, never wedge the connection
+                        self.send_error(404)
+                        return
+                    reply(self, svg, 200, "image/svg+xml")
                 elif self.path in ("/", "/index.html"):
                     reply(self, server.render_page(), 200, "text/html")
                 else:
@@ -183,6 +301,17 @@ class WebStatusServer(Logger):
                     runtime,
                     time.strftime("%X",
                                   time.localtime(s.get("updated", 0)))))
+        graphs = []
+        for key, s in sorted(self.statuses().items()):
+            if isinstance(s.get("graph"), dict):
+                # mtime-style cache-buster: the 3s meta-refresh must
+                # re-fetch the re-rendered live graph, like the plots
+                graphs.append(
+                    "<h3>%s</h3><img src='/graph/%s.svg?t=%d' "
+                    "style='max-width:100%%;border:1px solid #ccc'/>"
+                    % (escape(str(s.get("name", key))),
+                       urllib.parse.quote(key),
+                       int(s.get("updated", 0))))
         plots = []
         if self.plots_directory and os.path.isdir(self.plots_directory):
             for path in sorted(glob.glob(
@@ -201,6 +330,7 @@ class WebStatusServer(Logger):
                              % (name, stamp, name))
         return _PAGE % {"rows": "".join(rows) or
                         "<tr><td colspan=5>none</td></tr>",
+                        "graphs": "".join(graphs) or "<p>none</p>",
                         "plots": "".join(plots) or "<p>none</p>"}
 
 
@@ -233,6 +363,14 @@ class StatusNotifier:
         agent = getattr(launcher, "agent", None)
         if agent is not None and hasattr(agent, "fleet_status"):
             status["slaves"] = agent.fleet_status().get("slaves", [])
+        # the live unit DAG (+ run counters) for the dashboard's graph
+        # view — the reference's viz.js workflow page
+        # (web_status.py:113-165), rendered server-side as SVG here
+        if hasattr(launcher.workflow, "graph_snapshot"):
+            try:
+                status["graph"] = launcher.workflow.graph_snapshot()
+            except Exception:
+                pass
         return status
 
     def start(self):
