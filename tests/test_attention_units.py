@@ -38,8 +38,12 @@ def test_self_attention_forward_matches_naive():
     p = jax.nn.softmax(s, axis=-1)
     ref = jnp.einsum("bhqk,bkhd->bqhd", p, v.reshape(shape)).reshape(
         2, 8, 16) @ attn.out_weights.data + attn.out_bias.data
+    # the unit runs the ENGINE precision policy (bf16 projections +
+    # attention core, f32 accumulation — ops/attention.attention_block)
+    # while this naive reference is pure f32: the bound covers the bf16
+    # operand rounding, same as the conv parity tests
     numpy.testing.assert_allclose(numpy.asarray(attn.output.mem),
-                                  numpy.asarray(ref), rtol=2e-2, atol=1e-3)
+                                  numpy.asarray(ref), rtol=3e-2, atol=6e-3)
 
 
 def test_gd_self_attention_matches_autodiff():

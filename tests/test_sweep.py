@@ -306,6 +306,48 @@ def test_sweep_pipelined_identical_on_no_improvement_stop():
             numpy.asarray(fp.weights.data), numpy.asarray(fs.weights.data))
 
 
+def test_sweep_snapshot_resume(tmp_path):
+    """A swept workflow pickles and resumes: the FusedSweep rides the
+    snapshot (EPHEMERAL = excluded from checksum, not from pickle), its
+    volatile plan/state rebuild, and training continues."""
+    import os
+    import glob
+
+    from veles_tpu.snapshotter import SnapshotterToFile
+
+    data, labels = _dataset()
+    wf = _build(data, labels, Observer, fused="auto", max_epochs=2)
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="swp",
+                             interval=1, time_interval=0)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    wf.end_point.unlink_from(wf.decision)
+    wf.end_point.link_from(snap)
+    wf.initialize()
+    assert isinstance(wf.sweep_unit, FusedSweep)
+    wf.run()
+    assert glob.glob(os.path.join(str(tmp_path), "swp_*.pickle*"))
+
+    restored = SnapshotterToFile.import_(snap.destination)
+    assert restored.restored_from_snapshot
+    restored.workflow = __import__(
+        "veles_tpu.dummy", fromlist=["DummyLauncher"]).DummyLauncher()
+    # the splice survived the pickle: the sweep unit is still the
+    # loader's consumer and keeps its member list
+    assert isinstance(restored.sweep_unit, FusedSweep)
+    assert restored.sweep_unit in restored.loader.links_to
+    restored.decision.max_epochs = 4
+    restored.decision.complete.unset()
+    restored.decision.train_ended.unset()
+    restored.initialize()
+    # _enable_segments must NOT have spliced a second engine
+    assert sum(1 for u in restored.units
+               if isinstance(u, FusedSweep)) == 1
+    restored.run()
+    assert restored.decision._epochs_done >= 2
+    assert restored.sweep_unit.ticks > 0
+
+
 def test_sweep_dispatch_count():
     """The speed claim in structural form: host dispatches per epoch are
     sweep-granular (chunked), not minibatch-granular."""

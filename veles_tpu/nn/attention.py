@@ -19,7 +19,7 @@ from veles_tpu.core.prng import get as get_rng
 from veles_tpu.memory import Array
 from veles_tpu.nn.jit_unit import ForwardUnit
 from veles_tpu.nn.gd import GradientDescent
-from veles_tpu.ops.attention import attention
+from veles_tpu.ops.attention import attention_block
 
 
 class SelfAttention(ForwardUnit):
@@ -65,14 +65,10 @@ class SelfAttention(ForwardUnit):
             self.output.data = jnp.zeros(self.input.shape, jnp.float32)
 
     def _forward(self, x, w_qkv, b_qkv, w_out, b_out):
-        batch, t, embed = x.shape
-        head_dim = embed // self.heads
-        qkv = x @ w_qkv + b_qkv  # (B, T, 3E)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (batch, t, self.heads, head_dim)
-        out = attention(q.reshape(shape), k.reshape(shape),
-                        v.reshape(shape), causal=self.causal)
-        return out.reshape(batch, t, embed) @ w_out + b_out
+        # shared implementation with the fused engine: the whole block
+        # under the engine precision policy (ops/attention.py)
+        return attention_block(x, w_qkv, b_qkv, w_out, b_out,
+                               self.heads, self.causal)
 
     def compute(self, x, w_qkv, b_qkv, w_out, b_out):
         return self._forward(x, w_qkv, b_qkv, w_out, b_out)

@@ -209,19 +209,14 @@ def _layer_forward(spec):
             return act(conv2d(x, p["w"], sliding, padding) + p["b"])
         return fwd
     if kind == _ATTN:
-        from veles_tpu.ops.attention import attention as attn_op
+        from veles_tpu.ops.attention import attention_block
         heads, causal = spec["heads"], spec["causal"]
 
         def fwd(p, x):
-            # mirrors nn.attention.SelfAttention._forward exactly
-            batch, t, embed = x.shape
-            head_dim = embed // heads
-            qkv = x @ p["w"] + p["b"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            shape = (batch, t, heads, head_dim)
-            out = attn_op(q.reshape(shape), k.reshape(shape),
-                          v.reshape(shape), causal=causal)
-            return out.reshape(batch, t, embed) @ p["ow"] + p["ob"]
+            # THE SAME implementation the graph unit runs
+            # (nn.attention.SelfAttention._forward delegates there too)
+            return attention_block(x, p["w"], p["b"], p["ow"], p["ob"],
+                                   heads, causal)
         return fwd
     if kind == _NORM:
         eps = spec["eps"]
@@ -298,8 +293,15 @@ def build_tick(specs, norm_type="none", mesh=None,
       class per epoch instead of one per minibatch;
     - ``eval_sweep(...)`` likewise without updates.
     """
+    from veles_tpu.core.config import root
     key = (_freeze(specs), norm_type, with_confusion, augment,
-           loss_kind, None if mesh is None else id(mesh))
+           loss_kind, None if mesh is None else id(mesh),
+           # EVERY engine knob the trace folds in: a changed level /
+           # dtype / Pallas opt-in must not reuse a stale compiled tick
+           root.common.engine.get("precision_level", 0),
+           str(root.common.engine.get("compute_dtype", "bfloat16")),
+           bool(root.common.engine.get("use_pallas", False)),
+           bool(root.common.engine.get("pallas_epilogue", False)))
     cached = _TICK_CACHE.get(key)
     if cached is not None:
         return cached
