@@ -1,0 +1,39 @@
+// Leveled diagnostics for the native runtime — the eina-log role in the
+// reference (libVeles inc/veles/logger.h wraps eina_log with per-component
+// colored level macros; the vendored eina headers live in
+// inc/veles/eina_*.h). Same capability, dependency-free: the level comes
+// from the VELES_RT_LOG environment variable (off|error|warn|info|debug,
+// default warn), parsed once; each message is rendered into one buffer and
+// written with a single stderr call so concurrent engine workers don't
+// interleave lines.
+#pragma once
+
+namespace veles_rt {
+
+enum class LogLevel { kOff = 0, kError = 1, kWarn = 2, kInfo = 3, kDebug = 4 };
+
+// Parse a VELES_RT_LOG value; unknown/empty strings mean the default (warn).
+LogLevel ParseLogLevel(const char* value);
+
+// Current level: first call reads VELES_RT_LOG, later calls are cached.
+LogLevel log_level();
+
+// Override the cached level (tests, embedders).
+void set_log_level(LogLevel level);
+
+// printf-style; drops the message when `level` is above the current level.
+#if defined(__GNUC__)
+__attribute__((format(printf, 2, 3)))
+#endif
+void LogMessage(LogLevel level, const char* fmt, ...);
+
+}  // namespace veles_rt
+
+#define VRT_ERROR(...) \
+  ::veles_rt::LogMessage(::veles_rt::LogLevel::kError, __VA_ARGS__)
+#define VRT_WARN(...) \
+  ::veles_rt::LogMessage(::veles_rt::LogLevel::kWarn, __VA_ARGS__)
+#define VRT_INFO(...) \
+  ::veles_rt::LogMessage(::veles_rt::LogLevel::kInfo, __VA_ARGS__)
+#define VRT_DEBUG(...) \
+  ::veles_rt::LogMessage(::veles_rt::LogLevel::kDebug, __VA_ARGS__)

@@ -1,0 +1,15 @@
+// Banned-function traps — the reference poison.h role (libVeles
+// inc/veles/poison.h marks unsafe/legacy libc calls so they fail the
+// build instead of shipping). Include this LAST in a translation unit,
+// after every system header, because `#pragma GCC poison` rejects any
+// later mention of the identifiers, including ones inside headers.
+#pragma once
+
+#if defined(__GNUC__) || defined(__clang__)
+// No bounds: classic overflow sources. Use std::string / snprintf.
+#pragma GCC poison gets strcpy strcat sprintf vsprintf
+// Non-reentrant state that breaks under the thread-pool engine.
+#pragma GCC poison strtok asctime ctime gmtime localtime
+// Terminate-without-unwind; the runtime reports errors by exception.
+#pragma GCC poison abort
+#endif

@@ -5,7 +5,9 @@
 #include <exception>
 #include <string>
 
+#include "veles_rt/log.h"
 #include "veles_rt/workflow.h"
+#include "veles_rt/poison.h"
 
 namespace {
 thread_local std::string g_last_error;
@@ -17,6 +19,8 @@ void* veles_rt_load(const char* path) {
   try {
     return veles_rt::Workflow::Load(path).release();
   } catch (const std::exception& e) {
+    // the only trace a ctypes caller gets unless it checks last_error
+    VRT_ERROR("load failed for %s: %s", path, e.what());
     g_last_error = e.what();
     return nullptr;
   }
@@ -42,6 +46,7 @@ int veles_rt_run(void* wf, const float* input, int batch, float* output) {
     static_cast<veles_rt::Workflow*>(wf)->Run(input, batch, output);
     return 0;
   } catch (const std::exception& e) {
+    VRT_ERROR("run failed: %s", e.what());
     g_last_error = e.what();
     return -1;
   }

@@ -1,0 +1,66 @@
+#include "veles_rt/log.h"
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "veles_rt/poison.h"
+
+namespace veles_rt {
+
+namespace {
+
+constexpr int kUnset = -1;
+std::atomic<int> g_level{kUnset};
+
+const char* LevelTag(LogLevel level) {
+  switch (level) {
+    case LogLevel::kError: return "ERROR";
+    case LogLevel::kWarn:  return "WARN";
+    case LogLevel::kInfo:  return "INFO";
+    case LogLevel::kDebug: return "DEBUG";
+    default:               return "?";
+  }
+}
+
+}  // namespace
+
+LogLevel ParseLogLevel(const char* value) {
+  if (value == nullptr) return LogLevel::kWarn;
+  if (std::strcmp(value, "off") == 0) return LogLevel::kOff;
+  if (std::strcmp(value, "error") == 0) return LogLevel::kError;
+  if (std::strcmp(value, "warn") == 0) return LogLevel::kWarn;
+  if (std::strcmp(value, "info") == 0) return LogLevel::kInfo;
+  if (std::strcmp(value, "debug") == 0) return LogLevel::kDebug;
+  return LogLevel::kWarn;
+}
+
+LogLevel log_level() {
+  int level = g_level.load(std::memory_order_relaxed);
+  if (level == kUnset) {
+    level = static_cast<int>(ParseLogLevel(std::getenv("VELES_RT_LOG")));
+    g_level.store(level, std::memory_order_relaxed);
+  }
+  return static_cast<LogLevel>(level);
+}
+
+void set_log_level(LogLevel level) {
+  g_level.store(static_cast<int>(level), std::memory_order_relaxed);
+}
+
+void LogMessage(LogLevel level, const char* fmt, ...) {
+  if (static_cast<int>(level) > static_cast<int>(log_level())) return;
+  char buf[1024];
+  int at = std::snprintf(buf, sizeof(buf), "veles_rt %s: ", LevelTag(level));
+  if (at < 0) return;
+  va_list args;
+  va_start(args, fmt);
+  std::vsnprintf(buf + at, sizeof(buf) - static_cast<size_t>(at) - 1, fmt,
+                 args);
+  va_end(args);
+  std::fprintf(stderr, "%s\n", buf);
+}
+
+}  // namespace veles_rt

@@ -5,6 +5,8 @@
 #include <fstream>
 #include <stdexcept>
 
+#include "veles_rt/poison.h"
+
 namespace veles_rt {
 namespace {
 

@@ -7,6 +7,7 @@
 #include <stdexcept>
 
 #include "veles_rt/workflow.h"
+#include "veles_rt/poison.h"
 
 namespace veles_rt {
 namespace {

@@ -8,6 +8,9 @@
 #include <stdexcept>
 #include <thread>
 
+#include "veles_rt/log.h"
+#include "veles_rt/poison.h"
+
 namespace veles_rt {
 
 // -- factory ------------------------------------------------------------------
@@ -25,8 +28,10 @@ std::unique_ptr<Unit> UnitFactory::Create(
     const std::string& type, const Json& spec,
     std::map<std::string, Tensor>* arrays) const {
   auto it = ctors_.find(type);
-  if (it == ctors_.end())
+  if (it == ctors_.end()) {
+    VRT_ERROR("no unit registered for type: %s", type.c_str());
     throw std::runtime_error("no unit registered for type: " + type);
+  }
   return it->second(spec, arrays);
 }
 
@@ -162,6 +167,9 @@ std::unique_ptr<Workflow> Workflow::Load(const std::string& path) {
     unit->out_shape = shape;
     wf->units_.push_back(std::move(unit));
   }
+  VRT_INFO("loaded workflow '%s': %zu units, %zu arrays, input %lld",
+           wf->name_.c_str(), wf->units_.size(), arrays.size(),
+           static_cast<long long>(wf->input_shape_.count()));
   return wf;
 }
 
@@ -185,6 +193,8 @@ void Workflow::InitializeLocked(int batch) {
             static_cast<int64_t>(sizeof(float))});
   }
   int64_t arena_bytes = PackIntervals(&buffers);
+  VRT_DEBUG("planned arena: %lld bytes for batch %d (%zu buffers)",
+            static_cast<long long>(arena_bytes), batch, buffers.size());
   arena_.assign(static_cast<size_t>(arena_bytes / sizeof(float)) + 1, 0.f);
   offsets_.clear();
   for (auto& buf : buffers)

@@ -7,6 +7,7 @@
 #include <string>
 
 #include "veles_rt/json.h"
+#include "veles_rt/log.h"
 #include "veles_rt/package.h"
 #include "veles_rt/workflow.h"
 
@@ -33,6 +34,22 @@ static void TestJson() {
   CHECK(v.at("ok").boolean);
   CHECK(v.at("arr").array.size() == 3);
   CHECK(v.at("nested").at("k").as_str() == "v\n");
+}
+
+static void TestLog() {
+  using veles_rt::LogLevel;
+  CHECK(veles_rt::ParseLogLevel(nullptr) == LogLevel::kWarn);
+  CHECK(veles_rt::ParseLogLevel("debug") == LogLevel::kDebug);
+  CHECK(veles_rt::ParseLogLevel("off") == LogLevel::kOff);
+  CHECK(veles_rt::ParseLogLevel("bogus") == LogLevel::kWarn);
+  veles_rt::set_log_level(LogLevel::kOff);
+  VRT_ERROR("must not appear: %d", 1);  // filtered, must not crash
+  veles_rt::set_log_level(LogLevel::kDebug);
+  VRT_DEBUG("log smoke: %s %d", "ok", 2);
+  veles_rt::set_log_level(veles_rt::ParseLogLevel(
+      std::getenv("VELES_RT_LOG")));
+  CHECK(veles_rt::log_level() == veles_rt::ParseLogLevel(
+      std::getenv("VELES_RT_LOG")));
 }
 
 static void TestPackIntervals() {
@@ -81,6 +98,7 @@ static void TestPackageInference(const std::string& dir) {
 
 int main(int argc, char** argv) {
   TestJson();
+  TestLog();
   TestPackIntervals();
   if (argc > 1) {
     TestNpyRoundtrip(argv[1]);
