@@ -328,7 +328,8 @@ class SelfAttentionUnit : public Unit {
                     std::map<std::string, Tensor>* arrays,
                     const Json& spec)
       : heads_(config.at("heads").as_int()),
-        causal_(config.at("causal").as_int() != 0) {
+        causal_(config.at("causal").as_int() != 0),
+        residual_(config.get("residual", Json()).as_int() != 0) {
     w_qkv_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "weights")));
     b_qkv_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "bias")));
     w_out_ =
@@ -414,14 +415,112 @@ class SelfAttentionUnit : public Unit {
         std::memcpy(y + static_cast<int64_t>(r) * embed_,
                     b_out_.data.data(), embed_ * sizeof(float));
       Gemm(mixed.data(), w_out_.data.data(), y, t_, embed_, embed_);
+      if (residual_)
+        for (int64_t i = 0; i < sample; ++i) y[i] += x[i];
     }
   }
 
  private:
   int heads_;
-  bool causal_;
+  bool causal_, residual_;
   Tensor w_qkv_, b_qkv_, w_out_, b_out_;
   int t_ = 0, embed_ = 0;
+};
+
+// Position-wise feed-forward block over (T, E) samples:
+// act(x W1 + b1) W2 + b2 (+ x with the residual flag) — completes the
+// transformer tier (mirrors veles_tpu/ops/attention.py ffn_block; gelu
+// is the same tanh approximation jax.nn.gelu uses by default).
+class FfnUnit : public Unit {
+ public:
+  enum class Act { kGelu, kRelu, kTanh, kLinear };
+
+  FfnUnit(const Json& config, std::map<std::string, Tensor>* arrays,
+          const Json& spec)
+      : residual_(config.get("residual", Json()).as_int() != 0) {
+    const std::string& name = config.at("activation").as_str();
+    if (name == "gelu") act_ = Act::kGelu;
+    else if (name == "relu") act_ = Act::kRelu;
+    else if (name == "tanh") act_ = Act::kTanh;
+    else if (name == "linear") act_ = Act::kLinear;
+    else throw std::runtime_error("unknown ffn activation: " + name);
+    w1_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "weights")));
+    b1_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "bias")));
+    w2_ =
+        std::move((*arrays).at(All2AllUnit::RefKey(spec, "out_weights")));
+    b2_ = std::move((*arrays).at(All2AllUnit::RefKey(spec, "out_bias")));
+  }
+
+  const char* type() const override { return "ffn"; }
+
+  Shape Infer(const Shape& in) override {
+    if (in.dims.size() != 2)
+      throw std::runtime_error("ffn expects (T, E) input");
+    t_ = static_cast<int>(in.dims[0]);
+    embed_ = static_cast<int>(in.dims[1]);
+    if (w1_.shape.size() != 2 ||
+        embed_ != static_cast<int>(w1_.shape[0]))
+      throw std::runtime_error("ffn expansion weight mismatch");
+    hidden_ = static_cast<int>(w1_.shape[1]);
+    if (static_cast<int64_t>(b1_.data.size()) < hidden_)
+      throw std::runtime_error("ffn expansion bias too small");
+    if (w2_.shape.size() != 2 ||
+        hidden_ != static_cast<int>(w2_.shape[0]) ||
+        embed_ != static_cast<int>(w2_.shape[1]))
+      throw std::runtime_error("ffn contraction weight mismatch");
+    if (static_cast<int64_t>(b2_.data.size()) < embed_)
+      throw std::runtime_error("ffn contraction bias too small");
+    return in;
+  }
+
+  void Run(const float* in, float* out, int batch) const override {
+    int64_t sample = static_cast<int64_t>(t_) * embed_;
+    std::vector<float> h(static_cast<int64_t>(t_) * hidden_);
+    for (int b = 0; b < batch; ++b) {
+      const float* x = in + b * sample;
+      float* y = out + b * sample;
+      for (int r = 0; r < t_; ++r)
+        std::memcpy(h.data() + static_cast<int64_t>(r) * hidden_,
+                    b1_.data.data(), hidden_ * sizeof(float));
+      Gemm(x, w1_.data.data(), h.data(), t_, embed_, hidden_);
+      Activate(h.data(), h.size());
+      for (int r = 0; r < t_; ++r)
+        std::memcpy(y + static_cast<int64_t>(r) * embed_,
+                    b2_.data.data(), embed_ * sizeof(float));
+      Gemm(h.data(), w2_.data.data(), y, t_, hidden_, embed_);
+      if (residual_)
+        for (int64_t i = 0; i < sample; ++i) y[i] += x[i];
+    }
+  }
+
+ private:
+  void Activate(float* data, size_t n) const {
+    switch (act_) {
+      case Act::kGelu:
+        // jax.nn.gelu's default tanh approximation:
+        // 0.5x(1 + tanh(sqrt(2/pi)(x + 0.044715 x^3)))
+        for (size_t i = 0; i < n; ++i) {
+          float x = data[i];
+          data[i] = 0.5f * x *
+                    (1.0f + std::tanh(0.7978845608f *
+                                      (x + 0.044715f * x * x * x)));
+        }
+        return;
+      case Act::kRelu:  // jax.nn.relu (max), not the Znicz softplus
+        for (size_t i = 0; i < n; ++i) data[i] = std::max(0.f, data[i]);
+        return;
+      case Act::kTanh:  // plain tanh, not the Znicz scaled form
+        for (size_t i = 0; i < n; ++i) data[i] = std::tanh(data[i]);
+        return;
+      case Act::kLinear:
+        return;
+    }
+  }
+
+  bool residual_;
+  Act act_;
+  Tensor w1_, b1_, w2_, b2_;
+  int t_ = 0, embed_ = 0, hidden_ = 0;
 };
 
 // Static registrations (reference RegisterUnit<T> statics).
@@ -465,6 +564,12 @@ struct Registrar {
                      [](const Json& spec,
                         std::map<std::string, Tensor>* arrays) {
                        return std::make_unique<SelfAttentionUnit>(
+                           spec.at("config"), arrays, spec);
+                     });
+    factory.Register("ffn",
+                     [](const Json& spec,
+                        std::map<std::string, Tensor>* arrays) {
+                       return std::make_unique<FfnUnit>(
                            spec.at("config"), arrays, spec);
                      });
   }

@@ -11,7 +11,8 @@ from veles_tpu.dummy import DummyLauncher, DummyWorkflow
 from veles_tpu.memory import Array
 from veles_tpu.models.standard import StandardWorkflow
 from veles_tpu.nn.attention import (
-    GDLayerNorm, GDSelfAttention, LayerNorm, SelfAttention)
+    GDLayerNorm, GDSelfAttention, GDTokenFFN, LayerNorm, SelfAttention,
+    TokenFFN)
 
 
 def _x(b=2, t=8, e=16, seed=0):
@@ -78,6 +79,94 @@ def test_gd_self_attention_matches_autodiff():
         numpy.asarray(attn.out_weights.mem), ow0 - numpy.asarray(g_out),
         rtol=2e-2, atol=1e-4)
     assert gd.err_input.shape == x.shape
+
+
+def test_token_ffn_forward_matches_naive():
+    x = _x()
+    wf = DummyWorkflow()
+    ffn = TokenFFN(wf, ratio=2)
+    ffn.input = Array(x)
+    ffn.initialize()
+    ffn.run()
+    ref = jnp.asarray(x) + jax.nn.gelu(
+        jnp.asarray(x) @ ffn.weights.data + ffn.bias.data
+    ) @ ffn.out_weights.data + ffn.out_bias.data
+    # engine precision policy (bf16 projections, f32 accumulation) vs
+    # this pure-f32 reference — same bound family as the attention test
+    numpy.testing.assert_allclose(numpy.asarray(ffn.output.mem),
+                                  numpy.asarray(ref), rtol=3e-2,
+                                  atol=6e-3)
+    assert ffn.weights.shape == (16, 32)
+    assert ffn.out_weights.shape == (32, 16)
+
+
+def test_token_ffn_no_residual():
+    x = _x()
+    wf = DummyWorkflow()
+    ffn = TokenFFN(wf, ratio=1, residual=False, activation="relu")
+    ffn.input = Array(x)
+    ffn.initialize()
+    ffn.run()
+    ref = jnp.maximum(
+        jnp.asarray(x) @ ffn.weights.data + ffn.bias.data, 0.0
+    ) @ ffn.out_weights.data + ffn.out_bias.data
+    numpy.testing.assert_allclose(numpy.asarray(ffn.output.mem),
+                                  numpy.asarray(ref), rtol=3e-2,
+                                  atol=6e-3)
+
+
+def test_gd_token_ffn_matches_autodiff():
+    x = _x(seed=5)
+    err = _x(seed=6) * 0.01
+    wf = DummyWorkflow()
+    ffn = TokenFFN(wf, ratio=2)
+    ffn.input = Array(x)
+    ffn.initialize()
+    ffn.run()
+    w0 = numpy.asarray(ffn.weights.mem).copy()
+    ow0 = numpy.asarray(ffn.out_weights.mem).copy()
+
+    gd = GDTokenFFN(wf, learning_rate=1.0)
+    gd.link_ffn(ffn, type("E", (), {"err_output": Array(err)})())
+    gd.initialize()
+    gd.run()
+
+    def loss(w1, w2):
+        out = ffn._forward(jnp.asarray(x), w1,
+                           jnp.zeros_like(ffn.bias.data),
+                           w2, jnp.zeros_like(ffn.out_bias.data))
+        return jnp.sum(out * jnp.asarray(err))
+
+    g1, g2 = jax.grad(loss, argnums=(0, 1))(
+        jnp.asarray(w0), jnp.asarray(ow0))
+    numpy.testing.assert_allclose(
+        numpy.asarray(ffn.weights.mem), w0 - numpy.asarray(g1),
+        rtol=2e-2, atol=1e-4)
+    numpy.testing.assert_allclose(
+        numpy.asarray(ffn.out_weights.mem), ow0 - numpy.asarray(g2),
+        rtol=2e-2, atol=1e-4)
+    assert gd.err_input.shape == x.shape
+
+
+def test_residual_attention_forward():
+    x = _x()
+    wf = DummyWorkflow()
+    plain = SelfAttention(wf, heads=4)
+    plain.input = Array(x)
+    plain.initialize()
+    plain.run()
+    res = SelfAttention(wf, heads=4, residual=True)
+    res.input = Array(x)
+    res.initialize()
+    # same weights so the two outputs differ exactly by x
+    res.weights.data = plain.weights.data
+    res.bias.data = plain.bias.data
+    res.out_weights.data = plain.out_weights.data
+    res.out_bias.data = plain.out_bias.data
+    res.run()
+    numpy.testing.assert_allclose(
+        numpy.asarray(res.output.mem),
+        numpy.asarray(plain.output.mem) + x, rtol=1e-5, atol=1e-5)
 
 
 def test_layer_norm_forward_and_backward():

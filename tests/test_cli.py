@@ -46,7 +46,9 @@ def test_transformer_sample_end_to_end(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert json.load(open(result_file))["epochs"] == 2
     from veles_tpu.inference import NativeWorkflow
-    assert NativeWorkflow(package).unit_count == 5
+    # 6 units: the full pre-LN block (LN, residual attention, LN, ffn)
+    # + dense + softmax head
+    assert NativeWorkflow(package).unit_count == 6
 
 
 def test_dry_run_init():

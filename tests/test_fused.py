@@ -63,16 +63,18 @@ def test_fused_mode_matches_graph_mode(sweep):
     assert fused.decision.best_n_err[VALID] == graph.decision.best_n_err[
         VALID]
     assert fused.decision._epochs_done == graph.decision._epochs_done
-    # near-identical weights: each train tick agrees to ~1e-5 (fp
-    # reassociation between the fused autodiff graph and the per-unit
-    # chain), compounding over 45 ticks — metrics above stay exact
+    # near-identical weights: each train tick agrees to fp reassociation
+    # between the fused autodiff graph and the per-unit chain,
+    # compounding over 45 ticks to ~1e-4 measured — metrics stay exact.
+    # (atol was 2e-2 before round 4's gate fix: graph mode used to DROP
+    # the stopping epoch's final update, and the slack masked it.)
     for fg, ff in zip(graph.forwards, fused.forwards):
         numpy.testing.assert_allclose(
             numpy.asarray(fg.weights.data), numpy.asarray(ff.weights.data),
-            atol=2e-2)
+            atol=1e-3)
         numpy.testing.assert_allclose(
             numpy.asarray(fg.bias.data), numpy.asarray(ff.bias.data),
-            atol=2e-2)
+            atol=1e-3)
 
 
 def test_fused_mode_learns():
@@ -98,7 +100,7 @@ def test_fused_data_parallel_matches_single_device():
     for fs, fd in zip(single.forwards, dp.forwards):
         numpy.testing.assert_allclose(
             numpy.asarray(fs.weights.data), numpy.asarray(fd.weights.data),
-            atol=2e-2)
+            atol=1e-3)
 
 
 def test_pipelined_data_parallel_matches_single_device():
@@ -117,7 +119,7 @@ def test_pipelined_data_parallel_matches_single_device():
     for fs, fd in zip(single.forwards, dp.forwards):
         numpy.testing.assert_allclose(
             numpy.asarray(fs.weights.data), numpy.asarray(fd.weights.data),
-            atol=2e-2)
+            atol=1e-3)
 
 
 def test_fused_convnet_matches_graph_mode():
@@ -156,7 +158,7 @@ def test_fused_convnet_matches_graph_mode():
             continue
         numpy.testing.assert_allclose(
             numpy.asarray(fg.weights.data), numpy.asarray(ff.weights.data),
-            atol=2e-2)
+            atol=2e-3)
 
 
 def test_fused_annealing_applies():
@@ -235,9 +237,9 @@ def test_fused_transformer_matches_graph_mode():
     fused = _train(build(True))
     assert fused.fused_tick is not None
     # metrics must agree EXACTLY; weights follow the fp-reassociation
-    # contract of the dense identity test (per-tick ~1e-3 through the
-    # attention stack's softmax/rsqrt; momentum is off here so the drift
-    # does not compound)
+    # contract of the dense identity test (bf16 softmax/rsqrt
+    # reassociation; momentum is off here so the drift does not
+    # compound)
     assert fused.decision.best_n_err[VALID] == graph.decision.best_n_err[
         VALID]
     for fg, ff in zip(graph.forwards, fused.forwards):
@@ -247,7 +249,51 @@ def test_fused_transformer_matches_graph_mode():
                 continue
             numpy.testing.assert_allclose(
                 numpy.asarray(ag.data), numpy.asarray(af.data),
-                atol=1e-2)
+                atol=2e-3)
+
+
+def test_fused_transformer_block_matches_graph_mode():
+    """The COMPLETE pre-LN transformer block — layer_norm → residual
+    self_attention → layer_norm → residual ffn → softmax head — fuses
+    and matches graph mode (metrics exactly, weights to fp tolerance)."""
+    rng = numpy.random.RandomState(1)
+    n, t, e = 300, 8, 16
+    X = rng.randn(n, t, e).astype(numpy.float32) * 0.1
+    y = rng.randint(0, 2, n).astype(numpy.int32)
+    for i in range(n):
+        X[i, : t // 2 if y[i] == 0 else t, 0] += 1.0
+    layers = [
+        {"type": "layer_norm"},
+        {"type": "self_attention", "heads": 4, "residual": True},
+        {"type": "layer_norm"},
+        {"type": "ffn", "ratio": 2},
+        {"type": "softmax", "output_sample_shape": (2,)},
+    ]
+
+    def build(fused):
+        prng.get("default").seed(21)
+        prng.get("loader").seed(22)
+        return StandardWorkflow(
+            DummyLauncher(), layers=layers,
+            loader_kwargs=dict(data=X, labels=y,
+                               class_lengths=[0, 50, 250],
+                               minibatch_size=50),
+            learning_rate=0.05, weights_decay=1e-4, fused=fused,
+            decision_kwargs=dict(max_epochs=1), name="fused-block")
+
+    graph = _train(build(False))
+    fused = _train(build(True))
+    assert fused.fused_tick is not None
+    assert fused.decision.best_n_err[VALID] == graph.decision.best_n_err[
+        VALID]
+    for fg, ff in zip(graph.forwards, fused.forwards):
+        for attr in ("weights", "bias", "out_weights", "out_bias"):
+            ag, af = getattr(fg, attr, None), getattr(ff, attr, None)
+            if ag is None or ag.data is None:
+                continue
+            numpy.testing.assert_allclose(
+                numpy.asarray(ag.data), numpy.asarray(af.data),
+                atol=2e-3)
 
 
 def test_pipelined_is_the_default_product_path():

@@ -136,8 +136,10 @@ def test_native_convnet(native_lib, tmp_path):
 
 
 def test_native_transformer(native_lib, tmp_path):
-    """layer_norm + self_attention + softmax head export path: the C++
-    runtime's transformer tier must match the JAX units' forward."""
+    """The complete pre-LN transformer block — layer_norm → residual
+    self_attention → layer_norm → residual ffn → softmax head — through
+    export: the C++ runtime's transformer tier must match the JAX
+    units' forward."""
     rng = numpy.random.RandomState(0)
     n, t, e = 400, 6, 16
     X = rng.randn(n, t, e).astype(numpy.float32) * 0.2
@@ -146,7 +148,9 @@ def test_native_transformer(native_lib, tmp_path):
         DummyLauncher(),
         layers=[
             {"type": "layer_norm"},
-            {"type": "self_attention", "heads": 4},
+            {"type": "self_attention", "heads": 4, "residual": True},
+            {"type": "layer_norm"},
+            {"type": "ffn", "ratio": 2},
             {"type": "softmax", "output_sample_shape": (2,)},
         ],
         loader_kwargs=dict(data=X, labels=y, class_lengths=[0, 100, 300],
@@ -158,7 +162,7 @@ def test_native_transformer(native_lib, tmp_path):
     package = str(tmp_path / "attn.tar")
     package_export(wf, package)
     rt = NativeWorkflow(package)
-    assert rt.unit_count == 3
+    assert rt.unit_count == 5
 
     batch = X[:8]
     native_out = rt.run(batch)

@@ -39,7 +39,7 @@ def _npy_bytes(array, dtype=numpy.float32):
 def _unit_spec(unit, arrays):
     """Describe one forward unit; register its arrays."""
     from veles_tpu.nn.all2all import All2All
-    from veles_tpu.nn.attention import LayerNorm, SelfAttention
+    from veles_tpu.nn.attention import LayerNorm, SelfAttention, TokenFFN
     from veles_tpu.nn.conv import Conv
     from veles_tpu.nn.pooling import AvgPooling, MaxPooling, Pooling
 
@@ -83,9 +83,19 @@ def _unit_spec(unit, arrays):
                           "stride_x": unit.sliding[1]}
     elif isinstance(unit, SelfAttention):
         spec["type"] = "self_attention"
-        # causal as 0/1: the runtime's mini JSON reader is numeric
+        # causal/residual as 0/1: the runtime's mini JSON reader is numeric
         spec["config"] = {"heads": unit.heads,
-                          "causal": int(unit.causal)}
+                          "causal": int(unit.causal),
+                          "residual": int(getattr(unit, "residual",
+                                                  False))}
+        ref("weights", unit.weights)
+        ref("bias", unit.bias)
+        ref("out_weights", unit.out_weights)
+        ref("out_bias", unit.out_bias)
+    elif isinstance(unit, TokenFFN):
+        spec["type"] = "ffn"
+        spec["config"] = {"activation": unit.activation,
+                          "residual": int(unit.residual)}
         ref("weights", unit.weights)
         ref("bias", unit.bias)
         ref("out_weights", unit.out_weights)

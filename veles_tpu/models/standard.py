@@ -29,12 +29,14 @@ from veles_tpu.nn.gd import (
     GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh, GradientDescent,
     link_err_output)
 from veles_tpu.nn.attention import (
-    GDLayerNorm, GDSelfAttention, LayerNorm, SelfAttention)
+    GDLayerNorm, GDSelfAttention, GDTokenFFN, LayerNorm, SelfAttention,
+    TokenFFN)
 from veles_tpu.nn.pooling import (
     AvgPooling, GDPooling, MaxAbsPooling, MaxPooling)
 
 FORWARD_TYPES = {
     "self_attention": (SelfAttention, GDSelfAttention),
+    "ffn": (TokenFFN, GDTokenFFN),
     "layer_norm": (LayerNorm, GDLayerNorm),
     "all2all": (All2All, GradientDescent),
     "all2all_tanh": (All2AllTanh, GDTanh),
@@ -104,7 +106,16 @@ class StandardWorkflow(Workflow):
         self._build_gds()
         self.repeater.link_from(self.gds[0])
         self.end_point.link_from(self.decision)
+        # the completing tick's backward chain still runs — its minibatch
+        # is real train data, and the fused engine, sweep tier, and fleet
+        # slave path all apply that update.  The EndPoint's AND-gate
+        # therefore waits for BOTH the decision and the gd chain, so the
+        # final update lands before on_workflow_finished; the LOADER (not
+        # the gds) is stop-gated for the tick after.  Kohonen uses the
+        # same pattern (models/kohonen.py).
+        self.end_point.link_from(self.gds[0])
         self.end_point.gate_block = ~self.decision.complete
+        self.loader.gate_block = self.decision.complete
         # fleet: the loader's job stream dries up when the decision says so
         # (same Bool object, so the master's NoMoreJobs check follows it)
         self.loader.complete = self.decision.complete
@@ -220,6 +231,9 @@ class StandardWorkflow(Workflow):
         self.decision.unlink_from(self.evaluator)
         self.gds[-1].unlink_from(self.decision)
         self.repeater.unlink_from(self.gds[0])
+        # the detached chain can't fire the EndPoint's AND-gate; the
+        # decision link alone finishes the fused run
+        self.end_point.unlink_from(self.gds[0])
         # splice the fused tick in
         self.fused_tick.link_from(self.loader)
         self.decision.link_from(self.fused_tick)
@@ -340,8 +354,6 @@ class StandardWorkflow(Workflow):
     def _disable_fused(self):
         """Reverse the FusedTick splice (e.g. the loader's HBM-OOM host
         fallback made in-tick gather counterproductive)."""
-        from veles_tpu.core.mutable import Bool
-
         tick = self.fused_tick
         if tick is None:
             return
@@ -354,7 +366,8 @@ class StandardWorkflow(Workflow):
         self.decision.link_from(self.evaluator)
         self.gds[-1].link_from(self.decision)
         self.repeater.link_from(self.gds[0])
-        self.loader.gate_block = Bool(False)
+        self.end_point.link_from(self.gds[0])
+        self.loader.gate_block = self.decision.complete
         self.loader.fill_data = True
         self.loader.sweep_serving = False
 
@@ -409,7 +422,8 @@ class StandardWorkflow(Workflow):
             if gd_cls is GDPooling:
                 gd = GDPooling(self, name="gd%d" % i)
                 gd.link_pooling(self.forwards[i], err_src)
-            elif gd_cls is GDSelfAttention:
+            elif issubclass(gd_cls, GDSelfAttention):
+                # covers GDTokenFFN too (same four-leaf slot contract)
                 gd = gd_cls(self, name="gd%d" % i, **trainer)
                 gd.link_attention(self.forwards[i], err_src)
             elif issubclass(gd_cls, GDConv):
@@ -420,7 +434,6 @@ class StandardWorkflow(Workflow):
                 gd.link_forward(self.forwards[i], err_src)
             gd.link_from(prev)
             gd.gate_skip = self.decision.gd_skipped
-            gd.gate_block = self.decision.complete
             self.gds[i] = gd
             err_src = gd
             prev = gd

@@ -19,7 +19,7 @@ from veles_tpu.core.prng import get as get_rng
 from veles_tpu.memory import Array
 from veles_tpu.nn.jit_unit import ForwardUnit
 from veles_tpu.nn.gd import GradientDescent
-from veles_tpu.ops.attention import attention_block
+from veles_tpu.ops.attention import attention_block, ffn_block
 
 
 class SelfAttention(ForwardUnit):
@@ -27,17 +27,21 @@ class SelfAttention(ForwardUnit):
 
     Input/output: (B, T, E). Weights: qkv (E, 3·E) fused projection and
     out (E, E), biases each. One jitted compute; the attention core is the
-    flash kernel on TPU.
+    flash kernel on TPU. ``residual=True`` adds the block input to the
+    output (the standard pre-LN transformer wiring: pair with a LayerNorm
+    in front and a residual :class:`TokenFFN` behind).
     """
 
     INPUTS = ("input", "weights", "bias", "out_weights", "out_bias")
     OUTPUTS = ("output",)
 
-    def __init__(self, workflow, heads=8, causal=False, **kwargs):
+    def __init__(self, workflow, heads=8, causal=False, residual=False,
+                 **kwargs):
         self.prng_key = kwargs.pop("prng_key", "default")
         super().__init__(workflow, **kwargs)
         self.heads = heads
         self.causal = causal
+        self.residual = residual
         self.weights = Array()
         self.bias = Array()
         self.out_weights = Array()
@@ -66,9 +70,9 @@ class SelfAttention(ForwardUnit):
 
     def _forward(self, x, w_qkv, b_qkv, w_out, b_out):
         # shared implementation with the fused engine: the whole block
-        # under the engine precision policy (ops/attention.py)
+        # (residual included) under the engine precision policy
         return attention_block(x, w_qkv, b_qkv, w_out, b_out,
-                               self.heads, self.causal)
+                               self.heads, self.causal, self.residual)
 
     def compute(self, x, w_qkv, b_qkv, w_out, b_out):
         return self._forward(x, w_qkv, b_qkv, w_out, b_out)
@@ -130,6 +134,72 @@ class GDSelfAttention(GradientDescent):
         return (err_input, w_qkv, b_qkv, w_out, b_out,
                 vel_w, vel_b, vel_ow, vel_ob) \
             + extras((sec_w, sec_b, sec_ow, sec_ob))
+
+
+class TokenFFN(ForwardUnit):
+    """Position-wise transformer feed-forward block:
+    ``act(x @ w1 + b1) @ w2 + b2`` (+ residual, default on) applied to
+    every token independently.
+
+    Input/output: (B, T, E). Weights: expansion (E, ratio·E) and
+    contraction (ratio·E, E) projections — stored in the same slot names
+    as SelfAttention (``weights``/``out_weights``) so the GD/fleet/fused
+    leaf contracts are shared. With LayerNorm and a residual
+    SelfAttention this completes the standard transformer block as a
+    unit-graph topology.
+    """
+
+    INPUTS = ("input", "weights", "bias", "out_weights", "out_bias")
+    OUTPUTS = ("output",)
+
+    def __init__(self, workflow, ratio=4, activation="gelu",
+                 residual=True, **kwargs):
+        self.prng_key = kwargs.pop("prng_key", "default")
+        super().__init__(workflow, **kwargs)
+        self.ratio = ratio
+        self.activation = activation
+        self.residual = residual
+        self.weights = Array()
+        self.bias = Array()
+        self.out_weights = Array()
+        self.out_bias = Array()
+        self.input = None
+
+    def initialize(self, **kwargs):
+        if self.input is None or (isinstance(self.input, Array)
+                                  and self.input.data is None):
+            return True
+        embed = self.input.shape[-1]
+        hidden = int(self.ratio * embed)
+        if self.weights.data is None:
+            rng = get_rng(self.prng_key)
+            self.weights.data = jnp.asarray(
+                rng.fill_uniform((embed, hidden), 1.0 / math.sqrt(embed)),
+                jnp.float32)
+            self.bias.data = jnp.zeros((hidden,), jnp.float32)
+            self.out_weights.data = jnp.asarray(
+                rng.fill_uniform((hidden, embed),
+                                 1.0 / math.sqrt(hidden)), jnp.float32)
+            self.out_bias.data = jnp.zeros((embed,), jnp.float32)
+        if self.output.data is None:
+            self.output.data = jnp.zeros(self.input.shape, jnp.float32)
+
+    def _forward(self, x, w1, b1, w2, b2):
+        # shared implementation with the fused engine (ops/attention.py)
+        return ffn_block(x, w1, b1, w2, b2, self.activation,
+                         self.residual)
+
+    def compute(self, x, w1, b1, w2, b2):
+        return self._forward(x, w1, b1, w2, b2)
+
+
+class GDTokenFFN(GDSelfAttention):
+    """Backward for TokenFFN — the four-leaf vjp update of
+    GDSelfAttention verbatim (the slot contract is identical:
+    ``weights``/``bias`` are the expansion projection,
+    ``out_weights``/``out_bias`` the contraction)."""
+
+    link_ffn = GDSelfAttention.link_attention
 
 
 class GDLayerNorm(GradientDescent):

@@ -53,16 +53,16 @@ def _use_pallas_flash(q, k):
 
 
 def attention_block(x, w_qkv, b_qkv, w_out, b_out, heads, causal,
-                    precision_level=None):
+                    residual=False, precision_level=None):
     """The complete self-attention block — fused qkv projection →
-    multi-head attention → out projection — under the SAME engine
-    precision policy as the dense/conv paths (``ops/gemm.py
+    multi-head attention → out projection (→ residual add) — under the
+    SAME engine precision policy as the dense/conv paths (``ops/gemm.py
     compute_operands``): level 0 runs the projections and the attention
     core in bf16 with f32 matmul accumulation (~15% faster forward than
     f32 operands, measured), levels 1/2 keep f32 with HIGH/HIGHEST.
-    ONE implementation serves the graph unit (``nn/attention.py``), its
-    vjp backward, and the fused engine — the modes stay bit-identical
-    by construction."""
+    ONE implementation — the residual included, like ``ffn_block`` —
+    serves the graph unit (``nn/attention.py``), its vjp backward, and
+    the fused engine — the modes stay bit-identical by construction."""
     from veles_tpu.ops.gemm import compute_operands
 
     batch, t, embed = x.shape
@@ -85,8 +85,47 @@ def attention_block(x, w_qkv, b_qkv, w_out, b_out, heads, causal,
     out = lax.dot_general(
         out.reshape(batch, t, embed).astype(xc.dtype), wout,
         (((2,), (0,)), ((), ())), precision=precision,
-        preferred_element_type=jnp.float32)
-    return out + b_out
+        preferred_element_type=jnp.float32) + b_out
+    return x + out if residual else out
+
+
+#: activations usable inside the FFN block. gelu is jax.nn's default
+#: tanh approximation — the native runtime (native/src/units.cc FfnUnit)
+#: implements the same polynomial so exported packages stay in tolerance.
+_FFN_ACTIVATIONS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "linear": lambda h: h,
+}
+
+
+def ffn_block(x, w1, b1, w2, b2, activation="gelu", residual=True,
+              precision_level=None):
+    """Position-wise transformer feed-forward block —
+    ``act(x @ w1 + b1) @ w2 + b2`` with an optional residual add — under
+    the SAME engine precision policy as the attention/dense/conv paths
+    (``ops/gemm.py compute_operands``): level 0 runs both projections in
+    bf16 with f32 matmul accumulation; the bias adds, activation and
+    residual stay f32. ONE implementation serves the graph unit
+    (``nn/attention.TokenFFN``), its vjp backward, and the fused engine —
+    the modes stay bit-identical by construction.
+
+    No reference counterpart (VELES predates transformers); this extends
+    the sequence-model tier the same way SelfAttention does."""
+    from veles_tpu.ops.gemm import compute_operands
+
+    act = _FFN_ACTIVATIONS[activation]
+    (xc, w1c, w2c), precision = compute_operands(
+        x, w1, w2, precision_level=precision_level)
+    h = lax.dot_general(
+        xc, w1c, (((x.ndim - 1,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32) + b1
+    out = lax.dot_general(
+        act(h).astype(xc.dtype), w2c,
+        (((h.ndim - 1,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32) + b2
+    return x + out if residual else out
 
 
 def _precise_attention(q, k, v, causal, precision):

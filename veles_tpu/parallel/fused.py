@@ -43,6 +43,7 @@ from veles_tpu.loader.normalization import normalizer_registry
 _DENSE = "dense"
 _CONV = "conv"
 _ATTN = "attention"
+_FFN = "ffn"
 _NORM = "layer_norm"
 _POOL_KINDS = {"MaxPooling": "max", "AvgPooling": "avg",
                "MaxAbsPooling": "maxabs"}
@@ -65,15 +66,16 @@ def extract_model_spec(workflow):
     caller then stays on graph mode)."""
     from veles_tpu.nn.all2all import All2All, All2AllSoftmax
     from veles_tpu.nn.attention import (GDLayerNorm, GDSelfAttention,
-                                        LayerNorm, SelfAttention)
+                                        GDTokenFFN, LayerNorm,
+                                        SelfAttention, TokenFFN)
     from veles_tpu.nn.conv import Conv, GDConv
     from veles_tpu.nn.gd import GradientDescent
     from veles_tpu.nn.pooling import GDPooling, Pooling
 
     known_computes = {getattr(cls, "compute", None) for cls in (
-        All2All, All2AllSoftmax, Conv, SelfAttention, LayerNorm, Pooling,
-        GradientDescent, GDConv, GDSelfAttention, GDLayerNorm,
-        GDPooling)}
+        All2All, All2AllSoftmax, Conv, SelfAttention, TokenFFN,
+        LayerNorm, Pooling, GradientDescent, GDConv, GDSelfAttention,
+        GDTokenFFN, GDLayerNorm, GDPooling)}
 
     def modified(unit):
         """A subclass that overrides compute() carries custom math the
@@ -99,7 +101,12 @@ def extract_model_spec(workflow):
                     "leaves": _WB_LEAVES}
         elif isinstance(fwd, SelfAttention):
             spec = {"kind": _ATTN, "heads": fwd.heads,
-                    "causal": fwd.causal, "leaves": _ATTN_LEAVES}
+                    "causal": fwd.causal,
+                    "residual": getattr(fwd, "residual", False),
+                    "leaves": _ATTN_LEAVES}
+        elif isinstance(fwd, TokenFFN):
+            spec = {"kind": _FFN, "activation": fwd.activation,
+                    "residual": fwd.residual, "leaves": _ATTN_LEAVES}
         elif isinstance(fwd, LayerNorm):
             spec = {"kind": _NORM, "eps": fwd.eps, "leaves": _WB_LEAVES}
         elif isinstance(fwd, Pooling):
@@ -211,12 +218,23 @@ def _layer_forward(spec):
     if kind == _ATTN:
         from veles_tpu.ops.attention import attention_block
         heads, causal = spec["heads"], spec["causal"]
+        residual = spec.get("residual", False)
 
         def fwd(p, x):
             # THE SAME implementation the graph unit runs
             # (nn.attention.SelfAttention._forward delegates there too)
             return attention_block(x, p["w"], p["b"], p["ow"], p["ob"],
-                                   heads, causal)
+                                   heads, causal, residual)
+        return fwd
+    if kind == _FFN:
+        from veles_tpu.ops.attention import ffn_block
+        activation = spec["activation"]
+        residual = spec.get("residual", True)
+
+        def fwd(p, x):
+            # mirrors nn.attention.TokenFFN._forward exactly
+            return ffn_block(x, p["w"], p["b"], p["ow"], p["ob"],
+                             activation, residual)
         return fwd
     if kind == _NORM:
         eps = spec["eps"]

@@ -42,12 +42,11 @@ or write device Array slots, so they must declare it:
 A non-transparent host unit makes the workflow fall back to the
 per-tick segment tier — correctness beats speed.
 
-Weight semantics match the FUSED engine, not graph mode, on one final
-tick: the stopping epoch's last TRAIN minibatch still applies its
-update before the Decision raises ``complete`` (graph mode's
-``gate_block = decision.complete`` suppresses that very last update).
-Metrics are bit-identical to graph mode throughout — every metric sweep
-precedes the updates that could diverge.
+Weight semantics match every other tier: the stopping epoch's last
+TRAIN minibatch applies its update before the run finishes (graph mode
+wires the EndPoint's AND-gate behind the gd chain for the same effect —
+see StandardWorkflow.__init__). Metrics are bit-identical to graph mode
+throughout — every metric sweep precedes the updates.
 """
 
 import numpy
@@ -100,8 +99,16 @@ def classify(workflow):
     for unit in chain:
         if unit is decision:
             continue
+        # the EndPoint hangs off the LAST chain unit (its AND-gate holds
+        # the final update before finish — StandardWorkflow wiring); the
+        # sweep splice subsumes that by stopping the serving loop, and
+        # disable() restores exactly this link. An end_point link from
+        # any OTHER unit is custom finish wiring the splice could not
+        # restore — those chains stay on the segment tier.
+        permitted = allowed | ({workflow.end_point}
+                               if unit is chain[-1] else set())
         outside = [u for u in list(unit.links_from) + list(unit.links_to)
-                   if u not in allowed]
+                   if u not in permitted]
         if outside:
             # a monitor/provider hangs off a cycle unit: per-sweep
             # execution would change when it fires — segment tier keeps
@@ -270,10 +277,9 @@ class FusedSweep(Unit):
 
     def disable(self):
         """Undo the splice: relink the original linear cycle (classify
-        guaranteed the chain had no outside links, so a sequential
-        relink is a complete restoration)."""
-        from veles_tpu.core.mutable import Bool
-
+        guaranteed the chain had no outside links beyond the EndPoint
+        gate, so a sequential relink + the finish gate is a complete
+        restoration)."""
         wf = self.workflow
         loader = wf.loader
         self.unlink_all()
@@ -283,7 +289,10 @@ class FusedSweep(Unit):
             unit.link_from(prev)
             prev = unit
         wf.repeater.link_from(prev)
-        loader.gate_block = Bool(False)
+        # restore the graph wiring's finish gate: the EndPoint waits for
+        # the last chain unit so the completing tick's update lands
+        wf.end_point.link_from(prev)
+        loader.gate_block = wf.decision.complete
         loader.fill_data = True
         loader.sweep_serving = False
         if getattr(wf, "sweep_unit", None) is self:
