@@ -369,3 +369,92 @@ class TestManhole:
             client.close()
         finally:
             manhole.stop()
+
+
+class TestPluginScan:
+    """veles_tpu.scan_plugins(): the reference's ``veles.__plugins__``
+    namespace scan (``__init__.py:191-215``) in its TPU-era form —
+    installed ``veles_tpu_*`` modules are imported and their units
+    register through the same metaclass registry as in-tree units."""
+
+    def test_scans_and_registers(self, tmp_path, monkeypatch):
+        import sys
+        import veles_tpu
+        from veles_tpu.core.registry import UnitRegistry
+
+        plugin = tmp_path / "veles_tpu_demo_plugin.py"
+        plugin.write_text(
+            "from veles_tpu.core.units import TrivialUnit\n"
+            "class DemoPluginUnit(TrivialUnit):\n"
+            "    pass\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        monkeypatch.setattr(veles_tpu, "__plugins__", None)
+        plugins = veles_tpu.scan_plugins()
+        names = [p.__name__ for p in plugins]
+        assert "veles_tpu_demo_plugin" in names
+        assert any(cls.__name__ == "DemoPluginUnit"
+                   for cls in UnitRegistry.units)
+        # cached: a second call returns the same list without rescanning
+        assert veles_tpu.scan_plugins() is plugins
+        sys.modules.pop("veles_tpu_demo_plugin", None)
+        monkeypatch.setattr(veles_tpu, "__plugins__", None)
+
+
+class TestYarnDiscovery:
+    """yarn:// node specs resolve through the ResourceManager REST API
+    (reference YARN discovery, launcher.py:887-906)."""
+
+    def _serve(self, payload, status=200):
+        import http.server
+        import threading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                assert self.path.startswith("/ws/v1/cluster/nodes")
+                body = payload.encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server
+
+    def test_discovers_running_nodes(self):
+        import json as jsonlib
+
+        from veles_tpu.launcher import discover_yarn_nodes
+
+        payload = jsonlib.dumps({"nodes": {"node": [
+            {"nodeHostName": "worker-1", "state": "RUNNING"},
+            {"nodeHostName": "worker-2", "state": "RUNNING"},
+            {"rack": "/default", "state": "RUNNING"},  # no hostname
+        ]}})
+        server = self._serve(payload)
+        try:
+            hosts = discover_yarn_nodes(
+                "127.0.0.1:%d" % server.server_address[1])
+            assert hosts == ["worker-1", "worker-2"]
+        finally:
+            server.shutdown()
+
+    def test_expand_mixes_plain_and_yarn_and_survives_failure(self):
+        import json as jsonlib
+
+        from veles_tpu.launcher import Launcher
+
+        launcher = Launcher()
+        payload = jsonlib.dumps({"nodes": {"node": [
+            {"nodeHostName": "w1"}]}})
+        server = self._serve(payload)
+        try:
+            specs = ["hostA",
+                     "yarn://127.0.0.1:%d" % server.server_address[1],
+                     "yarn://127.0.0.1:1"]  # refused: must skip, not die
+            assert launcher._expand_node_specs(specs) == ["hostA", "w1"]
+        finally:
+            server.shutdown()

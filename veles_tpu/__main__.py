@@ -339,6 +339,17 @@ class Main(Logger):
         self.visualize = args.visualize
         self.dump_unit_attributes = args.dump_unit_attributes
         self.profile_dir = args.profile
+        # plugins BEFORE the workflow module: a ``veles_tpu_*`` package /
+        # ``veles_tpu.plugins`` entry point registers its units through
+        # the registry metaclasses, making them constructible by name in
+        # the workflow being loaded (reference ``veles.__plugins__``
+        # namespace scan, ``__init__.py:191-215``)
+        import veles_tpu
+        plugins = veles_tpu.scan_plugins()
+        if plugins:
+            self.info("plugins: %s",
+                      ", ".join(getattr(p, "__name__", repr(p))
+                                for p in plugins))
         # module FIRST (its import-time root.* updates are defaults), then
         # the config file, then CLI overrides — the reference's layering
         # (__main__.py:396,426-481)

@@ -16,6 +16,20 @@ from veles_tpu.core.executor import ThreadPool
 from veles_tpu.core.logger import Logger
 
 
+def discover_yarn_nodes(rm_address, timeout=10.0):
+    """Resolve a Hadoop/YARN ResourceManager address to the cluster's
+    RUNNING node hostnames via its REST API (reference YARN discovery,
+    ``launcher.py:887-906`` — the reference asked the RM so ``-n`` could
+    target a whole Hadoop cluster without listing hosts by hand)."""
+    from urllib.request import urlopen
+
+    url = "http://%s/ws/v1/cluster/nodes?states=RUNNING" % rm_address
+    with urlopen(url, timeout=timeout) as resp:
+        payload = json.load(resp)
+    nodes = (payload.get("nodes") or {}).get("node") or []
+    return [n["nodeHostName"] for n in nodes if n.get("nodeHostName")]
+
+
 class Launcher(Logger):
     """Workflow process driver (reference ``launcher.py:100``)."""
 
@@ -195,9 +209,29 @@ class Launcher(Logger):
         # source the way config/checksum ones do — forward them
         # (getattr: test fakes implement only the Server surface they use)
         env.update(getattr(self.agent, "secret_spawn_env", dict)())
-        for host in self.nodes:
+        for host in self._expand_node_specs(self.nodes):
             self.info("launching slave on %s", host)
             default_spawner(host, command, cwd=recipe["cwd"], env=env)
+
+    def _expand_node_specs(self, specs):
+        """``yarn://rm-host:port`` entries expand to the cluster's
+        RUNNING nodes via the ResourceManager REST API; plain hosts pass
+        through. A failed discovery logs and skips the spec rather than
+        killing the master — the fleet is elastic, hosts can be added
+        later."""
+        hosts = []
+        for spec in specs:
+            if spec.startswith("yarn://"):
+                try:
+                    found = discover_yarn_nodes(spec[len("yarn://"):])
+                    self.info("yarn discovery %s: %d node(s)", spec,
+                              len(found))
+                    hosts.extend(found)
+                except Exception as e:
+                    self.warning("yarn discovery %s failed: %s", spec, e)
+            else:
+                hosts.append(spec)
+        return hosts
 
     def run(self):
         """Blocks until the workflow completes (reference ran the reactor

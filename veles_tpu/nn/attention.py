@@ -154,8 +154,16 @@ class TokenFFN(ForwardUnit):
 
     def __init__(self, workflow, ratio=4, activation="gelu",
                  residual=True, **kwargs):
+        from veles_tpu.ops.attention import _FFN_ACTIVATIONS
         self.prng_key = kwargs.pop("prng_key", "default")
         super().__init__(workflow, **kwargs)
+        if activation not in _FFN_ACTIVATIONS:
+            # fail at construction with the valid names, not with a bare
+            # KeyError inside jit tracing on the first tick
+            raise ValueError(
+                "%s: unknown ffn activation %r (one of %s)"
+                % (self.name, activation,
+                   "/".join(sorted(_FFN_ACTIVATIONS))))
         self.ratio = ratio
         self.activation = activation
         self.residual = residual

@@ -289,10 +289,18 @@ class FusedSweep(Unit):
             unit.link_from(prev)
             prev = unit
         wf.repeater.link_from(prev)
-        # restore the graph wiring's finish gate: the EndPoint waits for
-        # the last chain unit so the completing tick's update lands
-        wf.end_point.link_from(prev)
-        loader.gate_block = wf.decision.complete
+        # restore the finish gate EXACTLY as it was at enable() time: a
+        # StandardWorkflow chain had the EndPoint AND-gated on the last
+        # gd (the completing tick's update lands before finish); a
+        # custom chain gated on the decision alone must NOT gain a
+        # second AND input it never fires
+        if getattr(self, "restore_finish_link", True):
+            wf.end_point.link_from(prev)
+        saved_gate = getattr(self, "saved_loader_gate", None)
+        # `is not None`, not truthiness: a saved Bool(False) is falsy
+        # but is exactly what must come back
+        loader.gate_block = (saved_gate if saved_gate is not None
+                             else wf.decision.complete)
         loader.fill_data = True
         loader.sweep_serving = False
         if getattr(wf, "sweep_unit", None) is self:
@@ -649,6 +657,13 @@ def enable(workflow, pipelined=False):
     chain = chain_of(workflow)
     sweep = FusedSweep(workflow, members, hosts, chain,
                        pipelined=pipelined)
+    # record what disable() must put back EXACTLY: whether the last
+    # chain unit held the EndPoint finish gate (StandardWorkflow wiring;
+    # a custom chain may gate the EndPoint on the decision alone), and
+    # the loader's original stop gate
+    sweep.restore_finish_link = (
+        workflow.end_point in chain[-1].links_to)
+    sweep.saved_loader_gate = loader.gate_block
     # detaching every non-Decision chain unit also clears its links INTO
     # the repeater and the Decision (unlink_all is bidirectional); the
     # repeater keeps its start_point provider, the Decision keeps its
