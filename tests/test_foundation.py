@@ -203,3 +203,98 @@ class TestPickleable:
         h2 = pickle.loads(pickle.dumps(h))
         assert isinstance(h2.weights, numpy.ndarray)
         assert numpy.array_equal(h2.weights, numpy.ones((3, 3)))
+
+
+class TestMongoDuplication:
+    """MongoLogHandler / duplicate_all_logging_to_mongo (reference
+    logger.py:210,292) against an injected fake client — pymongo is not
+    a hard dependency."""
+
+    @staticmethod
+    def _fake_client():
+        class Coll:
+            def __init__(self, database):
+                self.database = database
+                self.docs = []
+
+            def insert_one(self, doc):
+                self.docs.append(doc)
+
+        class DB:
+            def __init__(self):
+                self._colls = {}
+
+            def __getitem__(self, name):
+                return self._colls.setdefault(name, Coll(self))
+
+        class Client:
+            def __init__(self):
+                self._dbs = {}
+                self.addr = None
+
+            def __getitem__(self, name):
+                return self._dbs.setdefault(name, DB())
+
+        return Client()
+
+    def test_logs_and_events_duplicate(self):
+        import logging
+
+        from veles_tpu.core.logger import (
+            Logger, duplicate_all_logging_to_mongo, get_event_recorder)
+
+        client = self._fake_client()
+        handler = duplicate_all_logging_to_mongo(
+            "ignored:1", docid="sess", client_factory=lambda a: client)
+        try:
+            log = Logger(logger_name="mongo-test")
+            # warning: above the root logger's default level, so the
+            # record reaches root handlers without setup_logging()
+            log.warning("hello %d", 42)
+            logs = client["veles"]["logs"].docs
+            assert any(d["message"] == "hello 42" and d["session"] == "sess"
+                       for d in logs)
+            log.event("epoch", "begin", number=3)
+            events = client["veles"]["events"].docs
+            assert any(e["name"] == "epoch" and e["etype"] == "begin"
+                       and e["number"] == 3 for e in events)
+        finally:
+            logging.getLogger().removeHandler(handler)
+            get_event_recorder()._sinks.clear()
+
+    def test_failing_sink_is_kept_and_reported_once(self):
+        from veles_tpu.core.logger import Logger, get_event_recorder
+
+        rec = get_event_recorder()
+        calls = []
+
+        def flaky(attrs):
+            calls.append(attrs)
+            if len(calls) < 3:
+                raise RuntimeError("sink boom")
+
+        rec.add_sink(flaky)
+        try:
+            log = Logger(logger_name="sink-test")
+            log.event("x", "single")   # raises: swallowed, logged once
+            log.event("y", "single")   # raises: swallowed silently
+            log.event("z", "single")   # recovers: delivered
+            assert len(calls) == 3     # transient outage did NOT drop it
+            assert rec._sinks == [flaky]
+        finally:
+            rec._sinks.clear()
+            rec._sink_warned.clear()
+
+    def test_missing_pymongo_reports_clearly(self, monkeypatch):
+        import sys
+
+        import pytest
+
+        from veles_tpu.core.logger import MongoLogHandler
+
+        # force the ImportError path even where pymongo IS installed
+        # (MongoClient connects lazily, so a bad address raises nothing)
+        monkeypatch.setitem(sys.modules, "pymongo", None)
+        with pytest.raises(RuntimeError) as err:
+            MongoLogHandler("127.0.0.1:1")
+        assert "pymongo" in str(err.value)

@@ -124,6 +124,73 @@ def duplicate_all_logging_to_file(path, level=logging.DEBUG):
     return handler
 
 
+class MongoLogHandler(logging.Handler):
+    """Duplicate every log record into a MongoDB collection (reference
+    ``MongoLogHandler``, ``logger.py:292`` — the web dashboard's
+    ``logs.html`` read these). pymongo is NOT a hard dependency: the
+    default ``client_factory`` imports it lazily and raises a clear
+    error if absent; tests and alternative drivers inject their own
+    factory returning any object with
+    ``client[db][collection].insert_one(doc)``."""
+
+    def __init__(self, addr="127.0.0.1:27017", docid=None,
+                 database="veles", collection="logs",
+                 client_factory=None, level=logging.DEBUG):
+        super().__init__(level)
+        if client_factory is None:
+            def client_factory(address):
+                try:
+                    import pymongo
+                except ImportError:
+                    raise RuntimeError(
+                        "MongoDB log duplication needs pymongo installed "
+                        "(the JSONL event recorder needs nothing — see "
+                        "enable_event_recording)") from None
+                return pymongo.MongoClient("mongodb://%s" % address)
+        self.docid = docid or "%d" % os.getpid()
+        self._collection = client_factory(addr)[database][collection]
+        self._emitting = threading.local()
+
+    def emit(self, record):
+        # pymongo 4.8+ itself logs DEBUG records during insert_one
+        # (command/connection monitoring): without the re-entrancy guard
+        # and driver filter, mirroring its records would recurse forever
+        if record.name.startswith("pymongo") \
+                or getattr(self._emitting, "active", False):
+            return
+        self._emitting.active = True
+        try:
+            self._collection.insert_one({
+                "session": self.docid,
+                "time": record.created,
+                "level": record.levelname,
+                "logger": record.name,
+                "message": record.getMessage(),
+            })
+        except Exception:
+            self.handleError(record)
+        finally:
+            self._emitting.active = False
+
+
+def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None):
+    """Mirror the root logger into MongoDB (reference ``logger.py:210``)
+    and route event spans there too: the returned handler is also
+    registered as an event sink, so ``Logger.event()`` spans land in the
+    same database (collection ``events``) as they did in the reference."""
+    handler = MongoLogHandler(addr, docid=docid,
+                              client_factory=client_factory)
+    logging.getLogger().addHandler(handler)
+    events = handler._collection.database["events"]
+    # override the recorder's pid-based session with the handler's docid
+    # so veles.logs and veles.events join on the same key (the
+    # reference's dashboard correlated them per session)
+    get_event_recorder().add_sink(
+        lambda attrs: events.insert_one(
+            dict(attrs, session=handler.docid)))
+    return handler
+
+
 class EventRecorder:
     """Append-only JSONL event-span log, the TPU-era stand-in for the
     reference's MongoDB event store (``logger.py:210-289``). Spans carry a
@@ -136,7 +203,19 @@ class EventRecorder:
         self._lock = threading.Lock()
         self._fd = None
         self._buffer = []
+        self._sinks = []
+        self._sink_warned = set()
         self.enabled = path is not None
+
+    def add_sink(self, sink):
+        """Register an extra span consumer (e.g. the Mongo duplicator);
+        ``sink(attrs_dict)`` is called for every recorded span. Sink
+        exceptions are swallowed (logged once per sink) and the sink
+        KEPT — a transient outage must neither kill the run nor
+        permanently disable duplication."""
+        with self._lock:
+            self._sinks.append(sink)
+            self._sink_warned.discard(id(sink))
 
     def open(self, path):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -157,6 +236,18 @@ class EventRecorder:
                 self._fd.write(line)
             elif self.enabled:
                 self._buffer.append(line)
+        with self._lock:
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink(attrs)
+            except Exception:
+                with self._lock:
+                    warn = id(sink) not in self._sink_warned
+                    self._sink_warned.add(id(sink))
+                if warn:  # once per sink — spans can be high-frequency
+                    logging.getLogger("EventRecorder").exception(
+                        "event sink failed (kept; reported once)")
 
     def close(self):
         with self._lock:
