@@ -732,6 +732,78 @@ def alexnet_throughput(n_valid=128, n_train=1152, epochs=8):
     return n / (sum(deltas) / len(deltas)), [n / d for d in deltas], wf
 
 
+def decode_device(batch=8, prompt=512, embed=1024, heads=16, blocks=4,
+                  vocab=32768):
+    """KV-cache greedy decode throughput (the serving side of the
+    long-context tier — ``parallel/decode.py``): steady-state tokens/sec
+    at a realistic config, prefill + dispatch costs cancelled by the
+    two-length scan timing."""
+    from veles_tpu.parallel.decode import (decode_step, init_kv_cache,
+                                           prefill)
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+
+    rng = numpy.random.RandomState(0)
+    params = init_transformer_params(rng, blocks, embed, heads, vocab)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.02)
+    toks = jnp.asarray(rng.randint(0, vocab, (batch, prompt)))
+    # headroom must cover the LONGEST timing scan (272 steps below):
+    # short slots would clamp dynamic_update_slice writes and time a
+    # program decoding garbage
+    cache0 = init_kv_cache(blocks, batch, prompt + 288, heads,
+                           embed // heads)
+    logits0, cache0 = jax.jit(prefill, static_argnames="heads")(
+        params, table[toks], heads, cache0)
+
+    def scan_builder(length):
+        # params/table ride as ARGUMENTS: closing over them would bake
+        # 128+ MB of weights into the HLO as constants (the tunnel's
+        # remote-compile endpoint rejects the upload)
+        @jax.jit
+        def steps(state):
+            params, table, cache, logits = state
+
+            def body(carry, _):
+                cache, logits = carry
+                tok = jnp.argmax(logits, axis=-1)
+                x_tok = table[tok][:, None, :]
+                logits, cache = decode_step(params, x_tok, heads, cache)
+                return (cache, logits), ()
+
+            (cache, logits), _ = jax.lax.scan(body, (cache, logits),
+                                              None, length=length)
+            # scalar result: the timing loop MATERIALIZES it —
+            # block_until_ready measured a no-op for this program shape
+            # on the tunneled backend, so the honest fence is the
+            # device->host read (constant-size, cancelled by the
+            # two-length subtraction)
+            return jnp.sum(logits)
+        return steps
+
+    state = (params, table, cache0, logits0)
+    results, spreads = {}, []
+    for length in (16, 272):
+        fn = scan_builder(length)
+        float(fn(state))  # compile + warm
+        times = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            float(fn(state))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        results[length] = times[0]
+        spreads.append((times[1] - times[0]) / times[0])
+    sec = (results[272] - results[16]) / (272 - 16)
+    spread = round(max(spreads), 4)
+    return {"decode_step_ms": round(sec * 1000, 3),
+            "decode_spread": spread,
+            "decode_tokens_per_sec": round(batch / sec, 1),
+            "decode_config": "b%d_p%d_e%d_h%d_L%d_v%d"
+                             % (batch, prompt, embed, heads, blocks,
+                                vocab)}
+
+
 def _guarded(fn, *args, fallback=(None, []), **kwargs):
     """One failed section must not kill the headline line — but the
     failure has to be visible somewhere (stderr; stdout stays one JSON
@@ -766,6 +838,7 @@ def main():
             "alexnet_mfu_device")
     device_keys.update(_guarded(transformer_device, peak, fallback={}))
     device_keys.update(_guarded(longctx_device, fallback={}))
+    device_keys.update(_guarded(decode_device, fallback={}))
     device_keys.update(_guarded(pod_overhead, fallback={}))
     device_keys.update(_guarded(pallas_epilogue_compare, fallback={}))
     gflops = device_keys.get("fused_step_gflops")

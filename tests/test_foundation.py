@@ -238,14 +238,13 @@ class TestMongoDuplication:
         return Client()
 
     def test_logs_and_events_duplicate(self):
-        import logging
-
         from veles_tpu.core.logger import (
             Logger, duplicate_all_logging_to_mongo, get_event_recorder)
 
         client = self._fake_client()
         handler = duplicate_all_logging_to_mongo(
-            "ignored:1", docid="sess", client_factory=lambda a: client)
+            "ignored:1", docid="sess", client_factory=lambda a: client,
+            background=False)
         try:
             log = Logger(logger_name="mongo-test")
             # warning: above the root logger's default level, so the
@@ -257,10 +256,30 @@ class TestMongoDuplication:
             log.event("epoch", "begin", number=3)
             events = client["veles"]["events"].docs
             assert any(e["name"] == "epoch" and e["etype"] == "begin"
-                       and e["number"] == 3 for e in events)
+                       and e["number"] == 3
+                       and e["session"] == "sess" for e in events)
         finally:
-            logging.getLogger().removeHandler(handler)
-            get_event_recorder()._sinks.clear()
+            handler.close()
+        # close() detached everything: nothing more arrives
+        n_logs, n_events = len(logs), len(events)
+        Logger(logger_name="mongo-test").warning("after close")
+        Logger(logger_name="mongo-test").event("late", "single")
+        assert (len(logs), len(events)) == (n_logs, n_events)
+        assert not get_event_recorder()._sinks
+
+    def test_background_emission_flushes_on_close(self):
+        """The default QueueListener path: records emit off the caller's
+        thread and close() flushes the queue before detaching."""
+        from veles_tpu.core.logger import (
+            Logger, duplicate_all_logging_to_mongo)
+
+        client = self._fake_client()
+        handler = duplicate_all_logging_to_mongo(
+            "ignored:1", docid="bg", client_factory=lambda a: client)
+        Logger(logger_name="mongo-bg").warning("queued %d", 7)
+        handler.close()   # stops the listener, flushing the queue
+        logs = client["veles"]["logs"].docs
+        assert any(d["message"] == "queued 7" for d in logs)
 
     def test_failing_sink_is_kept_and_reported_once(self):
         from veles_tpu.core.logger import Logger, get_event_recorder

@@ -150,6 +150,14 @@ class MongoLogHandler(logging.Handler):
         self.docid = docid or "%d" % os.getpid()
         self._collection = client_factory(addr)[database][collection]
         self._emitting = threading.local()
+        self.on_close = None  # duplicate_all_logging_to_mongo's detach
+
+    def close(self):
+        detach = self.on_close
+        self.on_close = None
+        if detach is not None:
+            detach()
+        super().close()
 
     def emit(self, record):
         # pymongo 4.8+ itself logs DEBUG records during insert_one
@@ -173,21 +181,54 @@ class MongoLogHandler(logging.Handler):
             self._emitting.active = False
 
 
-def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None):
+def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
+                                   background=True):
     """Mirror the root logger into MongoDB (reference ``logger.py:210``)
-    and route event spans there too: the returned handler is also
-    registered as an event sink, so ``Logger.event()`` spans land in the
-    same database (collection ``events``) as they did in the reference."""
+    and route event spans there too (collection ``events``), correlated
+    by the same session docid as the log records.
+
+    ``background=True`` (default) emits through a
+    ``QueueHandler``/``QueueListener`` pair so the per-record network
+    round trip happens on a listener thread, never blocking the caller
+    (a slow/unreachable server would otherwise stall every log call on
+    the driver's timeout, serialized through the handler lock).
+
+    Tear down with ``handler.close()`` on the RETURNED handler: it
+    detaches the root-logger handler, stops the listener (flushing
+    queued records), and unregisters the event sink."""
     handler = MongoLogHandler(addr, docid=docid,
                               client_factory=client_factory)
-    logging.getLogger().addHandler(handler)
+    root_logger = logging.getLogger()
+    listener = queue_handler = None
+    if background:
+        import queue as queue_mod
+        from logging.handlers import QueueHandler, QueueListener
+
+        queue_handler = QueueHandler(queue_mod.SimpleQueue())
+        listener = QueueListener(queue_handler.queue, handler)
+        listener.start()
+        root_logger.addHandler(queue_handler)
+    else:
+        root_logger.addHandler(handler)
     events = handler._collection.database["events"]
+
     # override the recorder's pid-based session with the handler's docid
     # so veles.logs and veles.events join on the same key (the
     # reference's dashboard correlated them per session)
-    get_event_recorder().add_sink(
-        lambda attrs: events.insert_one(
-            dict(attrs, session=handler.docid)))
+    def sink(attrs):
+        events.insert_one(dict(attrs, session=handler.docid))
+
+    get_event_recorder().add_sink(sink)
+
+    def detach():
+        get_event_recorder().remove_sink(sink)
+        if listener is not None:
+            root_logger.removeHandler(queue_handler)
+            listener.stop()
+        else:
+            root_logger.removeHandler(handler)
+
+    handler.on_close = detach
     return handler
 
 
@@ -215,6 +256,12 @@ class EventRecorder:
         permanently disable duplication."""
         with self._lock:
             self._sinks.append(sink)
+            self._sink_warned.discard(id(sink))
+
+    def remove_sink(self, sink):
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
             self._sink_warned.discard(id(sink))
 
     def open(self, path):

@@ -1,0 +1,155 @@
+"""KV-cache autoregressive decoding for the causal-LM tier.
+
+Training-side long context is covered by ring/Ulysses sequence
+parallelism (``transformer_step.py``); this module is the SERVING side:
+generate tokens from the same pre-LN causal model without recomputing
+the prompt every step. TPU-native shape: the whole generation loop is
+ONE ``lax.scan`` inside one jit — per-step K/V appends are
+``lax.dynamic_update_slice`` into a static-shape cache (XLA keeps it
+in-place via donation), the attention against the cache prefix masks by
+position, and the sampled token feeds back through the scan carry. No
+reference counterpart (VELES predates transformers) — additive tier.
+
+Numerical contract: decode produces the same logits as running
+``transformer_step._forward`` over the growing full sequence to within
+fp-reassociation tolerance (``tests/test_decode.py`` asserts
+rtol 2e-4 — the cached path computes attention in a different order
+and ``_forward``'s core may take the engine's reduced-precision
+policy, so equality is numerical, not bitwise), because both use the
+identical parameter pytree and sublayer math.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from veles_tpu.parallel.transformer_step import _ln
+
+
+def init_kv_cache(n_blocks, batch, max_len, heads, head_dim,
+                  dtype=jnp.float32):
+    """Static-shape cache: K/V per block, plus the filled length."""
+    shape = (n_blocks, batch, max_len, heads, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "length": jnp.zeros((), jnp.int32)}
+
+
+def _block_qkv(blk, x, heads):
+    batch, t, embed = x.shape
+    h = _ln(x, blk["ln1_w"], blk["ln1_b"])
+    qkv = h @ blk["wqkv"] + blk["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (batch, t, heads, embed // heads)
+    return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+
+def _mlp(blk, x):
+    h = _ln(x, blk["ln2_w"], blk["ln2_b"])
+    return x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
+        + blk["b2"]
+
+
+def prefill(params, x, heads, cache):
+    """Run the prompt (B, T, E) once, filling ``cache`` positions
+    [0, T); returns ``(last_logits, cache)`` with ``last_logits``
+    (B, vocab) for the first generated token."""
+    batch, t, embed = x.shape
+    ks, vs = [], []
+    for blk in params["blocks"]:
+        q, k, v = _block_qkv(blk, x, heads)
+        ks.append(k)
+        vs.append(v)
+        # full causal attention over the prompt — the training math
+        att = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        x = x + att.reshape(batch, t, embed) @ blk["wout"] + blk["bout"]
+        x = _mlp(blk, x)
+    logits = _ln(x[:, -1], params["lnf_w"], params["lnf_b"]) \
+        @ params["head"]
+    cache = {
+        "k": lax.dynamic_update_slice(
+            cache["k"], jnp.stack(ks).astype(cache["k"].dtype),
+            (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(
+            cache["v"], jnp.stack(vs).astype(cache["v"].dtype),
+            (0, 0, 0, 0, 0)),
+        "length": jnp.int32(t),
+    }
+    return logits, cache
+
+
+def decode_step(params, x_tok, heads, cache):
+    """One token (B, 1, E) through every block against the cache;
+    returns ``(logits, cache)`` with the token's K/V appended."""
+    batch, _, embed = x_tok.shape
+    length = cache["length"]
+    max_len = cache["k"].shape[2]
+    # positions [0, length] are valid (the new token attends to itself)
+    mask = (jnp.arange(max_len) <= length)[None, None, None, :]
+    x = x_tok
+    new_k, new_v = cache["k"], cache["v"]
+    for i, blk in enumerate(params["blocks"]):
+        q, k, v = _block_qkv(blk, x, heads)
+        new_k = lax.dynamic_update_slice(
+            new_k, k[None].astype(new_k.dtype), (i, 0, length, 0, 0))
+        new_v = lax.dynamic_update_slice(
+            new_v, v[None].astype(new_v.dtype), (i, 0, length, 0, 0))
+        scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+        # q (B,1,H,D) x cache K (B,L,H,D) -> (B,H,1,L), f32 softmax
+        s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                       new_k[i].astype(q.dtype),
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype),
+                         new_v[i].astype(q.dtype),
+                         preferred_element_type=jnp.float32
+                         ).astype(x.dtype)
+        x = x + att.reshape(batch, 1, embed) @ blk["wout"] + blk["bout"]
+        x = _mlp(blk, x)
+    logits = _ln(x[:, 0], params["lnf_w"], params["lnf_b"]) \
+        @ params["head"]
+    return logits, {"k": new_k, "v": new_v, "length": length + 1}
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "n_tokens"),
+                   donate_argnames=("cache",))
+def _generate_jit(params, embed_table, prompt_x, heads, n_tokens, cache):
+    logits, cache = prefill(params, prompt_x, heads, cache)
+
+    def body(carry, _):
+        cache, logits = carry
+        tok = jnp.argmax(logits, axis=-1)            # greedy (B,)
+        x_tok = embed_table[tok][:, None, :]         # (B, 1, E)
+        logits, cache = decode_step(params, x_tok, heads, cache)
+        return (cache, logits), tok
+
+    (cache, logits), toks = lax.scan(body, (cache, logits),
+                                     None, length=n_tokens)
+    return jnp.swapaxes(toks, 0, 1), logits, cache
+
+
+def generate(params, embed_table, prompt_tokens, heads, n_tokens,
+             max_len=None):
+    """Greedy-decode ``n_tokens`` after ``prompt_tokens`` (B, T) int32.
+
+    ``embed_table`` (vocab, E) maps tokens to the model's input
+    embeddings (the toy model trains on pre-embedded x, so the table is
+    the caller's). The prompt prefills the cache in one pass; the whole
+    decode loop is one scan inside one jit with the cache donated.
+    Returns ``(tokens (B, n_tokens), cache)``."""
+    batch, t = prompt_tokens.shape
+    n_blocks = len(params["blocks"])
+    embed = embed_table.shape[1]
+    head_dim = embed // heads
+    if max_len is None:
+        max_len = t + n_tokens
+    if max_len < t + n_tokens:
+        raise ValueError("max_len %d < prompt %d + n_tokens %d"
+                         % (max_len, t, n_tokens))
+    cache = init_kv_cache(n_blocks, batch, max_len, heads, head_dim)
+    prompt_x = embed_table[prompt_tokens]
+    toks, _, cache = _generate_jit(params, embed_table, prompt_x, heads,
+                                   n_tokens, cache)
+    return toks, cache
