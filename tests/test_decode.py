@@ -88,3 +88,27 @@ def test_generate_rejects_overflow(model):
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError):
         generate(params, table, prompt, HEADS, n_tokens=5, max_len=8)
+
+
+def test_generate_bf16_matches_bf16_reference(model):
+    """The bf16 serving configuration (params/table/cache all bf16 —
+    the bench's decode_bfloat16 keys): scan-decode tokens equal the
+    bf16 full-recompute loop."""
+    params, table = model
+    bf16 = jnp.bfloat16
+    params16 = jax.tree.map(lambda a: a.astype(bf16), params)
+    table16 = table.astype(bf16)
+    rng = numpy.random.RandomState(4)
+    prompt = jnp.asarray(rng.randint(0, VOCAB, (2, 5)))
+
+    toks, _ = generate(params16, table16, prompt, HEADS, n_tokens=6)
+
+    seq = table16[prompt]
+    ref = []
+    for _ in range(6):
+        logits = _forward(params16, seq, HEADS, 1, "ulysses")[:, -1]
+        tok = jnp.argmax(logits, axis=-1)
+        ref.append(tok)
+        seq = jnp.concatenate([seq, table16[tok][:, None, :]], axis=1)
+    numpy.testing.assert_array_equal(
+        numpy.asarray(toks), numpy.asarray(jnp.stack(ref, axis=1)))

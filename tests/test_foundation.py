@@ -317,3 +317,20 @@ class TestMongoDuplication:
         with pytest.raises(RuntimeError) as err:
             MongoLogHandler("127.0.0.1:1")
         assert "pymongo" in str(err.value)
+
+    def test_background_events_flush_on_close(self):
+        """Events in background mode ride a worker thread; close()
+        drains the queue before detaching."""
+        from veles_tpu.core.logger import (
+            Logger, duplicate_all_logging_to_mongo)
+
+        client = self._fake_client()
+        handler = duplicate_all_logging_to_mongo(
+            "ignored:1", docid="bg-ev", client_factory=lambda a: client)
+        log = Logger(logger_name="mongo-bg-ev")
+        for i in range(5):
+            log.event("tick", "single", number=i)
+        handler.close()
+        events = client["veles"]["events"].docs
+        assert [e["number"] for e in events] == list(range(5))
+        assert all(e["session"] == "bg-ev" for e in events)

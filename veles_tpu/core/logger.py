@@ -199,7 +199,12 @@ def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
     handler = MongoLogHandler(addr, docid=docid,
                               client_factory=client_factory)
     root_logger = logging.getLogger()
-    listener = queue_handler = None
+    listener = queue_handler = event_worker = event_queue = None
+    events = handler._collection.database["events"]
+
+    # override the recorder's pid-based session with the handler's docid
+    # so veles.logs and veles.events join on the same key (the
+    # reference's dashboard correlated them per session)
     if background:
         import queue as queue_mod
         from logging.handlers import QueueHandler, QueueListener
@@ -208,15 +213,35 @@ def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
         listener = QueueListener(queue_handler.queue, handler)
         listener.start()
         root_logger.addHandler(queue_handler)
+
+        # events go through their own worker for the same reason the
+        # log records do: Logger.event() must never block on a Mongo
+        # round trip (or the driver's multi-second timeout)
+        event_queue = queue_mod.SimpleQueue()
+
+        def sink(attrs):
+            event_queue.put(dict(attrs, session=handler.docid))
+
+        def drain():
+            while True:
+                item = event_queue.get()
+                if item is None:
+                    return
+                try:
+                    events.insert_one(item)
+                except Exception:
+                    pass  # record() already warn-onced sync failures;
+                    # here the span is dropped silently — the JSONL
+                    # recorder still has it
+
+        event_worker = threading.Thread(target=drain,
+                                        name="mongo-events", daemon=True)
+        event_worker.start()
     else:
         root_logger.addHandler(handler)
-    events = handler._collection.database["events"]
 
-    # override the recorder's pid-based session with the handler's docid
-    # so veles.logs and veles.events join on the same key (the
-    # reference's dashboard correlated them per session)
-    def sink(attrs):
-        events.insert_one(dict(attrs, session=handler.docid))
+        def sink(attrs):
+            events.insert_one(dict(attrs, session=handler.docid))
 
     get_event_recorder().add_sink(sink)
 
@@ -225,6 +250,8 @@ def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
         if listener is not None:
             root_logger.removeHandler(queue_handler)
             listener.stop()
+            event_queue.put(None)  # drains queued spans first (FIFO)
+            event_worker.join(timeout=10)
         else:
             root_logger.removeHandler(handler)
 

@@ -55,15 +55,36 @@ def _ln(x, w, b, eps=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
 
 
+# The sublayer helpers are shared with the KV-cache decode path
+# (parallel/decode.py) — ONE copy of the block math keeps the cached
+# and full-recompute forwards numerically equivalent by construction.
+
+def _block_qkv(blk, x, heads):
+    """Pre-LN qkv projection: (B, T, E) -> three (B, T, H, D)."""
+    batch, t, embed = x.shape
+    h = _ln(x, blk["ln1_w"], blk["ln1_b"])
+    qkv = h @ blk["wqkv"] + blk["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (batch, t, heads, embed // heads)
+    return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+
+def _mlp(blk, x):
+    """Pre-LN residual gelu MLP."""
+    h = _ln(x, blk["ln2_w"], blk["ln2_b"])
+    return x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
+        + blk["b2"]
+
+
+def _head(params, x):
+    """Final layer norm + vocab projection."""
+    return _ln(x, params["lnf_w"], params["lnf_b"]) @ params["head"]
+
+
 def _forward(params, x, heads, seq_ax, sp_strategy):
     batch, t, embed = x.shape
-    head_dim = embed // heads
     for blk in params["blocks"]:
-        h = _ln(x, blk["ln1_w"], blk["ln1_b"])
-        qkv = h @ blk["wqkv"] + blk["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (batch, t, heads, head_dim)
-        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        q, k, v = _block_qkv(blk, x, heads)
         if seq_ax > 1 and sp_strategy == "ring":
             att = ring_attention(q, k, v, "seq", causal=True)
         elif seq_ax > 1:
@@ -71,10 +92,8 @@ def _forward(params, x, heads, seq_ax, sp_strategy):
         else:
             att = attention(q, k, v, causal=True)
         x = x + att.reshape(batch, t, embed) @ blk["wout"] + blk["bout"]
-        h = _ln(x, blk["ln2_w"], blk["ln2_b"])
-        x = x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
-            + blk["b2"]
-    return _ln(x, params["lnf_w"], params["lnf_b"]) @ params["head"]
+        x = _mlp(blk, x)
+    return _head(params, x)
 
 
 def build_transformer_train_step(heads, mesh=None, learning_rate=0.1,
