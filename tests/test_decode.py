@@ -112,3 +112,28 @@ def test_generate_bf16_matches_bf16_reference(model):
         seq = jnp.concatenate([seq, table16[tok][:, None, :]], axis=1)
     numpy.testing.assert_array_equal(
         numpy.asarray(toks), numpy.asarray(jnp.stack(ref, axis=1)))
+
+
+def test_generate_sampling_reproducible_and_topk_bounded(model):
+    """temperature sampling: same key => same tokens; different key =>
+    (almost surely) different; top_k=1 degenerates to greedy."""
+    params, table = model
+    rng = numpy.random.RandomState(5)
+    prompt = jnp.asarray(rng.randint(0, VOCAB, (2, 5)))
+    key = jax.random.key(42)
+
+    t1, _ = generate(params, table, prompt, HEADS, n_tokens=8,
+                     temperature=1.0, key=key)
+    t2, _ = generate(params, table, prompt, HEADS, n_tokens=8,
+                     temperature=1.0, key=key)
+    numpy.testing.assert_array_equal(numpy.asarray(t1),
+                                     numpy.asarray(t2))
+    t3, _ = generate(params, table, prompt, HEADS, n_tokens=8,
+                     temperature=1.0, key=jax.random.key(43))
+    assert not numpy.array_equal(numpy.asarray(t1), numpy.asarray(t3))
+
+    greedy, _ = generate(params, table, prompt, HEADS, n_tokens=8)
+    top1, _ = generate(params, table, prompt, HEADS, n_tokens=8,
+                       temperature=0.7, top_k=1, key=key)
+    numpy.testing.assert_array_equal(numpy.asarray(greedy),
+                                     numpy.asarray(top1))

@@ -223,6 +223,7 @@ def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
             event_queue.put(dict(attrs, session=handler.docid))
 
         def drain():
+            warned = False
             while True:
                 item = event_queue.get()
                 if item is None:
@@ -230,9 +231,14 @@ def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
                 try:
                     events.insert_one(item)
                 except Exception:
-                    pass  # record() already warn-onced sync failures;
-                    # here the span is dropped silently — the JSONL
-                    # recorder still has it
+                    # the span is dropped (the JSONL recorder still has
+                    # it) — but say so ONCE: in this mode sink() only
+                    # enqueues, so record()'s warn-once can never fire
+                    if not warned:
+                        warned = True
+                        logging.getLogger("MongoLogHandler").exception(
+                            "event insert failed (further failures "
+                            "silent; spans remain in the JSONL log)")
 
         event_worker = threading.Thread(target=drain,
                                         name="mongo-events", daemon=True)
@@ -252,6 +258,13 @@ def duplicate_all_logging_to_mongo(addr, docid=None, client_factory=None,
             listener.stop()
             event_queue.put(None)  # drains queued spans first (FIFO)
             event_worker.join(timeout=10)
+            if event_worker.is_alive():
+                # a stuck driver timeout can outlive the join budget —
+                # the flush promise must fail loudly, not silently
+                logging.getLogger("MongoLogHandler").warning(
+                    "mongo event queue not fully flushed within 10s; "
+                    "remaining spans may be lost (daemon worker still "
+                    "inserting)")
         else:
             root_logger.removeHandler(handler)
 

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from veles_tpu.ops.attention import attention
 # ONE copy of the sublayer math, shared with the training-side full
 # forward — the equivalence the module contract promises is structural
 from veles_tpu.parallel.transformer_step import _block_qkv, _head, _mlp
@@ -48,8 +49,9 @@ def prefill(params, x, heads, cache):
         q, k, v = _block_qkv(blk, x, heads)
         ks.append(k)
         vs.append(v)
-        # full causal attention over the prompt — the training math
-        att = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        # full causal attention over the prompt — the SAME gated op the
+        # training forward uses (flash kernel for prompts >= 4096)
+        att = attention(q, k, v, causal=True)
         x = x + att.reshape(batch, t, embed) @ blk["wout"] + blk["bout"]
         x = _mlp(blk, x)
     logits = _head(params, x[:, -1])
@@ -98,26 +100,46 @@ def decode_step(params, x_tok, heads, cache):
     return logits, {"k": new_k, "v": new_v, "length": length + 1}
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "n_tokens"),
+def _pick_token(logits, key, temperature, top_k):
+    """Greedy (temperature 0/None) or temperature sampling, optionally
+    truncated to the top-k logits. Pure — runs inside the scan."""
+    if not temperature:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k:
+        # lax.top_k, not a full vocab sort — this runs per token inside
+        # the hot decode scan
+        kth = lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("heads", "n_tokens", "temperature",
+                                    "top_k"),
                    donate_argnames=("cache",))
-def _generate_jit(params, embed_table, prompt_x, heads, n_tokens, cache):
+def _generate_jit(params, embed_table, prompt_x, heads, n_tokens, cache,
+                  key, temperature, top_k):
     logits, cache = prefill(params, prompt_x, heads, cache)
 
-    def body(carry, _):
+    def body(carry, step_key):
         cache, logits = carry
-        tok = jnp.argmax(logits, axis=-1)            # greedy (B,)
-        x_tok = embed_table[tok][:, None, :]         # (B, 1, E)
+        tok = _pick_token(logits, step_key, temperature, top_k)  # (B,)
+        x_tok = embed_table[tok][:, None, :]                     # (B,1,E)
         logits, cache = decode_step(params, x_tok, heads, cache)
         return (cache, logits), tok
 
     (cache, logits), toks = lax.scan(body, (cache, logits),
-                                     None, length=n_tokens)
+                                     jax.random.split(key, n_tokens))
     return jnp.swapaxes(toks, 0, 1), logits, cache
 
 
 def generate(params, embed_table, prompt_tokens, heads, n_tokens,
-             max_len=None):
-    """Greedy-decode ``n_tokens`` after ``prompt_tokens`` (B, T) int32.
+             max_len=None, temperature=0.0, top_k=0, key=None):
+    """Decode ``n_tokens`` after ``prompt_tokens`` (B, T) int32 —
+    greedy by default; ``temperature > 0`` samples (optionally truncated
+    to the ``top_k`` highest logits) from the reproducible ``key``
+    (defaults to the framework's named "decode" PRNG stream).
 
     ``embed_table`` (vocab, E) maps tokens to the model's input
     embeddings (the toy model trains on pre-embedded x, so the table is
@@ -133,6 +155,15 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
     if max_len < t + n_tokens:
         raise ValueError("max_len %d < prompt %d + n_tokens %d"
                          % (max_len, t, n_tokens))
+    if top_k < 0:
+        raise ValueError("top_k must be >= 0, got %d" % top_k)
+    top_k = min(int(top_k), embed_table.shape[0])  # clamp to the vocab
+    if key is None:
+        if temperature:
+            from veles_tpu.core.prng import get as get_rng
+            key = get_rng("decode").next_key()
+        else:
+            key = jax.random.key(0)  # unused by greedy, jit wants one
     # the cache follows the serving dtype: with bf16 params/table the
     # K/V traffic (comparable to the weight traffic at long context)
     # halves too — measured +~50% tokens/sec on the memory-bound loop
@@ -140,5 +171,6 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
                           dtype=embed_table.dtype)
     prompt_x = embed_table[prompt_tokens]
     toks, _, cache = _generate_jit(params, embed_table, prompt_x, heads,
-                                   n_tokens, cache)
+                                   n_tokens, cache, key,
+                                   float(temperature), int(top_k))
     return toks, cache
