@@ -93,9 +93,13 @@ class Workflow {
   // Plan buffers for this batch size (re-plans if batch changes).
   void Initialize(int batch);
   // Run inference: input (batch, input_size), output (batch, output_size).
-  // Thread-safe: concurrent callers serialize on the workflow's run mutex
-  // (the arena is shared state); the batch plan is (re)built under the
-  // same lock so mixed batch sizes from different threads stay correct.
+  // Large batches fan out across hardware threads (per-worker chunks,
+  // each with its own planned arena — units are stateless between
+  // Run() calls, so rows are independent); small batches run on the
+  // caller's thread. Thread-safe: the parallel path shares nothing
+  // mutable, and single-threaded callers serialize on the run mutex
+  // (the member arena is shared state), with the batch plan (re)built
+  // under the same lock.
   void Run(const float* input, int batch, float* output);
 
   int64_t input_size() const { return input_shape_.count(); }
@@ -117,6 +121,11 @@ class Workflow {
   std::mutex run_mutex_;
 
   void InitializeLocked(int batch);
+  // Plan arena offsets for `rows`-row buffers; returns the arena float
+  // count (shared by the sequential plan and per-worker parallel plans).
+  int64_t PlanOffsets(int rows, std::vector<int64_t>* offsets) const;
+  void RunRows(const float* input, int rows, float* output, float* arena,
+               const std::vector<int64_t>& offsets) const;
 };
 
 }  // namespace veles_rt

@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <queue>
@@ -178,48 +179,124 @@ void Workflow::Initialize(int batch) {
   InitializeLocked(batch);
 }
 
-void Workflow::InitializeLocked(int batch) {
-  if (batch == batch_) return;
-  batch_ = batch;
+int64_t Workflow::PlanOffsets(int rows,
+                              std::vector<int64_t>* offsets) const {
   // intermediate buffers only: unit i's output feeds unit i+1, so buffer i
   // is live over [i, i+2) in topological time (producer + consumer steps);
   // the LAST unit writes straight into the caller's output and needs no
-  // arena slot
+  // arena slot. ONE planner serves the cached sequential plan and the
+  // per-worker parallel plans.
   std::vector<BufferInterval> buffers;
   for (size_t i = 0; i + 1 < units_.size(); ++i) {
     buffers.push_back(BufferInterval{
         static_cast<int>(i), static_cast<int>(i) + 2,
-        static_cast<int64_t>(units_[i]->out_shape.count()) * batch *
+        static_cast<int64_t>(units_[i]->out_shape.count()) * rows *
             static_cast<int64_t>(sizeof(float))});
   }
   int64_t arena_bytes = PackIntervals(&buffers);
-  VRT_DEBUG("planned arena: %lld bytes for batch %d (%zu buffers)",
-            static_cast<long long>(arena_bytes), batch, buffers.size());
-  arena_.assign(static_cast<size_t>(arena_bytes / sizeof(float)) + 1, 0.f);
-  offsets_.clear();
+  offsets->clear();
   for (auto& buf : buffers)
-    offsets_.push_back(buf.offset / static_cast<int64_t>(sizeof(float)));
+    offsets->push_back(buf.offset / static_cast<int64_t>(sizeof(float)));
+  return arena_bytes / static_cast<int64_t>(sizeof(float)) + 1;
+}
+
+void Workflow::InitializeLocked(int batch) {
+  if (batch == batch_) return;
+  batch_ = batch;
+  int64_t floats = PlanOffsets(batch, &offsets_);
+  VRT_DEBUG("planned arena: %lld floats for batch %d",
+            static_cast<long long>(floats), batch);
+  arena_.assign(static_cast<size_t>(floats), 0.f);
+}
+
+namespace {
+
+// Below this many rows per worker, thread spawn/join overhead beats the
+// parallel win — small/latency-sensitive batches stay single-threaded.
+constexpr int kMinRowsPerWorker = 8;
+
+int MaxWorkers() {
+  // VELES_RT_WORKERS overrides hardware_concurrency (deployment sizing;
+  // also how single-core CI still exercises the threaded path)
+  const char* env = std::getenv("VELES_RT_WORKERS");
+  if (env != nullptr) {
+    int n = std::atoi(env);
+    if (n > 0) return n;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  return hw ? static_cast<int>(hw) : 1;
+}
+
+}  // namespace
+
+void Workflow::RunRows(const float* input, int rows, float* output,
+                       float* arena,
+                       const std::vector<int64_t>& offsets) const {
+  const float* src = input;
+  for (size_t i = 0; i < units_.size(); ++i) {
+    float* dst = (i + 1 == units_.size()) ? output
+                                          : arena + offsets[i];
+    units_[i]->Run(src, dst, rows);
+    src = dst;
+  }
 }
 
 void Workflow::Run(const float* input, int batch, float* output) {
-  // serialize: the arena is shared mutable state, and ctypes callers drop
-  // the GIL during this call
-  std::lock_guard<std::mutex> lock(run_mutex_);
-  InitializeLocked(batch > 0 ? batch : 1);
-  const float* src = input;
-  for (size_t i = 0; i < units_.size(); ++i) {
-    float* dst = (i + 1 == units_.size())
-                     ? output
-                     : arena_.data() + offsets_[i];
-    // a chain executes sequentially; the engine exists for branchy
-    // graphs and concurrent requests
-    units_[i]->Run(src, dst, batch_);
-    src = dst;
-  }
-  if (units_.empty())
+  if (batch <= 0) batch = 1;
+  if (units_.empty()) {
     std::memcpy(output, input,
-                static_cast<size_t>(input_size()) * batch_ *
+                static_cast<size_t>(input_size()) * batch *
                     sizeof(float));
+    return;
+  }
+  int workers = static_cast<int>(
+      std::min<int64_t>(MaxWorkers(), batch / kMinRowsPerWorker));
+  if (workers > 1) {
+    // Units are stateless between Run() calls (the Unit contract), so
+    // rows are independent: split the batch into per-worker chunks,
+    // each with its OWN planned arena — no shared mutable state, no
+    // run-mutex serialization (the libZnicz-era engine's role for flat
+    // chains). Offsets planned for the full chunk size stay valid for
+    // the smaller tail chunk (buffers only shrink).
+    int chunk = (batch + workers - 1) / workers;
+    std::vector<int64_t> offsets;
+    int64_t arena_floats = PlanOffsets(chunk, &offsets);
+    VRT_DEBUG("parallel run: %d workers x %d rows, arena %lld floats "
+              "each", workers, chunk,
+              static_cast<long long>(arena_floats));
+    int64_t in_row = input_size(), out_row = output_size();
+    // fresh threads per call: a chunk is >= kMinRowsPerWorker rows of
+    // model compute, dwarfing the ~10 us thread spawn; arenas are NOT
+    // zero-filled (units write every output element before it is read)
+    std::vector<std::thread> threads;
+    std::vector<std::exception_ptr> errors(workers);
+    for (int w = 0; w < workers; ++w) {
+      int row0 = w * chunk;
+      int rows = std::min(chunk, batch - row0);
+      if (rows <= 0) break;
+      threads.emplace_back([=, &offsets, &errors] {
+        try {
+          std::unique_ptr<float[]> arena(
+              new float[static_cast<size_t>(arena_floats)]);
+          RunRows(input + row0 * in_row, rows, output + row0 * out_row,
+                  arena.get(), offsets);
+        } catch (...) {
+          // escaping a thread start function would std::terminate the
+          // embedding process; surface through the C API instead
+          errors[w] = std::current_exception();
+        }
+      });
+    }
+    for (auto& t : threads) t.join();
+    for (auto& err : errors)
+      if (err) std::rethrow_exception(err);
+    return;
+  }
+  // single-threaded path: the member arena is shared mutable state, and
+  // ctypes callers drop the GIL during this call — serialize
+  std::lock_guard<std::mutex> lock(run_mutex_);
+  InitializeLocked(batch);
+  RunRows(input, batch_, output, arena_.data(), offsets_);
 }
 
 }  // namespace veles_rt

@@ -96,6 +96,30 @@ static void TestPackageInference(const std::string& dir) {
   }
 }
 
+static void TestParallelBatch(const std::string& dir) {
+  // the multi-worker path (batch >= workers * 8) must equal per-row
+  // sequential execution exactly — same float ops, different threads.
+  // Force 4 workers so the threaded path runs even on single-core CI.
+  setenv("VELES_RT_WORKERS", "4", 1);
+  auto wf = veles_rt::Workflow::Load(dir + "/mlp_package.tar");
+  int batch = 64;
+  std::vector<float> input(static_cast<size_t>(wf->input_size()) * batch);
+  for (size_t i = 0; i < input.size(); ++i)
+    input[i] = static_cast<float>((i * 37) % 11) / 11.0f;
+  std::vector<float> parallel(
+      static_cast<size_t>(wf->output_size()) * batch);
+  wf->Run(input.data(), batch, parallel.data());
+  std::vector<float> row(static_cast<size_t>(wf->output_size()));
+  for (int r = 0; r < batch; ++r) {
+    wf->Run(input.data() + static_cast<size_t>(r) * wf->input_size(), 1,
+            row.data());
+    for (int c = 0; c < wf->output_size(); ++c)
+      CHECK(parallel[static_cast<size_t>(r) * wf->output_size() + c] ==
+            row[static_cast<size_t>(c)]);
+  }
+  unsetenv("VELES_RT_WORKERS");
+}
+
 int main(int argc, char** argv) {
   TestJson();
   TestLog();
@@ -103,6 +127,7 @@ int main(int argc, char** argv) {
   if (argc > 1) {
     TestNpyRoundtrip(argv[1]);
     TestPackageInference(argv[1]);
+    TestParallelBatch(argv[1]);
   }
   std::printf("native runtime tests OK\n");
   return 0;

@@ -100,10 +100,12 @@ def decode_step(params, x_tok, heads, cache):
     return logits, {"k": new_k, "v": new_v, "length": length + 1}
 
 
-def _pick_token(logits, key, temperature, top_k):
-    """Greedy (temperature 0/None) or temperature sampling, optionally
-    truncated to the top-k logits. Pure — runs inside the scan."""
-    if not temperature:
+def _pick_token(logits, key, temperature, sample, top_k):
+    """Greedy (``sample=False``) or temperature sampling, optionally
+    truncated to the top-k logits. Pure — runs inside the scan.
+    ``sample``/``top_k`` are trace-time constants; ``temperature`` is a
+    traced operand (a new value must NOT recompile the decode loop)."""
+    if not sample:
         return jnp.argmax(logits, axis=-1)
     scaled = logits.astype(jnp.float32) / temperature
     if top_k:
@@ -115,16 +117,17 @@ def _pick_token(logits, key, temperature, top_k):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("heads", "n_tokens", "temperature",
+                   static_argnames=("heads", "n_tokens", "sample",
                                     "top_k"),
                    donate_argnames=("cache",))
 def _generate_jit(params, embed_table, prompt_x, heads, n_tokens, cache,
-                  key, temperature, top_k):
+                  key, temperature, sample, top_k):
     logits, cache = prefill(params, prompt_x, heads, cache)
 
     def body(carry, step_key):
         cache, logits = carry
-        tok = _pick_token(logits, step_key, temperature, top_k)  # (B,)
+        tok = _pick_token(logits, step_key, temperature, sample,
+                          top_k)                                 # (B,)
         x_tok = embed_table[tok][:, None, :]                     # (B,1,E)
         logits, cache = decode_step(params, x_tok, heads, cache)
         return (cache, logits), tok
@@ -172,5 +175,6 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
     prompt_x = embed_table[prompt_tokens]
     toks, _, cache = _generate_jit(params, embed_table, prompt_x, heads,
                                    n_tokens, cache, key,
-                                   float(temperature), int(top_k))
+                                   jnp.float32(temperature or 1.0),
+                                   bool(temperature), int(top_k))
     return toks, cache
