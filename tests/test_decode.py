@@ -137,3 +137,41 @@ def test_generate_sampling_reproducible_and_topk_bounded(model):
                        temperature=0.7, top_k=1, key=key)
     numpy.testing.assert_array_equal(numpy.asarray(greedy),
                                      numpy.asarray(top1))
+
+
+def test_tensor_parallel_decode_matches_single_device(model):
+    """Megatron-style TP decode over an 8-device model axis: the
+    sharded run's tokens equal the single-device generate()."""
+    from veles_tpu.parallel.decode import make_tp_generate
+    from veles_tpu.parallel.mesh import build_mesh
+
+    params, table = model
+    # vocab 11 doesn't divide 8 — build a TP-compatible model instead
+    rng = numpy.random.RandomState(6)
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    heads, embed, vocab = 8, 32, 16
+    tp_params = init_transformer_params(rng, 2, embed, heads, vocab)
+    tp_table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    prompt = jnp.asarray(rng.randint(0, vocab, (2, 6)))
+
+    single, _ = generate(tp_params, tp_table, prompt, heads, n_tokens=7)
+
+    mesh = build_mesh(devices=jax.devices()[:8], data=1, model=8)
+    run = make_tp_generate(mesh, heads, n_tokens=7)
+    sharded = run(tp_params, tp_table, prompt)
+    numpy.testing.assert_array_equal(numpy.asarray(sharded),
+                                     numpy.asarray(single))
+    _ = params, table
+
+
+def test_tensor_parallel_rejects_indivisible(model):
+    from veles_tpu.parallel.decode import make_tp_generate
+    from veles_tpu.parallel.mesh import build_mesh
+
+    params, table = model  # HEADS=4, vocab 11: not divisible by 8
+    mesh = build_mesh(devices=jax.devices()[:8], data=1, model=8)
+    run = make_tp_generate(mesh, HEADS, n_tokens=3)
+    with pytest.raises(ValueError):
+        run(params, table, jnp.zeros((1, 4), jnp.int32))

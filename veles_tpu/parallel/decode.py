@@ -67,6 +67,21 @@ def prefill(params, x, heads, cache):
     return logits, cache
 
 
+def _cache_attend(q, k_all, v_all, mask):
+    """Attention of query tokens against the cache prefix, f32 softmax:
+    ONE copy of the math for the single-device and tensor-parallel
+    decode paths (the TP guarantee of token-identity depends on it)."""
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    # q (B,1,H,D) x cache K (B,L,H,D) -> (B,H,1,L)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(q.dtype),
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype),
+                      v_all.astype(q.dtype),
+                      preferred_element_type=jnp.float32)
+
+
 def decode_step(params, x_tok, heads, cache):
     """One token (B, 1, E) through every block against the cache;
     returns ``(logits, cache)`` with the token's K/V appended."""
@@ -83,17 +98,7 @@ def decode_step(params, x_tok, heads, cache):
             new_k, k[None].astype(new_k.dtype), (i, 0, length, 0, 0))
         new_v = lax.dynamic_update_slice(
             new_v, v[None].astype(new_v.dtype), (i, 0, length, 0, 0))
-        scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
-        # q (B,1,H,D) x cache K (B,L,H,D) -> (B,H,1,L), f32 softmax
-        s = jnp.einsum("bqhd,bkhd->bhqk", q,
-                       new_k[i].astype(q.dtype),
-                       preferred_element_type=jnp.float32) * scale
-        s = jnp.where(mask, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        att = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype),
-                         new_v[i].astype(q.dtype),
-                         preferred_element_type=jnp.float32
-                         ).astype(x.dtype)
+        att = _cache_attend(q, new_k[i], new_v[i], mask).astype(x.dtype)
         x = x + att.reshape(batch, 1, embed) @ blk["wout"] + blk["bout"]
         x = _mlp(blk, x)
     logits = _head(params, x[:, 0])
@@ -178,3 +183,189 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
                                    jnp.float32(temperature or 1.0),
                                    bool(temperature), int(top_k))
     return toks, cache
+
+
+# -- tensor-parallel decode (Megatron-style weight sharding) ------------------
+
+def _repack_block(blk, heads):
+    """Host-side repack of one block into head-major layouts the TP
+    specs can shard: qkv (E, 3E) → (E, 3, H, D) so each device owns
+    whole heads (a flat column shard would give device 0 all the Q
+    columns), out-proj (E, E) → (H, D, E) row-sharded by head."""
+    embed = blk["wqkv"].shape[0]
+    head_dim = embed // heads
+    return dict(
+        blk,
+        wqkv=blk["wqkv"].reshape(embed, 3, heads, head_dim),
+        bqkv=blk["bqkv"].reshape(3, heads, head_dim),
+        wout=blk["wout"].reshape(heads, head_dim, embed),
+    )
+
+
+def _tp_specs(n_blocks, axis):
+    """PartitionSpec pytree for the repacked params under ``axis``:
+    whole heads and FFN columns shard; norms and biases that are added
+    AFTER a psum stay replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    block = {
+        "ln1_w": P(), "ln1_b": P(),
+        "wqkv": P(None, None, axis, None),
+        "bqkv": P(None, axis, None),
+        "wout": P(axis, None, None),
+        "bout": P(),
+        "ln2_w": P(), "ln2_b": P(),
+        "w1": P(None, axis), "b1": P(axis),
+        "w2": P(axis, None), "b2": P(),
+    }
+    return {"blocks": [dict(block) for _ in range(n_blocks)],
+            "lnf_w": P(), "lnf_b": P(),
+            "head": P(None, axis)}
+
+
+def _tp_local_qkv(blk, x):
+    """(B, S, E) → q, k, v each (B, S, h_local, D) from the device's
+    head slice of the repacked qkv projection."""
+    from veles_tpu.parallel.transformer_step import _ln
+
+    h = _ln(x, blk["ln1_w"], blk["ln1_b"])
+    qkv = jnp.einsum("bse,eihd->bsihd", h, blk["wqkv"]) + blk["bqkv"]
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def make_tp_generate(mesh, heads, n_tokens, axis="model"):
+    """Tensor-parallel greedy decoding over ``mesh``'s ``axis``: every
+    device holds a head slice of each attention block, a column/row
+    slice of each FFN, and a vocab slice of the head — activations are
+    replicated, the two per-block matmul reductions ``psum`` over ICI
+    (the Megatron inference recipe). The KV cache shards over heads, so
+    per-device cache HBM scales with H/n.
+
+    Returns ``run(params, embed_table, prompt_tokens) -> tokens``; the
+    params are the standard ``init_transformer_params`` pytree (repacked
+    and sharded internally). Requires ``heads`` and the FFN hidden dim
+    divisible by the axis size."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from veles_tpu.parallel.transformer_step import _ln
+
+    n = mesh.shape[axis]
+
+    def tp_mlp(blk, x):
+        # the shared _mlp with the TP reduction injected: w1
+        # col-sharded, w2 row-sharded, psum completes the contraction
+        return _mlp(blk, x, reduce=lambda y: lax.psum(y, axis))
+
+    def device_step(params, embed_table, cache, logits):
+        """One decode step on each device's shard (inside shard_map)."""
+        tok = jnp.argmax(logits, axis=-1)
+        x = embed_table[tok][:, None, :]
+        length = cache["length"]
+        max_len = cache["k"].shape[2]
+        mask = (jnp.arange(max_len) <= length)[None, None, None, :]
+        new_k, new_v = cache["k"], cache["v"]
+        for i, blk in enumerate(params["blocks"]):
+            q, k, v = _tp_local_qkv(blk, x)
+            new_k = lax.dynamic_update_slice(
+                new_k, k[None].astype(new_k.dtype), (i, 0, length, 0, 0))
+            new_v = lax.dynamic_update_slice(
+                new_v, v[None].astype(new_v.dtype), (i, 0, length, 0, 0))
+            # the SAME cache-attend the single-device decode_step runs
+            att = _cache_attend(q, new_k[i], new_v[i], mask)
+            # row-sharded out-projection: psum completes the contraction
+            out = lax.psum(
+                jnp.einsum("bqhd,hde->bqe", att.astype(x.dtype),
+                           blk["wout"]), axis)
+            x = x + out + blk["bout"]
+            x = tp_mlp(blk, x)
+        local_logits = _ln(x[:, 0], params["lnf_w"], params["lnf_b"]) \
+            @ params["head"]
+        logits = lax.all_gather(local_logits, axis, axis=1, tiled=True)
+        return {"k": new_k, "v": new_v, "length": length + 1}, logits, tok
+
+    def device_run(params, embed_table, prompt_x, cache):
+        # prefill on the local head slice (full causal attention)
+        batch, t, embed = prompt_x.shape
+        x = prompt_x
+        ks, vs = [], []
+        for blk in params["blocks"]:
+            q, k, v = _tp_local_qkv(blk, x)
+            ks.append(k)
+            vs.append(v)
+            att = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+            out = lax.psum(
+                jnp.einsum("bshd,hde->bse", att.astype(x.dtype),
+                           blk["wout"]), axis)
+            x = x + out + blk["bout"]
+            x = tp_mlp(blk, x)
+        local_logits = _ln(x[:, -1], params["lnf_w"], params["lnf_b"]) \
+            @ params["head"]
+        logits = lax.all_gather(local_logits, axis, axis=1, tiled=True)
+        cache = {
+            "k": lax.dynamic_update_slice(
+                cache["k"], jnp.stack(ks).astype(cache["k"].dtype),
+                (0, 0, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                cache["v"], jnp.stack(vs).astype(cache["v"].dtype),
+                (0, 0, 0, 0, 0)),
+            "length": jnp.int32(t),
+        }
+
+        def body(carry, _):
+            cache, logits = carry
+            cache, logits, tok = device_step(params, embed_table, cache,
+                                             logits)
+            return (cache, logits), tok
+
+        (cache, logits), toks = lax.scan(body, (cache, logits), None,
+                                         length=n_tokens)
+        return jnp.swapaxes(toks, 0, 1)
+
+    cache_spec = P(None, None, None, axis, None)
+    param_specs = None  # built on first call (needs n_blocks)
+
+    def run(params, embed_table, prompt_tokens):
+        nonlocal param_specs
+        n_blocks = len(params["blocks"])
+        embed = embed_table.shape[1]
+        head_dim = embed // heads
+        if heads % n or (params["blocks"][0]["w1"].shape[1] % n) \
+                or (embed_table.shape[0] % n):
+            raise ValueError(
+                "tensor-parallel decode needs heads (%d), ffn hidden "
+                "(%d) and vocab (%d) divisible by the %r axis size %d"
+                % (heads, params["blocks"][0]["w1"].shape[1],
+                   embed_table.shape[0], axis, n))
+        packed = {"blocks": [_repack_block(blk, heads)
+                             for blk in params["blocks"]],
+                  "lnf_w": params["lnf_w"], "lnf_b": params["lnf_b"],
+                  "head": params["head"]}
+        if param_specs is None:
+            param_specs = _tp_specs(n_blocks)
+        batch, t = prompt_tokens.shape
+        cache = init_kv_cache(n_blocks, batch, t + n_tokens, heads,
+                              head_dim, dtype=embed_table.dtype)
+        prompt_x = embed_table[prompt_tokens]
+        cache_specs = {"k": cache_spec, "v": cache_spec,
+                       "length": P()}
+        # the TABLE is replicated (every device embeds the full token
+        # vector); the VOCAB sharding lives in params["head"], whose
+        # local logits all_gather back to full width
+        fn = jax.jit(jax.shard_map(
+            device_run, mesh=mesh,
+            in_specs=(param_specs, P(), P(), cache_specs),
+            out_specs=P(),
+            check_vma=False))
+        # place the shards explicitly (shard_map would otherwise
+        # require pre-sharded inputs for non-replicated specs)
+        packed = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            packed, param_specs)
+        table_sharded = jax.device_put(
+            embed_table, NamedSharding(mesh, P()))
+        cache = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(
+                    mesh, cache_spec if a.ndim == 5 else P())), cache)
+        return fn(packed, table_sharded, prompt_x, cache)
+
+    return run

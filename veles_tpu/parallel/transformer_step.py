@@ -69,11 +69,16 @@ def _block_qkv(blk, x, heads):
     return q.reshape(shape), k.reshape(shape), v.reshape(shape)
 
 
-def _mlp(blk, x):
-    """Pre-LN residual gelu MLP."""
+def _mlp(blk, x, reduce=None):
+    """Pre-LN residual gelu MLP. ``reduce`` completes a sharded
+    contraction (tensor-parallel decode passes a psum; ``b2`` is added
+    AFTER it, so it stays replicated) — one copy of the math for the
+    single-device and TP paths alike."""
     h = _ln(x, blk["ln2_w"], blk["ln2_b"])
-    return x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
-        + blk["b2"]
+    y = jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"]
+    if reduce is not None:
+        y = reduce(y)
+    return x + y + blk["b2"]
 
 
 def _head(params, x):
