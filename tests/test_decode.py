@@ -139,6 +139,28 @@ def test_generate_sampling_reproducible_and_topk_bounded(model):
                                      numpy.asarray(top1))
 
 
+def test_tensor_parallel_decode_smoke_2dev():
+    """Cheap TP-decode smoke tier: 2-device mesh, 2 tokens, tiny model —
+    fast enough to run on every suite invocation so the TP call path
+    (repack → _tp_specs → shard_map) is always exercised."""
+    from veles_tpu.parallel.decode import make_tp_generate
+    from veles_tpu.parallel.mesh import build_mesh
+
+    rng = numpy.random.RandomState(9)
+    heads, embed, vocab = 2, 8, 4
+    tp_params = init_transformer_params(rng, 1, embed, heads, vocab)
+    tp_table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    prompt = jnp.asarray(rng.randint(0, vocab, (1, 3)))
+
+    single, _ = generate(tp_params, tp_table, prompt, heads, n_tokens=2)
+    mesh = build_mesh(devices=jax.devices()[:2], data=1, model=2)
+    run = make_tp_generate(mesh, heads, n_tokens=2)
+    sharded = run(tp_params, tp_table, prompt)
+    numpy.testing.assert_array_equal(numpy.asarray(sharded),
+                                     numpy.asarray(single))
+
+
 def test_tensor_parallel_decode_matches_single_device(model):
     """Megatron-style TP decode over an 8-device model axis: the
     sharded run's tokens equal the single-device generate()."""

@@ -340,7 +340,7 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
                   "lnf_w": params["lnf_w"], "lnf_b": params["lnf_b"],
                   "head": params["head"]}
         if param_specs is None:
-            param_specs = _tp_specs(n_blocks)
+            param_specs = _tp_specs(n_blocks, axis)
         batch, t = prompt_tokens.shape
         cache = init_kv_cache(n_blocks, batch, t + n_tokens, heads,
                               head_dim, dtype=embed_table.dtype)
