@@ -330,6 +330,84 @@ class TestFusedAugmentation:
             numpy.asarray(fused.forwards[0].weights.data),
             numpy.asarray(graph.forwards[0].weights.data), atol=2e-2)
 
+    def test_shift_transform_fused_matches_graph(self):
+        """train_transform="shift1" on a plain FullBatchLoader: the
+        fused tick replicates the shift in-jit (same seeds), so both
+        engines land identical metrics — the dead-augmentation guard
+        for the second transform."""
+        from veles_tpu.core import prng
+        from veles_tpu.models.standard import StandardWorkflow
+
+        rng = numpy.random.RandomState(3)
+        data = rng.rand(120, 8, 8, 1).astype(numpy.float32)
+        labels = rng.randint(0, 4, 120).astype(numpy.int32)
+
+        def build(fused):
+            prng.get("default").seed(42)
+            prng.get("loader").seed(24)
+            return StandardWorkflow(
+                DummyLauncher(),
+                loader_kwargs=dict(
+                    data=data, labels=labels,
+                    class_lengths=[0, 40, 80], minibatch_size=20,
+                    train_transform="shift1",
+                    normalization_type="none"),
+                layers=[
+                    {"type": "all2all_tanh", "output_sample_shape": 16},
+                    {"type": "softmax", "output_sample_shape": 4},
+                ],
+                learning_rate=0.05, fused=fused,
+                decision_kwargs=dict(max_epochs=3), name="shift-fused")
+
+        graph = build(False)
+        graph.initialize()
+        assert graph.fused_tick is None
+        graph.run()
+        fused = build(True)
+        fused.initialize()
+        assert fused.fused_tick is not None, \
+            "shift1 loader must fuse (jit_transform)"
+        fused.run()
+        assert fused.decision.best_n_err[1] == graph.decision.best_n_err[1]
+        numpy.testing.assert_allclose(
+            numpy.asarray(fused.forwards[0].weights.data),
+            numpy.asarray(graph.forwards[0].weights.data), atol=2e-2)
+
+    def test_shift_batch_semantics(self):
+        """shift_batch: every output sample is a zero-filled integer
+        translation of its input within +-max_shift."""
+        from veles_tpu.ops.augment import shift_batch
+
+        rng = numpy.random.RandomState(1)
+        batch = rng.rand(12, 5, 7, 2).astype(numpy.float32) + 1.0
+        out = numpy.asarray(shift_batch(batch, 11, max_shift=1))
+
+        def shifted(img, dh, dw):
+            ref = numpy.zeros_like(img)
+            hs = slice(max(dh, 0), img.shape[0] + min(dh, 0))
+            ws = slice(max(dw, 0), img.shape[1] + min(dw, 0))
+            hsrc = slice(max(-dh, 0), img.shape[0] + min(-dh, 0))
+            wsrc = slice(max(-dw, 0), img.shape[1] + min(-dw, 0))
+            ref[hs, ws] = img[hsrc, wsrc]
+            return ref
+
+        matched = 0
+        moved = 0
+        for i in range(len(batch)):
+            candidates = [(dh, dw) for dh in (-1, 0, 1)
+                          for dw in (-1, 0, 1)]
+            hits = [(dh, dw) for dh, dw in candidates
+                    if numpy.array_equal(out[i],
+                                         shifted(batch[i], dh, dw))]
+            assert hits, "sample %d is not any +-1 shift" % i
+            matched += 1
+            if (0, 0) not in hits:
+                moved += 1
+        assert matched == len(batch)
+        assert moved > 0, "seeded shifts must actually move samples"
+        numpy.testing.assert_array_equal(
+            out, numpy.asarray(shift_batch(batch, 11, max_shift=1)))
+
     def test_shared_mirror_math(self):
         """Both engines trace ops.augment.mirror_batch: check its
         semantics directly — per-sample flip over the W axis, seeded."""

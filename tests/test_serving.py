@@ -2,6 +2,7 @@
 test_restful.py / test_web_status.py roles)."""
 
 import json
+import os
 import threading
 import urllib.request
 import urllib.error
@@ -259,6 +260,49 @@ class TestWebStatus:
         with urllib.request.urlopen(base + "/graph/my%20wf.svg",
                                     timeout=5) as resp:
             assert resp.read().decode().startswith("<svg")
+
+    def test_live_stream_pushes_plot_refresh(self, server):
+        """VERDICT r4 #7 (live plot viewing): /stream is an SSE feed —
+        one state event on connect, another when a plot file lands or
+        is re-rendered (mtime bump) — driving one full refresh cycle
+        the way the dashboard JS does."""
+        srv, tmp_path = server
+        srv.STREAM_POLL = 0.05
+        base = "http://127.0.0.1:%d" % srv.port
+        post(base + "/update", {"name": "wf-live", "mode": "master",
+                                "runtime": 1})
+
+        def next_event(resp):
+            payload = []
+            while True:
+                line = resp.readline().decode()
+                if line.startswith("data:"):
+                    payload.append(line[len("data:"):].strip())
+                elif line.strip() == "" and payload:
+                    return json.loads("".join(payload))
+
+        resp = urllib.request.urlopen(base + "/stream", timeout=10)
+        try:
+            first = next_event(resp)
+            assert first["workflows"][0]["name"] == "wf-live"
+            assert first["plots"] == []
+            # a plot renders -> the stream pushes the new state
+            (tmp_path / "loss.png").write_bytes(b"\x89PNG live")
+            second = next_event(resp)
+            assert second["plots"][0]["name"] == "loss.png"
+            stamp = second["plots"][0]["mtime"]
+            # re-render (mtime bump) -> another push with a new
+            # cache-buster
+            os.utime(tmp_path / "loss.png", (stamp + 5, stamp + 5))
+            third = next_event(resp)
+            assert third["plots"][0]["mtime"] == stamp + 5
+        finally:
+            resp.close()
+        # the polling fallback sees the same state
+        with urllib.request.urlopen(base + "/plots.json",
+                                    timeout=5) as r:
+            plots = json.loads(r.read().decode())
+        assert plots[0]["name"] == "loss.png"
 
     def test_notifier(self, server):
         srv, _ = server

@@ -84,13 +84,34 @@ class ForgeClient(Logger):
             query["version"] = version
         return self._request("/delete", query, data=b"")
 
+    def history(self, name):
+        """Chronological version timeline of a model."""
+        return self._request("/service", {"query": "history",
+                                          "name": name})
+
+    def diff(self, name, v_from, v_to):
+        """Manifest + file changes between two stored versions."""
+        return self._request("/service", {"query": "diff", "name": name,
+                                          "from": v_from, "to": v_to})
+
+    def register(self, email):
+        """Request an upload token for ``email``."""
+        req = urllib.request.Request(
+            self.base_url + "/register",
+            data=json.dumps({"email": email}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read().decode())
+
 
 def main(argv=None):
     """``veles_tpu forge`` subcommand entry (reference
     ``__main__.py:230-241`` wiring)."""
     parser = argparse.ArgumentParser(prog="veles_tpu forge")
     parser.add_argument("action", choices=("list", "details", "fetch",
-                                           "upload", "delete"))
+                                           "upload", "delete",
+                                           "history", "diff",
+                                           "register"))
     parser.add_argument("-s", "--server", default=None,
                         help="forge server base URL")
     parser.add_argument("-n", "--name", default=None)
@@ -98,6 +119,12 @@ def main(argv=None):
     parser.add_argument("-d", "--directory", default=None,
                         help="fetch destination / upload source")
     parser.add_argument("-t", "--token", default=None)
+    parser.add_argument("--from", dest="v_from", default=None,
+                        help="diff base version")
+    parser.add_argument("--to", dest="v_to", default=None,
+                        help="diff target version")
+    parser.add_argument("--email", default=None,
+                        help="register: the uploader email")
     args = parser.parse_args(argv)
     client = ForgeClient(args.server, args.token)
     if args.action == "list":
@@ -123,4 +150,17 @@ def main(argv=None):
             parser.error("delete needs -n NAME")
         print(json.dumps(client.delete(args.name, args.version),
                          indent=1))
+    elif args.action == "history":
+        if not args.name:
+            parser.error("history needs -n NAME")
+        print(json.dumps(client.history(args.name), indent=1))
+    elif args.action == "diff":
+        if not (args.name and args.v_from and args.v_to):
+            parser.error("diff needs -n NAME --from V1 --to V2")
+        print(json.dumps(client.diff(args.name, args.v_from,
+                                     args.v_to), indent=1))
+    elif args.action == "register":
+        if not args.email:
+            parser.error("register needs --email ADDRESS")
+        print(json.dumps(client.register(args.email), indent=1))
     return 0

@@ -76,6 +76,23 @@ def read_manifest(blob):
     return validate_manifest(manifest)
 
 
+def file_inventory(blob):
+    """Per-file metadata of a package: {name: {"size", "sha256"}} —
+    the diffable content record the server stores with every version
+    (the role of the reference's per-model git history)."""
+    import hashlib
+
+    out = {}
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            if not member.isfile():
+                continue
+            digest = hashlib.sha256(
+                tar.extractfile(member).read()).hexdigest()
+            out[member.name] = {"size": member.size, "sha256": digest}
+    return out
+
+
 def unpack(blob, dest):
     """Safely extract package bytes into ``dest``; returns the manifest."""
     os.makedirs(dest, exist_ok=True)

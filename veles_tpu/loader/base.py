@@ -284,6 +284,15 @@ class Loader(Unit):
         return bool(getattr(wf, "restored_from_snapshot", False)) \
             and self.shuffled_indices[TRAIN] is not None
 
+    def draw_transform_seeds(self, n):
+        """``n`` augmentation seeds in the SAME stream order graph-mode
+        ``fill_minibatch`` draws them — one per TRAIN minibatch (any
+        loader that exposes a ``jit_transform`` inherits this)."""
+        gen = prng.get(self.prng_key)
+        return numpy.asarray(
+            [int(gen.randint(0, 2 ** 31 - 1)) for _ in range(n)],
+            numpy.int64)
+
     def _shuffle_train(self):
         if self.shuffle_limit is not None \
                 and self.epoch_number >= self.shuffle_limit:

@@ -36,10 +36,23 @@ class FullBatchLoader(Loader):
         self.normalization_parameters = kwargs.pop(
             "normalization_parameters", {})
         self.validation_ratio = kwargs.pop("validation_ratio", None)
+        #: in-jit TRAIN-minibatch augmentation by name ("mirror",
+        #: "shift1" — ops/augment.TRANSFORMS); needs NHWC data. The
+        #: reference reached augmentation only through the image-loader
+        #: family (mirror/crop offsets, ``loader/image.py``); array
+        #: datasets get the same tier here
+        self.train_transform = kwargs.pop("train_transform", None)
         data = kwargs.pop("data", None)
         labels = kwargs.pop("labels", None)
         lengths = kwargs.pop("class_lengths", None)
         super().__init__(workflow, **kwargs)
+        if self.train_transform is not None:
+            from veles_tpu.ops.augment import TRANSFORMS
+            if self.train_transform not in TRANSFORMS:
+                raise ValueError(
+                    "unknown train_transform %r (known: %s)"
+                    % (self.train_transform,
+                       ", ".join(sorted(TRANSFORMS))))
         self.original_data = Array()
         self.original_labels = Array()
         self._provided_data = data
@@ -54,6 +67,10 @@ class FullBatchLoader(Loader):
             raise NotImplementedError(
                 "%s: override load_data() or pass data=" % self.name)
         data = numpy.asarray(self._provided_data, numpy.float32)
+        if self.train_transform is not None and data.ndim != 4:
+            raise ValueError(
+                "train_transform %r needs NHWC data, got shape %s"
+                % (self.train_transform, data.shape))
         self.original_data.reset(data)
         if self._provided_labels is not None:
             self._raw_labels = numpy.asarray(self._provided_labels)
@@ -137,10 +154,21 @@ class FullBatchLoader(Loader):
         self.minibatch_indices.reset(numpy.zeros(size, numpy.int64))
         self.sample_mask.reset(numpy.zeros(size, numpy.float32))
 
+    #: fused-engine contract (same as the image loaders): fill-time
+    #: transforms force graph mode unless the tick replicates them
+    @property
+    def has_fill_transforms(self):
+        return self.train_transform is not None
+
+    @property
+    def jit_transform(self):
+        return self.train_transform
+
     def init_unpickled(self):
         super().init_unpickled()
         self._fill_jit_ = None
         self._zero_labels_ = None
+        self._transform_jit_ = None
 
     @property
     def _fill_jit(self):
@@ -194,6 +222,13 @@ class FullBatchLoader(Loader):
         # device_put dispatch per tick
         batch, lab, mask = self._fill_jit(data, labels, indices,
                                           numpy.int32(valid))
+        if self.train_transform and self.minibatch_class == TRAIN:
+            if self._transform_jit_ is None:
+                from veles_tpu.ops.augment import TRANSFORMS
+                self._transform_jit_ = jax.jit(
+                    TRANSFORMS[self.train_transform])
+            batch = self._transform_jit_(
+                batch, int(self.draw_transform_seeds(1)[0]))
         self.minibatch_data.data = batch
         self.minibatch_labels.data = lab
         self.sample_mask.data = mask

@@ -163,14 +163,6 @@ class FullBatchImageLoader(FullBatchLoader):
         (seeded identically, so fused == graph numerics)."""
         return "mirror" if self.mirror == "random" else None
 
-    def draw_transform_seeds(self, n):
-        """``n`` augmentation seeds in the SAME stream order graph-mode
-        ``fill_minibatch`` draws them — one per TRAIN minibatch."""
-        gen = prng.get(self.prng_key)
-        return numpy.asarray(
-            [int(gen.randint(0, 2 ** 31 - 1)) for _ in range(n)],
-            numpy.int64)
-
     # -- image source contract ----------------------------------------------
     def get_keys(self, klass):
         raise NotImplementedError

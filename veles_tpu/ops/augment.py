@@ -18,3 +18,36 @@ def mirror_batch(batch, seed):
     flip = jax.random.bernoulli(key, 0.5, (batch.shape[0],))
     mirrored = jnp.flip(batch, axis=2)  # horizontal (W axis)
     return jnp.where(flip[:, None, None, None], mirrored, batch)
+
+
+def shift_batch(batch, seed, max_shift=1):
+    """Per-sample random integer translation of an NHWC batch by
+    [-max_shift, +max_shift] pixels in H and W, zero-filled — the
+    reference ImageLoader's random crop-offset augmentation
+    (``loader/image.py`` crop with random offsets) as one in-jit
+    gather."""
+    n, height, width = batch.shape[0], batch.shape[1], batch.shape[2]
+    key = jax.random.key(seed)
+    kh, kw = jax.random.split(key)
+    dh = jax.random.randint(kh, (n,), -max_shift, max_shift + 1)
+    dw = jax.random.randint(kw, (n,), -max_shift, max_shift + 1)
+    rows = jnp.arange(height)[None, :] - dh[:, None]      # (N, H) src
+    cols = jnp.arange(width)[None, :] - dw[:, None]       # (N, W) src
+    row_ok = (rows >= 0) & (rows < height)
+    col_ok = (cols >= 0) & (cols < width)
+    rows = jnp.clip(rows, 0, height - 1)
+    cols = jnp.clip(cols, 0, width - 1)
+    out = batch[jnp.arange(n)[:, None, None],
+                rows[:, :, None], cols[:, None, :], :]
+    mask = (row_ok[:, :, None] & col_ok[:, None, :])[..., None]
+    return jnp.where(mask, out, jnp.zeros((), batch.dtype))
+
+
+def shift1_batch(batch, seed):
+    """``shift_batch`` pinned to +-1 px (the "shift1" transform name)."""
+    return shift_batch(batch, seed, max_shift=1)
+
+
+#: transform name -> (batch, seed) fn: the loaders' ``jit_transform``
+#: names resolve here in BOTH engines (graph fill and fused tick)
+TRANSFORMS = {"mirror": mirror_batch, "shift1": shift1_batch}

@@ -340,6 +340,20 @@ def _load_cache():
     return _tuning_cache
 
 
+def _persist_cache(cache):
+    """Write the (already-updated) tuning cache to disk; shared by the
+    GEMM and int8-matvec autotuners."""
+    global _tuning_cache
+    _tuning_cache = cache
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fout:
+            json.dump(cache, fout, indent=1)
+    except OSError:
+        pass
+
+
 def _tuned_blocks(m, n, k, dtype):
     key = "%s:%d" % (dtype, _size_bucket(m, n, k))
     entry = _load_cache().get(key)
@@ -369,9 +383,30 @@ def autotune_main(argv=None):
     parser.add_argument("--dtype", default="bfloat16",
                         choices=("bfloat16", "float32"))
     parser.add_argument("--iters", type=int, default=3)
+    parser.add_argument("--int8", action="store_true",
+                        help="tune the int8 dequant-fused matvec "
+                             "(ops/quant.py) instead of the GEMM: "
+                             "shapes are MxKxN")
     args = parser.parse_args(argv)
     dtype = getattr(jnp, args.dtype)
     failed = 0
+    if args.int8:
+        from veles_tpu.ops.quant import autotune_int8
+        for spec in args.shapes.split(","):
+            m, k, n = (int(x) for x in spec.lower().split("x"))
+            decision = autotune_int8(m, k, n, dtype=dtype)
+            key = "int8:%dx%d" % (k, n)
+            try:
+                with open(_cache_path()) as fin:
+                    persisted = key in json.load(fin)
+            except (OSError, ValueError):
+                persisted = False
+            if not persisted:
+                failed += 1
+            print(json.dumps(dict(decision, shape=[m, k, n],
+                                  persisted=persisted,
+                                  cache=_cache_path())))
+        return 1 if failed else 0
     for spec in args.shapes.split(","):
         m, n, k = (int(x) for x in spec.lower().split("x"))
         blocks = autotune_matmul(m, n, k, dtype=dtype, iters=args.iters)
@@ -420,11 +455,5 @@ def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=3):
     cache = _load_cache()
     cache["%s:%d" % (str(jnp.dtype(dtype)), _size_bucket(m, n, k))] = {
         "blocks": list(best), "seconds": best_dt}
-    path = _cache_path()
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as fout:
-            json.dump(cache, fout, indent=1)
-    except OSError:
-        pass
+    _persist_cache(cache)
     return best

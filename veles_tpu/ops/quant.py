@@ -1,0 +1,235 @@
+"""Int8 weight-only quantization with a dequant-fused Pallas matvec.
+
+The serving decode loop is memory-bound: every token reads every weight
+matrix out of HBM (``parallel/decode.py``; the bf16 tier already bought
++~50% tokens/sec by halving that traffic). This module halves it AGAIN:
+weights live in HBM as int8 with one f32 scale per output channel, and
+the Pallas kernel dequantizes inside the matvec — the bf16/f32 weights
+never exist in HBM at all.
+
+Measured on TPU v5e (two-length scan timing, m=8 decode rows): the
+kernel beats XLA's fused-convert dot 6x on the qkv projection shape
+(k1024 x n3072 — XLA handles the non-power-of-two N badly) and ~1.3x
+on the 32k vocab head, and ties within noise on the square shapes —
+WHEN the in-kernel dequant matches the activation dtype (bf16 serving)
+and the lane block suits the shape. Those two knobs are what this
+module tunes; the decision persists in the same autotune cache as the
+Pallas GEMM blocks (``ops/gemm.py`` — the ``device_infos.json``
+descendant, reference ``backends.py:623-731``), and the runtime gate
+auto-engages the kernel only where it measured faster (the
+flash-attention >=4096 doctrine, VERDICT r4 #5).
+
+Quantization scheme: symmetric per-output-channel absmax
+(``q = round(w / scale)`` with ``scale = absmax / 127``), the standard
+W8A16 serving recipe — activations stay bf16/f32, so the only numeric
+change is the weight rounding (|error| <= scale/2 per element,
+``tests/test_quant.py``).
+
+No reference counterpart: VELES ships fp16 export precision at most
+(``workflow.py:864-971``); this is an additive serving tier.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+#: the Pallas path auto-engages below this many rows of x: the decode
+#: regime (M = batch) where the matvec is HBM-bound and the x block
+#: (M x K) stays a sliver of VMEM. Above it (prefill, training) the
+#: MXU-bound XLA dequant path wins and engages instead.
+PALLAS_MAX_ROWS = 256
+
+#: lane-block candidates per grid step (N must divide by the choice)
+BLOCK_N_CANDIDATES = (2048, 1024, 512)
+
+#: None = auto (tuned decision); True/False pin the kernel on/off for
+#: every auto-gated call — the bench's interleaved on/off comparison
+#: and emergency opt-out knob
+FORCE_PALLAS = None
+
+
+def quantize_int8(w):
+    """Symmetric per-output-channel int8 quantization of ``w`` (K, N):
+    returns ``(q int8 (K, N), scale f32 (N,))`` with
+    ``w ~= q * scale``. Zero columns get scale 1 (q = 0)."""
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _matvec_kernel(x_ref, q_ref, s_ref, o_ref):
+    # x (M, K) | q (K, BN) int8 | s (1, BN) f32 -> o (M, BN) f32.
+    # The int8 block widens to x's dtype in VMEM only (HBM saw one byte
+    # per weight); the MXU accumulates in f32 either way. bf16 x keeps
+    # the MXU on its native input width — measured faster than f32 at
+    # every shape that matters (see module docstring).
+    w = q_ref[:].astype(x_ref.dtype)
+    o_ref[:] = jnp.dot(x_ref[:], w,
+                       preferred_element_type=jnp.float32) * s_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _pallas_int8_matmul(x, q, scale, block_n, interpret=False):
+    from jax.experimental import pallas as pl
+
+    m, k = x.shape
+    n = q.shape[1]
+    return pl.pallas_call(
+        _matvec_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda j: (0, 0)),
+            pl.BlockSpec((k, block_n), lambda j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((m, block_n), lambda j: (0, j)),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, -1))
+
+
+def _default_block_n(k, n):
+    """Lane block when the shape has no tuned cache entry. From the
+    v5e sweep: the 32k vocab head wants 2048; mid-width projections
+    want 1024; 512 is the floor that still always fits VMEM."""
+    for candidate in BLOCK_N_CANDIDATES:
+        if n % candidate == 0 and (candidate < 2048 or n >= 16384):
+            return candidate
+    return 512 if n % 512 == 0 else None
+
+
+def _tuned_decision(m, k, n):
+    """(use_pallas, block_n) for this shape — the persisted autotune
+    verdict when one exists, else the measured-defaults heuristic.
+    The decode-regime row bound applies EITHER way: tuned entries are
+    measured at decode m, and a prefill/training call (m up to B x T)
+    would blow the kernel's whole-x VMEM block."""
+    if m > PALLAS_MAX_ROWS:
+        return False, None
+    from veles_tpu.ops import gemm
+
+    entry = gemm._load_cache().get("int8:%dx%d" % (k, n))
+    if entry:
+        return bool(entry.get("use_pallas")), entry.get("block_n")
+    block_n = _default_block_n(k, n)
+    ok = block_n is not None and k % 32 == 0
+    return ok, block_n
+
+
+def int8_matmul(x, q, scale, use_pallas=None, interpret=False):
+    """``x @ (q * scale)`` with the dequantization fused into the
+    product. ``x`` (M, K) float; ``q`` (K, N) int8; ``scale`` (N,) f32.
+    Returns (M, N) in ``x``'s dtype.
+
+    ``use_pallas=None`` auto-engages the Pallas kernel on TPU in the
+    decode regime per the tuned decision (persisted by
+    ``autotune_int8`` / heuristic defaults) — the measured-win gate.
+    Everywhere else the XLA formulation runs: dequant-to-x.dtype
+    feeding dot_general (prefill/training sizes are MXU-bound, where
+    XLA wins)."""
+    m, k = x.shape
+    n = q.shape[1]
+    block_n = None
+    if use_pallas is None and FORCE_PALLAS is not None:
+        use_pallas = FORCE_PALLAS
+    if use_pallas is None:
+        if jax.default_backend() in ("tpu", "axon"):
+            use_pallas, block_n = _tuned_decision(m, k, n)
+        else:
+            use_pallas = False
+    if use_pallas:
+        if block_n is None:
+            block_n = _default_block_n(k, n)
+        if block_n is not None and k % 32 == 0:
+            out = _pallas_int8_matmul(x, q, scale, block_n,
+                                      interpret=interpret)
+            return out.astype(x.dtype)
+    compute = x.dtype if x.dtype != jnp.float64 else jnp.float32
+    out = jnp.dot(x, q.astype(compute),
+                  preferred_element_type=jnp.float32)
+    return (out * scale).astype(x.dtype)
+
+
+def matmul_any(x, w):
+    """``x @ w`` where ``w`` is a dense array OR the quantized
+    ``{"q8", "scale"}`` dict — the single dispatch point the shared
+    transformer sublayer math routes through, so one code path serves
+    the fp32, bf16 and int8 tiers (leading dims of ``x`` are
+    flattened for the product)."""
+    if isinstance(w, dict):
+        lead = x.shape[:-1]
+        y = int8_matmul(x.reshape(-1, x.shape[-1]), w["q8"], w["scale"])
+        return y.reshape(lead + (w["q8"].shape[1],))
+    return x @ w
+
+
+def autotune_int8(m, k, n, dtype=jnp.bfloat16, repeats=4):
+    """Measure XLA vs the Pallas kernel over the lane-block candidates
+    for one (m, k, n) matvec on the current device, persist the winner
+    in the shared tuning cache, and return the decision dict.
+
+    Timing: a length-L ``lax.scan`` of the product at two L values —
+    the difference cancels dispatch and transfer constants (the same
+    tunnel-proof protocol as ``bench.py``)."""
+    import time
+
+    import numpy
+    from veles_tpu.ops import gemm
+
+    rng = numpy.random.RandomState(0)
+    x = jnp.asarray(rng.randn(m, k), dtype)
+    q = jnp.asarray(rng.randint(-127, 128, (k, n)), jnp.int8)
+    scale = jnp.asarray(rng.rand(n).astype(numpy.float32))
+
+    def measure(fn):
+        def loop(length):
+            @jax.jit
+            def run(x):
+                def body(carry, _):
+                    y = fn(carry)
+                    return carry + (jnp.sum(y) * 1e-38).astype(
+                        carry.dtype), ()
+                return jnp.sum(jax.lax.scan(
+                    body, x, None, length=length)[0])
+            return run
+        lengths = (200, 1400)
+        best = {}
+        for length in lengths:
+            run = loop(length)
+            float(run(x))  # compile + warm
+            t = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                float(run(x))
+                t = min(t, time.perf_counter() - t0)
+            best[length] = t
+        return (best[lengths[1]] - best[lengths[0]]) \
+            / (lengths[1] - lengths[0])
+
+    results = {"xla": measure(
+        lambda v: int8_matmul(v, q, scale, use_pallas=False))}
+    for block_n in BLOCK_N_CANDIDATES:
+        if n % block_n:
+            continue
+        try:
+            results["pallas_%d" % block_n] = measure(
+                lambda v, b=block_n: _pallas_int8_matmul(
+                    v, q, scale, b).astype(v.dtype))
+        except Exception:
+            continue
+    winner = min(results, key=results.get)
+    decision = {
+        "use_pallas": winner != "xla",
+        "block_n": (int(winner.split("_")[1])
+                    if winner != "xla" else None),
+        "seconds": results[winner],
+        "measured": {key: round(val * 1e6, 2)
+                     for key, val in results.items()},
+    }
+    cache = gemm._load_cache()
+    cache["int8:%dx%d" % (k, n)] = decision
+    gemm._persist_cache(cache)
+    return decision

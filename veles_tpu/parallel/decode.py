@@ -26,17 +26,41 @@ import jax.numpy as jnp
 from jax import lax
 
 from veles_tpu.ops.attention import attention
+from veles_tpu.ops.quant import matmul_any, quantize_int8
 # ONE copy of the sublayer math, shared with the training-side full
 # forward — the equivalence the module contract promises is structural
 from veles_tpu.parallel.transformer_step import _block_qkv, _head, _mlp
 
 
 def init_kv_cache(n_blocks, batch, max_len, heads, head_dim,
-                  dtype=jnp.float32):
-    """Static-shape cache: K/V per block, plus the filled length."""
+                  dtype=jnp.float32, quantized=False):
+    """Static-shape cache: K/V per block, plus the filled length.
+
+    ``quantized=True`` stores K/V as int8 with one f32 absmax scale per
+    (block, batch, position, head) — the KV half of the int8 serving
+    tier. At decode lengths the cache read rivals the weight read, so
+    this halves the OTHER half of the memory-bound loop's traffic."""
     shape = (n_blocks, batch, max_len, heads, head_dim)
+    if quantized:
+        sshape = (n_blocks, batch, max_len, heads)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32),
+                "length": jnp.zeros((), jnp.int32)}
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
             "length": jnp.zeros((), jnp.int32)}
+
+
+def _quantize_kv(x):
+    """Per-(batch, position, head) symmetric int8: (..., D) ->
+    (int8 (..., D), f32 scale (...,)). The quantization the cache
+    stores; one copy for prefill and decode appends."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale[..., 0]
 
 
 def prefill(params, x, heads, cache):
@@ -50,33 +74,52 @@ def prefill(params, x, heads, cache):
         ks.append(k)
         vs.append(v)
         # full causal attention over the prompt — the SAME gated op the
-        # training forward uses (flash kernel for prompts >= 4096)
+        # training forward uses (flash kernel for prompts >= 4096).
+        # With a quantized cache the prompt attention still runs on the
+        # exact K/V; only the CACHED copies are rounded (decode steps
+        # then attend against what was stored, like every later token).
         att = attention(q, k, v, causal=True)
-        x = x + att.reshape(batch, t, embed) @ blk["wout"] + blk["bout"]
+        x = x + matmul_any(att.reshape(batch, t, embed),
+                           blk["wout"]) + blk["bout"]
         x = _mlp(blk, x)
     logits = _head(params, x[:, -1])
-    cache = {
-        "k": lax.dynamic_update_slice(
-            cache["k"], jnp.stack(ks).astype(cache["k"].dtype),
-            (0, 0, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(
-            cache["v"], jnp.stack(vs).astype(cache["v"].dtype),
-            (0, 0, 0, 0, 0)),
-        "length": jnp.int32(t),
-    }
-    return logits, cache
+    k_all, v_all = jnp.stack(ks), jnp.stack(vs)
+    new = {"length": jnp.int32(t)}
+    if "k_scale" in cache:
+        for name, val in (("k", k_all), ("v", v_all)):
+            q8, scale = _quantize_kv(val)
+            new[name] = lax.dynamic_update_slice(
+                cache[name], q8, (0, 0, 0, 0, 0))
+            new[name + "_scale"] = lax.dynamic_update_slice(
+                cache[name + "_scale"], scale, (0, 0, 0, 0))
+    else:
+        new["k"] = lax.dynamic_update_slice(
+            cache["k"], k_all.astype(cache["k"].dtype), (0, 0, 0, 0, 0))
+        new["v"] = lax.dynamic_update_slice(
+            cache["v"], v_all.astype(cache["v"].dtype), (0, 0, 0, 0, 0))
+    return logits, new
 
 
-def _cache_attend(q, k_all, v_all, mask):
+def _cache_attend(q, k_all, v_all, mask, k_scale=None, v_scale=None):
     """Attention of query tokens against the cache prefix, f32 softmax:
     ONE copy of the math for the single-device and tensor-parallel
-    decode paths (the TP guarantee of token-identity depends on it)."""
+    decode paths (the TP guarantee of token-identity depends on it).
+
+    With an int8 cache the per-(position, head) dequant scales fold
+    OUTSIDE the dots: the score row multiplies by ``k_scale`` after the
+    q x K product, and ``v_scale`` folds into the softmax weights
+    before the p x V product — the int8 payloads feed the einsums
+    directly, so the wide K/V never materialize."""
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     # q (B,1,H,D) x cache K (B,L,H,D) -> (B,H,1,L)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(q.dtype),
                    preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:  # (B,L,H) -> (B,H,1,L)
+        s = s * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, :]
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, :]
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype),
                       v_all.astype(q.dtype),
                       preferred_element_type=jnp.float32)
@@ -88,21 +131,66 @@ def decode_step(params, x_tok, heads, cache):
     batch, _, embed = x_tok.shape
     length = cache["length"]
     max_len = cache["k"].shape[2]
+    quantized = "k_scale" in cache
     # positions [0, length] are valid (the new token attends to itself)
     mask = (jnp.arange(max_len) <= length)[None, None, None, :]
     x = x_tok
     new_k, new_v = cache["k"], cache["v"]
+    new_ks = cache.get("k_scale")
+    new_vs = cache.get("v_scale")
     for i, blk in enumerate(params["blocks"]):
         q, k, v = _block_qkv(blk, x, heads)
-        new_k = lax.dynamic_update_slice(
-            new_k, k[None].astype(new_k.dtype), (i, 0, length, 0, 0))
-        new_v = lax.dynamic_update_slice(
-            new_v, v[None].astype(new_v.dtype), (i, 0, length, 0, 0))
-        att = _cache_attend(q, new_k[i], new_v[i], mask).astype(x.dtype)
-        x = x + att.reshape(batch, 1, embed) @ blk["wout"] + blk["bout"]
+        if quantized:
+            kq, ks = _quantize_kv(k)
+            vq, vs = _quantize_kv(v)
+            new_k = lax.dynamic_update_slice(
+                new_k, kq[None], (i, 0, length, 0, 0))
+            new_v = lax.dynamic_update_slice(
+                new_v, vq[None], (i, 0, length, 0, 0))
+            new_ks = lax.dynamic_update_slice(
+                new_ks, ks[None], (i, 0, length, 0))
+            new_vs = lax.dynamic_update_slice(
+                new_vs, vs[None], (i, 0, length, 0))
+            att = _cache_attend(q, new_k[i], new_v[i], mask,
+                                k_scale=new_ks[i], v_scale=new_vs[i])
+        else:
+            new_k = lax.dynamic_update_slice(
+                new_k, k[None].astype(new_k.dtype), (i, 0, length, 0, 0))
+            new_v = lax.dynamic_update_slice(
+                new_v, v[None].astype(new_v.dtype), (i, 0, length, 0, 0))
+            att = _cache_attend(q, new_k[i], new_v[i], mask)
+        att = att.astype(x.dtype)
+        x = x + matmul_any(att.reshape(batch, 1, embed),
+                           blk["wout"]) + blk["bout"]
         x = _mlp(blk, x)
     logits = _head(params, x[:, 0])
-    return logits, {"k": new_k, "v": new_v, "length": length + 1}
+    new = {"k": new_k, "v": new_v, "length": length + 1}
+    if quantized:
+        new["k_scale"] = new_ks
+        new["v_scale"] = new_vs
+    return logits, new
+
+
+#: the decode-path weight matrices the int8 tier quantizes (everything
+#: the per-token loop reads in bulk; norms and biases stay fp)
+_QUANT_BLOCK_MATS = ("wqkv", "wout", "w1", "w2")
+
+
+def quantize_params(params):
+    """Weight-only int8 quantization of the decode-path matmuls
+    (``ops/quant.py`` W8A16 recipe): every block projection and the
+    vocab head become ``{"q8": int8, "scale": f32}`` leaves that
+    ``matmul_any`` dequantizes inside the product. Norms, biases and
+    the caller's embed table stay in the serving float dtype."""
+    qblocks = []
+    for blk in params["blocks"]:
+        qblk = dict(blk)
+        for name in _QUANT_BLOCK_MATS:
+            q, s = quantize_int8(blk[name])
+            qblk[name] = {"q8": q, "scale": s}
+        qblocks.append(qblk)
+    q, s = quantize_int8(params["head"])
+    return dict(params, blocks=qblocks, head={"q8": q, "scale": s})
 
 
 def _pick_token(logits, key, temperature, sample, top_k):
@@ -143,17 +231,34 @@ def _generate_jit(params, embed_table, prompt_x, heads, n_tokens, cache,
 
 
 def generate(params, embed_table, prompt_tokens, heads, n_tokens,
-             max_len=None, temperature=0.0, top_k=0, key=None):
+             max_len=None, temperature=0.0, top_k=0, key=None,
+             quantize=None):
     """Decode ``n_tokens`` after ``prompt_tokens`` (B, T) int32 —
     greedy by default; ``temperature > 0`` samples (optionally truncated
     to the ``top_k`` highest logits) from the reproducible ``key``
     (defaults to the framework's named "decode" PRNG stream).
+
+    ``quantize="int8"`` runs the W8A16 serving tier: the weight
+    matrices are absmax-quantized once up front and the per-token loop
+    reads them as int8 through the dequant-fused Pallas matvec
+    (``ops/quant.py``) — half the bf16 tier's HBM traffic on the
+    memory-bound loop. ``quantize="int8-kv"`` additionally stores the
+    KV cache as int8 with per-(position, head) scales — at decode
+    lengths the cache read rivals the weight read, so this halves the
+    other half too. Pass an already-``quantize_params``-ed pytree to
+    skip the requantization cost across calls.
 
     ``embed_table`` (vocab, E) maps tokens to the model's input
     embeddings (the toy model trains on pre-embedded x, so the table is
     the caller's). The prompt prefills the cache in one pass; the whole
     decode loop is one scan inside one jit with the cache donated.
     Returns ``(tokens (B, n_tokens), cache)``."""
+    if quantize not in (None, "none", "int8", "int8-kv"):
+        raise ValueError("quantize must be None, 'int8' or 'int8-kv', "
+                         "got %r" % (quantize,))
+    if quantize in ("int8", "int8-kv") \
+            and not isinstance(params["head"], dict):
+        params = quantize_params(params)
     batch, t = prompt_tokens.shape
     n_blocks = len(params["blocks"])
     embed = embed_table.shape[1]
@@ -176,7 +281,8 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
     # K/V traffic (comparable to the weight traffic at long context)
     # halves too — measured +~50% tokens/sec on the memory-bound loop
     cache = init_kv_cache(n_blocks, batch, max_len, heads, head_dim,
-                          dtype=embed_table.dtype)
+                          dtype=embed_table.dtype,
+                          quantized=quantize == "int8-kv")
     prompt_x = embed_table[prompt_tokens]
     toks, _, cache = _generate_jit(params, embed_table, prompt_x, heads,
                                    n_tokens, cache, key,
@@ -325,6 +431,10 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
 
     def run(params, embed_table, prompt_tokens):
         nonlocal param_specs
+        if isinstance(params["head"], dict):
+            raise ValueError(
+                "tensor-parallel decode takes unquantized params (the "
+                "int8 tier is single-device serving; TP shards bf16)")
         n_blocks = len(params["blocks"])
         embed = embed_table.shape[1]
         head_dim = embed // heads

@@ -336,12 +336,13 @@ def build_tick(specs, norm_type="none", mesh=None,
         return norm_cls.apply_state(jnp, batch, norm), lab
 
     def apply_augment(batch, seed):
-        if augment != "mirror":
+        # the SAME traced functions the graph path jits — numeric
+        # parity with the loaders' fill_minibatch is structural
+        from veles_tpu.ops.augment import TRANSFORMS
+        transform = TRANSFORMS.get(augment)
+        if transform is None:
             return batch
-        # the SAME traced function the graph path jits — numeric parity
-        # with FullBatchImageLoader.fill_minibatch is structural
-        from veles_tpu.ops.augment import mirror_batch
-        return mirror_batch(batch, seed)
+        return transform(batch, seed)
 
     def model_forward(wb, x):
         for fwd, p in zip(layer_fwds, wb):
@@ -520,7 +521,8 @@ def supports(workflow, mesh=None):
         # only for transforms the tick replicates in-jit itself
         # (single-device: per-sample randomness draws over the GLOBAL
         # minibatch, which a data-sharded tick could not reproduce)
-        if getattr(loader, "jit_transform", None) != "mirror" \
+        from veles_tpu.ops.augment import TRANSFORMS
+        if getattr(loader, "jit_transform", None) not in TRANSFORMS \
                 or mesh is not None:
             return False
     if extract_model_spec(workflow) is None:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from veles_tpu.ops.quant import matmul_any
 from veles_tpu.ops.attention import (attention, ring_attention,
                                      ulysses_attention)
 
@@ -63,7 +64,7 @@ def _block_qkv(blk, x, heads):
     """Pre-LN qkv projection: (B, T, E) -> three (B, T, H, D)."""
     batch, t, embed = x.shape
     h = _ln(x, blk["ln1_w"], blk["ln1_b"])
-    qkv = h @ blk["wqkv"] + blk["bqkv"]
+    qkv = matmul_any(h, blk["wqkv"]) + blk["bqkv"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shape = (batch, t, heads, embed // heads)
     return q.reshape(shape), k.reshape(shape), v.reshape(shape)
@@ -73,9 +74,12 @@ def _mlp(blk, x, reduce=None):
     """Pre-LN residual gelu MLP. ``reduce`` completes a sharded
     contraction (tensor-parallel decode passes a psum; ``b2`` is added
     AFTER it, so it stays replicated) — one copy of the math for the
-    single-device and TP paths alike."""
+    single-device and TP paths alike. The products route through
+    ``matmul_any`` so the int8 serving tier (``ops/quant.py``) shares
+    this exact sublayer math."""
     h = _ln(x, blk["ln2_w"], blk["ln2_b"])
-    y = jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"]
+    y = matmul_any(jax.nn.gelu(matmul_any(h, blk["w1"]) + blk["b1"]),
+                   blk["w2"])
     if reduce is not None:
         y = reduce(y)
     return x + y + blk["b2"]
@@ -83,7 +87,8 @@ def _mlp(blk, x, reduce=None):
 
 def _head(params, x):
     """Final layer norm + vocab projection."""
-    return _ln(x, params["lnf_w"], params["lnf_b"]) @ params["head"]
+    return matmul_any(_ln(x, params["lnf_w"], params["lnf_b"]),
+                      params["head"])
 
 
 def _forward(params, x, heads, seq_ax, sp_strategy):
@@ -96,7 +101,8 @@ def _forward(params, x, heads, seq_ax, sp_strategy):
             att = ulysses_attention(q, k, v, "seq", causal=True)
         else:
             att = attention(q, k, v, causal=True)
-        x = x + att.reshape(batch, t, embed) @ blk["wout"] + blk["bout"]
+        x = x + matmul_any(att.reshape(batch, t, embed),
+                           blk["wout"]) + blk["bout"]
         x = _mlp(blk, x)
     return _head(params, x)
 

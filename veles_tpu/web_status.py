@@ -34,7 +34,7 @@ from veles_tpu.core.logger import Logger
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>veles_tpu status</title>
-<meta http-equiv="refresh" content="3">
+<noscript><meta http-equiv="refresh" content="3"></noscript>
 <style>
  body { font-family: sans-serif; margin: 2em; }
  table { border-collapse: collapse; }
@@ -43,10 +43,49 @@ _PAGE = """<!DOCTYPE html>
 </style></head><body>
 <h1>veles_tpu status</h1>
 <h2>Workflows</h2>
-<table><tr><th>name</th><th>mode</th><th>slaves</th><th>runtime (s)</th>
-<th>updated</th></tr>%(rows)s</table>
-<h2>Workflow graphs</h2>%(graphs)s
-<h2>Plots</h2>%(plots)s
+<table id="wf"><tr><th>name</th><th>mode</th><th>slaves</th>
+<th>runtime (s)</th><th>updated</th></tr>%(rows)s</table>
+<h2>Workflow graphs</h2><div id="graphs">%(graphs)s</div>
+<h2>Plots</h2><div id="plots">%(plots)s</div>
+<script>
+// live updates over SSE (/stream): swap the table and re-point the
+// plot/graph <img> cache-busters when the server says state changed —
+// a running training is watchable without page reloads (the reference
+// streamed live plots over epgm multicast, graphics_server.py:100-133)
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, function(c) {
+    return {'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',
+            "'":'&#39;'}[c]; });
+}
+var src = new EventSource('/stream');
+src.onmessage = function(ev) {
+  var state = JSON.parse(ev.data);
+  var rows = ['<tr><th>name</th><th>mode</th><th>slaves</th>' +
+              '<th>runtime (s)</th><th>updated</th></tr>'];
+  (state.workflows || []).forEach(function(w) {
+    rows.push('<tr><td>' + esc(w.name) + '</td><td>' + esc(w.mode) +
+              '</td><td>' + (0 | w.slaves) + '</td><td>' +
+              Math.round(w.runtime) + '</td><td>' + esc(w.updated) +
+              '</td></tr>');
+  });
+  document.getElementById('wf').innerHTML = rows.join('');
+  var graphs = [];
+  (state.graphs || []).forEach(function(g) {
+    graphs.push('<h3>' + esc(g.name) + '</h3><img src="/graph/' +
+                encodeURIComponent(g.key) + '.svg?t=' + g.t +
+                '" style="max-width:100%%;border:1px solid #ccc"/>');
+  });
+  document.getElementById('graphs').innerHTML =
+    graphs.join('') || '<p>none</p>';
+  var plots = [];
+  (state.plots || []).forEach(function(p) {
+    plots.push('<img src="/plots/' + encodeURIComponent(p.name) +
+               '?t=' + p.mtime + '" alt="' + esc(p.name) + '"/>');
+  });
+  document.getElementById('plots').innerHTML =
+    plots.join('') || '<p>none</p>';
+};
+</script>
 </body></html>"""
 
 #: view-group fill colors for the live graph (the reference's viz.js
@@ -154,6 +193,8 @@ class WebStatusServer(Logger):
 
     #: drop master records not refreshed for this long (reference GC)
     STALE_AFTER = 3600.0
+    #: /stream server-side change-poll cadence (seconds)
+    STREAM_POLL = 0.5
 
     def __init__(self, port=None, host=None, plots_directory=None,
                  events_path=None):
@@ -167,6 +208,7 @@ class WebStatusServer(Logger):
         self._statuses = {}
         self._lock = threading.Lock()
         self._httpd = None
+        self._shutdown = threading.Event()
 
     def start(self):
         from http.server import BaseHTTPRequestHandler
@@ -197,6 +239,10 @@ class WebStatusServer(Logger):
                     reply(self, server.statuses())
                 elif self.path.startswith("/events"):
                     reply(self, server.tail_events())
+                elif self.path.startswith("/plots.json"):
+                    reply(self, server.plots_state())
+                elif self.path.startswith("/stream"):
+                    self._serve_stream()
                 elif self.path.startswith("/plots/"):
                     self._serve_plot(self.path[len("/plots/"):])
                 elif self.path.startswith("/graph/"):
@@ -222,6 +268,31 @@ class WebStatusServer(Logger):
                 else:
                     self.send_error(404)
 
+            def _serve_stream(self):
+                """SSE: one state event immediately, then one whenever a
+                plot mtime or a master status changes (polled server-side
+                every STREAM_POLL seconds). One thread per subscriber
+                (ThreadingHTTPServer); ends on client disconnect or
+                server shutdown."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                last = None
+                try:
+                    while not server._shutdown.is_set():
+                        state = server.live_state()
+                        digest = json.dumps(state, sort_keys=True)
+                        if digest != last:
+                            last = digest
+                            self.wfile.write(
+                                b"data: " + digest.encode() + b"\n\n")
+                            self.wfile.flush()
+                        server._shutdown.wait(server.STREAM_POLL)
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    pass  # subscriber went away
+
             def _serve_plot(self, name):
                 name = name.partition("?")[0]  # cache-buster query
                 directory = server.plots_directory
@@ -244,6 +315,7 @@ class WebStatusServer(Logger):
         return self
 
     def stop(self):
+        self._shutdown.set()  # wake + end the /stream subscriber loops
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
@@ -265,6 +337,46 @@ class WebStatusServer(Logger):
     def statuses(self):
         with self._lock:
             return dict(self._statuses)
+
+    def plots_state(self):
+        """The rendered plots as [{"name", "mtime"}] — the polling half
+        of the live view (and what /stream diffs against)."""
+        out = []
+        if self.plots_directory and os.path.isdir(self.plots_directory):
+            for path in sorted(glob.glob(
+                    os.path.join(self.plots_directory, "*.png"))):
+                try:
+                    mtime = int(os.stat(path).st_mtime)
+                except OSError:
+                    continue
+                out.append({"name": os.path.basename(path),
+                            "mtime": mtime})
+        return out
+
+    def live_state(self):
+        """The compact state snapshot /stream pushes: workflow rows,
+        graph stamps, plot mtimes — everything the live page redraws."""
+        workflows, graphs = [], []
+        for key, s in sorted(self.statuses().items()):
+            try:
+                runtime = float(s.get("runtime", 0))
+            except (TypeError, ValueError):
+                runtime = 0.0
+            slaves = s.get("slaves", [])
+            workflows.append({
+                "name": str(s.get("name", key)),
+                "mode": str(s.get("mode", "?")),
+                "slaves": len(slaves)
+                if isinstance(slaves, (list, tuple)) else 0,
+                "runtime": runtime,
+                "updated": time.strftime(
+                    "%X", time.localtime(s.get("updated", 0)))})
+            if isinstance(s.get("graph"), dict):
+                graphs.append({"key": key,
+                               "name": str(s.get("name", key)),
+                               "t": int(s.get("updated", 0))})
+        return {"workflows": workflows, "graphs": graphs,
+                "plots": self.plots_state()}
 
     def tail_events(self, limit=200):
         path = self.events_path
