@@ -211,6 +211,19 @@ class TestForgeRoundtrip:
         with pytest.raises(urllib.error.HTTPError) as err:
             registered.delete("toy-model")
         assert err.value.code == 403
+        # ...nor may ANOTHER registered identity add versions to a
+        # model it doesn't own (hijacking "latest" of someone else's
+        # model); the owner and the master token still can
+        other = self.client(
+            server, token=anon.register("eve@example.com")["token"])
+        d2 = make_model_dir(tmp_path / "hijack", version="9.9")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            other.upload(d2)
+        assert err.value.code == 403
+        registered.upload(make_model_dir(tmp_path / "own2",
+                                         version="2.0"))
+        self.client(server).upload(make_model_dir(tmp_path / "master3",
+                                                  version="3.0"))
         assert self.client(server).delete("toy-model")["deleted"]
 
     def test_fetched_model_runs(self, server, tmp_path):

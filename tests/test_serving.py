@@ -341,3 +341,166 @@ class TestWebStatus:
         url = re.search(r'src="(/plots/err\.png\?t=\d+)"', html).group(1)
         with urllib.request.urlopen(base + url, timeout=5) as resp:
             assert resp.read() == b"\x89PNG v1"
+
+
+class TestContinuousDecoder:
+    """Continuous batching: sequences joining mid-flight must decode
+    exactly what single-request generate() produces (VERDICT r4 #10)."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(0)
+        heads, embed, vocab = 4, 16, 11
+        params = init_transformer_params(rng, 2, embed, heads, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+        return params, table, heads, vocab
+
+    def test_staggered_requests_match_generate(self, model):
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(1)
+        prompts = [rng.randint(0, vocab, n) for n in (5, 3, 7, 4, 6)]
+        budgets = [6, 4, 5, 7, 3]
+
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=8)
+        # two requests start; the rest join as slots free up
+        ids = [dec.submit(prompts[0], budgets[0]),
+               dec.submit(prompts[1], budgets[1])]
+        dec.step()
+        ids.append(dec.submit(prompts[2], budgets[2]))  # queued: full
+        dec.step()
+        dec.step()
+        dec.step()  # request 1 (budget 4) retires here or earlier
+        ids.append(dec.submit(prompts[3], budgets[3]))
+        ids.append(dec.submit(prompts[4], budgets[4]))
+        results = dec.run_until_drained()
+
+        for rid, prompt, budget in zip(ids, prompts, budgets):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=budget)
+            assert results[rid] == numpy.asarray(want)[0].tolist(), \
+                "request %d diverged from single-request decode" % rid
+        assert not dec.busy
+        assert dec.tokens_out == sum(budgets)
+
+    def test_eos_retires_early_and_slot_recycles(self, model):
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(2)
+        prompt = rng.randint(0, vocab, 5)
+        ref, _ = generate(params, table, jnp.asarray(prompt)[None],
+                          heads, n_tokens=8)
+        ref = numpy.asarray(ref)[0].tolist()
+        eos = ref[2]
+        # a sequence stops at its FIRST eos occurrence (greedy decode
+        # often repeats tokens, so derive the expectation from ref)
+        expect = ref[:ref.index(eos) + 1]
+        dec = ContinuousDecoder(params, table, heads, slots=1,
+                                max_len=32, n_tokens=8, eos=eos)
+        first = dec.submit(prompt)
+        second = dec.submit(prompt)  # queued until the slot recycles
+        results = dec.run_until_drained()
+        assert results[first] == expect
+        assert results[second] == expect
+        assert len(expect) < len(ref)  # it really did stop early
+
+    def test_step_many_matches_stepwise(self, model):
+        """The chunked throughput mode produces the same token streams
+        as per-token stepping (tail tokens past a budget discarded)."""
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(3)
+        prompts = [rng.randint(0, vocab, n) for n in (4, 6, 5)]
+        budgets = [5, 9, 3]
+
+        ref = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=8)
+        ref_ids = [ref.submit(p, b) for p, b in zip(prompts, budgets)]
+        ref.run_until_drained()
+
+        fast = ContinuousDecoder(params, table, heads, slots=2,
+                                 max_len=32, n_tokens=8)
+        ids = [fast.submit(p, b) for p, b in zip(prompts, budgets)]
+        fast.run_until_drained(chunk=4)
+
+        for a, b in zip(ref_ids, ids):
+            assert ref.results[a] == fast.results[b]
+        assert fast.tokens_out == sum(budgets)
+
+    def test_drain_pipelined_matches_stepwise(self, model):
+        """The lag-1 pipelined drain (readback hidden behind the next
+        chunk) yields the same streams as per-token stepping."""
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(4)
+        prompts = [rng.randint(0, vocab, n) for n in (4, 6, 5, 3)]
+        budgets = [5, 9, 3, 7]
+
+        ref = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=48, n_tokens=9)
+        ref_ids = [ref.submit(p, b) for p, b in zip(prompts, budgets)]
+        ref.run_until_drained()
+
+        piped = ContinuousDecoder(params, table, heads, slots=2,
+                                  max_len=48, n_tokens=9)
+        ids = [piped.submit(p, b) for p, b in zip(prompts, budgets)]
+        piped.drain_pipelined(chunk=4)
+
+        for a, b in zip(ref_ids, ids):
+            assert ref.results[a] == piped.results[b]
+        assert piped.tokens_out == sum(budgets)
+        assert not piped.busy
+
+    def test_sampled_streams_match_generate_per_request(self, model):
+        """Temperature sampling: each request draws from its OWN key
+        stream (fold_in(base, rid)), so its tokens equal
+        generate(batch=1, key=that key) no matter which slot it lands
+        in or who shares the batch — and two requests with the same
+        prompt still differ."""
+        import jax
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(5)
+        prompts = [rng.randint(0, vocab, n) for n in (5, 5, 4)]
+        base = jax.random.key(99)
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=6,
+                                temperature=0.8, key=base)
+        ids = [dec.submit(p) for p in prompts]
+        results = dec.run_until_drained()
+        for rid, prompt in zip(ids, prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=6, temperature=0.8,
+                               key=jax.random.fold_in(base, rid))
+            assert results[rid] == numpy.asarray(want)[0].tolist(), \
+                "request %d sampled stream diverged" % rid
+        # same prompt, different request ids -> different streams
+        assert results[ids[0]] != results[ids[1]]
+
+    def test_budget_overflow_rejected(self, model):
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads, vocab = model
+        dec = ContinuousDecoder(params, table, heads, slots=1,
+                                max_len=16, n_tokens=8)
+        with pytest.raises(ValueError):
+            dec.submit(numpy.arange(12) % vocab)

@@ -193,6 +193,17 @@ class ForgeServer(Logger):
             model_dir = os.path.join(self.root_dir, name)
             os.makedirs(model_dir, exist_ok=True)
             meta = self._load_meta(name) or {"versions": {}}
+            # ownership: the first uploader owns the model name; later
+            # versions need the same identity or the master token —
+            # open registration must not allow hijacking another
+            # uploader's "latest" (every default fetch would run it)
+            owner = meta.get("owner")
+            if owner is None:
+                meta["owner"] = uploaded_by or "anonymous"
+            elif uploaded_by not in (owner, "master"):
+                raise PermissionError(
+                    "%s is owned by %s; only the owner or the master "
+                    "token may add versions" % (name, owner))
             if version in meta["versions"]:
                 raise ValueError("%s version %s already exists"
                                  % (name, version))
@@ -363,6 +374,8 @@ class ForgeServer(Logger):
                         reply(self, server.upload(read_body(self),
                                                   query.get("version"),
                                                   uploaded_by=identity))
+                    except PermissionError as exc:
+                        reply(self, {"error": str(exc)}, code=403)
                     except (ValueError, TypeError, OSError) as exc:
                         reply(self, {"error": str(exc)}, code=400)
                 elif path == "/delete":

@@ -39,7 +39,14 @@ def attention(q, k, v, causal=False, scale=None):
         q, k, v, scale=scale, is_causal=causal)
 
 
+#: None = auto (the measured >=4096 gate); True/False pin the flash
+#: kernel for every call — the bench's interleaved on/off comparison
+FORCE_FLASH = None
+
+
 def _use_pallas_flash(q, k):
+    if FORCE_FLASH is not None:
+        return FORCE_FLASH
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     # MEASURED crossover on the v5e (two-length device timing, causal,

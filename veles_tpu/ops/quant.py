@@ -174,8 +174,6 @@ def autotune_int8(m, k, n, dtype=jnp.bfloat16, repeats=4):
     Timing: a length-L ``lax.scan`` of the product at two L values —
     the difference cancels dispatch and transfer constants (the same
     tunnel-proof protocol as ``bench.py``)."""
-    import time
-
     import numpy
     from veles_tpu.ops import gemm
 
@@ -184,30 +182,11 @@ def autotune_int8(m, k, n, dtype=jnp.bfloat16, repeats=4):
     q = jnp.asarray(rng.randint(-127, 128, (k, n)), jnp.int8)
     scale = jnp.asarray(rng.rand(n).astype(numpy.float32))
 
+    # ONE copy of the tunnel-proof serialized-scan timing protocol
+    # (gemm._matmul_scan_time) serves the GEMM and int8 autotuners
     def measure(fn):
-        def loop(length):
-            @jax.jit
-            def run(x):
-                def body(carry, _):
-                    y = fn(carry)
-                    return carry + (jnp.sum(y) * 1e-38).astype(
-                        carry.dtype), ()
-                return jnp.sum(jax.lax.scan(
-                    body, x, None, length=length)[0])
-            return run
-        lengths = (200, 1400)
-        best = {}
-        for length in lengths:
-            run = loop(length)
-            float(run(x))  # compile + warm
-            t = float("inf")
-            for _ in range(repeats):
-                t0 = time.perf_counter()
-                float(run(x))
-                t = min(t, time.perf_counter() - t0)
-            best[length] = t
-        return (best[lengths[1]] - best[lengths[0]]) \
-            / (lengths[1] - lengths[0])
+        return gemm._matmul_scan_time(fn, x, lengths=(200, 1400),
+                                      repeats=repeats)
 
     results = {"xla": measure(
         lambda v: int8_matmul(v, q, scale, use_pallas=False))}

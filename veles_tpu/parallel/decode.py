@@ -63,10 +63,17 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), scale[..., 0]
 
 
-def prefill(params, x, heads, cache):
+def prefill(params, x, heads, cache, length=None):
     """Run the prompt (B, T, E) once, filling ``cache`` positions
     [0, T); returns ``(last_logits, cache)`` with ``last_logits``
-    (B, vocab) for the first generated token."""
+    (B, vocab) for the first generated token.
+
+    ``length`` (traced scalar, default T) supports right-PADDED
+    prompts: the causal mask means pad positions past ``length`` never
+    influence the real positions' K/V, the logits read from position
+    ``length - 1``, and the cache length is ``length`` — so one
+    compiled program serves a whole bucket of prompt lengths (the
+    continuous-batching admission path)."""
     batch, t, embed = x.shape
     ks, vs = [], []
     for blk in params["blocks"]:
@@ -82,9 +89,16 @@ def prefill(params, x, heads, cache):
         x = x + matmul_any(att.reshape(batch, t, embed),
                            blk["wout"]) + blk["bout"]
         x = _mlp(blk, x)
-    logits = _head(params, x[:, -1])
+    if length is None:
+        last = x[:, -1]
+        cache_len = jnp.int32(t)
+    else:
+        cache_len = jnp.int32(length)
+        last = lax.dynamic_slice_in_dim(x, cache_len - 1, 1,
+                                        axis=1)[:, 0]
+    logits = _head(params, last)
     k_all, v_all = jnp.stack(ks), jnp.stack(vs)
-    new = {"length": jnp.int32(t)}
+    new = {"length": cache_len}
     if "k_scale" in cache:
         for name, val in (("k", k_all), ("v", v_all)):
             q8, scale = _quantize_kv(val)
@@ -225,8 +239,12 @@ def _generate_jit(params, embed_table, prompt_x, heads, n_tokens, cache,
         logits, cache = decode_step(params, x_tok, heads, cache)
         return (cache, logits), tok
 
-    (cache, logits), toks = lax.scan(body, (cache, logits),
-                                     jax.random.split(key, n_tokens))
+    # per-step keys by fold_in(key, step) — the SAME derivation the
+    # continuous-batching slot engine uses per (request key, step), so
+    # a slot's sampled stream reproduces generate(batch=1) exactly
+    step_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, jnp.arange(n_tokens))
+    (cache, logits), toks = lax.scan(body, (cache, logits), step_keys)
     return jnp.swapaxes(toks, 0, 1), logits, cache
 
 
@@ -289,6 +307,147 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
                                    jnp.float32(temperature or 1.0),
                                    bool(temperature), int(top_k))
     return toks, cache
+
+
+# -- continuous batching (slot engine) ----------------------------------------
+#
+# The serving tier's per-request loop: a fixed pool of cache SLOTS, each
+# holding one in-flight sequence at its own length. New requests prefill
+# into a free slot while other slots keep decoding — the "continuous
+# batching" serving recipe (beyond-reference; VELES's serving analogue
+# batches per tick, ``restful_api.py:78-215``). The math per slot is
+# decode_step's exactly (same _block_qkv/_cache_attend/_head), with the
+# scalar cache length generalized to a per-slot vector and the appends
+# generalized from dynamic_update_slice to per-slot scatters.
+
+
+def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
+                    dtype=jnp.float32):
+    """Cache + control state for ``slots`` concurrent sequences."""
+    shape = (n_blocks, slots, max_len, heads, head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+        "lengths": jnp.zeros((slots,), jnp.int32),
+        "logits": jnp.zeros((slots, vocab), jnp.float32),
+        # per-slot sampling stream: the request's key + how many tokens
+        # it has generated (step key = fold_in(req_key, step) — the
+        # derivation generate() shares, so sampled streams match)
+        "req_key": jax.random.split(jax.random.key(0), slots),
+        "step": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("heads",),
+                   donate_argnames=("state",))
+def slot_admit(params, embed_table, heads, state, slot, prompt_x,
+               req_key=None, length=None):
+    """Prefill ``prompt_x`` (1, T, E) into slot ``slot`` (traced scalar
+    — one compiled program serves every slot). Overwrites the slot's
+    whole cache lane, so stale state from a retired sequence never
+    leaks into the new one. ``req_key`` seeds the slot's sampling
+    stream (ignored by greedy serving); ``length`` (traced) marks the
+    true prompt length of a right-padded ``prompt_x`` — the admission
+    path pads to buckets so a new prompt LENGTH doesn't mean a new XLA
+    compile stalling every in-flight slot."""
+    max_len = state["k"].shape[2]
+    n_blocks = state["k"].shape[0]
+    heads_n, head_dim = state["k"].shape[3], state["k"].shape[4]
+    tmp = init_kv_cache(n_blocks, 1, max_len, heads_n, head_dim,
+                        dtype=state["k"].dtype)
+    logits, tmp = prefill(params, prompt_x, heads, tmp, length=length)
+    if req_key is None:
+        req_key = jax.random.key(0)
+    return dict(
+        state,
+        k=lax.dynamic_update_slice(state["k"], tmp["k"],
+                                   (0, slot, 0, 0, 0)),
+        v=lax.dynamic_update_slice(state["v"], tmp["v"],
+                                   (0, slot, 0, 0, 0)),
+        lengths=lax.dynamic_update_slice(
+            state["lengths"], tmp["length"][None], (slot,)),
+        logits=lax.dynamic_update_slice(
+            state["logits"], logits.astype(jnp.float32), (slot, 0)),
+        req_key=state["req_key"].at[slot].set(req_key),
+        step=state["step"].at[slot].set(0),
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("heads", "sample", "top_k"),
+                   donate_argnames=("state",))
+def slot_step(params, embed_table, heads, state, active,
+              temperature=1.0, sample=False, top_k=0):
+    """One decode step across ALL slots; ``active`` (S,) bool gates
+    which slots advance (inactive slots' lanes are computed but their
+    lengths/logits stay frozen and their emitted token is meaningless —
+    the host filters by its own active set). Greedy by default;
+    ``sample=True`` draws per slot from its own key stream
+    (``fold_in(req_key, step)``) so a slot's sampled tokens equal
+    ``generate(batch=1, key=req_key)``'s. Returns ``(state, emitted
+    (S,))`` where ``emitted[s]`` is the token slot ``s`` generates THIS
+    step — picked from the pre-step logits, matching ``generate``'s
+    emission order (its first emitted token comes from the prefill
+    logits)."""
+    slots = state["lengths"].shape[0]
+    max_len = state["k"].shape[2]
+    lengths = state["lengths"]
+    if sample:
+        step_keys = jax.vmap(jax.random.fold_in)(state["req_key"],
+                                                 state["step"])
+        # inner shape (1, V): the SAME categorical shape generate's
+        # batch-1 path draws, so the random bits match exactly
+        tok_in = jax.vmap(
+            lambda l, k: _pick_token(l[None], k, temperature, True,
+                                     top_k)[0])(state["logits"],
+                                                step_keys)
+    else:
+        tok_in = jnp.argmax(state["logits"], axis=-1)
+    x = embed_table[tok_in][:, None, :]
+    # per-slot mask: position p of slot s is visible iff p <= length[s]
+    # (the new token attends to itself at index length[s])
+    mask = (jnp.arange(max_len)[None, :]
+            <= lengths[:, None])[:, None, None, :]
+    rows = jnp.arange(slots)
+    new_k, new_v = state["k"], state["v"]
+    for i, blk in enumerate(params["blocks"]):
+        q, k, v = _block_qkv(blk, x, heads)
+        # per-slot append at each slot's own length (scatter — the
+        # slots sit at different positions, unlike decode_step's
+        # uniform dynamic_update_slice)
+        new_k = new_k.at[i, rows, lengths].set(
+            k[:, 0].astype(new_k.dtype))
+        new_v = new_v.at[i, rows, lengths].set(
+            v[:, 0].astype(new_v.dtype))
+        att = _cache_attend(q, new_k[i], new_v[i], mask).astype(x.dtype)
+        x = x + matmul_any(att.reshape(slots, 1, -1),
+                           blk["wout"]) + blk["bout"]
+        x = _mlp(blk, x)
+    logits = _head(params, x[:, 0]).astype(jnp.float32)
+    new_state = dict(
+        state, k=new_k, v=new_v,
+        lengths=jnp.where(active, lengths + 1, lengths),
+        logits=jnp.where(active[:, None], logits, state["logits"]),
+        step=jnp.where(active, state["step"] + 1, state["step"]),
+    )
+    return new_state, tok_in
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("heads", "n", "sample", "top_k"),
+                   donate_argnames=("state",))
+def slot_step_many(params, embed_table, heads, state, active, n,
+                   temperature=1.0, sample=False, top_k=0):
+    """``n`` lockstep ``slot_step``s as ONE ``lax.scan`` dispatch —
+    the throughput mode: admission happens between chunks, so a
+    high-RTT host pays one round trip per ``n`` tokens instead of per
+    token. Returns ``(state, emitted (n, S))``; the host discards a
+    slot's tail tokens past its budget/eos."""
+    def body(state, _):
+        state, emitted = slot_step(params, embed_table, heads, state,
+                                   active, temperature, sample, top_k)
+        return state, emitted
+
+    return lax.scan(body, state, None, length=n)
 
 
 # -- tensor-parallel decode (Megatron-style weight sharding) ------------------

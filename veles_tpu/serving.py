@@ -319,3 +319,255 @@ class RESTfulAPI(Unit):
                                    if isinstance(value, numpy.ndarray)
                                    else float(value))
             responder["event"].set()
+
+
+class ContinuousDecoder:
+    """Continuous-batching LLM serving on the slot engine
+    (``parallel/decode.py`` ``init_slot_state``/``slot_admit``/
+    ``slot_step``): a fixed pool of KV-cache slots decodes in lockstep
+    while new requests prefill into free slots MID-FLIGHT — no
+    generation restarts, no waiting for the batch to drain (the
+    beyond-reference serving tier; VELES's analogue batched per tick,
+    ``restful_api.py:78-215``).
+
+    Host-side single-threaded driver: call :meth:`submit` any time,
+    then :meth:`step` repeatedly (or :meth:`run_until_drained`); each
+    step admits queued requests into free slots and advances every
+    active slot by one token. Greedy by default, ``temperature > 0``
+    samples per request from ``fold_in(base_key, request_id)``;
+    per-request token budget ``n_tokens`` (or per-submit override),
+    optional ``eos`` token that retires a sequence early. Tokens stream
+    into ``results[request_id]`` as they are generated.
+
+    Numerical contract: a request's stream equals single-request
+    ``generate()``'s math-for-math (same sublayer fns, same per-step
+    sampling keys) — asserted exactly on CPU. On TPU, batching S slots
+    changes XLA's matmul tiling vs a batch-1 run, so logits can wobble
+    at the 1e-2 level and near-tied argmaxes may break differently;
+    trained models (clear logit margins) are unaffected, random-weight
+    toys can diverge at ties."""
+
+    def __init__(self, params, embed_table, heads, slots=4,
+                 max_len=512, n_tokens=32, eos=None,
+                 temperature=0.0, top_k=0, key=None):
+        import collections
+
+        import jax
+
+        from veles_tpu.parallel.decode import init_slot_state
+
+        self.params = params
+        self.embed_table = embed_table
+        self.heads = heads
+        self.slots = slots
+        self.max_len = max_len
+        self.n_tokens = n_tokens
+        self.eos = eos
+        #: temperature > 0 samples; each request draws from its OWN
+        #: key stream fold_in(base_key, request_id), so its tokens
+        #: equal generate(batch=1, key=that key) regardless of which
+        #: slot it lands in or who shares the batch
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.base_key = key if key is not None else jax.random.key(0)
+        n_blocks = len(params["blocks"])
+        embed = embed_table.shape[1]
+        vocab = embed_table.shape[0]
+        self.state = init_slot_state(
+            n_blocks, slots, max_len, heads, embed // heads, vocab,
+            dtype=embed_table.dtype)
+        self._queue = collections.deque()
+        self._free = list(range(slots))
+        self._slot_req = {}      # slot -> request id
+        self._budget = {}        # request id -> tokens still wanted
+        self.results = {}        # request id -> [token, ...]
+        self._next_id = 0
+        self.steps = 0
+        self.tokens_out = 0
+
+    def submit(self, prompt_tokens, n_tokens=None):
+        """Queue one prompt (1-D int sequence); returns the request id.
+        The prompt is admitted into a slot on a later :meth:`step` when
+        one is free."""
+        prompt = numpy.asarray(prompt_tokens, numpy.int32).reshape(-1)
+        budget = n_tokens if n_tokens is not None else self.n_tokens
+        if len(prompt) + budget > self.max_len:
+            raise ValueError(
+                "prompt %d + n_tokens %d exceeds max_len %d"
+                % (len(prompt), budget, self.max_len))
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, budget))
+        self.results[rid] = []
+        self._budget[rid] = budget
+        return rid
+
+    @property
+    def busy(self):
+        return bool(self._queue or self._slot_req)
+
+    @staticmethod
+    def _bucket(n):
+        """Prompt-length bucket: next power of two (min 16). Admission
+        right-pads to the bucket so XLA compiles ONE prefill program
+        per bucket instead of one per distinct prompt length (a fresh
+        multi-second compile per request would stall every in-flight
+        slot)."""
+        bucket = 16
+        while bucket < n:
+            bucket *= 2
+        return bucket
+
+    def _admit_pending(self):
+        import jax
+
+        from veles_tpu.parallel.decode import slot_admit
+
+        while self._queue and self._free:
+            rid, prompt, _ = self._queue.popleft()
+            slot = self._free.pop()
+            true_len = len(prompt)
+            bucket = min(self._bucket(true_len), self.max_len)
+            padded = numpy.zeros(bucket, numpy.int32)
+            padded[:true_len] = prompt
+            x = self.embed_table[jnp.asarray(padded)][None]
+            req_key = jax.random.fold_in(self.base_key, rid)
+            self.state = slot_admit(self.params, self.embed_table,
+                                    self.heads, self.state,
+                                    jnp.int32(slot), x,
+                                    req_key=req_key,
+                                    length=jnp.int32(true_len))
+            self._slot_req[slot] = rid
+
+    def step(self):
+        """Admit what fits, advance every active slot one token; returns
+        {request_id: token} for the tokens generated this step."""
+        from veles_tpu.parallel.decode import slot_step
+
+        self._admit_pending()
+        if not self._slot_req:
+            return {}
+        active = numpy.zeros(self.slots, bool)
+        for slot in self._slot_req:
+            active[slot] = True
+        self.state, emitted = slot_step(
+            self.params, self.embed_table, self.heads, self.state,
+            jnp.asarray(active), jnp.float32(self.temperature or 1.0),
+            sample=bool(self.temperature), top_k=self.top_k)
+        emitted = numpy.asarray(emitted)
+        out = {}
+        for slot, rid in list(self._slot_req.items()):
+            token = int(emitted[slot])
+            self.results[rid].append(token)
+            out[rid] = token
+            self.tokens_out += 1
+            self._budget[rid] -= 1
+            done = self._budget[rid] <= 0 or (
+                self.eos is not None and token == self.eos)
+            if done:
+                del self._slot_req[slot]
+                del self._budget[rid]
+                self._free.append(slot)
+        self.steps += 1
+        return out
+
+    def step_many(self, n):
+        """``n`` decode steps as ONE device dispatch (throughput mode
+        for high-RTT hosts — one round trip per ``n`` tokens).
+        Admission happens before the chunk; a request finishing
+        mid-chunk has its tail tokens discarded and its slot recycles
+        at the chunk boundary. Returns {request_id: [tokens...]}."""
+        dispatched = self._dispatch_chunk(n)
+        if dispatched is None:
+            return {}
+        return self._collect(*dispatched)
+
+    def _collect(self, emitted, snapshot):
+        """Account one chunk's tokens against the requests that were
+        assigned when it was DISPATCHED (``snapshot``). Requests that
+        finished in a previous chunk (pipelined mode keeps their slot
+        active one extra chunk) are skipped; tail tokens past a budget
+        or eos are discarded."""
+        emitted = numpy.asarray(emitted)  # (chunk, slots) — syncs
+        out = {}
+        for slot, rid in snapshot.items():
+            if rid not in self._budget:
+                continue  # retired while this chunk was in flight
+            stream = emitted[:, slot].tolist()
+            keep = min(self._budget[rid], len(stream))
+            tokens = stream[:keep]
+            if self.eos is not None and self.eos in tokens:
+                tokens = tokens[:tokens.index(self.eos) + 1]
+            self.results[rid].extend(tokens)
+            out[rid] = tokens
+            self.tokens_out += len(tokens)
+            self._budget[rid] -= len(tokens)
+            done = self._budget[rid] <= 0 or (
+                self.eos is not None and tokens
+                and tokens[-1] == self.eos)
+            if done:
+                del self._budget[rid]
+                if self._slot_req.get(slot) == rid:
+                    del self._slot_req[slot]
+                    self._free.append(slot)
+        return out
+
+    def _dispatch_chunk(self, chunk):
+        """Admit what fits and enqueue one chunk; returns the
+        un-materialized emitted tokens + the slot assignment at
+        dispatch time (or None when nothing is active)."""
+        from veles_tpu.parallel.decode import slot_step_many
+
+        self._admit_pending()
+        if not self._slot_req:
+            return None
+        active = numpy.zeros(self.slots, bool)
+        for slot in self._slot_req:
+            active[slot] = True
+        self.state, emitted = slot_step_many(
+            self.params, self.embed_table, self.heads, self.state,
+            jnp.asarray(active), chunk,
+            jnp.float32(self.temperature or 1.0),
+            sample=bool(self.temperature), top_k=self.top_k)
+        self.steps += chunk
+        return emitted, dict(self._slot_req)
+
+    def drain_pipelined(self, chunk, max_steps=100000, admit=None):
+        """Throughput drain: chunk N's tokens are read back while chunk
+        N+1 is already computing, so the host round trip (the dominant
+        cost on a remote/tunneled device) hides behind device compute.
+        Retirement and admission decisions lag one chunk — a finished
+        slot decodes one extra chunk whose tokens are discarded (its
+        cache lane is fully overwritten on the next admit), which is
+        the price of keeping the device queue fed. Token streams are
+        identical to the unpipelined drain. ``admit`` is an optional
+        zero-arg callable invoked once per pass — the caller's
+        staggered-submission hook (requests joining mid-flight)."""
+        pending = None
+        for _ in range(max_steps):
+            if admit is not None:
+                admit()
+            current = self._dispatch_chunk(chunk)
+            if pending is not None:
+                self._collect(*pending)
+            pending = current
+            if pending is None:
+                if not self.busy:
+                    return self.results
+                # nothing active but requests queued (all slots were
+                # busy at dispatch time): loop admits them next pass
+        raise RuntimeError("decoder did not drain in %d steps"
+                           % max_steps)
+
+    def run_until_drained(self, max_steps=100000, chunk=1):
+        """Drive the decoder until every submitted request finished
+        (``chunk`` > 1 uses :meth:`step_many` between admissions)."""
+        for _ in range(max_steps):
+            if not self.busy:
+                return self.results
+            if chunk > 1:
+                self.step_many(chunk)
+            else:
+                self.step()
+        raise RuntimeError("decoder did not drain in %d steps"
+                           % max_steps)
