@@ -226,6 +226,32 @@ class TestForgeRoundtrip:
                                                   version="3.0"))
         assert self.client(server).delete("toy-model")["deleted"]
 
+    def test_legacy_store_owner_seeded_from_history(self, server,
+                                                    tmp_path):
+        """A meta.json written before the ownership feature (no
+        'owner' key) must seed the owner from the recorded uploader
+        history — NOT let the next registered uploader claim it."""
+        client = self.client(server)
+        client.upload(make_model_dir(tmp_path))
+        # simulate a pre-ownership store
+        meta_path = os.path.join(server.root_dir, "toy-model",
+                                 "meta.json")
+        meta = json.load(open(meta_path))
+        del meta["owner"]
+        json.dump(meta, open(meta_path, "w"))
+
+        anon = self.client(server, token=None)
+        eve = self.client(
+            server, token=anon.register("eve@example.com")["token"])
+        with pytest.raises(urllib.error.HTTPError) as err:
+            eve.upload(make_model_dir(tmp_path / "legacy-hijack",
+                                      version="9.0"))
+        assert err.value.code == 403
+        # the historical uploader (the master token) still can
+        client.upload(make_model_dir(tmp_path / "legit",
+                                     version="2.0"))
+        assert json.load(open(meta_path))["owner"] == "master"
+
     def test_fetched_model_runs(self, server, tmp_path):
         """The full hub story: upload, fetch, run the fetched workflow."""
         import veles_tpu

@@ -133,14 +133,11 @@ def test_generate_int8_accepts_prequantized():
                                      numpy.asarray(t2))
 
 
-def test_cache_attend_scale_folding_matches_explicit_dequant():
-    """The int8-cache attention folds k_scale into the score row and
-    v_scale into the softmax weights; both must equal attending against
-    explicitly dequantized fp K/V (pure reassociation)."""
-    from veles_tpu.parallel.decode import _cache_attend, _quantize_kv
+def _attend_fixture(batch=2, length=7, heads=3, dim=8, seed=8):
+    """(q, head-major int8 K/V + scales, equivalent fp K/V, mask)."""
+    from veles_tpu.parallel.decode import _quantize_kv
 
-    rng = numpy.random.RandomState(8)
-    batch, length, heads, dim = 2, 7, 3, 8
+    rng = numpy.random.RandomState(seed)
     q = jnp.asarray(rng.randn(batch, 1, heads, dim).astype(
         numpy.float32))
     k = jnp.asarray(rng.randn(batch, length, heads, dim).astype(
@@ -149,14 +146,53 @@ def test_cache_attend_scale_folding_matches_explicit_dequant():
         numpy.float32))
     kq, ks = _quantize_kv(k)
     vq, vs = _quantize_kv(v)
-    mask = jnp.ones((1, 1, 1, length), bool)
-    got = _cache_attend(q, kq, vq, mask, k_scale=ks, v_scale=vs)
+    # (B,T,H,D) -> head-major (B,H,D,T); scales (B,T,H) -> (B,H,T)
+    to_hm = lambda a: jnp.transpose(a, (0, 2, 3, 1))  # noqa: E731
+    return (q, to_hm(kq), jnp.transpose(ks, (0, 2, 1)), to_hm(vq),
+            jnp.transpose(vs, (0, 2, 1)), k, v, kq, ks, vq, vs)
+
+
+def test_cache_attend_scale_folding_matches_explicit_dequant():
+    """int8_cache_attend (XLA formulation, head-major layout) folds
+    k_scale into the score row and v_scale into the softmax weights;
+    it must equal attending against explicitly dequantized fp K/V
+    through the plain _cache_attend (pure reassociation + layout)."""
+    from veles_tpu.parallel.decode import _cache_attend
+    from veles_tpu.ops.quant import int8_cache_attend
+
+    (q, khm, kshm, vhm, vshm, _, _, kq, ks, vq, vs) = _attend_fixture()
+    length, dim = kq.shape[1], q.shape[-1]
+    inv = 1.0 / numpy.sqrt(dim)
+    mask_addend = jnp.zeros(length, jnp.float32)
+    got = int8_cache_attend(q * inv, khm, kshm, vhm, vshm, mask_addend,
+                            use_pallas=False)
     deq_k = kq.astype(jnp.float32) * ks[..., None]
     deq_v = vq.astype(jnp.float32) * vs[..., None]
+    mask = jnp.ones((1, 1, 1, length), bool)
     want = _cache_attend(q, deq_k, deq_v, mask)
     numpy.testing.assert_allclose(numpy.asarray(got),
                                   numpy.asarray(want), rtol=1e-5,
                                   atol=1e-6)
+
+
+def test_cache_attend_kernel_matches_xla_formulation():
+    """The Pallas dequant-fused attend (interpret mode off-TPU) ==
+    the XLA formulation of the same math, mask included, at a
+    tile-friendly shape."""
+    from veles_tpu.ops.quant import int8_cache_attend
+
+    (q, khm, kshm, vhm, vshm, *_) = _attend_fixture(
+        batch=2, length=128, heads=2, dim=32, seed=11)
+    inv = 1.0 / numpy.sqrt(q.shape[-1])
+    mask_addend = jnp.where(jnp.arange(128) <= 50, 0.0,
+                            -1e30).astype(jnp.float32)
+    want = int8_cache_attend(q * inv, khm, kshm, vhm, vshm,
+                             mask_addend, use_pallas=False)
+    got = int8_cache_attend(q * inv, khm, kshm, vhm, vshm, mask_addend,
+                            use_pallas=True, interpret=True)
+    numpy.testing.assert_allclose(numpy.asarray(got),
+                                  numpy.asarray(want), rtol=2e-5,
+                                  atol=2e-5)
 
 
 def test_quantize_kv_roundtrip_bound():

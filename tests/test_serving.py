@@ -496,6 +496,30 @@ class TestContinuousDecoder:
         # same prompt, different request ids -> different streams
         assert results[ids[0]] != results[ids[1]]
 
+    def test_bucketed_admission_across_prompt_lengths(self, model):
+        """Prompts landing in different power-of-two buckets (the
+        right-padded prefill path) decode the same tokens as
+        generate(); the pad positions never leak into the stream."""
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(6)
+        # bucket 16, bucket 32 and an exact-bucket length
+        prompts = [rng.randint(0, vocab, n) for n in (7, 20, 16)]
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=64, n_tokens=5)
+        ids = [dec.submit(p) for p in prompts]
+        results = dec.run_until_drained()
+        for rid, prompt in zip(ids, prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=5, max_len=64)
+            assert results[rid] == numpy.asarray(want)[0].tolist(), \
+                "prompt len %d diverged through the padded prefill" \
+                % len(prompt)
+
     def test_budget_overflow_rejected(self, model):
         from veles_tpu.serving import ContinuousDecoder
 

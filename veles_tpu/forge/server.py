@@ -198,12 +198,21 @@ class ForgeServer(Logger):
             # open registration must not allow hijacking another
             # uploader's "latest" (every default fetch would run it)
             owner = meta.get("owner")
+            if owner is None and meta["versions"]:
+                # pre-ownership store: seed from the recorded uploader
+                # history instead of first-come-first-claimed
+                rows = sorted(meta["versions"].values(),
+                              key=lambda e: e.get("uploaded", 0))
+                owner = next((e.get("uploaded_by") for e in rows
+                              if e.get("uploaded_by")), None)
             if owner is None:
                 meta["owner"] = uploaded_by or "anonymous"
             elif uploaded_by not in (owner, "master"):
                 raise PermissionError(
                     "%s is owned by %s; only the owner or the master "
                     "token may add versions" % (name, owner))
+            else:
+                meta["owner"] = owner
             if version in meta["versions"]:
                 raise ValueError("%s version %s already exists"
                                  % (name, version))

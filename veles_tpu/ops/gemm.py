@@ -479,18 +479,15 @@ def _matmul_scan_time(product, a, lengths=(50, 350), repeats=4):
         / (lengths[1] - lengths[0])
 
 
-def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=None):
+def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=4):
     """Benchmark candidate block sizes AND the XLA dot for this shape
     bucket, persist the winner with a ``beats_xla`` verdict (reference
     ``backends.py:623-731`` per-device GEMM autotune — the tuned result
-    then engages automatically through ``matmul``'s "tuned" gate)."""
+    then engages automatically through ``matmul``'s "tuned" gate).
+    ``iters`` = timing repeats per measured scan length."""
     rng_a = jnp.ones((m, k), dtype) * 0.01
     b = jnp.ones((k, n), dtype) * 0.01
 
-    xla_dt = _matmul_scan_time(
-        lambda v: lax.dot_general(
-            v, b, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dtype), rng_a)
     best, best_dt = None, float("inf")
     for bm, bn, bk in _CANDIDATES:
         if bm > m or bn > n or bk > k:
@@ -499,13 +496,20 @@ def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=None):
             dt = _matmul_scan_time(
                 lambda v, bm=bm, bn=bn, bk=bk: pallas_matmul(
                     v, b, out_dtype=jnp.float32, bm=bm, bn=bn,
-                    bk=bk).astype(dtype), rng_a)
+                    bk=bk).astype(dtype), rng_a, repeats=iters)
         except Exception:
             continue
         if dt < best_dt:
             best, best_dt = (bm, bn, bk), dt
     if best is None:
+        # no viable candidate (e.g. off-TPU): skip the XLA baseline
+        # too — there is nothing to compare it against
         return _DEFAULT_BLOCKS
+    xla_dt = _matmul_scan_time(
+        lambda v: lax.dot_general(
+            v, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dtype), rng_a,
+        repeats=iters)
     cache = _load_cache()
     # require a clear margin: a tie-level "win" (sub-noise) must not
     # flip a product matmul onto the kernel

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from veles_tpu.ops.attention import attention
-from veles_tpu.ops.quant import matmul_any, quantize_int8
+from veles_tpu.ops.quant import (int8_cache_attend, matmul_any,
+                                 quantize_int8)
 # ONE copy of the sublayer math, shared with the training-side full
 # forward — the equivalence the module contract promises is structural
 from veles_tpu.parallel.transformer_step import _block_qkv, _head, _mlp
@@ -39,12 +40,18 @@ def init_kv_cache(n_blocks, batch, max_len, heads, head_dim,
     ``quantized=True`` stores K/V as int8 with one f32 absmax scale per
     (block, batch, position, head) — the KV half of the int8 serving
     tier. At decode lengths the cache read rivals the weight read, so
-    this halves the OTHER half of the memory-bound loop's traffic."""
+    this halves the OTHER half of the memory-bound loop's traffic.
+    Layout is (L, B, H, D, T) — head-major, positions minor: the
+    dequant-fused attend kernel's dots then tile the MXU natively
+    (q x K contracts D with T on lanes; V x p contracts T), and XLA
+    cannot sneak a materialized bf16 widening of the cache in between
+    (measured 4-8x slower in every positions-major layout)."""
     shape = (n_blocks, batch, max_len, heads, head_dim)
     if quantized:
-        sshape = (n_blocks, batch, max_len, heads)
-        return {"k": jnp.zeros(shape, jnp.int8),
-                "v": jnp.zeros(shape, jnp.int8),
+        qshape = (n_blocks, batch, heads, head_dim, max_len)
+        sshape = (n_blocks, batch, heads, max_len)
+        return {"k": jnp.zeros(qshape, jnp.int8),
+                "v": jnp.zeros(qshape, jnp.int8),
                 "k_scale": jnp.zeros(sshape, jnp.float32),
                 "v_scale": jnp.zeros(sshape, jnp.float32),
                 "length": jnp.zeros((), jnp.int32)}
@@ -101,11 +108,15 @@ def prefill(params, x, heads, cache, length=None):
     new = {"length": cache_len}
     if "k_scale" in cache:
         for name, val in (("k", k_all), ("v", v_all)):
-            q8, scale = _quantize_kv(val)
+            q8, scale = _quantize_kv(val)        # (L,B,T,H,D),(L,B,T,H)
+            # head-major, positions-minor cache layout (see
+            # init_kv_cache): (L,B,H,D,T) / (L,B,H,T)
             new[name] = lax.dynamic_update_slice(
-                cache[name], q8, (0, 0, 0, 0, 0))
+                cache[name], jnp.transpose(q8, (0, 1, 3, 4, 2)),
+                (0, 0, 0, 0, 0))
             new[name + "_scale"] = lax.dynamic_update_slice(
-                cache[name + "_scale"], scale, (0, 0, 0, 0))
+                cache[name + "_scale"],
+                jnp.transpose(scale, (0, 1, 3, 2)), (0, 0, 0, 0))
     else:
         new["k"] = lax.dynamic_update_slice(
             cache["k"], k_all.astype(cache["k"].dtype), (0, 0, 0, 0, 0))
@@ -114,26 +125,18 @@ def prefill(params, x, heads, cache, length=None):
     return logits, new
 
 
-def _cache_attend(q, k_all, v_all, mask, k_scale=None, v_scale=None):
+def _cache_attend(q, k_all, v_all, mask):
     """Attention of query tokens against the cache prefix, f32 softmax:
     ONE copy of the math for the single-device and tensor-parallel
     decode paths (the TP guarantee of token-identity depends on it).
-
-    With an int8 cache the per-(position, head) dequant scales fold
-    OUTSIDE the dots: the score row multiplies by ``k_scale`` after the
-    q x K product, and ``v_scale`` folds into the softmax weights
-    before the p x V product — the int8 payloads feed the einsums
-    directly, so the wide K/V never materialize."""
+    The int8-cache variant lives in ``ops/quant.int8_cache_attend``
+    (head-major layout + the dequant-fused Pallas kernel)."""
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     # q (B,1,H,D) x cache K (B,L,H,D) -> (B,H,1,L)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(q.dtype),
                    preferred_element_type=jnp.float32) * scale
-    if k_scale is not None:  # (B,L,H) -> (B,H,1,L)
-        s = s * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, :]
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    if v_scale is not None:
-        p = p * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, :]
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype),
                       v_all.astype(q.dtype),
                       preferred_element_type=jnp.float32)
@@ -144,10 +147,19 @@ def decode_step(params, x_tok, heads, cache):
     returns ``(logits, cache)`` with the token's K/V appended."""
     batch, _, embed = x_tok.shape
     length = cache["length"]
-    max_len = cache["k"].shape[2]
     quantized = "k_scale" in cache
     # positions [0, length] are valid (the new token attends to itself)
-    mask = (jnp.arange(max_len) <= length)[None, None, None, :]
+    if quantized:
+        max_len = cache["k"].shape[-1]  # head-major layout: T is minor
+        mask_addend = jnp.where(jnp.arange(max_len) <= length, 0.0,
+                                -1e30).astype(jnp.float32)
+        # python float (weak type): `q * inv_sqrt` must NOT promote a
+        # bf16 q to f32 — that would kill the fallback path's bf16
+        # compute branch and widen the int8 cache to f32
+        inv_sqrt = (embed // heads) ** -0.5
+    else:
+        max_len = cache["k"].shape[2]
+        mask = (jnp.arange(max_len) <= length)[None, None, None, :]
     x = x_tok
     new_k, new_v = cache["k"], cache["v"]
     new_ks = cache.get("k_scale")
@@ -155,18 +167,23 @@ def decode_step(params, x_tok, heads, cache):
     for i, blk in enumerate(params["blocks"]):
         q, k, v = _block_qkv(blk, x, heads)
         if quantized:
-            kq, ks = _quantize_kv(k)
+            kq, ks = _quantize_kv(k)        # (B,1,H,D), (B,1,H)
             vq, vs = _quantize_kv(v)
+            # head-major column write at position `length`
             new_k = lax.dynamic_update_slice(
-                new_k, kq[None], (i, 0, length, 0, 0))
+                new_k, jnp.transpose(kq, (0, 2, 3, 1))[None],
+                (i, 0, 0, 0, length))
             new_v = lax.dynamic_update_slice(
-                new_v, vq[None], (i, 0, length, 0, 0))
+                new_v, jnp.transpose(vq, (0, 2, 3, 1))[None],
+                (i, 0, 0, 0, length))
             new_ks = lax.dynamic_update_slice(
-                new_ks, ks[None], (i, 0, length, 0))
+                new_ks, jnp.transpose(ks, (0, 2, 1))[None],
+                (i, 0, 0, length))
             new_vs = lax.dynamic_update_slice(
-                new_vs, vs[None], (i, 0, length, 0))
-            att = _cache_attend(q, new_k[i], new_v[i], mask,
-                                k_scale=new_ks[i], v_scale=new_vs[i])
+                new_vs, jnp.transpose(vs, (0, 2, 1))[None],
+                (i, 0, 0, length))
+            att = int8_cache_attend(q * inv_sqrt, new_k[i], new_ks[i],
+                                    new_v[i], new_vs[i], mask_addend)
         else:
             new_k = lax.dynamic_update_slice(
                 new_k, k[None].astype(new_k.dtype), (i, 0, length, 0, 0))
@@ -295,6 +312,11 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
             key = get_rng("decode").next_key()
         else:
             key = jax.random.key(0)  # unused by greedy, jit wants one
+    if quantize == "int8-kv":
+        # round the quantized cache up to whole 128-lane tiles so the
+        # dequant-fused attend kernel's T gate engages (masking makes
+        # the extra positions inert)
+        max_len = -(-max_len // 128) * 128
     # the cache follows the serving dtype: with bf16 params/table the
     # K/V traffic (comparable to the weight traffic at long context)
     # halves too — measured +~50% tokens/sec on the memory-bound loop
