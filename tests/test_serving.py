@@ -528,3 +528,77 @@ class TestContinuousDecoder:
                                 max_len=16, n_tokens=8)
         with pytest.raises(ValueError):
             dec.submit(numpy.arange(12) % vocab)
+
+    def test_generate_api_http_roundtrip(self, model):
+        """The LLM serving HTTP surface: concurrent POSTs batch into
+        the slot pool, each answer equals single-request generate()."""
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import GenerateAPI
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=5, chunk=2, port=0)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            rng = numpy.random.RandomState(7)
+            prompts = [rng.randint(0, vocab, n).tolist()
+                       for n in (4, 6, 5)]
+            results = {}
+
+            def call(i):
+                results[i] = post(url, {"tokens": prompts[i]},
+                                  timeout=60)
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            for i, prompt in enumerate(prompts):
+                want, _ = generate(params, table,
+                                   jnp.asarray(prompt)[None], heads,
+                                   n_tokens=5, max_len=32)
+                assert results[i]["tokens"] == \
+                    numpy.asarray(want)[0].tolist()
+            # malformed requests 400 cleanly
+            for payload in ({"tokens": []}, {"tokens": "x"},
+                            {"tokens": [vocab + 5]},
+                            {"tokens": [1], "n_tokens": 0},
+                            {"tokens": list(range(3)) * 20}):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    post(url, payload)
+                assert err.value.code == 400
+        finally:
+            api.stop()
+
+    def test_generate_api_driver_failure_fails_fast(self, model):
+        """A device/runtime error in the driver loop must resolve every
+        in-flight request with an error (no 300 s timeout wedge) and
+        fail subsequent requests fast."""
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+        api = GenerateAPI(params, table, heads, slots=1, max_len=32,
+                          n_tokens=4, chunk=2, port=0)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+
+            def boom(*a, **k):
+                raise RuntimeError("injected device failure")
+
+            api.decoder.step_many = boom
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(url, {"tokens": [1, 2, 3]}, timeout=30)
+            assert err.value.code == 400
+            assert "injected device failure" in \
+                err.value.read().decode()
+            # the driver survives: later requests fail fast too
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(url, {"tokens": [2, 3]}, timeout=30)
+            assert err.value.code == 400
+        finally:
+            api.stop()

@@ -406,6 +406,11 @@ class ContinuousDecoder:
     def busy(self):
         return bool(self._queue or self._slot_req)
 
+    def done(self, rid):
+        """True once request ``rid``'s stream is complete (its tokens
+        sit in ``results[rid]``)."""
+        return rid in self.results and rid not in self._budget
+
     @staticmethod
     def _bucket(n):
         """Prompt-length bucket: next power of two (min 16). Admission
@@ -571,3 +576,187 @@ class ContinuousDecoder:
                 self.step()
         raise RuntimeError("decoder did not drain in %d steps"
                            % max_steps)
+
+
+class GenerateAPI:
+    """HTTP front for :class:`ContinuousDecoder` — the LLM analogue of
+    :class:`RESTfulAPI` (which serves per-tick forward passes, the
+    reference surface). ``POST <path>`` with
+    ``{"tokens": [...], "n_tokens": N?}`` answers
+    ``{"tokens": [...]}`` once the request's stream completes.
+
+    Handler threads only stage requests and block on a per-request
+    event; ONE driver thread owns the decoder (it is not thread-safe)
+    — admitting staged prompts and running chunked decode steps while
+    anything is in flight, so concurrent requests batch into the slot
+    pool automatically and new ones join mid-flight."""
+
+    def __init__(self, params, embed_table, heads, slots=4,
+                 max_len=512, n_tokens=32, temperature=0.0, top_k=0,
+                 eos=None, key=None, port=0, host="127.0.0.1",
+                 path="/generate", chunk=8, request_timeout=300.0):
+        import queue
+
+        self.decoder = ContinuousDecoder(
+            params, embed_table, heads, slots=slots, max_len=max_len,
+            n_tokens=n_tokens, temperature=temperature, top_k=top_k,
+            eos=eos, key=key)
+        self.vocab = embed_table.shape[0]
+        self.port = port
+        self.host = host
+        self.path = path
+        self.chunk = chunk
+        self.request_timeout = request_timeout
+        self._staged = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._httpd = None
+        self._driver = None
+        self._failed = None  # sticky driver-failure message
+
+    # -- driver thread (sole owner of the decoder) ------------------------
+    def _drain_staged(self):
+        import queue
+
+        waiting = {}
+        while True:
+            try:
+                prompt, budget, holder = self._staged.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                rid = self.decoder.submit(prompt, budget)
+            except ValueError as exc:
+                # belt-and-braces: the handler pre-validated, but a
+                # failed submit must never kill the driver thread —
+                # resolve the request with the error instead
+                holder["error"] = str(exc)
+                holder["event"].set()
+                continue
+            waiting[rid] = holder
+        return waiting
+
+    def _fail_all(self, waiting, message):
+        """Resolve every in-flight and staged request with an error —
+        nobody may be left blocking out their full request_timeout."""
+        import queue
+
+        for holder in waiting.values():
+            holder.setdefault("error", message)
+            holder["event"].set()
+        waiting.clear()
+        while True:
+            try:
+                _, _, holder = self._staged.get_nowait()
+            except queue.Empty:
+                return
+            holder.setdefault("error", message)
+            holder["event"].set()
+
+    def _drive(self):
+        waiting = {}
+        try:
+            while not self._stop.is_set():
+                if self._failed is not None:
+                    # decoder state is gone: fail new arrivals fast
+                    self._fail_all(waiting, self._failed)
+                    if not self._wake.wait(timeout=0.05):
+                        continue
+                    self._wake.clear()
+                    continue
+                waiting.update(self._drain_staged())
+                if not self.decoder.busy:
+                    if not self._wake.wait(timeout=0.05):
+                        continue
+                    self._wake.clear()
+                    continue
+                try:
+                    self.decoder.step_many(self.chunk)
+                    for rid in [r for r in waiting
+                                if self.decoder.done(r)]:
+                        holder = waiting.pop(rid)
+                        holder["tokens"] = self.decoder.results.pop(rid)
+                        holder["event"].set()
+                except Exception as exc:  # device/runtime failure:
+                    # the decoder's donated state is unusable — fail
+                    # everything loudly instead of wedging the server
+                    # behind 300-second timeouts
+                    import traceback
+                    traceback.print_exc()
+                    self._failed = "decode driver failed: %s" % exc
+                    self._fail_all(waiting, self._failed)
+        finally:
+            self._fail_all(waiting, "server stopped")
+
+    # -- HTTP -------------------------------------------------------------
+    def start(self):
+        from http.server import BaseHTTPRequestHandler
+        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
+                                          reply, start_server)
+
+        api = self
+
+        class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path.split("?")[0] != api.path:
+                    self.send_error(404)
+                    return
+                try:
+                    payload = json.loads(read_body(self).decode())
+                    tokens = payload["tokens"]
+                    if not isinstance(tokens, list) or not tokens \
+                            or not all(isinstance(t, int)
+                                       and 0 <= t < api.vocab
+                                       for t in tokens):
+                        raise ValueError(
+                            "tokens must be a non-empty list of ids "
+                            "in [0, %d)" % api.vocab)
+                    budget = payload.get("n_tokens")
+                    if budget is not None and (
+                            not isinstance(budget, int) or budget < 1):
+                        raise ValueError("n_tokens must be a positive "
+                                         "integer")
+                    prompt = numpy.asarray(tokens, numpy.int32)
+                    holder = {"event": threading.Event()}
+                    # max_len / budget validation happens on the
+                    # driver thread via submit(); pre-check here so
+                    # the client gets a 400, not a timeout
+                    limit = (budget if budget is not None
+                             else api.decoder.n_tokens)
+                    if len(prompt) + limit > api.decoder.max_len:
+                        raise ValueError(
+                            "prompt %d + n_tokens %d exceeds max_len "
+                            "%d" % (len(prompt), limit,
+                                    api.decoder.max_len))
+                except (ValueError, TypeError, KeyError) as exc:
+                    reply(self, {"error": str(exc)}, code=400)
+                    return
+                api._staged.put((prompt, budget, holder))
+                api._wake.set()
+                if not holder["event"].wait(api.request_timeout):
+                    reply(self, {"error": "timed out"}, code=503)
+                    return
+                if "error" in holder:
+                    reply(self, {"error": holder["error"]}, code=400)
+                    return
+                reply(self, {"tokens": holder["tokens"]})
+
+        self._httpd, self.port = start_server(
+            Handler, self.host, self.port, name="generate-api")
+        self._driver = threading.Thread(target=self._drive,
+                                        name="generate-driver",
+                                        daemon=True)
+        self._driver.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._driver is not None:
+            # the driver's finally-block resolves in-flight requests
+            # ("server stopped") so no handler blocks out its timeout
+            self._driver.join(timeout=10)
+            self._driver = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
