@@ -1,12 +1,23 @@
 PYTHON ?= python
 
-.PHONY: check test entry hooks
+.PHONY: check test entry hooks chaos
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q
+
+# Deterministic fault-injection suite (docs/fleet_robustness.md) under
+# three pinned chaos seeds — pinned so every configured fault fires
+# within the toy run (see tests/test_fleet_chaos.py).
+chaos:
+	for seed in 1 3 5; do \
+		echo "== chaos seed $$seed"; \
+		VELES_TPU_CHAOS_SEED=$$seed JAX_PLATFORMS=cpu \
+			$(PYTHON) -m pytest tests/test_fleet_chaos.py \
+			-m chaos -q || exit 1; \
+	done
 
 entry:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import jax, __graft_entry__ as g; \

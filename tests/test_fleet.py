@@ -121,6 +121,60 @@ class TestProtocol:
     def test_machine_id_stable(self):
         assert machine_id() == machine_id()
 
+    @staticmethod
+    def _raw_frame(codec, payload):
+        """Build a frame with an arbitrary codec byte and a VALID MAC, so
+        the test exercises the post-authentication rejection path."""
+        import struct
+        from veles_tpu.fleet.protocol import _mac
+        return (struct.pack(">IB", len(payload), codec)
+                + _mac(KEY, codec, payload) + payload)
+
+    def test_gzip_bomb_rejected(self):
+        """An authenticated peer must not be able to detonate a gzip bomb:
+        the frame limit applies to the DECOMPRESSED size too."""
+        import gzip
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+        bomb = gzip.compress(b"\0" * (4 * 1024 * 1024), compresslevel=9)
+        assert len(bomb) < 1024 * 1024  # fits the wire-length check
+        frame = self._raw_frame(1, bomb)
+        with pytest.raises(ProtocolError, match="exceeds limit"):
+            asyncio.run(read_frame(FakeReader(frame), KEY,
+                                   max_frame=1024 * 1024))
+
+    def test_truncated_gzip_member_rejected(self):
+        """A truncated gzip member is a protocol violation, never
+        silently-partial data."""
+        import gzip
+        import pickle
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+        member = gzip.compress(pickle.dumps({"type": "job"}))
+        frame = self._raw_frame(1, member[:-6])
+        with pytest.raises(ProtocolError,
+                           match="gzip"):
+            asyncio.run(read_frame(FakeReader(frame), KEY))
+
+    def test_unknown_codec_byte_rejected(self):
+        """An authenticated frame with an unassigned codec byte must be
+        rejected before any deserialization."""
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+        frame = self._raw_frame(7, b"payload")
+        with pytest.raises(ProtocolError, match="unknown frame codec"):
+            asyncio.run(read_frame(FakeReader(frame), KEY))
+
+    def test_oversized_preauth_hello_rejected(self):
+        """The server reads the pre-auth hello with a 64 KiB cap: an
+        unauthenticated peer cannot make it buffer a giant payload."""
+        from veles_tpu.fleet.protocol import ProtocolError, read_frame
+        # incompressible padding: the frame must exceed the cap on the
+        # wire, exercising the pre-buffer length check (a compressible
+        # payload would instead trip the decompressed-size guard)
+        big = encode_frame({"type": "hello",
+                            "pad": os.urandom(1 << 17)}, KEY)
+        with pytest.raises(ProtocolError, match="exceeds limit"):
+            asyncio.run(read_frame(FakeReader(big), KEY,
+                                   max_frame=1 << 16))
+
 
 class TestSharedIO:
     """Same-host shared-memory data plane (reference txzmq SharedIO)."""

@@ -1,0 +1,482 @@
+"""Fault-tolerant fleet: job ledger, epoch fencing, chaos harness.
+
+The tentpole robustness suite (docs/fleet_robustness.md): unit tests for
+the ledger state machine and the seeded chaos monkey, plus the acceptance
+test — a real localhost master/slave fleet driven through mid-job death,
+frame drops and duplicate-update replay must converge to **bit-identical**
+final weights vs the fault-free run, with ``fleet_status()`` counters
+proving each fault actually fired.
+
+``VELES_TPU_CHAOS_SEED`` selects the chaos RNG seed (``make chaos`` runs
+the suite under three fixed seeds); the default seed is 1. The fixed
+seeds are PINNED to schedules where every configured fault fires within
+the short toy run — fault firing is probabilistic, so an arbitrary seed
+may roll e.g. zero deaths in ~18 jobs and fail the every-fault-fired
+asserts (recovery itself is seed-independent).
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.core import prng
+from veles_tpu.fleet.chaos import ChaosConfig, ChaosMonkey
+from veles_tpu.fleet.ledger import (
+    DONE, FENCE_DUPLICATE, FENCE_FOREIGN, FENCE_REQUEUED,
+    FENCE_STALE_EPOCH, FENCE_UNKNOWN, JobLedger, OUTSTANDING, REQUEUED)
+from veles_tpu.fleet.protocol import encode_frame
+from veles_tpu.launcher import Launcher
+from veles_tpu.loader.base import VALID
+from veles_tpu.models.mlp import MLPWorkflow
+
+CHAOS_SEED = int(os.environ.get("VELES_TPU_CHAOS_SEED", "1"))
+
+pytestmark = pytest.mark.chaos
+
+
+class TestJobLedger:
+    def test_issue_settle_exactly_once(self):
+        ledger = JobLedger()
+        job = ledger.issue("slave-1", timeout=60.0)
+        assert ledger.state_of(job) == OUTSTANDING
+        assert ledger.settle(job, "slave-1") is None  # apply
+        assert ledger.state_of(job) == DONE
+        # duplicate replay of the same update is fenced
+        assert ledger.settle(job, "slave-1") == FENCE_DUPLICATE
+        snap = ledger.snapshot()
+        assert snap["issued"] == 1 and snap["done"] == 1
+        assert snap["fenced"][FENCE_DUPLICATE] == 1
+
+    def test_unknown_and_foreign_fenced(self):
+        ledger = JobLedger()
+        assert ledger.settle(99, "slave-1") == FENCE_UNKNOWN
+        assert ledger.settle(None, "slave-1") == FENCE_UNKNOWN
+        assert ledger.settle("1", "slave-1") == FENCE_UNKNOWN
+        job = ledger.issue("slave-1", timeout=60.0)
+        # another slave cannot settle someone else's lease
+        assert ledger.settle(job, "slave-2") == FENCE_FOREIGN
+        assert ledger.state_of(job) == OUTSTANDING
+        assert ledger.settle(job, "slave-1") is None
+
+    def test_drop_requeues_then_fences_zombie(self):
+        ledger = JobLedger()
+        j1 = ledger.issue("slave-1", timeout=60.0)
+        j2 = ledger.issue("slave-1", timeout=60.0)
+        j3 = ledger.issue("slave-2", timeout=60.0)
+        assert sorted(ledger.requeue_for_slave("slave-1")) == [j1, j2]
+        assert ledger.state_of(j1) == REQUEUED
+        assert ledger.state_of(j3) == OUTSTANDING  # other slave untouched
+        # the zombie's late update must not be applied
+        assert ledger.settle(j1, "slave-1") == FENCE_REQUEUED
+        snap = ledger.snapshot()
+        assert snap["requeued_dropped"] == 2
+        assert snap["fenced"][FENCE_REQUEUED] == 1
+
+    def test_lease_expiry(self):
+        ledger = JobLedger()
+        job = ledger.issue("slave-1", timeout=10.0, now=1000.0)
+        # before the deadline: nothing to expire
+        assert not ledger.expire_if_outstanding(job, now=1005.0)
+        assert ledger.expire_if_outstanding(job, now=1011.0)
+        assert ledger.state_of(job) == REQUEUED
+        # idempotent: a second timer firing must not double-count
+        assert not ledger.expire_if_outstanding(job, now=1012.0)
+        assert ledger.snapshot()["requeued_expired"] == 1
+        # a DONE lease never expires
+        done = ledger.issue("slave-1", timeout=10.0, now=1000.0)
+        assert ledger.settle(done, "slave-1") is None
+        assert not ledger.expire_if_outstanding(done, now=9999.0)
+
+    def test_gc_watermark_keeps_fencing_duplicates(self):
+        """Settled leases beyond keep_settled are GC'd, but their ids must
+        still fence as duplicates — never as unknown-and-applicable."""
+        ledger = JobLedger(keep_settled=5)
+        jobs = [ledger.issue("s", timeout=60.0) for _ in range(20)]
+        for job in jobs:
+            assert ledger.settle(job, "s") is None
+        # the oldest ids were GC'd out of the lease table
+        assert len(ledger._leases) <= 5
+        assert ledger.settle(jobs[0], "s") == FENCE_DUPLICATE
+        assert ledger.state_of(jobs[0]) == DONE  # via watermark
+
+    def test_requeue_after_gc_warmup(self):
+        """Regression: requeue_for_slave retires leases (triggering GC
+        pops on the same dict) while walking the lease table — must not
+        die with 'dictionary changed size during iteration' once the
+        settled backlog reaches keep_settled."""
+        ledger = JobLedger(keep_settled=3)
+        for _ in range(10):
+            job = ledger.issue("s", timeout=60.0)
+            assert ledger.settle(job, "s") is None
+        open_job = ledger.issue("s", timeout=60.0)
+        assert ledger.requeue_for_slave("s") == [open_job]
+        assert ledger.state_of(open_job) == REQUEUED
+
+    def test_outstanding_listing(self):
+        ledger = JobLedger()
+        j1 = ledger.issue("a", timeout=60.0)
+        j2 = ledger.issue("b", timeout=60.0)
+        assert sorted(ledger.outstanding()) == [j1, j2]
+        assert ledger.outstanding("a") == [j1]
+        ledger.settle(j1, "a")
+        assert ledger.outstanding() == [j2]
+
+
+class TestChaosMonkey:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="outside"):
+            ChaosConfig(frame_drop=1.5)
+        with pytest.raises(ValueError, match="death_mode"):
+            ChaosConfig(death_mode="bogus")
+        assert not ChaosConfig().any_enabled
+        assert ChaosConfig(death=0.1).any_enabled
+
+    def test_deterministic_schedule(self):
+        """Same seed -> the exact same fault schedule; the whole point of
+        the harness (chaos runs are replayable and assertable)."""
+        def schedule(seed):
+            monkey = ChaosMonkey(ChaosConfig(seed=seed, death=0.5))
+            fired = []
+            for _ in range(64):
+                try:
+                    monkey.maybe_die()
+                    fired.append(False)
+                except ConnectionResetError:
+                    fired.append(True)
+            return fired
+
+        assert schedule(7) == schedule(7)
+        assert schedule(7) != schedule(8)
+        monkey = ChaosMonkey(ChaosConfig(seed=7, death=0.5))
+        for _ in range(64):
+            try:
+                monkey.maybe_die()
+            except ConnectionResetError:
+                pass
+        assert monkey.counters["deaths"] == sum(schedule(7))
+
+    def test_from_config_disabled_by_default(self):
+        from veles_tpu.core.config import root
+        saved = root.common.fleet.chaos.__content__()
+        try:
+            root.common.fleet.chaos.update(dict(
+                enabled=False, frame_drop=0.0, death=0.0))
+            assert ChaosMonkey.from_config() is None
+            root.common.fleet.chaos.update(dict(
+                enabled=True, frame_drop=0.25, seed=3))
+            monkey = ChaosMonkey.from_config()
+            assert monkey is not None
+            assert monkey.config.frame_drop == 0.25
+            assert monkey.config.seed == 3
+            # probabilities set but enabled=False -> force-disabled
+            root.common.fleet.chaos.enabled = False
+            assert ChaosMonkey.from_config() is None
+        finally:
+            root.common.fleet.chaos.update(saved)
+            root.common.fleet.chaos.enabled = saved.get("enabled", False)
+
+    def test_duplicate_update_replays_frame(self):
+        """An update frame rolls the duplicate fault and ships twice,
+        with the chaos tallies stamped into the payload."""
+        written = []
+
+        class FakeWriter:
+            def write(self, data):
+                written.append(data)
+
+            async def drain(self):
+                pass
+
+        monkey = ChaosMonkey(ChaosConfig(seed=1, duplicate_update=1.0))
+        asyncio.run(monkey.write_frame(
+            FakeWriter(), {"type": "update", "update": [], "job_id": 5},
+            b"k"))
+        assert len(written) == 2
+        assert monkey.counters["updates_duplicated"] == 1
+        # non-update frames are never duplicated
+        written.clear()
+        asyncio.run(monkey.write_frame(
+            FakeWriter(), {"type": "job_request"}, b"k"))
+        assert len(written) == 1
+
+
+class TestEpochFencing:
+    def _server(self):
+        from veles_tpu.fleet.server import Server, SlaveDescription
+        server = Server("127.0.0.1:0", None, secret="fence-test")
+        server.epoch = "epoch-A"
+        return server, SlaveDescription("slave-1", {})
+
+    def test_stale_epoch_fenced(self):
+        server, slave = self._server()
+        job = server.ledger.issue(slave.id, timeout=60.0)
+        msg = {"job_id": job, "epoch": "epoch-OLD", "update": []}
+        assert server._fence_update(slave, msg) == FENCE_STALE_EPOCH
+        # the lease is still open: fencing a stale answer must not
+        # consume it
+        assert server.ledger.state_of(job) == OUTSTANDING
+        assert server.ledger.snapshot()["fenced"][FENCE_STALE_EPOCH] == 1
+
+    def test_current_epoch_applies_once(self):
+        server, slave = self._server()
+        job = server.ledger.issue(slave.id, timeout=60.0)
+        msg = {"job_id": job, "epoch": "epoch-A", "update": []}
+        assert server._fence_update(slave, msg) is None
+        assert server._fence_update(slave, msg) == FENCE_DUPLICATE
+
+    def test_missing_epoch_fenced(self):
+        server, slave = self._server()
+        job = server.ledger.issue(slave.id, timeout=60.0)
+        assert server._fence_update(
+            slave, {"job_id": job, "update": []}) == FENCE_STALE_EPOCH
+
+    def test_fleet_status_shape(self):
+        server, _ = self._server()
+        status = server.fleet_status()
+        assert status["epoch"] == "epoch-A"
+        assert status["ledger"]["issued"] == 0
+        assert status["chaos"] == {}
+        assert "queued_jobs" in status and "blacklist" in status
+
+
+class _ScriptedWorkflow:
+    """Minimal fleet workflow: serves ``jobs`` payloads, then
+    ``when_empty`` (None = "no more jobs", False = park the request —
+    keeps a slave waiting, for restart scenarios)."""
+
+    def __init__(self, jobs, when_empty=None, on_applied=None):
+        self.checksum = "chaos-restart"
+        self.jobs = list(jobs)
+        self.when_empty = when_empty
+        self.on_applied = on_applied
+        self.applied = []
+
+    def generate_initial_data_for_slave(self, slave):
+        return None
+
+    def generate_data_for_slave(self, slave):
+        return self.jobs.pop(0) if self.jobs else self.when_empty
+
+    def apply_data_from_slave(self, update, slave):
+        self.applied.append(update)
+        if self.on_applied is not None:
+            self.on_applied()
+
+    def apply_initial_data_from_master(self, initial):
+        pass
+
+    def do_job(self, job, callback):
+        callback(job * 10)
+
+    def drop_slave(self, slave):
+        pass
+
+    def has_more_jobs(self):
+        return bool(self.jobs)
+
+
+class TestMasterRestart:
+    def test_client_rejoins_new_epoch_with_restored_budget(self):
+        """Recovery-matrix row "master restart": the client survives the
+        master's death, re-handshakes with the successor (new epoch UUID)
+        on the same port, gets its reconnect budget restored, and the new
+        master's ledger fences nothing."""
+        from veles_tpu.fleet.client import Client
+        from veles_tpu.fleet.server import Server
+
+        first_done = threading.Event()
+        # serves one job, then PARKS the next request (backpressure) so
+        # the client is mid-session when the master dies
+        wf1 = _ScriptedWorkflow([1], when_empty=False,
+                                on_applied=first_done.set)
+        server1 = Server("127.0.0.1:0", wf1,
+                         secret="chaos-restart").start()
+        port = server1.port
+        client = Client("127.0.0.1:%d" % port, _ScriptedWorkflow([]),
+                        secret="chaos-restart",
+                        max_reconnect_attempts=50, chaos=False).start()
+        finished = threading.Event()
+        client.on_finished = finished.set
+        try:
+            assert first_done.wait(10), "first master served no job"
+            epoch1 = server1.epoch
+            server1.stop()
+            # burn some reconnect budget while the master is down
+            deadline = time.time() + 5
+            while client._attempts == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert client._attempts > 0, "client never started retrying"
+            wf2 = _ScriptedWorkflow([2])
+            server2 = Server("127.0.0.1:%d" % port, wf2,
+                             secret="chaos-restart").start()
+            try:
+                assert finished.wait(30), "client never finished on the "\
+                    "restarted master"
+                assert epoch1 != server2.epoch
+                assert client.master_epoch == server2.epoch
+                assert client._attempts == 0, "budget not restored"
+                assert wf2.applied == [20]
+                snap = server2.ledger.snapshot()
+                assert snap["done"] == 1 and snap["fenced_total"] == 0
+            finally:
+                server2.stop()
+        finally:
+            client.stop()
+            server1.stop()
+
+
+class TestPausedBackoff:
+    def test_paused_poll_backs_off_exponentially(self, monkeypatch):
+        """A long-paused slave must not poll at a steady 2 Hz: the sleeps
+        between job_requests double up to PAUSE_POLL_MAX and reset once a
+        real job arrives."""
+        from veles_tpu.fleet.client import Client
+
+        from test_fleet import FakeReader
+
+        key = b"backoff-test"
+        frames = [
+            {"type": "welcome", "id": "slave-1", "epoch": "e1"},
+        ] + [{"type": "job", "paused": True}] * 6 + [
+            {"type": "job", "job": None},
+        ]
+        reader = FakeReader(b"".join(encode_frame(f, key)
+                                     for f in frames))
+
+        class NullWriter:
+            def write(self, data):
+                pass
+
+            async def drain(self):
+                pass
+
+        sleeps = []
+        real_sleep = asyncio.sleep
+
+        async def fake_sleep(duration, *args, **kwargs):
+            sleeps.append(duration)
+            await real_sleep(0)
+
+        monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+        client = Client("127.0.0.1:1", _ScriptedWorkflow([]),
+                        secret="backoff-test", chaos=False)
+        done = asyncio.run(client._work(reader, NullWriter()))
+        assert done is True
+        assert sleeps == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]
+
+
+def _synthetic_kw(max_epochs=3):
+    rng = numpy.random.RandomState(0)
+    data = rng.rand(300, 8).astype(numpy.float32)
+    labels = (data[:, 0] > 0.5).astype(numpy.int32)
+    return dict(
+        layers=(8, 2),
+        loader_kwargs=dict(data=data, labels=labels,
+                           class_lengths=[0, 60, 240],
+                           minibatch_size=60,
+                           normalization_type="linear"),
+        learning_rate=0.3, max_epochs=max_epochs)
+
+
+def _seed_training():
+    prng.get("default").seed(42)
+    prng.get("loader").seed(43)
+
+
+def _run_fleet(kw, chaos=None):
+    """One master + one slave over loopback; returns (final weight
+    arrays, best validation errors, master fleet_status, slave agent)."""
+    _seed_training()
+    master = Launcher(listen_address="127.0.0.1:0")
+    wf_m = MLPWorkflow(master, name="chaos-t", **kw)
+    master.initialize()
+    thread = threading.Thread(target=master.run, daemon=True)
+    thread.start()
+    _seed_training()
+    slave = Launcher(master_address="127.0.0.1:%d" % master.agent.port,
+                     chaos=chaos)
+    MLPWorkflow(slave, name="chaos-t", **kw)
+    slave.initialize()
+    slave.run()
+    thread.join(120)
+    assert not thread.is_alive(), "master did not finish"
+    status = master.agent.fleet_status()
+    weights = []
+    for gd in wf_m.gds:
+        weights.append(numpy.asarray(gd.weights.mem).copy())
+        weights.append(numpy.asarray(gd.bias.mem).copy())
+    best = wf_m.decision.best_n_err[VALID]
+    slave_agent = slave.agent
+    master.stop()
+    slave.stop()
+    return weights, best, status, slave_agent
+
+
+@pytest.fixture
+def chaos_config_reset():
+    from veles_tpu.core.config import root
+    saved = root.common.fleet.chaos.__content__()
+    yield
+    root.common.fleet.chaos.update(dict(
+        enabled=False, seed=1, frame_delay=0.0, frame_drop=0.0,
+        slow_job=0.0, duplicate_update=0.0, death=0.0))
+    root.common.fleet.chaos.update(saved)
+
+
+class TestChaosConvergence:
+    """THE acceptance test: faults fire, training result is unchanged."""
+
+    def test_fleet_survives_chaos_bit_identical(self, chaos_config_reset):
+        kw = _synthetic_kw(max_epochs=3)
+        clean_weights, clean_best, clean_status, _ = _run_fleet(kw)
+        # the fault-free run must itself be clean
+        assert clean_status["ledger"]["requeued"] == 0
+        assert clean_status["ledger"]["fenced_total"] == 0
+
+        chaos = dict(enabled=True, seed=CHAOS_SEED,
+                     death=0.18, death_mode="disconnect",
+                     frame_drop=0.04, frame_delay=0.10,
+                     frame_delay_ms=5.0,
+                     duplicate_update=0.25,
+                     slow_job=0.25, slow_job_ms=20.0)
+        weights, best, status, slave_agent = _run_fleet(kw, chaos=chaos)
+
+        # every configured fault actually fired (slave-side tallies)...
+        counters = slave_agent.chaos.counters
+        assert counters["deaths"] >= 1, counters
+        assert counters["frames_dropped"] >= 1, counters
+        assert counters["updates_duplicated"] >= 1, counters
+        assert counters["jobs_slowed"] >= 1, counters
+        assert counters["frames_delayed"] >= 1, counters
+        # ...and the master's ledger proves the recovery machinery ran:
+        # deaths/drops -> explicit lease requeue, replays -> fencing
+        ledger = status["ledger"]
+        assert ledger["requeued"] >= 1, ledger
+        assert ledger["fenced"]["duplicate"] >= 1, ledger
+        assert ledger["done"] >= 15  # 3 epochs x 5 minibatches
+        # chaos tallies reached the dashboard feed too
+        assert status["chaos"].get("updates_duplicated", 0) >= 1
+
+        # the point of it all: the faulted run converges to the SAME
+        # model, bit for bit
+        assert best == clean_best
+        assert len(weights) == len(clean_weights)
+        for got, expected in zip(weights, clean_weights):
+            numpy.testing.assert_array_equal(got, expected)
+
+    def test_dashboard_renders_chaos_counters(self):
+        from veles_tpu.web_status import format_fleet_health
+        cell = format_fleet_health({
+            "ledger": {"issued": 20, "done": 17, "requeued": 2,
+                       "fenced_total": 3},
+            "chaos": {"deaths": 1, "frames_dropped": 2,
+                      "updates_duplicated": 0}})
+        assert "17/20 jobs done" in cell
+        assert "2 requeued" in cell and "3 fenced" in cell
+        assert "1 deaths" in cell and "2 frames dropped" in cell
+        assert "updates" not in cell  # zero tallies are elided
+        assert format_fleet_health(None) == ""

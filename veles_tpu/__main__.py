@@ -114,6 +114,30 @@ class Main(Logger):
                                  "hosts; slave: ship the relaunch recipe")
         parser.add_argument("--slave-death-probability", type=float,
                             default=0.0, help="fault injection")
+        chaos = parser.add_argument_group(
+            "chaos harness", "slave-side deterministic fault injection "
+            "(fleet/chaos.py; probabilities in [0,1], one seeded RNG "
+            "stream so a given seed replays the same fault schedule)")
+        chaos.add_argument("--chaos-seed", type=int, default=None,
+                           metavar="N", help="chaos RNG seed")
+        chaos.add_argument("--chaos-frame-drop", type=float, default=None,
+                           metavar="P", help="drop a frame (connection "
+                           "reset) with probability P")
+        chaos.add_argument("--chaos-frame-delay", type=float, default=None,
+                           metavar="P", help="delay a frame with "
+                           "probability P")
+        chaos.add_argument("--chaos-slow-job", type=float, default=None,
+                           metavar="P", help="stretch a job (straggler) "
+                           "with probability P")
+        chaos.add_argument("--chaos-duplicate-update", type=float,
+                           default=None, metavar="P",
+                           help="replay an update frame with probability "
+                           "P (the master must fence the duplicate)")
+        chaos.add_argument("--chaos-death", type=float, default=None,
+                           metavar="P", help="die mid-job with "
+                           "probability P (disconnect in-process; "
+                           "root.common.fleet.chaos.death_mode=exit for "
+                           "the reference os._exit)")
         parser.add_argument("--dry-run",
                             choices=("load", "init"), default=None,
                             help="stop after loading/initializing")
@@ -371,6 +395,17 @@ class Main(Logger):
                     parser.error("--mesh expects AXIS=N[,AXIS=N...], "
                                  "got %r" % args.mesh)
                 setattr(root.common.mesh.axes, axis, size)
+        # chaos flags AFTER the config layering: the CLI wins over
+        # root.common.fleet.chaos.* set by config files
+        for flag, key in (("chaos_seed", "seed"),
+                          ("chaos_frame_drop", "frame_drop"),
+                          ("chaos_frame_delay", "frame_delay"),
+                          ("chaos_slow_job", "slow_job"),
+                          ("chaos_duplicate_update", "duplicate_update"),
+                          ("chaos_death", "death")):
+            value = getattr(args, flag)
+            if value is not None:
+                setattr(root.common.fleet.chaos, key, value)
         if args.background:
             # AFTER config layering: daemon.log must honor a cache dir
             # set by the config file or CLI overrides

@@ -15,7 +15,17 @@ parallelism, and parity with the reference's elasticity semantics. The
 transport is asyncio TCP with length-prefixed pickled frames (the modern
 stdlib equivalent of the reference's Twisted control plane + ZeroMQ
 streaming-pickle data plane, reference ``txzmq/connection.py:395-562``).
+
+Fault tolerance (docs/fleet_robustness.md): every served job is a
+*leased* ledger entry (``ledger.py``) — expired or dropped leases are
+requeued explicitly and duplicate/stale/foreign updates are fenced,
+with the master's per-start ``epoch`` UUID fencing across restarts. The
+deterministic chaos harness (``chaos.py``) injects frame delay/drop,
+stragglers, duplicate replay and mid-job death from one seeded RNG
+stream so recovery is testable bit-for-bit.
 """
 
 from veles_tpu.fleet.server import Server  # noqa: F401
 from veles_tpu.fleet.client import Client  # noqa: F401
+from veles_tpu.fleet.ledger import JobLedger  # noqa: F401
+from veles_tpu.fleet.chaos import ChaosConfig, ChaosMonkey  # noqa: F401

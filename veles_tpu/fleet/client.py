@@ -13,6 +13,18 @@ Reference ``veles/client.py``. Kept semantics:
 - fault injection ``death_probability`` — the slave kills itself mid-job
   with the given probability, exercising the master's requeue path
   (``client.py:438-442``).
+
+Robustness additions over the reference:
+
+- every job carries a ``job_id`` lease and the master's ``epoch``
+  (minted per ``Server.start()``); the client echoes both in the update
+  so the master can fence duplicates, requeued leases and answers to a
+  previous master incarnation (see ``fleet/ledger.py``);
+- a welcome with a NEW epoch means the master restarted: the client
+  re-handshakes cleanly and restores its reconnect budget;
+- the single ``death_probability`` hook generalizes to the seeded
+  deterministic chaos harness (``fleet/chaos.py``) wrapping the
+  post-handshake frame traffic and the job loop.
 """
 
 import asyncio
@@ -28,9 +40,15 @@ from veles_tpu.fleet.protocol import (
 class Client(Logger):
     """The fleet slave (reference ``client.py:405``)."""
 
+    #: paused-poll backoff: first retry after PAUSE_POLL_BASE seconds,
+    #: doubling up to PAUSE_POLL_MAX — a long-paused slave must not
+    #: generate a steady 2 Hz frame stream
+    PAUSE_POLL_BASE = 0.5
+    PAUSE_POLL_MAX = 8.0
+
     def __init__(self, address, workflow, power=1.0, async_mode=False,
                  death_probability=0.0, max_reconnect_attempts=7,
-                 secret=None, enable_respawn=False):
+                 secret=None, enable_respawn=False, chaos=None):
         super().__init__(logger_name="fleet.Client")
         self.enable_respawn = enable_respawn
         host, _, port = address.rpartition(":")
@@ -42,8 +60,16 @@ class Client(Logger):
         self.async_mode = async_mode
         self.death_probability = death_probability
         self.max_reconnect_attempts = max_reconnect_attempts
+        if chaos is None:
+            # default: build from root.common.fleet.chaos (None when no
+            # fault is configured); pass chaos=False to force-disable
+            from veles_tpu.fleet.chaos import ChaosMonkey
+            chaos = ChaosMonkey.from_config()
+        self.chaos = chaos or None
         self.sid = None
+        self.master_epoch = None
         self.jobs_done = 0
+        self._attempts = 0
         self._loop = None
         self._thread = None
         self._stopped = threading.Event()
@@ -106,18 +132,18 @@ class Client(Logger):
 
     # -- session with reconnect budget ---------------------------------------
     async def _session(self):
-        attempts = 0
+        self._attempts = 0
         while not self._stopped.is_set():
             try:
                 reader, writer = await asyncio.open_connection(
                     self.host, self.port)
             except OSError:
-                attempts += 1
-                if attempts > self.max_reconnect_attempts:
+                self._attempts += 1
+                if self._attempts > self.max_reconnect_attempts:
                     self.error("gave up reconnecting after %d attempts",
-                               attempts - 1)
+                               self._attempts - 1)
                     return
-                await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
+                await asyncio.sleep(min(0.2 * 2 ** self._attempts, 5.0))
                 continue
             self._writer_ = writer
             self._handshaked_ = False
@@ -125,7 +151,7 @@ class Client(Logger):
                 done = await self._work(reader, writer)
                 if done:
                     return
-                attempts = 0
+                self._attempts = 0
             except (asyncio.IncompleteReadError, ConnectionError,
                     ProtocolError) as exc:
                 if not self._handshaked_:
@@ -133,18 +159,19 @@ class Client(Logger):
                     # mismatch shows up as a silent close on its side):
                     # this is NOT a transient network loss — burn an
                     # attempt and back off, or we busy-loop forever
-                    attempts += 1
-                    if attempts > self.max_reconnect_attempts:
+                    self._attempts += 1
+                    if self._attempts > self.max_reconnect_attempts:
                         self.error(
                             "master refused the handshake %d times "
                             "(wrong fleet secret or workflow checksum?); "
-                            "giving up", attempts - 1)
+                            "giving up", self._attempts - 1)
                         return
                     self.warning("handshake failed (%s); retrying",
                                  type(exc).__name__)
-                    await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
+                    await asyncio.sleep(min(0.2 * 2 ** self._attempts,
+                                            5.0))
                 else:
-                    attempts = 0
+                    self._attempts = 0
                     self.warning("connection to master lost; reconnecting")
                     # breathe before reconnecting: a master that welcomes
                     # then consistently drops would otherwise be hammered
@@ -175,6 +202,16 @@ class Client(Logger):
             return True
         self._handshaked_ = True
         self.sid = welcome["id"]
+        epoch = welcome.get("epoch")
+        if self.master_epoch is not None and epoch != self.master_epoch:
+            # a NEW epoch means the master restarted (not a network
+            # blip): this handshake is a clean re-join — restore the
+            # reconnect budget burnt while the master was away
+            self.info("master epoch changed (%s -> %s): master "
+                      "restarted, re-handshaking cleanly",
+                      self.master_epoch, epoch)
+            self._attempts = 0
+        self.master_epoch = epoch
         # master confirmed the same-host shared-memory data plane
         from veles_tpu.fleet.protocol import COMPRESS_THRESHOLD
         self._shm_thr_ = (COMPRESS_THRESHOLD if welcome.get("shm")
@@ -183,38 +220,71 @@ class Client(Logger):
         if initial:
             self.workflow.apply_initial_data_from_master(initial)
         self.info("connected as %s", self.sid)
-        await write_frame(writer, {"type": "job_request"}, self._secret)
+        # the handshake above never routes through chaos — a fault must
+        # not masquerade as an authentication failure; everything below
+        # does (self._read/self._write)
+        await self._write(writer, {"type": "job_request"})
+        pause_streak = 0
         while not self._stopped.is_set():
-            msg = await read_frame(reader, self._secret)
+            msg = await self._read(reader)
             mtype = msg.get("type")
+            if mtype != "job" or not msg.get("paused"):
+                pause_streak = 0
             if mtype == "job":
                 if msg.get("paused"):
-                    await asyncio.sleep(0.5)
-                    await write_frame(writer, {"type": "job_request"}, self._secret)
+                    # capped exponential backoff: a long-paused slave
+                    # must not poll the master at a steady 2 Hz
+                    await asyncio.sleep(
+                        min(self.PAUSE_POLL_BASE * 2 ** pause_streak,
+                            self.PAUSE_POLL_MAX))
+                    pause_streak += 1
+                    await self._write(writer, {"type": "job_request"})
                     continue
                 if msg.get("job") is None:
                     self.info("no more jobs; exiting")
                     return True
+                job_id = msg.get("job_id")
                 update = await self._do_job(msg["job"])
+                if self.chaos is not None:
+                    self.chaos.maybe_die(writer)
                 if self.death_probability > 0 \
                         and random.random() < self.death_probability:
                     self.warning("fault injection: dying mid-job")
                     os._exit(1)
                 shm_thr = getattr(self, "_shm_thr_", None)
+                # echo the lease + master epoch: the ledger fences
+                # duplicates, requeued leases and stale-epoch answers
+                await self._write(writer,
+                                  {"type": "update", "update": update,
+                                   "job_id": job_id,
+                                   "epoch": self.master_epoch},
+                                  shm_threshold=shm_thr)
                 if self.async_mode:
                     # pipelined: next request goes out with the update
-                    await write_frame(writer, {"type": "update",
-                                               "update": update},
-                                      self._secret, shm_threshold=shm_thr)
-                    await write_frame(writer, {"type": "job_request"}, self._secret)
-                else:
-                    await write_frame(writer, {"type": "update",
-                                               "update": update},
-                                      self._secret, shm_threshold=shm_thr)
+                    await self._write(writer, {"type": "job_request"})
             elif mtype == "update_ack":
-                if not self.async_mode:
-                    await write_frame(writer, {"type": "job_request"}, self._secret)
+                if msg.get("fenced"):
+                    # the master rejected the (duplicate/stale) update;
+                    # this ack is informational — requesting another job
+                    # for it would double-feed the pipeline
+                    self.warning("master fenced our update: %s",
+                                 msg["fenced"])
+                elif not self.async_mode:
+                    await self._write(writer, {"type": "job_request"})
         return False
+
+    async def _read(self, reader):
+        if self.chaos is not None:
+            return await self.chaos.read_frame(reader, self._secret)
+        return await read_frame(reader, self._secret)
+
+    async def _write(self, writer, message, shm_threshold=None):
+        if self.chaos is not None:
+            await self.chaos.write_frame(writer, message, self._secret,
+                                         shm_threshold=shm_threshold)
+        else:
+            await write_frame(writer, message, self._secret,
+                              shm_threshold=shm_threshold)
 
     async def _do_job(self, job):
         """Run the whole workflow locally on the job (reference
@@ -230,5 +300,7 @@ class Client(Logger):
 
         await loop.run_in_executor(None, launch)
         update = await future
+        if self.chaos is not None:
+            await self.chaos.stretch_job()  # slow-slave fault
         self.jobs_done += 1
         return update

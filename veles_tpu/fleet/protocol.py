@@ -12,6 +12,19 @@ reference's pluggable chunk compression), 2 = safe (pickle-free, see
 key; job/update payloads ride inside them (the units' generate/apply
 contracts define their content).
 
+Message schema (master <-> slave, after the hello/welcome handshake):
+
+- ``welcome``: ``id`` (slave id), ``shm`` (shared-memory negotiated),
+  ``epoch`` (the master's per-start fencing UUID), ``initial``;
+- ``job``: ``job`` (payload list, ``None`` = no more jobs), ``job_id``
+  (monotonic lease id, see ``fleet/ledger.py``), ``epoch``, ``paused``;
+- ``update``: ``update`` (payload list), ``job_id`` + ``epoch`` echoed
+  from the job (the master fences mismatches instead of applying them),
+  optional ``chaos`` (fault-injection tallies, ``fleet/chaos.py``);
+- ``update_ack``: optional ``fenced`` (the rejection verdict — the
+  slave must not answer a fenced ack with another job_request);
+- ``job_request`` / ``power`` / ``bye``: as in the reference.
+
 Security: EVERY frame — including the pre-handshake hello — is
 authenticated with a shared-secret HMAC verified *before* any
 decompression or deserialization; a peer without the secret cannot reach

@@ -20,9 +20,11 @@ Reference ``veles/server.py``. Kept semantics:
 import asyncio
 import threading
 import time
+import uuid
 
 from veles_tpu.core.config import root
 from veles_tpu.core.logger import Logger
+from veles_tpu.fleet.ledger import JobLedger
 from veles_tpu.fleet.protocol import (
     COMPRESS_THRESHOLD, ProtocolError, machine_id, read_frame,
     resolve_secret, write_frame)
@@ -30,6 +32,11 @@ from veles_tpu.fleet.protocol import (
 
 class SlaveDescription:
     """Fleet-roster entry (reference ``server.py:172``)."""
+
+    #: job-duration history cap: the mean+3sigma hang threshold must track
+    #: the slave's RECENT speed, not be skewed by ancient samples (and the
+    #: list must not grow unboundedly over long runs)
+    JOB_TIMES_KEEP = 100
 
     def __init__(self, sid, info):
         self.id = sid
@@ -47,6 +54,12 @@ class SlaveDescription:
         self.job_times = []
         self.job_started = None
         self.paused = False
+        self.chaos_counters = None  # latest fault tallies from the slave
+
+    def record_job_time(self, duration):
+        self.job_times.append(duration)
+        if len(self.job_times) > self.JOB_TIMES_KEEP:
+            del self.job_times[:-self.JOB_TIMES_KEEP]
 
     def timeout(self, default):
         """mean + 3σ adaptive hang threshold (reference
@@ -96,6 +109,16 @@ class Server(Logger):
         self.job_timeout = job_timeout
         self.slaves = {}
         self.blacklist = set()
+        #: job-level accounting: leases, explicit requeue, update fencing
+        self.ledger = JobLedger()
+        #: master-generation fence, minted at start(); echoed in every
+        #: post-welcome frame so updates addressed to a previous master
+        #: incarnation are rejected, not applied (see fleet/ledger.py)
+        self.epoch = None
+        #: latest chaos tallies per client process (mid, pid): counters
+        #: are cumulative per process, so keeping the last report per
+        #: process survives reconnects without double counting
+        self._chaos_reports = {}
         self._next_id = 0
         self._pending_requests = []  # backpressured (sid, writer)
         self._writers = {}
@@ -111,6 +134,7 @@ class Server(Logger):
         """Run the asyncio server in a dedicated thread (the reactor role;
         reference ran Twisted as the main loop, but here jit dispatch owns
         the main thread)."""
+        self.epoch = uuid.uuid4().hex
         ready = threading.Event()
 
         def run_loop():
@@ -173,7 +197,17 @@ class Server(Logger):
         if self.respawn_manager is not None:
             self.respawn_manager.stop()
         if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            def shutdown():
+                # close live slave transports BEFORE the loop dies: a
+                # stopped loop never runs its suspended handlers again,
+                # so an un-closed socket would leave parked slaves
+                # waiting forever instead of reconnecting to our
+                # successor (the master-restart recovery path)
+                for writer in list(self._writers.values()):
+                    writer.close()
+                self._loop.stop()
+
+            self._loop.call_soon_threadsafe(shutdown)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
 
@@ -254,7 +288,7 @@ class Server(Logger):
             initial = await self._in_thread(
                 self.workflow.generate_initial_data_for_slave, slave)
             await write_frame(writer, {"type": "welcome", "id": sid,
-                                       "shm": shm_ok,
+                                       "shm": shm_ok, "epoch": self.epoch,
                                        "initial": initial}, self._secret,
                               shm_threshold=slave.shm_threshold)
             self.info("slave %s connected (mid=%s power=%.1f)", sid,
@@ -305,14 +339,36 @@ class Server(Logger):
             return
         slave.state = "WORK"
         slave.job_started = time.time()
-        await write_frame(writer, {"type": "job", "job": job}, self._secret,
+        # lease: deadline from the slave's adaptive timeout; the update
+        # must echo the job_id (exactly-once fence) and our epoch
+        timeout = slave.timeout(self.job_timeout)
+        job_id = self.ledger.issue(slave.id, timeout)
+        await write_frame(writer, {"type": "job", "job": job,
+                                   "job_id": job_id,
+                                   "epoch": self.epoch}, self._secret,
                           shm_threshold=getattr(slave, "shm_threshold",
                                                 None))
-        self._watch_hang(slave)
+        self._watch_hang(slave, job_id, timeout)
 
     async def _apply_update(self, slave, writer, msg):
+        if isinstance(msg.get("chaos"), dict):
+            # the slave's fault-injection tallies ride its updates so the
+            # dashboard can prove each configured fault actually fired
+            slave.chaos_counters = msg["chaos"]
+            self._chaos_reports[(slave.mid, slave.pid)] = msg["chaos"]
+        verdict = self._fence_update(slave, msg)
+        if verdict is not None:
+            self.warning("fenced update from %s: %s (job_id=%r)",
+                         slave.id, verdict, msg.get("job_id"))
+            # still ack (flagged) so a sync slave doesn't stall — it
+            # skips the job_request for fenced acks
+            await write_frame(writer, {"type": "update_ack",
+                                       "fenced": verdict}, self._secret)
+            slave.state = "WAIT"
+            await self._retry_pending()
+            return
         if slave.job_started is not None:
-            slave.job_times.append(time.time() - slave.job_started)
+            slave.record_job_time(time.time() - slave.job_started)
             slave.job_started = None
         slave.jobs_done += 1
         if slave.jobs_done == 1 and self.respawn_manager is not None \
@@ -327,6 +383,15 @@ class Server(Logger):
         await write_frame(writer, {"type": "update_ack"}, self._secret)
         slave.state = "WAIT"
         await self._retry_pending()
+
+    def _fence_update(self, slave, msg):
+        """Judge an update before it can touch master state. Returns
+        ``None`` (apply it) or the fence verdict string (reject): unknown/
+        duplicate/requeued/foreign ``job_id`` via the ledger, or a stale
+        master ``epoch`` (the update answers a previous incarnation)."""
+        if msg.get("epoch") != self.epoch:
+            return self.ledger.count_stale_epoch()
+        return self.ledger.settle(msg.get("job_id"), slave.id)
 
     def _locked_apply(self, update, slave):
         with self._update_lock:
@@ -351,9 +416,7 @@ class Server(Logger):
             if slave is not None:
                 await self._serve_job(slave, writer)
 
-    def _watch_hang(self, slave):
-        timeout = slave.timeout(self.job_timeout)
-
+    def _watch_hang(self, slave, job_id, timeout):
         def check():
             if self.slaves.get(slave.id) is not slave:
                 # the slave already dropped (death/disconnect): a stale
@@ -361,10 +424,14 @@ class Server(Logger):
                 # that would ban every future (e.g. respawned) slave of
                 # that host
                 return
-            if slave.job_started is not None \
-                    and time.time() - slave.job_started > timeout:
-                self.warning("slave %s hanged (> %.1fs); dropping + "
-                             "blacklisting", slave.id, timeout)
+            # per-lease expiry: only fires when THIS job is still
+            # OUTSTANDING past its deadline (the old elapsed-time check
+            # could misread a later, faster job); marking it REQUEUED
+            # arms the fence against the zombie's eventual late update
+            if self.ledger.expire_if_outstanding(job_id):
+                self.warning("slave %s hanged on job %d (> %.1fs); "
+                             "dropping + blacklisting", slave.id, job_id,
+                             timeout)
                 if slave.mid != "?":
                     # never blacklist the unknown-mid placeholder: one
                     # anonymous hang would ban every future such slave
@@ -379,6 +446,14 @@ class Server(Logger):
         slave = self.slaves.pop(sid, None)
         if slave is not None:
             slave.job_started = None  # disarm any in-flight hang timer
+        # explicit job-level requeue: every lease still OUTSTANDING for
+        # this slave transitions to REQUEUED (the workflow's drop_slave
+        # below requeues the actual minibatch payloads) and its late
+        # update, should the peer resurface, is fenced
+        requeued = self.ledger.requeue_for_slave(sid)
+        if requeued:
+            self.info("requeued %d outstanding lease(s) of %s: %s",
+                      len(requeued), sid, requeued)
         self._writers.pop(sid, None)
         self._pending_requests = [
             (s, w) for s, w in self._pending_requests if s != sid]
@@ -433,7 +508,23 @@ class Server(Logger):
 
     def fleet_status(self):
         """Observability snapshot consumed by the web-status dashboard
-        and the SlaveStats plotter (reference ``web_status.py`` feed)."""
-        return {"slaves": [s.as_dict() for s in self.slaves.values()],
-                "blacklist": sorted(self.blacklist),
-                "queued_jobs": len(self._pending_requests)}
+        and the SlaveStats plotter (reference ``web_status.py`` feed).
+        Called from the status/plotter threads while the event-loop
+        thread mutates the roster — snapshot both containers first (as
+        ``drain()`` does) instead of iterating them live."""
+        slaves = list(self.slaves.values())
+        pending = list(self._pending_requests)
+        chaos = {}
+        for counters in list(self._chaos_reports.values()):
+            for key, value in counters.items():
+                if isinstance(value, (int, float)):
+                    chaos[key] = chaos.get(key, 0) + value
+        return {"slaves": [s.as_dict() for s in slaves],
+                # .copy() is a single C-level op (GIL-atomic), unlike
+                # sorted() iterating the live set under a concurrent
+                # hang-check blacklist.add
+                "blacklist": sorted(self.blacklist.copy()),
+                "queued_jobs": len(pending),
+                "epoch": self.epoch,
+                "ledger": self.ledger.snapshot(),
+                "chaos": chaos}

@@ -36,9 +36,12 @@ class Launcher(Logger):
     def __init__(self, listen_address=None, master_address=None,
                  result_file=None, slave_power=1.0, async_slave=False,
                  slave_death_probability=0.0, respawn=False, nodes=None,
-                 **kwargs):
+                 chaos=None, **kwargs):
         super().__init__(logger_name="Launcher")
         self.respawn = respawn
+        #: chaos-harness overrides (dict merged into
+        #: root.common.fleet.chaos at initialize; see fleet/chaos.py)
+        self.chaos = dict(chaos or {})
         #: hosts to spawn slaves on at master startup (reference
         #: ``-n host`` specs, ``launcher.py:617-660``)
         self.nodes = list(nodes or [])
@@ -150,6 +153,10 @@ class Launcher(Logger):
             if self.nodes:
                 self._launch_nodes()
         elif self.is_slave:
+            if self.chaos:
+                # launcher-level chaos knobs land in the config tree the
+                # Client builds its ChaosMonkey from
+                root.common.fleet.chaos.update(self.chaos)
             from veles_tpu.fleet.client import Client
             self.agent = Client(
                 self.master_address, self.workflow,

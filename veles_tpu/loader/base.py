@@ -324,8 +324,13 @@ class Loader(Unit):
         epoch). The epoch tag lets the master's Decision bucket updates
         that arrive out of order across epoch boundaries."""
         if self.failed_minibatches:
-            klass, indices, valid, epoch = self.failed_minibatches.pop()
-            requeued = True
+            # re-serve with the ORIGINAL last_of_class/last_of_epoch
+            # flags: a requeued job must be bit-identical to the one the
+            # dead slave held, or an epoch-closing minibatch would lose
+            # its epoch-end semantics on retry (the chaos harness asserts
+            # faulted == fault-free convergence on exactly this)
+            (klass, indices, valid, last_of_class, last_of_epoch,
+             epoch) = self.failed_minibatches.pop()
         else:
             block = self._next_block()
             if block is None:
@@ -336,16 +341,15 @@ class Loader(Unit):
             # copy, not view: the epoch reshuffle mutates shuffled_indices
             # in place, which would corrupt pending/requeued payloads
             indices = self.shuffled_indices[klass][pos:pos + valid].copy()
-            requeued = False
+            lengths = self.effective_class_lengths
+            last_of_class = self._position[klass] >= lengths[klass]
+            last_of_epoch = last_of_class and all(
+                self._position[k] >= lengths[k] or lengths[k] == 0
+                for k in (TEST, VALID, TRAIN))
         if slave_id is not None:
             self.pending_minibatches_[slave_id].append(
-                (klass, indices, valid, epoch))
-        lengths = self.effective_class_lengths
-        last_of_class = (not requeued
-                         and self._position[klass] >= lengths[klass])
-        last_of_epoch = last_of_class and all(
-            self._position[k] >= lengths[k] or lengths[k] == 0
-            for k in (TEST, VALID, TRAIN))
+                (klass, indices, valid, last_of_class, last_of_epoch,
+                 epoch))
         return klass, indices, valid, last_of_class, last_of_epoch, epoch
 
     def serve_next_class_sweep(self):

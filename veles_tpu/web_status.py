@@ -44,7 +44,7 @@ _PAGE = """<!DOCTYPE html>
 <h1>veles_tpu status</h1>
 <h2>Workflows</h2>
 <table id="wf"><tr><th>name</th><th>mode</th><th>slaves</th>
-<th>runtime (s)</th><th>updated</th></tr>%(rows)s</table>
+<th>runtime (s)</th><th>fleet health</th><th>updated</th></tr>%(rows)s</table>
 <h2>Workflow graphs</h2><div id="graphs">%(graphs)s</div>
 <h2>Plots</h2><div id="plots">%(plots)s</div>
 <script>
@@ -61,12 +61,13 @@ var src = new EventSource('/stream');
 src.onmessage = function(ev) {
   var state = JSON.parse(ev.data);
   var rows = ['<tr><th>name</th><th>mode</th><th>slaves</th>' +
-              '<th>runtime (s)</th><th>updated</th></tr>'];
+              '<th>runtime (s)</th><th>fleet health</th>' +
+              '<th>updated</th></tr>'];
   (state.workflows || []).forEach(function(w) {
     rows.push('<tr><td>' + esc(w.name) + '</td><td>' + esc(w.mode) +
               '</td><td>' + (0 | w.slaves) + '</td><td>' +
-              Math.round(w.runtime) + '</td><td>' + esc(w.updated) +
-              '</td></tr>');
+              Math.round(w.runtime) + '</td><td>' + esc(w.fleet || '') +
+              '</td><td>' + esc(w.updated) + '</td></tr>');
   });
   document.getElementById('wf').innerHTML = rows.join('');
   var graphs = [];
@@ -87,6 +88,30 @@ src.onmessage = function(ev) {
 };
 </script>
 </body></html>"""
+
+def format_fleet_health(fleet):
+    """The master's ledger/chaos counters as one table cell (consumed by
+    both the static page and the /stream JS — formatted server-side so
+    the two views cannot drift). Empty for standalone runs."""
+    if not isinstance(fleet, dict):
+        return ""
+    parts = []
+    ledger = fleet.get("ledger")
+    if isinstance(ledger, dict):
+        parts.append("%s/%s jobs done" % (ledger.get("done", 0),
+                                          ledger.get("issued", 0)))
+        if ledger.get("requeued"):
+            parts.append("%s requeued" % ledger["requeued"])
+        if ledger.get("fenced_total"):
+            parts.append("%s fenced" % ledger["fenced_total"])
+    chaos = fleet.get("chaos")
+    if isinstance(chaos, dict):
+        fired = ", ".join("%s %s" % (v, k.replace("_", " "))
+                          for k, v in sorted(chaos.items()) if v)
+        if fired:
+            parts.append("chaos: " + fired)
+    return " · ".join(parts)
+
 
 #: view-group fill colors for the live graph (the reference's viz.js
 #: page colored by the same VIEW_GROUP taxonomy)
@@ -369,6 +394,7 @@ class WebStatusServer(Logger):
                 "slaves": len(slaves)
                 if isinstance(slaves, (list, tuple)) else 0,
                 "runtime": runtime,
+                "fleet": format_fleet_health(s.get("fleet")),
                 "updated": time.strftime(
                     "%X", time.localtime(s.get("updated", 0)))})
             if isinstance(s.get("graph"), dict):
@@ -405,12 +431,13 @@ class WebStatusServer(Logger):
             slaves = s.get("slaves", [])
             rows.append(
                 "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.0f</td>"
-                "<td>%s</td></tr>" % (
+                "<td>%s</td><td>%s</td></tr>" % (
                     escape(str(s.get("name", key))),
                     escape(str(s.get("mode", "?"))),
                     len(slaves) if isinstance(slaves, (list, tuple))
                     else 0,
                     runtime,
+                    escape(format_fleet_health(s.get("fleet"))),
                     time.strftime("%X",
                                   time.localtime(s.get("updated", 0)))))
         graphs = []
@@ -441,7 +468,7 @@ class WebStatusServer(Logger):
                 plots.append('<img src="/plots/%s?t=%d" alt="%s"/>'
                              % (name, stamp, name))
         return _PAGE % {"rows": "".join(rows) or
-                        "<tr><td colspan=5>none</td></tr>",
+                        "<tr><td colspan=6>none</td></tr>",
                         "graphs": "".join(graphs) or "<p>none</p>",
                         "plots": "".join(plots) or "<p>none</p>"}
 
@@ -474,7 +501,13 @@ class StatusNotifier:
         }
         agent = getattr(launcher, "agent", None)
         if agent is not None and hasattr(agent, "fleet_status"):
-            status["slaves"] = agent.fleet_status().get("slaves", [])
+            fleet = agent.fleet_status()
+            status["slaves"] = fleet.get("slaves", [])
+            # job-ledger + chaos observability (docs/fleet_robustness.md):
+            # the dashboard's proof that requeue/fencing actually works
+            status["fleet"] = {
+                key: fleet.get(key)
+                for key in ("epoch", "queued_jobs", "ledger", "chaos")}
         # the live unit DAG (+ run counters) for the dashboard's graph
         # view — the reference's viz.js workflow page
         # (web_status.py:113-165), rendered server-side as SVG here
