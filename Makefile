@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: check test entry hooks chaos
+.PHONY: check test entry hooks chaos chaos-serve
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -17,6 +17,17 @@ chaos:
 		VELES_TPU_CHAOS_SEED=$$seed JAX_PLATFORMS=cpu \
 			$(PYTHON) -m pytest tests/test_fleet_chaos.py \
 			-m chaos -q || exit 1; \
+	done
+
+# Serving chaos suite (docs/serving_robustness.md): breaker recovery,
+# deadline expiry, admission control, hostile clients — under the same
+# three pinned seeds (see tests/test_serving_chaos.py).
+chaos-serve:
+	for seed in 1 3 5; do \
+		echo "== chaos-serve seed $$seed"; \
+		VELES_TPU_CHAOS_SEED=$$seed JAX_PLATFORMS=cpu \
+			$(PYTHON) -m pytest tests/test_serving_chaos.py \
+			-m chaos_serve -q || exit 1; \
 	done
 
 entry:

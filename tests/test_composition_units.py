@@ -132,6 +132,18 @@ class TestCallableModule:
                         "--listen", "0.0.0.0:5050", "--seed", "42",
                         "--async-slave"]
 
+    def test_kwargs_to_argv_repeats_list_flags(self):
+        """List/tuple values repeat the flag (argparse append actions
+        like --nodes) and the serving-survival knobs pass through."""
+        from veles_tpu.cli import kwargs_to_argv
+        argv = kwargs_to_argv("wf.py", nodes=["h1", "h2"],
+                              serve_max_queue=16, serve_deadline=2.5,
+                              chaos_serve_step_fail=0.1)
+        assert argv == ["wf.py", "-", "--nodes", "h1", "--nodes", "h2",
+                        "--serve-max-queue", "16",
+                        "--serve-deadline", "2.5",
+                        "--chaos-serve-step-fail", "0.1"]
+
     def test_module_is_callable_end_to_end(self, tmp_path):
         import veles_tpu
         wf_file = tmp_path / "tiny_wf.py"

@@ -145,6 +145,35 @@ class TestForgeRoundtrip:
             urllib.request.urlopen(req, timeout=10)
         assert err.value.code == 400
 
+    def test_oversized_register_gets_single_413(self, server):
+        """The shared read_body cap applies to forge's JSON endpoints:
+        an oversized /register body answers ONE 413 (not a 413 followed
+        by a 400 on the same socket) before buffering anything; uploads
+        keep their own much larger bound (UPLOAD_MAX_BODY)."""
+        import socket
+
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=10) as sock:
+            sock.sendall(b"POST /register HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Content-Length: 9999999999\r\n\r\n")
+            sock.settimeout(10)
+            chunks = []
+            while True:
+                data = sock.recv(4096)
+                if not data:
+                    break
+                chunks.append(data)
+        reply = b"".join(chunks).decode(errors="replace")
+        assert "413" in reply.split("\r\n")[0]
+        assert reply.count("HTTP/1.0") == 1  # exactly one response
+        # the server keeps serving afterwards
+        import urllib.request
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/service?query=list" % server.port,
+                timeout=10) as resp:
+            assert resp.status == 200
+
     def test_write_actions_need_token(self, server, tmp_path):
         anon = self.client(server, token=None)
         with pytest.raises(urllib.error.HTTPError) as err:

@@ -574,15 +574,22 @@ class TestContinuousDecoder:
         finally:
             api.stop()
 
-    def test_generate_api_driver_failure_fails_fast(self, model):
+    def test_generate_api_driver_failure_sheds_then_heals(self, model):
         """A device/runtime error in the driver loop must resolve every
-        in-flight request with an error (no 300 s timeout wedge) and
-        fail subsequent requests fast."""
+        in-flight request with a retryable error (no 300 s timeout
+        wedge), trip the breaker, and SELF-HEAL: the decoder is rebuilt
+        from the held params and a retried request succeeds without a
+        process restart (docs/serving_robustness.md)."""
+        import time
+
+        from veles_tpu.parallel.decode import generate
         from veles_tpu.serving import GenerateAPI
+        import jax.numpy as jnp
 
         params, table, heads, vocab = model
         api = GenerateAPI(params, table, heads, slots=1, max_len=32,
-                          n_tokens=4, chunk=2, port=0)
+                          n_tokens=4, chunk=2, port=0,
+                          rebuild_backoff=0.02)
         api.start()
         try:
             url = "http://127.0.0.1:%d/generate" % api.port
@@ -593,12 +600,24 @@ class TestContinuousDecoder:
             api.decoder.step_many = boom
             with pytest.raises(urllib.error.HTTPError) as err:
                 post(url, {"tokens": [1, 2, 3]}, timeout=30)
-            assert err.value.code == 400
+            assert err.value.code == 503  # shed, retryable
             assert "injected device failure" in \
                 err.value.read().decode()
-            # the driver survives: later requests fail fast too
-            with pytest.raises(urllib.error.HTTPError) as err:
-                post(url, {"tokens": [2, 3]}, timeout=30)
-            assert err.value.code == 400
+            # the breaker tripped and the rebuild closes it again
+            deadline = time.time() + 30
+            while not api.health.ready and time.time() < deadline:
+                time.sleep(0.02)
+            assert api.health.ready, api.health.snapshot()
+            snap = api.health.snapshot()
+            assert snap["counters"]["trips"] == 1
+            assert snap["counters"]["rebuilds"] == 1
+            assert snap["counters"]["shed"] == 1
+            # the rebuilt decoder serves correct tokens (the injected
+            # failure died with the old decoder instance)
+            out = post(url, {"tokens": [2, 3]}, timeout=60)
+            want, _ = generate(params, table,
+                               jnp.asarray([2, 3])[None], heads,
+                               n_tokens=4, max_len=32)
+            assert out["tokens"] == numpy.asarray(want)[0].tolist()
         finally:
             api.stop()

@@ -1,0 +1,788 @@
+"""Serving survival layer: admission control, deadlines, self-healing
+driver, chaos harness (docs/serving_robustness.md).
+
+The acceptance property, mirroring ``tests/test_fleet_chaos.py``: with a
+pinned seed, an injected decoder-step failure trips the circuit breaker,
+the server sheds in-flight requests with retryable errors, rebuilds the
+decoder and returns to ``/readyz`` OK *without a restart* — and the
+re-issued greedy requests return tokens **bit-identical** to a fault-free
+run. Saturation answers 429 (never a hang) and expired deadlines free
+their decoder slots, both proven through the ``/healthz`` counters.
+
+``VELES_TPU_CHAOS_SEED`` selects the chaos RNG seed (``make chaos-serve``
+runs the suite under three fixed seeds). The breaker trip itself is
+deterministic by construction — ``step_fail=1.0`` capped by
+``step_fail_max`` — so recovery is asserted on every seed; the seed
+varies the slow-step/hostile-client schedule.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.serving import ContinuousDecoder, GenerateAPI, ServingHealth
+from veles_tpu.serving_chaos import (ChaosStepError, ServingChaosConfig,
+                                     ServingChaosMonkey)
+
+CHAOS_SEED = int(os.environ.get("VELES_TPU_CHAOS_SEED", "1"))
+
+pytestmark = pytest.mark.chaos_serve
+
+
+def post(url, payload, timeout=30):
+    """POST JSON; returns (status_code, decoded_body) without raising."""
+    data = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as err:
+        body = err.read().decode()
+        try:
+            body = json.loads(body)
+        except ValueError:
+            body = {"raw": body}
+        return err.code, body, dict(err.headers)
+
+
+def get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            raw = resp.read().decode()
+            status = resp.status
+    except urllib.error.HTTPError as err:
+        raw = err.read().decode()
+        status = err.code
+    try:
+        return status, json.loads(raw)
+    except ValueError:
+        return status, {"raw": raw}
+
+
+@pytest.fixture(scope="module")
+def model():
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 16, 11
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    return params, table, heads, vocab
+
+
+def make_api(model, **kw):
+    """A toy GenerateAPI; chaos is OFF unless passed explicitly (the
+    default root.common.serve.chaos has no probabilities set)."""
+    params, table, heads, _ = model
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("n_tokens", 5)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("port", 0)
+    kw.setdefault("rebuild_backoff", 0.02)
+    return GenerateAPI(params, table, heads, **kw)
+
+
+class TestServingChaosMonkey:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="outside"):
+            ServingChaosConfig(step_fail=1.5)
+        with pytest.raises(ValueError, match="step_fail_max"):
+            ServingChaosConfig(step_fail_max=-1)
+        assert not ServingChaosConfig().any_enabled
+        assert ServingChaosConfig(slow_step=0.1).any_enabled
+
+    def test_deterministic_schedule_and_cap(self):
+        def schedule(seed):
+            monkey = ServingChaosMonkey(
+                ServingChaosConfig(seed=seed, step_fail=0.5))
+            fired = []
+            for _ in range(64):
+                try:
+                    monkey.before_step()
+                    fired.append(False)
+                except ChaosStepError:
+                    fired.append(True)
+            return fired
+
+        assert schedule(7) == schedule(7)
+        assert schedule(7) != schedule(8)
+        capped = ServingChaosMonkey(
+            ServingChaosConfig(seed=7, step_fail=1.0, step_fail_max=2))
+        failures = 0
+        for _ in range(16):
+            try:
+                capped.before_step()
+            except ChaosStepError:
+                failures += 1
+        assert failures == 2  # the cap makes chaos runs settle
+        assert capped.counters["steps_failed"] == 2
+
+    def test_client_fault_roll_deterministic(self):
+        def rolls(seed):
+            monkey = ServingChaosMonkey(ServingChaosConfig(
+                seed=seed, disconnect=0.3, garbage_body=0.3,
+                oversize_body=0.3))
+            return [monkey.roll_client_fault() for _ in range(32)]
+
+        assert rolls(CHAOS_SEED) == rolls(CHAOS_SEED)
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=1, disconnect=1.0))
+        assert monkey.roll_client_fault() == "disconnect"
+        assert monkey.counters["disconnects"] == 1
+
+    def test_from_config_disabled_by_default(self):
+        from veles_tpu.core.config import root
+        saved = root.common.serve.chaos.__content__()
+        try:
+            root.common.serve.chaos.update(dict(
+                enabled=False, step_fail=0.0))
+            assert ServingChaosMonkey.from_config() is None
+            root.common.serve.chaos.update(dict(
+                enabled=True, step_fail=0.25, seed=3,
+                step_fail_max=4))
+            monkey = ServingChaosMonkey.from_config()
+            assert monkey is not None
+            assert monkey.config.step_fail == 0.25
+            assert monkey.config.step_fail_max == 4
+            root.common.serve.chaos.enabled = False
+            assert ServingChaosMonkey.from_config() is None
+        finally:
+            root.common.serve.chaos.update(saved)
+            root.common.serve.chaos.enabled = saved.get("enabled", False)
+
+
+class TestDecoderCancel:
+    def test_cancel_queued_and_active_frees_slots(self, model):
+        params, table, heads, vocab = model
+        dec = ContinuousDecoder(params, table, heads, slots=1,
+                                max_len=32, n_tokens=6)
+        active = dec.submit([1, 2, 3])
+        queued = dec.submit([4, 5])
+        dec.step()  # admits `active` into the only slot
+        assert dec.cancel(queued)   # still in the admission queue
+        assert dec.cancel(active)   # owns the slot
+        assert not dec.cancel(active)  # idempotent
+        assert dec._free == [0]
+        assert queued not in dec.results and active not in dec.results
+        assert not dec.busy
+        assert dec.cancelled == 2
+        # the freed slot admits and completes a new request cleanly
+        fresh = dec.submit([1, 2, 3])
+        results = dec.run_until_drained()
+        assert len(results[fresh]) == 6
+
+    def test_cancel_mid_chunk_discards_tail(self, model):
+        params, table, heads, vocab = model
+        ref = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=6)
+        keep_ref = ref.submit([1, 2, 3])
+        ref.run_until_drained()
+
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=6)
+        keep = dec.submit([1, 2, 3])
+        victim = dec.submit([4, 5, 6])
+        dec.step()
+        dec.cancel(victim)
+        results = dec.run_until_drained()
+        assert victim not in results
+        # the survivor's stream is untouched by the cancellation
+        assert results[keep] == ref.results[keep_ref]
+
+
+class TestHealthEndpoints:
+    def test_healthz_readyz_roundtrip(self, model):
+        api = make_api(model).start()
+        try:
+            base = "http://127.0.0.1:%d" % api.port
+            code, body = get(base + "/readyz")
+            assert code == 200 and body["ready"]
+            code, body = get(base + "/healthz")
+            assert code == 200
+            assert body["breaker"] == "closed"
+            assert body["counters"]["trips"] == 0
+            code, _ = get(base + "/nope")
+            assert code == 404
+        finally:
+            api.stop()
+        # stopped -> not ready (the probe pair outlives the driver)
+        assert not api.health.ready
+
+    def test_restful_api_health(self):
+        from test_serving import ServingHarness
+        harness = ServingHarness()
+        try:
+            base = "http://127.0.0.1:%d" % harness.api.port
+            code, body = get(base + "/readyz")
+            assert code == 200 and body["ready"]
+            code, body = get(base + "/healthz")
+            assert code == 200 and body["name"] == "restful-api"
+        finally:
+            harness.close()
+
+    def test_serving_health_admission_bookkeeping(self):
+        health = ServingHealth()
+        health.set_ready(True)
+        assert health.try_admit(2) is None
+        assert health.try_admit(2) is None
+        assert health.try_admit(2) == "full"
+        health.release("completed")
+        assert health.try_admit(2) is None
+        health.set_ready(False)
+        assert health.try_admit(2) == "unready"
+        snap = health.snapshot()
+        assert snap["counters"]["admitted"] == 3
+        assert snap["counters"]["rejected"] == 2
+        assert snap["counters"]["completed"] == 1
+        assert snap["inflight"] == 2
+
+
+class TestAdmissionControl:
+    def test_saturation_returns_429_not_a_hang(self, model):
+        api = make_api(model, slots=1, max_queue=2, deadline=60.0)
+        api.start()
+        gate = threading.Event()
+        real = api.decoder.step_many
+
+        def gated(n):
+            gate.wait(20)
+            return real(n)
+
+        api.decoder.step_many = gated
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            results = {}
+
+            def call(i):
+                results[i] = post(url, {"tokens": [1, 2, 3]},
+                                  timeout=90)
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            deadline = time.time() + 10
+            while api.health.inflight < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert api.health.inflight == 2
+            # the queue is full: the next arrival is shed immediately
+            started = time.time()
+            code, body, headers = post(url, {"tokens": [1, 2, 3]})
+            assert code == 429
+            assert "saturated" in body["error"]
+            assert headers.get("Retry-After") == "1"
+            assert time.time() - started < 5  # shed, not queued
+            gate.set()
+            for t in threads:
+                t.join(timeout=90)
+            for i in range(2):
+                code, body, _ = results[i]
+                assert code == 200 and len(body["tokens"]) == 5
+            snap = api.health.snapshot()
+            assert snap["counters"]["rejected"] >= 1
+            assert snap["counters"]["completed"] == 2
+        finally:
+            gate.set()
+            api.stop()
+
+
+class TestDeadlines:
+    def test_queued_and_active_expiry_free_slots(self, model):
+        api = make_api(model, slots=1, chunk=1, deadline=30.0)
+        api.start()
+        real = api.decoder.step_many
+
+        def slow(n):  # ~50 ms per decode step: deadlines can lap it
+            time.sleep(0.05)
+            return real(n)
+
+        api.decoder.step_many = slow
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            results = {}
+
+            def call(key, payload):
+                results[key] = post(url, payload, timeout=90)
+
+            # A occupies the only slot for ~1 s; B expires in the
+            # admission queue long before a slot frees
+            t_a = threading.Thread(target=call, args=(
+                "a", {"tokens": [1, 2, 3], "n_tokens": 20}))
+            t_a.start()
+            deadline = time.time() + 10
+            while not api.decoder.busy and time.time() < deadline:
+                time.sleep(0.01)
+            t_b = threading.Thread(target=call, args=(
+                "b", {"tokens": [4, 5], "n_tokens": 2,
+                      "deadline_s": 0.2}))
+            t_b.start()
+            t_a.join(timeout=90)
+            t_b.join(timeout=90)
+            code_a, body_a, _ = results["a"]
+            assert code_a == 200 and len(body_a["tokens"]) == 20
+            code_b, body_b, _ = results["b"]
+            assert code_b == 504
+            assert "deadline" in body_b["error"]
+            # an ACTIVE request expiring mid-decode frees its slot too
+            code_c, body_c, _ = post(
+                url, {"tokens": [1, 2], "n_tokens": 20,
+                      "deadline_s": 0.2}, timeout=90)
+            assert code_c == 504
+            snap = api.health.snapshot()
+            assert snap["counters"]["expired"] == 2
+            assert api.decoder.cancelled >= 1
+            # the expired requests' slots and result entries are gone:
+            # a fresh request decodes immediately
+            code_d, body_d, _ = post(url, {"tokens": [1, 2, 3]},
+                                     timeout=90)
+            assert code_d == 200 and len(body_d["tokens"]) == 5
+            assert not api.decoder._budget
+            assert len(api.decoder._free) == 1
+            assert not api.decoder.results  # reaped, not leaking
+        finally:
+            api.stop()
+
+    def test_bad_server_default_deadline_fails_at_startup(self, model):
+        """A misconfigured --serve-deadline must fail at construction,
+        never surface as a 400 blaming a field the client didn't send
+        (the per-request 86400 cap applies only to payload values)."""
+        params, table, heads, _ = model
+        for bad in (0, -5, float("inf"), float("nan"), 1e9):
+            with pytest.raises(ValueError, match="serve-deadline"):
+                GenerateAPI(params, table, heads, deadline=bad)
+        # a server default ABOVE the per-request cap is the operator's
+        # call and must not 400 implicit-deadline requests
+        api = make_api(model, deadline=90000.0).start()
+        try:
+            code, body, _ = post(
+                "http://127.0.0.1:%d/generate" % api.port,
+                {"tokens": [1, 2]}, timeout=60)
+            assert code == 200
+        finally:
+            api.stop()
+
+    def test_wedged_driver_backstop_releases_admission(self, model):
+        """A hung (non-raising) driver must not ratchet the in-flight
+        gauge: the handler backstop resolves the holder itself, so the
+        admission is released and the gauge cannot 429 forever."""
+        api = make_api(model, slots=1, deadline=30.0)
+        api.BACKSTOP_GRACE = 0.2
+        api.start()
+        gate = threading.Event()
+        real = api.decoder.step_many
+        api.decoder.step_many = lambda n: (gate.wait(30), real(n))[1]
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            code, body, _ = post(
+                url, {"tokens": [1, 2], "deadline_s": 0.2}, timeout=30)
+            assert code == 503
+            assert "timed out" in body["error"]
+            snap = api.health.snapshot()
+            assert snap["inflight"] == 0  # released by the backstop
+            assert snap["counters"]["errors"] >= 1
+            gate.set()
+            # the driver un-wedges and the server keeps serving
+            code, body, _ = post(url, {"tokens": [1, 2]}, timeout=60)
+            assert code == 200 and len(body["tokens"]) == 5
+        finally:
+            gate.set()
+            api.stop()
+
+    def test_bad_deadline_rejected(self, model):
+        api = make_api(model).start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            # json accepts Infinity/NaN and huge floats; a non-finite
+            # or overlarge deadline must 400, not crash the handler
+            # (Event.wait overflows) or spuriously expire (NaN)
+            for bad in (0, -1, "soon", True, float("inf"),
+                        float("nan"), 1e300, 86401):
+                code, body, _ = post(
+                    url, {"tokens": [1], "deadline_s": bad})
+                assert code == 400, bad
+                assert "deadline_s" in body["error"]
+            # the server survived all of them
+            code, body, _ = post(url, {"tokens": [1, 2]}, timeout=60)
+            assert code == 200
+        finally:
+            api.stop()
+
+
+class TestBreakerRecovery:
+    """THE acceptance test: an injected decoder-step failure trips the
+    breaker; the server heals itself and the re-issued requests return
+    bit-identical greedy tokens vs a fault-free run."""
+
+    def _collect(self, api, prompts, retries=80):
+        url = "http://127.0.0.1:%d/generate" % api.port
+        results = {}
+
+        def call(i):
+            for attempt in range(retries):
+                code, body, _ = post(url, {"tokens": prompts[i]},
+                                     timeout=60)
+                if code == 200:
+                    results[i] = body["tokens"]
+                    return
+                assert code in (429, 503, 504), (code, body)
+                time.sleep(0.02 * min(attempt + 1, 10))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        return results
+
+    def test_breaker_trips_heals_and_tokens_bit_identical(self, model):
+        rng = numpy.random.RandomState(CHAOS_SEED)
+        vocab = model[3]
+        prompts = [rng.randint(0, vocab, n).tolist() for n in (4, 6, 5)]
+
+        clean_api = make_api(model).start()
+        try:
+            clean = self._collect(clean_api, prompts)
+            assert clean_api.health.snapshot()["counters"]["trips"] == 0
+        finally:
+            clean_api.stop()
+        assert sorted(clean) == [0, 1, 2]
+
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, step_fail=1.0, step_fail_max=2,
+            slow_step=0.25, slow_step_ms=2.0))
+        api = make_api(model, chaos=monkey).start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            chaotic = self._collect(api, prompts)
+            # every request completed despite the injected failures...
+            assert sorted(chaotic) == [0, 1, 2]
+            # ...the injected faults actually fired (both of them:
+            # the trip AND the failed first rebuild probe)...
+            assert monkey.counters["steps_failed"] == 2
+            snap = api.health.snapshot()
+            assert snap["counters"]["trips"] >= 1, snap
+            assert snap["counters"]["rebuilds"] >= 1, snap
+            assert snap["counters"]["shed"] >= 1, snap
+            # ...the server healed WITHOUT a restart...
+            assert snap["ready"] and snap["breaker"] == "closed"
+            code, body = get(url + "/readyz")
+            assert code == 200 and body["ready"]
+            # ...and the greedy streams are bit-identical
+            assert chaotic == clean
+        finally:
+            api.stop()
+
+    def test_rebuild_preserves_request_id_keyspace(self, model):
+        """Request ids stay monotonic across a rebuild so sampled
+        requests never reuse another request's fold_in key stream."""
+        api = make_api(model)
+        api.decoder.submit([1, 2])
+        next_before = api.decoder._next_id
+        assert api._rebuild()
+        assert api.decoder._next_id >= next_before + 1  # + probe
+
+
+class TestHostileClients:
+    def _raw_request(self, port, body, content_length=None,
+                     read_reply=True):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=10) as sock:
+            length = (len(body) if content_length is None
+                      else content_length)
+            sock.sendall(
+                b"POST /generate HTTP/1.1\r\n"
+                b"Host: 127.0.0.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(length).encode() + b"\r\n"
+                b"\r\n" + body)
+            if not read_reply:
+                return None  # disconnect without reading the reply
+            sock.settimeout(10)
+            return sock.recv(4096).decode(errors="replace")
+
+    def test_seeded_hostile_client_mix_leaves_server_ready(self, model):
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, disconnect=0.25, garbage_body=0.25,
+            oversize_body=0.25))
+        api = make_api(model).start()
+        vocab = model[3]
+        good = [1, 2, 3]
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            want = None
+            for _ in range(12):
+                fault = monkey.roll_client_fault()
+                if fault == "disconnect":
+                    body = json.dumps({"tokens": good}).encode()
+                    self._raw_request(api.port, body, read_reply=False)
+                elif fault == "garbage_body":
+                    code, _, _ = post(url, b"\x00\xffnot json at all")
+                    assert code == 400
+                elif fault == "oversize_body":
+                    reply = self._raw_request(
+                        api.port, b"", content_length=1 << 31)
+                    assert "413" in reply.split("\r\n")[0]
+                else:
+                    code, body, _ = post(url, {"tokens": good},
+                                         timeout=60)
+                    assert code == 200
+                    if want is None:
+                        want = body["tokens"]
+                    else:  # hostile traffic never corrupts decoding
+                        assert body["tokens"] == want
+            assert sum(monkey.counters.values()) >= 1
+            code, body = get("http://127.0.0.1:%d/readyz" % api.port)
+            assert code == 200 and body["ready"]
+            # after the abuse a normal request still decodes correctly
+            code, body, _ = post(url, {"tokens": good}, timeout=60)
+            assert code == 200 and len(body["tokens"]) == 5
+            assert api.health.snapshot()["counters"]["trips"] == 0
+        finally:
+            api.stop()
+
+
+class TestErrorPathsLeaveServerServing:
+    """Satellite coverage: every malformed-client path answers cleanly
+    AND the very next request is served."""
+
+    def test_generate_api_error_paths(self, model):
+        api = make_api(model).start()
+        vocab = model[3]
+        try:
+            base = "http://127.0.0.1:%d" % api.port
+            url = base + "/generate"
+            cases = [
+                (b"{not json", 400),              # malformed JSON
+                ({"tokens": [1.5, 2.5]}, 400),    # non-int tokens
+                ({"tokens": [vocab + 7]}, 400),   # out-of-vocab ids
+                ({"tokens": [1], "n_tokens": 0}, 400),  # zero budget
+                ({"nope": 1}, 400),               # missing tokens
+            ]
+            for payload, want in cases:
+                code, _, _ = post(url, payload)
+                assert code == want, payload
+                code, body, _ = post(url, {"tokens": [1, 2]},
+                                     timeout=60)
+                assert code == 200 and len(body["tokens"]) == 5
+            code, _, _ = post(base + "/wrong", {"tokens": [1]})
+            assert code == 404
+            code, body, _ = post(url, {"tokens": [1, 2]}, timeout=60)
+            assert code == 200
+        finally:
+            api.stop()
+
+    def test_restful_api_error_paths(self):
+        from test_serving import ServingHarness
+        harness = ServingHarness()
+        try:
+            base = "http://127.0.0.1:%d" % harness.api.port
+            code, _, _ = post(base + "/api", b"{nope")   # malformed
+            assert code == 400
+            code, _, _ = post(base + "/elsewhere",       # wrong path
+                              {"input": [1.0] * 3, "codec": "list"})
+            assert code == 404
+            # disconnect mid-response: stage a request and hang up
+            with socket.create_connection(
+                    ("127.0.0.1", harness.api.port), timeout=10) as s:
+                body = json.dumps({"input": [9.0] * 3,
+                                   "codec": "list"}).encode()
+                s.sendall(b"POST /api HTTP/1.1\r\nHost: x\r\n"
+                          b"Content-Type: application/json\r\n"
+                          b"Content-Length: " +
+                          str(len(body)).encode() + b"\r\n\r\n" + body)
+            # the server keeps serving after all of it
+            code, body, _ = post(base + "/api",
+                                 {"input": [2.0, 2.0, 2.0],
+                                  "codec": "list"}, timeout=30)
+            assert code == 200 and body["result"] == [4.0, 4.0, 4.0]
+        finally:
+            harness.close()
+
+    def test_restful_api_oversized_body_413(self):
+        """The read_body cap (core/httpd.py): an oversized body answers
+        413 before buffering; the cap is per-unit configurable."""
+        import jax  # noqa: F401  (keep import order consistent)
+        from veles_tpu.dummy import DummyWorkflow
+        from veles_tpu.serving import RESTfulAPI, RestfulLoader
+
+        wf = DummyWorkflow()
+        loader = RestfulLoader(wf, sample_shape=(3,), minibatch_size=2)
+        loader.initialize()
+        api = RESTfulAPI(wf, port=0, path="/api", max_body=4096)
+        api.feed = loader.feed
+        api.requests = []
+        api.initialize()
+        try:
+            # raw socket: the server answers 413 BEFORE reading the
+            # body, which can reset a client still streaming it — a
+            # high-level client may see that as a dropped connection
+            with socket.create_connection(("127.0.0.1", api.port),
+                                          timeout=10) as sock:
+                sock.sendall(b"POST /api HTTP/1.1\r\nHost: x\r\n"
+                             b"Content-Type: application/json\r\n"
+                             b"Content-Length: 10000\r\n\r\n")
+                sock.settimeout(10)
+                chunks = []
+                while True:  # server closes after the 413: read to EOF
+                    data = sock.recv(4096)
+                    if not data:
+                        break
+                    chunks.append(data)
+                reply = b"".join(chunks).decode(errors="replace")
+            assert "413" in reply.split("\r\n")[0]
+            assert "cap" in reply
+        finally:
+            api.stop()
+            loader.stop()
+
+    def test_restful_api_saturation_429(self):
+        """Admission control on the reference surface: a full serving
+        minibatch sheds with 429 + Retry-After, not an opaque 400."""
+        from veles_tpu.dummy import DummyWorkflow
+        from veles_tpu.serving import RESTfulAPI, RestfulLoader
+
+        wf = DummyWorkflow()
+        loader = RestfulLoader(wf, sample_shape=(3,), minibatch_size=2)
+        loader.initialize()
+        api = RESTfulAPI(wf, port=0, path="/api")
+        api.feed = loader.feed
+        api.requests = []
+        api.initialize()
+        try:
+            # no workflow loop is draining the batch: fill it directly
+            for _ in range(2):
+                loader.feed(numpy.zeros(3, numpy.float32),
+                            {"event": threading.Event(), "result": None})
+            url = "http://127.0.0.1:%d/api" % api.port
+            code, body, headers = post(
+                url, {"input": [1.0] * 3, "codec": "list"})
+            assert code == 429
+            assert "saturated" in body["error"]
+            assert headers.get("Retry-After") == "1"
+            snap = api.health.snapshot()
+            # the overflow rolls the admission back: the request books
+            # as rejected-never-admitted and nothing is left in flight
+            assert snap["counters"]["rejected"] >= 1
+            assert snap["counters"]["admitted"] == 0
+            assert snap["inflight"] == 0
+        finally:
+            api.stop()
+            loader.stop()
+
+    def test_generate_api_oversized_body_413(self, model):
+        api = make_api(model).start()
+        try:
+            with socket.create_connection(("127.0.0.1", api.port),
+                                          timeout=10) as sock:
+                sock.sendall(
+                    b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: 9999999999\r\n\r\n")
+                sock.settimeout(10)
+                reply = sock.recv(4096).decode(errors="replace")
+            assert "413" in reply.split("\r\n")[0]
+            code, body, _ = post(
+                "http://127.0.0.1:%d/generate" % api.port,
+                {"tokens": [1, 2]}, timeout=60)
+            assert code == 200
+        finally:
+            api.stop()
+
+    def test_web_status_oversized_update_413(self):
+        from veles_tpu.web_status import WebStatusServer
+
+        server = WebStatusServer(port=0).start()
+        try:
+            with socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=10) as sock:
+                sock.sendall(
+                    b"POST /update HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: 9999999999\r\n\r\n")
+                sock.settimeout(10)
+                reply = sock.recv(4096).decode(errors="replace")
+            assert "413" in reply.split("\r\n")[0]
+        finally:
+            server.stop()
+
+
+class TestDashboardServingColumn:
+    def test_format_serving_health_cell(self):
+        from veles_tpu.web_status import format_serving_health
+        cell = format_serving_health({
+            "ready": True, "breaker": "closed", "inflight": 3,
+            "counters": {"completed": 41, "trips": 1, "rebuilds": 1,
+                         "shed": 2, "expired": 0, "rejected": 5,
+                         "errors": 4}})
+        assert "ready" in cell and "3 in flight" in cell
+        assert "41 completed" in cell and "1 trips" in cell
+        assert "2 shed" in cell and "5 rejected" in cell
+        assert "4 errors" in cell  # a steadily-erroring unit shows it
+        assert "expired" not in cell  # zero tallies are elided
+        assert "breaker" not in cell  # closed breaker is elided
+        open_cell = format_serving_health({
+            "ready": False, "breaker": "open", "counters": {}})
+        assert "NOT READY" in open_cell and "breaker open" in open_cell
+        assert format_serving_health(None) == ""
+        assert format_serving_health("junk") == ""
+
+    def test_notifier_mirrors_serving_health(self, model):
+        from veles_tpu.web_status import StatusNotifier, WebStatusServer
+
+        server = WebStatusServer(port=0).start()
+        api = make_api(model).start()
+        try:
+            class FakeLauncher:
+                workflow = type("W", (), {"name": "serving-wf"})()
+                mode = "standalone"
+                serving_api = api
+
+            notifier = StatusNotifier(
+                FakeLauncher(),
+                url="http://127.0.0.1:%d/update" % server.port)
+            assert notifier.notify_once()
+            status = next(iter(server.statuses().values()))
+            assert status["serving"]["ready"] is True
+            assert status["serving"]["breaker"] == "closed"
+            # the other attachment point: a serving unit hosted IN the
+            # workflow (RESTfulAPI) is discovered via its health attr
+            unit = type("U", (), {"health": api.health})()
+
+            class HostedLauncher:
+                workflow = type("W", (), {
+                    "name": "hosted-wf",
+                    "__iter__": lambda self: iter([unit])})()
+                mode = "standalone"
+
+            hosted = StatusNotifier(
+                HostedLauncher(),
+                url="http://127.0.0.1:%d/update" % server.port)
+            assert hosted.notify_once()
+            hosted_status = server.statuses()[
+                [k for k in server.statuses() if "hosted" in k][0]]
+            assert hosted_status["serving"]["breaker"] == "closed"
+            # and the dashboard row renders it
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/" % server.port,
+                    timeout=10) as resp:
+                html = resp.read().decode()
+            assert "<th>serving</th>" in html
+            assert "ready" in html
+        finally:
+            api.stop()
+            server.stop()

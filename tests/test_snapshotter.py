@@ -112,6 +112,160 @@ def test_skip_bool(tmp_path):
     assert snap.destination is None
 
 
+def test_current_link_updated_atomically(tmp_path):
+    """The `_current` resume pointer is replaced via temp-link +
+    os.replace — never removed-then-recreated — so a crash can no
+    longer leave NO pointer at all; and re-exports repoint it."""
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="atom",
+                             interval=1, time_interval=0)
+    snap.suffix = "first"
+    snap.export()
+    link = os.path.join(str(tmp_path), "atom_current.lnk")
+    assert os.path.islink(link)
+    assert "first" in os.readlink(link)
+    snap.suffix = "second"
+    snap.export()
+    assert os.path.islink(link)
+    assert "second" in os.readlink(link)
+    # no temp links left behind
+    assert not glob.glob(os.path.join(str(tmp_path), "*.lnk.tmp*"))
+    # the link resolves through import_
+    restored = SnapshotterToFile.import_(link)
+    assert restored.restored_from_snapshot
+
+
+def test_checksum_sidecar_and_corruption_fallback(tmp_path):
+    """Every export writes a SHA-256 sidecar; import_ verifies it and
+    falls back to the newest intact sibling — with a warning, not a
+    crash — when the snapshot is truncated or tampered with."""
+    from veles_tpu.snapshotter import SnapshotCorruptError
+
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="sha",
+                             interval=1, time_interval=0)
+    snap.suffix = "old"
+    snap.export()
+    intact = snap.destination
+    assert os.path.isfile(intact + ".sha256")
+    # the sidecar's first line is shasum-formatted
+    # ("<hexdigest>  <filename>"); a comment records the exact prefix
+    # so the corruption fallback never crosses experiments
+    lines = open(intact + ".sha256").read().splitlines()
+    digest, name = lines[0].split()
+    assert len(digest) == 64 and name == os.path.basename(intact)
+    assert "# prefix: sha" in lines[1]
+    snap.suffix = "new"
+    snap.export()
+    newest = snap.destination
+    os.utime(intact, (os.path.getmtime(newest) - 60,) * 2)
+    # tamper with the newest snapshot: flip bytes, keep the length
+    with open(newest, "r+b") as fout:
+        fout.seek(0)
+        fout.write(b"\x00\x01\x02\x03")
+    with pytest.raises(SnapshotCorruptError):
+        SnapshotterToFile._load_verified(newest)
+    # import_ falls back to the intact previous version
+    restored = SnapshotterToFile.import_(newest)
+    assert restored.restored_from_snapshot
+    # truncation (a crashed writer) is also survived
+    with open(newest, "wb") as fout:
+        fout.write(b"\x1f\x8b")  # gzip magic, then nothing
+    restored = SnapshotterToFile.import_(newest)
+    assert restored.restored_from_snapshot
+    # the fallback NEVER crosses into another experiment's prefix in a
+    # shared directory — even one that shares a leading "_" segment
+    # (the sidecar records the exact prefix; "sha_twin_current..."
+    # cannot be told apart from prefix "sha" + suffix "twin_current"
+    # by filename alone): with every same-prefix sibling corrupt,
+    # import_ raises despite the intact foreign snapshot sitting there
+    other = SnapshotterToFile(wf, directory=str(tmp_path),
+                              prefix="sha_twin", interval=1,
+                              time_interval=0)
+    other.export()
+    with open(intact, "r+b") as fout:
+        fout.write(b"\x00\x01\x02\x03")
+    with pytest.raises(Exception):
+        SnapshotterToFile.import_(newest)
+    # the foreign snapshot itself still imports fine
+    assert SnapshotterToFile.import_(
+        other.destination).restored_from_snapshot
+    # with NO intact sibling the corruption surfaces loudly
+    lonely = str(tmp_path / "lonely")
+    os.makedirs(lonely)
+    bad = os.path.join(lonely, "x_current.0.pickle")
+    with open(bad, "wb") as fout:
+        fout.write(b"garbage")
+    with pytest.raises(Exception):
+        SnapshotterToFile.import_(bad)
+
+
+def test_crash_between_sidecar_and_data_rename_tolerated(tmp_path):
+    """The export's two renames cannot be atomic together; the sidecar
+    lands first and vouches for the PREVIOUS generation too, so a crash
+    between the renames (new sidecar + old data bytes) must still
+    resume — not reject the intact old snapshot as corrupt."""
+    import shutil
+
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="win",
+                             interval=1, time_interval=0)
+    snap.export()
+    path = snap.destination
+    gen1 = str(tmp_path / "gen1.bak")
+    shutil.copy(path, gen1)
+    snap.export()  # same path (default suffix): overwrites generation 1
+    # emulate the crash window: sidecar is generation 2, data rolled
+    # back to generation 1 (the payload timestamp makes digests differ)
+    sidecar_lines = open(path + ".sha256").read().splitlines()
+    assert len([l for l in sidecar_lines
+                if l and not l.startswith("#")]) == 2
+    shutil.copy(gen1, path)
+    restored = SnapshotterToFile.import_(path)
+    assert restored.restored_from_snapshot
+    # an actually-corrupt file still fails both digests
+    with open(path, "r+b") as fout:
+        fout.write(b"\x00\x01\x02\x03")
+    from veles_tpu.snapshotter import SnapshotCorruptError
+    with pytest.raises(SnapshotCorruptError):
+        SnapshotterToFile._load_verified(path)
+
+
+def test_restful_api_unit_snapshots_cleanly():
+    """Regression: RESTfulAPI's health registry holds a Lock; it must
+    ride the volatile (trailing-underscore) contract so snapshotting a
+    workflow containing a serving unit keeps working."""
+    import pickle
+
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.serving import RESTfulAPI
+
+    api = RESTfulAPI(DummyWorkflow(), port=0)
+    api.health.set_ready(True)
+    restored = pickle.loads(pickle.dumps(api))
+    # the health registry is rebuilt fresh on unpickle
+    assert restored.health is not None
+    assert not restored.health.ready
+
+
+@pytest.mark.parametrize("codec", ["", "bz2", "xz"])
+def test_compression_codecs_roundtrip(tmp_path, codec):
+    """Every codec exports through the hashing tee and imports back
+    (regression: lzma.open refused the preset kwarg on READ, so xz
+    snapshots could never be resumed)."""
+    wf = make_wf(max_epochs=1)
+    wf.initialize()
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="c",
+                             compression=codec, interval=1,
+                             time_interval=0)
+    snap.export()
+    restored = SnapshotterToFile.import_(snap.destination)
+    assert restored.restored_from_snapshot
+
+
 def test_snapshotter_to_db_roundtrip(tmp_path):
     """DB-backed snapshot store (reference SnapshotterToDB role over
     sqlite3): export rows, import newest by prefix, exact by suffix."""

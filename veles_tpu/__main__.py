@@ -138,6 +138,33 @@ class Main(Logger):
                            "probability P (disconnect in-process; "
                            "root.common.fleet.chaos.death_mode=exit for "
                            "the reference os._exit)")
+        serve = parser.add_argument_group(
+            "serving survival", "admission control, deadlines and "
+            "chaos for the serving tier (serving.py / serving_chaos.py;"
+            " docs/serving_robustness.md)")
+        serve.add_argument("--serve-max-queue", type=int, default=None,
+                           metavar="N", help="bound on staged + "
+                           "in-flight serving requests; beyond it new "
+                           "arrivals get 429 + Retry-After (0 disables "
+                           "the bound)")
+        serve.add_argument("--serve-deadline", type=float, default=None,
+                           metavar="S", help="default per-request "
+                           "serving deadline in seconds; an expired "
+                           "request frees its decoder slot (504)")
+        serve.add_argument("--chaos-serve-seed", type=int, default=None,
+                           metavar="N", help="serving chaos RNG seed")
+        serve.add_argument("--chaos-serve-step-fail", type=float,
+                           default=None, metavar="P",
+                           help="inject a decoder-step failure with "
+                           "probability P (trips the circuit breaker)")
+        serve.add_argument("--chaos-serve-step-fail-max", type=int,
+                           default=None, metavar="N",
+                           help="cap on injected step failures (the "
+                           "chaos run provably settles)")
+        serve.add_argument("--chaos-serve-slow-step", type=float,
+                           default=None, metavar="P",
+                           help="stretch a decode step with "
+                           "probability P (straggling device)")
         parser.add_argument("--dry-run",
                             choices=("load", "init"), default=None,
                             help="stop after loading/initializing")
@@ -406,6 +433,20 @@ class Main(Logger):
             value = getattr(args, flag)
             if value is not None:
                 setattr(root.common.fleet.chaos, key, value)
+        # serving survival flags, same layering rule
+        for flag, node, key in (
+                ("serve_max_queue", root.common.serve, "max_queue"),
+                ("serve_deadline", root.common.serve, "deadline"),
+                ("chaos_serve_seed", root.common.serve.chaos, "seed"),
+                ("chaos_serve_step_fail", root.common.serve.chaos,
+                 "step_fail"),
+                ("chaos_serve_step_fail_max", root.common.serve.chaos,
+                 "step_fail_max"),
+                ("chaos_serve_slow_step", root.common.serve.chaos,
+                 "slow_step")):
+            value = getattr(args, flag)
+            if value is not None:
+                setattr(node, key, value)
         if args.background:
             # AFTER config layering: daemon.log must honor a cache dir
             # set by the config file or CLI overrides

@@ -11,7 +11,14 @@ flags (underscores for dashes). ``subprocess=True`` forks the run into a
 
 def kwargs_to_argv(workflow_file, config_file=None, overrides=(),
                    **kwargs):
-    """Translate call kwargs into the equivalent CLI argv."""
+    """Translate call kwargs into the equivalent CLI argv.
+
+    Every flag the parser knows works here with underscores for dashes
+    — ``listen``, ``mesh``, the ``chaos_*`` fleet-chaos knobs, the
+    serving-survival knobs (``serve_max_queue``, ``serve_deadline``,
+    ``chaos_serve_step_fail``, ...). A list/tuple value repeats the flag
+    once per element (``nodes=["h1", "h2"]`` → ``-n h1 -n h2``, the
+    argparse ``append`` actions)."""
     argv = [str(workflow_file), str(config_file or "-")]
     argv.extend(overrides)
     for key, value in kwargs.items():
@@ -19,6 +26,9 @@ def kwargs_to_argv(workflow_file, config_file=None, overrides=(),
         if isinstance(value, bool):
             if value:
                 argv.append(flag)
+        elif isinstance(value, (list, tuple)):
+            for item in value:
+                argv.extend((flag, str(item)))
         elif value is not None:
             argv.extend((flag, str(value)))
     return argv

@@ -191,6 +191,16 @@ root.common.update({
     "web": {"enabled": False, "host": "localhost", "port": 8090,
             "notification_interval": 1.0},
     "api": {"port": 8180, "path": "/api"},
+    # serving survival layer (docs/serving_robustness.md): admission
+    # bound (max_queue <= 0 disables load shedding), default
+    # per-request deadline, breaker rebuild backoff, and the serving
+    # chaos harness (serving_chaos.py, --chaos-serve-*)
+    "serve": {
+        "max_queue": 64,
+        "deadline": 300.0,
+        "rebuild_backoff": 0.5,
+        "rebuild_backoff_max": 30.0,
+    },
     "fleet": {
         "job_timeout": 120.0,
         "sync_interval": 1.0,

@@ -5,10 +5,25 @@ and JSON reply bookkeeping, used by the REST inference API
 (``serving.py``) and the web-status dashboard (``web_status.py``).
 Binds loopback by default — the same posture as the fleet server
 (``fleet/server.py``); pass an explicit host to expose wider.
+
+Survival-layer additions shared by every HTTP surface
+(docs/serving_robustness.md):
+
+- :func:`read_body` enforces a request-body byte cap and answers 413
+  *before* buffering anything, so no client can balloon server memory
+  with a huge ``Content-Length``;
+- :func:`serve_health` mounts the ``/healthz`` + ``/readyz`` probe pair
+  off any object with a ``snapshot()``/``ready`` surface (the serving
+  units' ``ServingHealth``), the same contract k8s-style orchestrators
+  expect.
 """
 
 import json
 import threading
+
+#: default request-body cap (bytes); generous for base64 tensors, far
+#: below anything that could pressure host memory
+MAX_BODY = 32 * 1024 * 1024
 
 
 class QuietHandlerMixin:
@@ -18,22 +33,76 @@ class QuietHandlerMixin:
         pass
 
 
-def reply(handler, body, code=200, content_type="application/json"):
-    """Write one complete HTTP response."""
+class BodyTooLarge(ValueError):
+    """Raised by :func:`read_body` after the 413 has been sent."""
+
+
+def reply(handler, body, code=200, content_type="application/json",
+          headers=None):
+    """Write one complete HTTP response. Client disconnects are
+    swallowed: the peer walking away mid-reply must never take down the
+    handler thread loop (or spam tracebacks) on a serving box."""
     if isinstance(body, (dict, list)):
         body = json.dumps(body).encode()
     elif isinstance(body, str):
         body = body.encode()
-    handler.send_response(code)
-    handler.send_header("Content-Type", content_type)
-    handler.send_header("Content-Length", str(len(body)))
-    handler.end_headers()
-    handler.wfile.write(body)
+    try:
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            handler.send_header(key, value)
+        handler.end_headers()
+        handler.wfile.write(body)
+    except OSError:  # covers BrokenPipe/ConnectionReset and the rest:
+        # a peer (or socket) failing mid-reply must never take down the
+        # handler thread; mark the connection dead so the handler does
+        # not try to keep-alive a half-closed socket
+        handler.close_connection = True
 
 
-def read_body(handler):
-    length = int(handler.headers.get("Content-Length", 0))
+def read_body(handler, limit=MAX_BODY):
+    """Read the request body, bounded.
+
+    An absent/garbage ``Content-Length`` reads as empty; a length above
+    ``limit`` answers 413 immediately (nothing is buffered) and raises
+    :class:`BodyTooLarge` so the caller just returns."""
+    try:
+        length = int(handler.headers.get("Content-Length", 0))
+    except (TypeError, ValueError):
+        length = 0
+    if length < 0:
+        length = 0
+    if length > limit:
+        reply(handler, {"error": "request body %d bytes exceeds the "
+                                 "%d byte cap" % (length, limit)},
+              code=413)
+        handler.close_connection = True
+        raise BodyTooLarge("body %d > cap %d" % (length, limit))
     return handler.rfile.read(length)
+
+
+def serve_health(handler, health):
+    """Route ``GET /healthz`` and ``GET /readyz`` against ``health``
+    (any object with ``snapshot()`` -> dict and a ``ready`` bool).
+
+    ``/healthz`` always answers 200 with the counter snapshot — the
+    process is alive and can say so; ``/readyz`` answers 200 only while
+    the unit can actually serve (breaker closed, decoder built) and 503
+    otherwise, so load balancers drain a rebuilding replica instead of
+    feeding it traffic. Returns True when the path was handled."""
+    path = handler.path.split("?")[0]
+    if path == "/healthz":
+        reply(handler, health.snapshot())
+        return True
+    if path == "/readyz":
+        if health.ready:
+            reply(handler, {"ready": True})
+        else:
+            reply(handler, {"ready": False, "state": health.snapshot()},
+                  code=503, headers={"Retry-After": "1"})
+        return True
+    return False
 
 
 def start_server(handler_cls, host="127.0.0.1", port=0, name="httpd"):

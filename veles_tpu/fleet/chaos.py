@@ -40,22 +40,47 @@ PROBABILITY_KEYS = ("frame_delay", "frame_drop", "slow_job",
                     "duplicate_update", "death")
 
 
-class ChaosConfig:
-    """Validated chaos knobs (all probabilities in [0, 1])."""
+def roll(rng, probability):
+    """One seeded fault decision (a probability <= 0 never fires and
+    never advances the stream) — the single implementation every chaos
+    monkey (fleet and serving) rolls through."""
+    return probability > 0.0 and rng.random() < probability
 
-    def __init__(self, seed=1, frame_delay=0.0, frame_delay_ms=20.0,
-                 frame_drop=0.0, slow_job=0.0, slow_job_ms=50.0,
-                 duplicate_update=0.0, death=0.0, death_mode="disconnect"):
-        for name, value in (("frame_delay", frame_delay),
-                            ("frame_drop", frame_drop),
-                            ("slow_job", slow_job),
-                            ("duplicate_update", duplicate_update),
-                            ("death", death)):
+
+class ChaosConfigBase:
+    """Shared validation for seeded fault-probability configs: each
+    subclass lists its fault knobs in ``PROBABILITY_KEYS`` and feeds
+    them through :meth:`_set_probabilities` (all must lie in [0, 1]);
+    ``any_enabled`` is the default-on trigger ``from_config`` uses."""
+
+    PROBABILITY_KEYS = ()
+
+    def _set_probabilities(self, **values):
+        for name, value in values.items():
             value = float(value)
             if not 0.0 <= value <= 1.0:
                 raise ValueError("chaos %s probability %r outside [0, 1]"
                                  % (name, value))
             setattr(self, name, value)
+
+    @property
+    def any_enabled(self):
+        return any(getattr(self, key) > 0.0
+                   for key in self.PROBABILITY_KEYS)
+
+
+class ChaosConfig(ChaosConfigBase):
+    """Validated fleet chaos knobs (all probabilities in [0, 1])."""
+
+    PROBABILITY_KEYS = PROBABILITY_KEYS
+
+    def __init__(self, seed=1, frame_delay=0.0, frame_delay_ms=20.0,
+                 frame_drop=0.0, slow_job=0.0, slow_job_ms=50.0,
+                 duplicate_update=0.0, death=0.0, death_mode="disconnect"):
+        self._set_probabilities(
+            frame_delay=frame_delay, frame_drop=frame_drop,
+            slow_job=slow_job, duplicate_update=duplicate_update,
+            death=death)
         if death_mode not in ("disconnect", "exit"):
             raise ValueError("chaos death_mode must be 'disconnect' or "
                              "'exit', got %r" % (death_mode,))
@@ -63,10 +88,6 @@ class ChaosConfig:
         self.frame_delay_ms = float(frame_delay_ms)
         self.slow_job_ms = float(slow_job_ms)
         self.death_mode = death_mode
-
-    @property
-    def any_enabled(self):
-        return any(getattr(self, key) > 0.0 for key in PROBABILITY_KEYS)
 
 
 class ChaosMonkey(Logger):
@@ -109,7 +130,7 @@ class ChaosMonkey(Logger):
     def _roll(self, probability):
         # one rng stream, always advanced in the same call order ->
         # deterministic fault schedule for a deterministic workload
-        return probability > 0.0 and self._rng.random() < probability
+        return roll(self._rng, probability)
 
     # -- frame-level faults ---------------------------------------------------
     async def read_frame(self, reader, key, **kwargs):

@@ -50,6 +50,10 @@ import urllib.parse
 from veles_tpu.core.logger import Logger
 from veles_tpu.forge import package as pkg
 
+#: upload body cap: model packages (weight archives) dwarf the shared
+#: httpd JSON cap; bounded all the same so no client can exhaust RAM
+UPLOAD_MAX_BODY = 4 << 30
+
 _EMAIL_RE = re.compile(r"^[^@\s]+@[^@\s]+\.[^@\s]+$")
 
 
@@ -305,7 +309,8 @@ class ForgeServer(Logger):
 
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
+        from veles_tpu.core.httpd import (BodyTooLarge,
+                                          QuietHandlerMixin, read_body,
                                           reply, start_server)
 
         server = self
@@ -371,6 +376,8 @@ class ForgeServer(Logger):
                         body = json.loads(read_body(self).decode())
                         reply(self, server.register(
                             body.get("email", "")))
+                    except BodyTooLarge:
+                        pass  # 413 already sent
                     except (ValueError, TypeError) as exc:
                         reply(self, {"error": str(exc)}, code=400)
                     return
@@ -380,9 +387,14 @@ class ForgeServer(Logger):
                     return
                 if path == "/upload":
                     try:
-                        reply(self, server.upload(read_body(self),
-                                                  query.get("version"),
-                                                  uploaded_by=identity))
+                        # packages are weight archives — far larger
+                        # than the shared JSON-request body cap
+                        reply(self, server.upload(
+                            read_body(self, limit=UPLOAD_MAX_BODY),
+                            query.get("version"),
+                            uploaded_by=identity))
+                    except BodyTooLarge:
+                        pass  # 413 already sent
                     except PermissionError as exc:
                         reply(self, {"error": str(exc)}, code=403)
                     except (ValueError, TypeError, OSError) as exc:

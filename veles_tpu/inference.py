@@ -71,11 +71,20 @@ def _load_lib():
 
 class NativeWorkflow:
     """A loaded inference package (reference ``WorkflowLoader::Load`` →
-    ``Workflow::Initialize/Run`` surface)."""
+    ``Workflow::Initialize/Run`` surface).
 
-    def __init__(self, package_path):
+    ``max_batch`` is the serving admission guard (the native twin of the
+    HTTP tier's queue bound, docs/serving_robustness.md): a caller-side
+    bug or hostile request size fails fast with ``ValueError`` instead
+    of asking the C++ runtime for an arbitrarily large activation
+    buffer. :meth:`probe` is the readiness check — one real one-sample
+    inference, the same proof-by-decode idea as ``GenerateAPI``'s
+    rebuild probe."""
+
+    def __init__(self, package_path, max_batch=4096):
         lib = _load_lib()
         self._lib = lib
+        self.max_batch = int(max_batch)
         self._handle = lib.veles_rt_load(
             os.fsencode(os.path.abspath(package_path)))
         if not self._handle:
@@ -86,11 +95,26 @@ class NativeWorkflow:
         self.output_size = lib.veles_rt_output_size(self._handle)
         self.unit_count = lib.veles_rt_unit_count(self._handle)
 
+    def probe(self):
+        """True when the loaded package can actually run: executes one
+        zero-sample inference end to end (``/readyz`` material for a
+        native-serving front)."""
+        try:
+            out = self.run(numpy.zeros((1, self.input_size),
+                                       numpy.float32))
+            return bool(numpy.all(numpy.isfinite(out)))
+        except Exception:
+            return False
+
     def run(self, batch):
         """Run inference on (batch, ...) float input; returns
         (batch, output_size) float32."""
         batch = numpy.ascontiguousarray(batch, numpy.float32)
         n = batch.shape[0]
+        if not 1 <= n <= self.max_batch:
+            raise ValueError(
+                "batch size %d outside [1, max_batch=%d]"
+                % (n, self.max_batch))
         flat = batch.reshape(n, -1)
         if flat.shape[1] != self.input_size:
             raise ValueError("input has %d features, package wants %d"

@@ -17,11 +17,22 @@ fires when the batch is full or ``max_response_time`` elapses with at
 least one request staged — so single requests still see bounded latency
 while bursts amortize one XLA dispatch across the whole batch (the TPU
 translation of the reference's LoopingCall flush).
+
+Survival layer (docs/serving_robustness.md): every HTTP surface carries
+a :class:`ServingHealth` exposing ``/healthz`` + ``/readyz``; admission
+is bounded (429 + ``Retry-After`` when saturated, 503 while not ready);
+requests carry deadlines that free their decoder slot on expiry; and
+:class:`GenerateAPI`'s driver is a circuit breaker that sheds, rebuilds
+the decoder from the held params with exponential backoff, probes, and
+closes again — a device failure degrades service for seconds instead of
+wedging the process until a human restarts it.
 """
 
 import base64
 import json
+import math
 import threading
+import time
 
 import numpy
 
@@ -197,6 +208,102 @@ class InteractiveLoader(Loader):
             return numpy.loadtxt(path, **self.loadtxt_kwargs)
 
 
+class ServingHealth:
+    """Thread-safe health + counter registry shared by the serving HTTP
+    surfaces; ``snapshot()`` backs ``/healthz``, the web-status
+    dashboard's serving column, and the chaos-suite asserts.
+
+    ``ready`` is the load-balancer signal (``/readyz``): True only while
+    the unit can actually take traffic. ``breaker`` is ``closed`` in
+    normal operation and ``open`` while :class:`GenerateAPI` rebuilds a
+    failed decoder. The counters:
+
+    - ``admitted`` / ``completed`` — requests let in / answered;
+    - ``rejected`` — load-shed at admission (429/503), never queued;
+    - ``expired`` — deadline hit; the request's decoder slot was freed;
+    - ``trips`` / ``rebuilds`` — breaker opened / decoder successfully
+      rebuilt and probed;
+    - ``shed`` — in-flight requests resolved with an error on a trip
+      (they never burn out their full timeout);
+    - ``errors`` — requests resolved with any other error."""
+
+    COUNTERS = ("admitted", "completed", "rejected", "expired", "shed",
+                "trips", "rebuilds", "errors")
+
+    def __init__(self, name="serving"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._ready = False
+        self._breaker = "closed"
+        self._inflight = 0
+        self._counters = {key: 0 for key in self.COUNTERS}
+
+    @property
+    def ready(self):
+        with self._lock:
+            return self._ready
+
+    def set_ready(self, flag):
+        with self._lock:
+            self._ready = bool(flag)
+
+    def set_breaker(self, state):
+        with self._lock:
+            self._breaker = state
+
+    def incr(self, key, n=1):
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def try_admit(self, limit):
+        """One atomic admission decision: returns ``None`` and counts
+        the request in, or the rejection kind (``"unready"`` -> 503,
+        ``"full"`` -> 429) — checked and booked under one lock so a
+        burst cannot race past the queue bound. ``limit`` of ``None``
+        or <= 0 means UNBOUNDED admission (load shedding off)."""
+        with self._lock:
+            if not self._ready:
+                self._counters["rejected"] += 1
+                return "unready"
+            if limit is not None and limit > 0 \
+                    and self._inflight >= limit:
+                self._counters["rejected"] += 1
+                return "full"
+            self._inflight += 1
+            self._counters["admitted"] += 1
+            return None
+
+    def release(self, outcome="completed"):
+        """Book one admitted request out (``completed`` / ``expired`` /
+        ``shed`` / ``errors``)."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._counters[outcome] = self._counters.get(outcome, 0) + 1
+
+    def reject_admitted(self):
+        """Roll an admission back as a rejection: RESTfulAPI discovers
+        saturation only when ``feed`` overflows, AFTER try_admit — the
+        request books as rejected-never-admitted so the counter
+        identity ``admitted == completed+expired+shed+errors+inflight``
+        holds on both surfaces."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._counters["admitted"] -= 1
+            self._counters["rejected"] += 1
+
+    @property
+    def inflight(self):
+        with self._lock:
+            return self._inflight
+
+    def snapshot(self):
+        with self._lock:
+            return {"name": self.name, "ready": self._ready,
+                    "breaker": self._breaker,
+                    "inflight": self._inflight,
+                    "counters": dict(self._counters)}
+
+
 class RESTfulAPI(Unit):
     """HTTP inference endpoint (reference ``RESTfulAPI``,
     ``restful_api.py:78-215``).
@@ -219,6 +326,7 @@ class RESTfulAPI(Unit):
                                root.common.api.get("host", "127.0.0.1"))
         if not self.path.startswith("/"):
             raise ValueError("path must start with '/'")
+        self.max_body = int(kwargs.pop("max_body", 0)) or None
         super().__init__(workflow, **kwargs)
         self.results = None
         self.demand("feed", "requests")
@@ -226,26 +334,46 @@ class RESTfulAPI(Unit):
     def init_unpickled(self):
         super().init_unpickled()
         self._httpd_ = None
+        # trailing underscore: volatile (holds a Lock — must be
+        # excluded from pickles and rebuilt on unpickle)
+        self.health_ = ServingHealth(name="restful-api")
+
+    @property
+    def health(self):
+        """Survival-layer health surface (``/healthz``/``readyz``)."""
+        return self.health_
 
     def initialize(self, **kwargs):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
-                                          start_server)
+        from veles_tpu.core.httpd import (MAX_BODY, BodyTooLarge,
+                                          QuietHandlerMixin, read_body,
+                                          serve_health, start_server)
 
         api = self
+        limit = self.max_body or MAX_BODY
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_POST(self):
                 if self.path != api.path:
                     self.send_error(404)
                     return
-                api.serve(self, read_body(self))
+                try:
+                    raw = read_body(self, limit=limit)
+                except BodyTooLarge:
+                    return  # 413 already sent, nothing buffered
+                api.serve(self, raw)
+
+            def do_GET(self):
+                if not serve_health(self, api.health):
+                    self.send_error(404)
 
         self._httpd_, self.port = start_server(
             Handler, self.host, self.port, name="restful-api")
+        self.health.set_ready(True)
         self.info("listening on %s:%d%s", self.host, self.port, self.path)
 
     def stop(self):
+        self.health.set_ready(False)
         if self._httpd_ is not None:
             self._httpd_.shutdown()
             self._httpd_ = None
@@ -294,16 +422,38 @@ class RESTfulAPI(Unit):
         data = self._decode(handler, payload)
         if data is None:
             return
+        from veles_tpu.core.httpd import reply
+        # the same atomic admit/release pair as GenerateAPI, so the
+        # /healthz inflight gauge and counters stay balanced here too
+        # (the queue bound itself is the minibatch: feed overflows)
+        if self.health.try_admit(None) is not None:
+            reply(handler, {"error": "not ready"}, code=503,
+                  headers={"Retry-After": "1"})
+            return
         responder = {"event": threading.Event(), "result": None}
         try:
             self.feed(data, responder)
+        except OverflowError:
+            # admission control: the serving minibatch is full — shed
+            # with a retry hint instead of queueing unboundedly (the
+            # batch flushes within max_response_time, so "1" is honest)
+            self.health.reject_admitted()
+            reply(handler, {"error": "server saturated: retry"},
+                  code=429, headers={"Retry-After": "1"})
+            return
         except Exception as exc:
+            self.health.release("errors")
             self._fail(handler, "invalid input: %s" % exc)
             return
         if not responder["event"].wait(self.RESPONSE_TIMEOUT):
-            self._fail(handler, "inference timed out")
+            # a server-side stall is retryable — 503, matching the
+            # GenerateAPI surface, never a client-blaming 400
+            self.health.release("expired")
+            self.warning("inference timed out")
+            reply(handler, {"error": "inference timed out"}, code=503,
+                  headers={"Retry-After": "1"})
             return
-        from veles_tpu.core.httpd import reply
+        self.health.release("completed")
         reply(handler, {"result": responder["result"]})
 
     # -- response side (workflow thread, after the forward tick) --------------
@@ -384,6 +534,7 @@ class ContinuousDecoder:
         self._next_id = 0
         self.steps = 0
         self.tokens_out = 0
+        self.cancelled = 0
 
     def submit(self, prompt_tokens, n_tokens=None):
         """Queue one prompt (1-D int sequence); returns the request id.
@@ -410,6 +561,31 @@ class ContinuousDecoder:
         """True once request ``rid``'s stream is complete (its tokens
         sit in ``results[rid]``)."""
         return rid in self.results and rid not in self._budget
+
+    def cancel(self, rid):
+        """Abort an incomplete request wherever it is — the admission
+        queue or an active slot — freeing the slot immediately and
+        reaping its ``results`` entry (an expired-deadline request must
+        not burn a slot for its remaining budget, nor leak its token
+        list). Safe mid-chunk: collect/step skip a rid with no budget,
+        and the freed cache lane is fully overwritten on the next admit.
+        Returns True when the request existed and was still running."""
+        if rid not in self._budget:
+            return False
+        for i, queued in enumerate(self._queue):
+            if queued[0] == rid:
+                del self._queue[i]
+                break
+        else:
+            for slot, owner in list(self._slot_req.items()):
+                if owner == rid:
+                    del self._slot_req[slot]
+                    self._free.append(slot)
+                    break
+        del self._budget[rid]
+        self.results.pop(rid, None)
+        self.cancelled += 1
+        return True
 
     @staticmethod
     def _bucket(n):
@@ -589,32 +765,99 @@ class GenerateAPI:
     event; ONE driver thread owns the decoder (it is not thread-safe)
     — admitting staged prompts and running chunked decode steps while
     anything is in flight, so concurrent requests batch into the slot
-    pool automatically and new ones join mid-flight."""
+    pool automatically and new ones join mid-flight.
+
+    Survival layer (docs/serving_robustness.md): admission is bounded
+    by ``max_queue`` (429 + ``Retry-After`` beyond it, 503 while not
+    ready); every request carries a deadline (``deadline`` default,
+    per-request ``"deadline_s"`` override) and an expired request is
+    cancelled INSIDE the decoder — slot freed, results reaped — instead
+    of burning a slot for its full budget; and a decoder failure trips
+    a circuit breaker that sheds in-flight requests, rebuilds the
+    decoder from the held params/embed_table with exponential backoff,
+    probes it with a real decode, and closes again. ``/healthz`` and
+    ``/readyz`` expose the breaker state and the trip/rebuild/shed/
+    expired counters. ``chaos`` accepts a
+    :class:`veles_tpu.serving_chaos.ServingChaosMonkey` (default: built
+    from ``root.common.serve.chaos``)."""
+
+    #: extra handler-side wait beyond the request deadline before the
+    #: handler gives up on the driver (wedged-driver backstop)
+    BACKSTOP_GRACE = 10.0
 
     def __init__(self, params, embed_table, heads, slots=4,
                  max_len=512, n_tokens=32, temperature=0.0, top_k=0,
                  eos=None, key=None, port=0, host="127.0.0.1",
-                 path="/generate", chunk=8, request_timeout=300.0):
+                 path="/generate", chunk=8, request_timeout=None,
+                 max_queue=None, deadline=None, rebuild_backoff=None,
+                 rebuild_backoff_max=None, chaos=None):
         import queue
 
-        self.decoder = ContinuousDecoder(
-            params, embed_table, heads, slots=slots, max_len=max_len,
-            n_tokens=n_tokens, temperature=temperature, top_k=top_k,
-            eos=eos, key=key)
+        from veles_tpu.core.config import root
+
+        serve_cfg = root.common.serve
+        #: default per-request deadline (seconds); ``request_timeout``
+        #: is the legacy name for the same knob. Validated BEFORE the
+        #: (expensive) decoder build, so a server misconfiguration
+        #: fails at startup — never as a 400 blaming a field the
+        #: client didn't send.
+        if deadline is None:
+            deadline = (request_timeout if request_timeout is not None
+                        else serve_cfg.get("deadline", 300.0))
+        self.deadline = float(deadline)
+        if not math.isfinite(self.deadline) \
+                or not 0 < self.deadline <= 1e7:
+            raise ValueError(
+                "serve deadline (--serve-deadline / deadline=) must "
+                "be a positive number of seconds (at most 1e7), "
+                "got %r" % deadline)
+        self._decoder_kwargs = dict(
+            params=params, embed_table=embed_table, heads=heads,
+            slots=slots, max_len=max_len, n_tokens=n_tokens,
+            temperature=temperature, top_k=top_k, eos=eos, key=key)
+        self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
         self.port = port
         self.host = host
         self.path = path
         self.chunk = chunk
-        self.request_timeout = request_timeout
+        #: staged + in-flight bound; beyond it new arrivals are shed
+        #: with 429 + Retry-After instead of queueing unboundedly
+        #: (<= 0 explicitly DISABLES the bound — load shedding off)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else serve_cfg.get("max_queue", 64))
+        self.rebuild_backoff = float(
+            rebuild_backoff if rebuild_backoff is not None
+            else serve_cfg.get("rebuild_backoff", 0.5))
+        self.rebuild_backoff_max = float(
+            rebuild_backoff_max if rebuild_backoff_max is not None
+            else serve_cfg.get("rebuild_backoff_max", 30.0))
+        if chaos is None:
+            from veles_tpu.serving_chaos import ServingChaosMonkey
+            chaos = ServingChaosMonkey.from_config()
+        self.chaos = chaos
+        self.health = ServingHealth(name="generate-api")
         self._staged = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._httpd = None
         self._driver = None
-        self._failed = None  # sticky driver-failure message
+        self._tripped = None  # breaker-open reason (None = closed)
 
     # -- driver thread (sole owner of the decoder) ------------------------
+    def _resolve(self, holder, outcome, **fields):
+        """Resolve one admitted request exactly once: stamp the reply
+        fields, book it out of the in-flight gauge under ``outcome``,
+        wake its handler thread. Safe against the driver and a
+        backstop-timing-out handler racing (dict.setdefault is atomic
+        under the GIL; only the winner books the release)."""
+        token = object()
+        if holder.setdefault("resolved", token) is not token:
+            return
+        holder.update(fields)
+        self.health.release(outcome)
+        holder["event"].set()
+
     def _drain_staged(self):
         import queue
 
@@ -630,79 +873,147 @@ class GenerateAPI:
                 # belt-and-braces: the handler pre-validated, but a
                 # failed submit must never kill the driver thread —
                 # resolve the request with the error instead
-                holder["error"] = str(exc)
-                holder["event"].set()
+                self._resolve(holder, "errors", error=str(exc),
+                              code=400)
                 continue
             waiting[rid] = holder
         return waiting
 
-    def _fail_all(self, waiting, message):
+    def _fail_all(self, waiting, message, outcome="errors", code=503):
         """Resolve every in-flight and staged request with an error —
-        nobody may be left blocking out their full request_timeout."""
+        nobody may be left blocking out their full deadline."""
         import queue
 
         for holder in waiting.values():
-            holder.setdefault("error", message)
-            holder["event"].set()
+            self._resolve(holder, outcome, error=message, code=code)
         waiting.clear()
         while True:
             try:
                 _, _, holder = self._staged.get_nowait()
             except queue.Empty:
                 return
-            holder.setdefault("error", message)
-            holder["event"].set()
+            self._resolve(holder, outcome, error=message, code=code)
+
+    def _expire_deadlines(self, waiting):
+        """Cancel every request whose deadline passed: the decoder slot
+        frees immediately, the results entry is reaped, the client gets
+        a 504 — a timed-out handler no longer leaks either."""
+        now = time.monotonic()
+        for rid in [r for r, h in waiting.items()
+                    if h.get("deadline") is not None
+                    and now >= h["deadline"]]:
+            holder = waiting.pop(rid)
+            self.decoder.cancel(rid)
+            self._resolve(holder, "expired", error="deadline exceeded",
+                          code=504)
+
+    def _trip(self, exc, waiting):
+        """Open the circuit: the decoder's donated state is unusable.
+        Shed everyone now queued/in-flight — loudly, with a retryable
+        503 — instead of wedging each behind its full deadline."""
+        self.health.incr("trips")
+        self.health.set_breaker("open")
+        self.health.set_ready(False)
+        self._tripped = "decode driver failed: %s; rebuilding" % exc
+        self._fail_all(waiting, self._tripped, outcome="shed", code=503)
+
+    def _rebuild(self):
+        """Build a fresh decoder from the held params/embed_table and
+        prove the device path end to end with a probe decode; only a
+        probed decoder takes traffic again. Returns True on success."""
+        try:
+            decoder = ContinuousDecoder(**self._decoder_kwargs)
+            # request ids stay monotonic across rebuilds so per-request
+            # sampling keys (fold_in(base, rid)) never repeat
+            decoder._next_id = self.decoder._next_id
+            probe = decoder.submit([0], 1)
+            for _ in range(8):
+                if self.chaos is not None:
+                    self.chaos.before_step()
+                decoder.step()
+                if decoder.done(probe):
+                    break
+            else:
+                raise RuntimeError("probe decode did not finish")
+            decoder.results.pop(probe, None)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return False
+        self.decoder = decoder
+        return True
 
     def _drive(self):
         waiting = {}
+        backoff = self.rebuild_backoff
         try:
             while not self._stop.is_set():
-                if self._failed is not None:
-                    # decoder state is gone: fail new arrivals fast
-                    self._fail_all(waiting, self._failed)
-                    if not self._wake.wait(timeout=0.05):
-                        continue
-                    self._wake.clear()
+                if self._tripped is not None:
+                    # breaker open: shed stragglers fast, rebuild with
+                    # exponential backoff, close only after the probe
+                    self._fail_all(waiting, self._tripped,
+                                   outcome="shed", code=503)
+                    if self._stop.wait(backoff):
+                        break
+                    if self._rebuild():
+                        self._tripped = None
+                        backoff = self.rebuild_backoff
+                        self.health.incr("rebuilds")
+                        self.health.set_breaker("closed")
+                        self.health.set_ready(True)
+                    else:
+                        backoff = min(backoff * 2,
+                                      self.rebuild_backoff_max)
                     continue
                 waiting.update(self._drain_staged())
+                self._expire_deadlines(waiting)
                 if not self.decoder.busy:
                     if not self._wake.wait(timeout=0.05):
                         continue
                     self._wake.clear()
                     continue
                 try:
+                    if self.chaos is not None:
+                        self.chaos.before_step()
                     self.decoder.step_many(self.chunk)
                     for rid in [r for r in waiting
                                 if self.decoder.done(r)]:
                         holder = waiting.pop(rid)
-                        holder["tokens"] = self.decoder.results.pop(rid)
-                        holder["event"].set()
-                except Exception as exc:  # device/runtime failure:
-                    # the decoder's donated state is unusable — fail
-                    # everything loudly instead of wedging the server
-                    # behind 300-second timeouts
+                        self._resolve(
+                            holder, "completed",
+                            tokens=self.decoder.results.pop(rid))
+                except Exception as exc:  # device/runtime failure
                     import traceback
                     traceback.print_exc()
-                    self._failed = "decode driver failed: %s" % exc
-                    self._fail_all(waiting, self._failed)
+                    self._trip(exc, waiting)
         finally:
             self._fail_all(waiting, "server stopped")
 
     # -- HTTP -------------------------------------------------------------
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
-                                          reply, start_server)
+        from veles_tpu.core.httpd import (BodyTooLarge,
+                                          QuietHandlerMixin, read_body,
+                                          reply, serve_health,
+                                          start_server)
 
         api = self
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
+            def do_GET(self):
+                if not serve_health(self, api.health):
+                    self.send_error(404)
+
             def do_POST(self):
                 if self.path.split("?")[0] != api.path:
                     self.send_error(404)
                     return
                 try:
-                    payload = json.loads(read_body(self).decode())
+                    raw = read_body(self)
+                except BodyTooLarge:
+                    return  # 413 sent, nothing buffered
+                try:
+                    payload = json.loads(raw.decode())
                     tokens = payload["tokens"]
                     if not isinstance(tokens, list) or not tokens \
                             or not all(isinstance(t, int)
@@ -716,8 +1027,19 @@ class GenerateAPI:
                             not isinstance(budget, int) or budget < 1):
                         raise ValueError("n_tokens must be a positive "
                                          "integer")
+                    deadline_s = payload.get("deadline_s")
+                    if deadline_s is None:
+                        # server default, validated at construction
+                        deadline_s = api.deadline
+                    elif isinstance(deadline_s, bool) \
+                            or not isinstance(deadline_s, (int, float)) \
+                            or not math.isfinite(deadline_s) \
+                            or not 0 < deadline_s <= 86400:
+                        # finite + bounded: json accepts Infinity/NaN,
+                        # and a huge value would overflow Event.wait()
+                        raise ValueError("deadline_s must be a number "
+                                         "of seconds in (0, 86400]")
                     prompt = numpy.asarray(tokens, numpy.int32)
-                    holder = {"event": threading.Event()}
                     # max_len / budget validation happens on the
                     # driver thread via submit(); pre-check here so
                     # the client gets a 400, not a timeout
@@ -731,13 +1053,40 @@ class GenerateAPI:
                 except (ValueError, TypeError, KeyError) as exc:
                     reply(self, {"error": str(exc)}, code=400)
                     return
+                # admission: atomic ready + queue-bound check; rejected
+                # requests never stage, so the decoder queue is bounded
+                verdict = api.health.try_admit(api.max_queue)
+                if verdict == "unready":
+                    reply(self, {"error": api._tripped or "not ready"},
+                          code=503, headers={"Retry-After": "1"})
+                    return
+                if verdict == "full":
+                    reply(self,
+                          {"error": "saturated: %d requests in flight"
+                           % api.max_queue},
+                          code=429, headers={"Retry-After": "1"})
+                    return
+                holder = {"event": threading.Event(),
+                          "deadline": time.monotonic() + deadline_s}
                 api._staged.put((prompt, budget, holder))
                 api._wake.set()
-                if not holder["event"].wait(api.request_timeout):
-                    reply(self, {"error": "timed out"}, code=503)
-                    return
+                # the DRIVER owns deadline expiry (it frees the slot);
+                # the grace here is only a backstop against a wedged
+                # (hung, non-raising) driver thread. The handler then
+                # resolves the holder ITSELF so the in-flight gauge is
+                # released — otherwise a dead driver would ratchet the
+                # gauge up to max_queue and 429 everything forever —
+                # and falls through to the shared reply logic (a driver
+                # winning the race by a hair still delivers its result).
+                if not holder["event"].wait(deadline_s
+                                            + api.BACKSTOP_GRACE):
+                    api._resolve(holder, "errors",
+                                 error="timed out", code=503)
                 if "error" in holder:
-                    reply(self, {"error": holder["error"]}, code=400)
+                    code = holder.get("code", 400)
+                    reply(self, {"error": holder["error"]}, code=code,
+                          headers={"Retry-After": "1"}
+                          if code in (429, 503) else None)
                     return
                 reply(self, {"tokens": holder["tokens"]})
 
@@ -747,14 +1096,16 @@ class GenerateAPI:
                                         name="generate-driver",
                                         daemon=True)
         self._driver.start()
+        self.health.set_ready(True)
         return self
 
     def stop(self):
+        self.health.set_ready(False)
         self._stop.set()
         self._wake.set()
         if self._driver is not None:
             # the driver's finally-block resolves in-flight requests
-            # ("server stopped") so no handler blocks out its timeout
+            # ("server stopped") so no handler blocks out its deadline
             self._driver.join(timeout=10)
             self._driver = None
         if self._httpd is not None:

@@ -1,0 +1,155 @@
+"""Deterministic chaos harness for the serving path.
+
+The fleet got a seeded fault injector in ``fleet/chaos.py``; this is the
+same idiom pointed at the serving tier (``serving.py``,
+docs/serving_robustness.md). Two fault families:
+
+**Server-side (driver) faults**, injected into ``GenerateAPI``'s decode
+loop through :meth:`ServingChaosMonkey.before_step`:
+
+- **step failure** — raise from a decoder step, emulating a device /
+  runtime error (the XLA dispatch dying under the driver). This is what
+  trips the circuit breaker; ``step_fail_max`` caps the total number of
+  injected failures so a chaos run provably settles and every request
+  eventually completes.
+- **slow step** — stretch a decode step (a straggling device or a
+  pre-empted TPU slice); exercises deadline expiry and queue backpressure
+  without killing anything.
+
+**Client-side faults**, rolled by the test harness's chaos client via
+:meth:`roll_client_fault` (the server cannot inject these on itself):
+
+- **disconnect** — send a request then drop the socket before reading
+  the reply (mobile clients, LB timeouts);
+- **garbage body** — POST bytes that are not JSON;
+- **oversize body** — claim a huge ``Content-Length`` (the
+  ``read_body`` cap must answer 413 before buffering).
+
+Each fault family draws from its OWN seeded stream — server faults from
+``Random(seed)`` on the driver thread, client faults from
+``Random("client-<seed>")`` on the harness thread — so the two threads
+never interleave on one RNG and a (seed, workload) pair replays the
+same fault schedule per family. The acceptance suite
+(``tests/test_serving_chaos.py``, ``make chaos-serve``) asserts
+bit-identical greedy tokens after recovery.
+
+Configuration: ``root.common.serve.chaos.*`` (see ``from_config``) or
+the ``--chaos-serve-*`` CLI flags.
+"""
+
+import random
+import time
+
+from veles_tpu.core.logger import Logger
+from veles_tpu.fleet.chaos import ChaosConfigBase, roll
+
+#: chaos config keys that are fault probabilities
+PROBABILITY_KEYS = ("step_fail", "slow_step", "disconnect",
+                    "garbage_body", "oversize_body")
+
+#: client-side fault kinds, in their fixed roll order
+CLIENT_FAULTS = ("disconnect", "garbage_body", "oversize_body")
+
+
+class ChaosStepError(RuntimeError):
+    """The injected decoder-step failure (stands in for a device /
+    runtime error under the driver loop)."""
+
+
+class ServingChaosConfig(ChaosConfigBase):
+    """Validated serving-chaos knobs (probabilities in [0, 1])."""
+
+    PROBABILITY_KEYS = PROBABILITY_KEYS
+
+    def __init__(self, seed=1, step_fail=0.0, step_fail_max=None,
+                 slow_step=0.0, slow_step_ms=20.0, disconnect=0.0,
+                 garbage_body=0.0, oversize_body=0.0):
+        self._set_probabilities(
+            step_fail=step_fail, slow_step=slow_step,
+            disconnect=disconnect, garbage_body=garbage_body,
+            oversize_body=oversize_body)
+        if step_fail_max is not None:
+            step_fail_max = int(step_fail_max)
+            if step_fail_max < 0:
+                raise ValueError("step_fail_max must be >= 0")
+        self.step_fail_max = step_fail_max
+        self.seed = int(seed)
+        self.slow_step_ms = float(slow_step_ms)
+
+
+class ServingChaosMonkey(Logger):
+    """The serving-path fault injector (see module docstring)."""
+
+    def __init__(self, config):
+        super().__init__(logger_name="serve.Chaos")
+        self.config = config
+        # independent streams per fault family: the driver thread and
+        # the harness's client thread must not race on one RNG (that
+        # would make the schedule depend on OS scheduling)
+        self._rng = random.Random(config.seed)
+        self._rng_client = random.Random("client-%d" % config.seed)
+        self.counters = {"steps_failed": 0, "steps_slowed": 0,
+                         "disconnects": 0, "garbage_bodies": 0,
+                         "oversize_bodies": 0}
+
+    @classmethod
+    def from_config(cls):
+        """Build from ``root.common.serve.chaos``; returns ``None`` when
+        chaos is disabled (no probability set, or ``enabled = False``)."""
+        from veles_tpu.core.config import root
+        cfg = root.common.serve.chaos
+        config = ServingChaosConfig(
+            seed=cfg.get("seed", 1),
+            step_fail=cfg.get("step_fail", 0.0),
+            step_fail_max=cfg.get("step_fail_max", None),
+            slow_step=cfg.get("slow_step", 0.0),
+            slow_step_ms=cfg.get("slow_step_ms", 20.0),
+            disconnect=cfg.get("disconnect", 0.0),
+            garbage_body=cfg.get("garbage_body", 0.0),
+            oversize_body=cfg.get("oversize_body", 0.0))
+        if not cfg.get("enabled", config.any_enabled):
+            return None
+        monkey = cls(config)
+        monkey.info(
+            "serving chaos enabled (seed=%d): %s", config.seed,
+            ", ".join("%s=%.3g" % (key, getattr(config, key))
+                      for key in PROBABILITY_KEYS
+                      if getattr(config, key) > 0.0))
+        return monkey
+
+    # -- server-side (driver) faults ------------------------------------------
+    def before_step(self):
+        """Called by the GenerateAPI driver before each decoder dispatch
+        (including rebuild-probe decodes): maybe stretch the step, maybe
+        raise the injected device failure. Each stream advances in a
+        fixed call order on its own thread -> deterministic fault
+        schedule for a deterministic workload."""
+        if roll(self._rng, self.config.slow_step):
+            self.counters["steps_slowed"] += 1
+            time.sleep(self.config.slow_step_ms / 1000.0)
+        if self.config.step_fail_max is not None \
+                and self.counters["steps_failed"] \
+                >= self.config.step_fail_max:
+            return
+        if roll(self._rng, self.config.step_fail):
+            self.counters["steps_failed"] += 1
+            self.warning("chaos: injecting decoder-step failure (#%d)",
+                         self.counters["steps_failed"])
+            raise ChaosStepError("chaos: injected decoder-step failure")
+
+    # -- client-side faults (rolled by the harness's chaos client) ------------
+    def roll_client_fault(self):
+        """One fault decision for the next client request: returns
+        ``None`` (behave) or one of ``CLIENT_FAULTS``. Rolls every fault
+        kind each call — fixed rng call order keeps the schedule
+        deterministic — and fires the first that hits."""
+        fired = None
+        for kind in CLIENT_FAULTS:
+            if roll(self._rng_client, getattr(self.config, kind)) \
+                    and fired is None:
+                fired = kind
+        if fired is not None:
+            self.counters[{"disconnect": "disconnects",
+                           "garbage_body": "garbage_bodies",
+                           "oversize_body": "oversize_bodies"}[fired]] += 1
+        return fired

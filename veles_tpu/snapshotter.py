@@ -21,7 +21,10 @@ role)."""
 
 import bz2
 import contextlib
+import glob
 import gzip
+import hashlib
+import logging
 import lzma
 import os
 import pickle
@@ -39,8 +42,52 @@ CODECS = {
     "": lambda path, mode: open(path, mode + "b"),
     "gz": lambda path, mode: gzip.open(path, mode + "b", compresslevel=6),
     "bz2": lambda path, mode: bz2.open(path, mode + "b", compresslevel=6),
-    "xz": lambda path, mode: lzma.open(path, mode + "b", preset=6),
+    # preset only on write: lzma.open raises if it is passed for read
+    "xz": lambda path, mode: lzma.open(
+        path, mode + "b", **({"preset": 6} if "w" in mode else {})),
 }
+
+
+#: codec wrappers over an already-open binary stream (the write path
+#: tees through a hasher; the path-based CODECS stay for reading)
+_STREAM_CODECS = {
+    None: lambda f: f,
+    "": lambda f: f,
+    "gz": lambda f: gzip.GzipFile(fileobj=f, mode="wb", compresslevel=6),
+    "bz2": lambda f: bz2.BZ2File(f, "wb", compresslevel=6),
+    "xz": lambda f: lzma.LZMAFile(f, "wb", preset=6),
+}
+
+
+class SnapshotCorruptError(Exception):
+    """The snapshot's SHA-256 sidecar does not match its bytes."""
+
+
+class _HashingWriter:
+    """File-object tee feeding SHA-256 with every written block, so the
+    sidecar digest costs no second full-file read on export."""
+
+    def __init__(self, fileobj):
+        self._file = fileobj
+        self._digest = hashlib.sha256()
+
+    def write(self, data):
+        self._digest.update(data)
+        return self._file.write(data)
+
+    def flush(self):
+        self._file.flush()
+
+    def hexdigest(self):
+        return self._digest.hexdigest()
+
+
+def _sha256_of(path):
+    digest = hashlib.sha256()
+    with open(path, "rb") as fin:
+        for block in iter(lambda: fin.read(1 << 20), b""):
+            digest.update(block)
+    return digest.hexdigest()
 
 
 class SnapshotterBase(Unit):
@@ -138,15 +185,48 @@ class SnapshotterToFile(SnapshotterBase):
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, name)
 
+        tmp = path + ".tmp%d" % os.getpid()
+
         def write(payload):
             # write-then-rename: a reader (or a crash) must never see a
-            # partially-written snapshot
-            tmp = path + ".tmp%d" % os.getpid()
-            with CODECS[ext](tmp, "w") as fout:
-                pickle.dump(payload, fout, protocol=self.WRITE_PROTOCOL)
-            os.replace(tmp, path)
+            # partially-written snapshot; the tee hashes the bytes as
+            # they land so the sidecar needs no second full-file read
+            with open(tmp, "wb") as raw:
+                tee = _HashingWriter(raw)
+                codec = _STREAM_CODECS[ext](tee)
+                try:
+                    pickle.dump(payload, codec,
+                                protocol=self.WRITE_PROTOCOL)
+                finally:
+                    if codec is not tee:
+                        codec.close()  # flush the compressed tail
+            return tee.hexdigest()
 
-        self._quiesced(write)
+        digest = self._quiesced(write)
+        # integrity sidecar (shasum format + a comment recording the
+        # prefix): import_ verifies the digest and, on a mismatch,
+        # falls back only to intact siblings of the SAME prefix — the
+        # filename alone cannot split prefix from suffix unambiguously.
+        # Two renames cannot be atomic together, so the sidecar lands
+        # FIRST and keeps the PREVIOUS generation's digest: whichever
+        # generation of the data file a crash between the renames
+        # leaves behind, the sidecar on disk vouches for it.
+        sidecar = path + ".sha256"
+        lines = ["%s  %s" % (digest, name)]
+        try:
+            with open(sidecar, "r") as fin:
+                first = fin.readline().split()
+            if first and first[0] != digest:
+                lines.append("%s  %s" % (
+                    first[0], first[1] if len(first) > 1 else name))
+        except OSError:
+            pass
+        digest_tmp = "%s.sha256.tmp%d" % (path, os.getpid())
+        with open(digest_tmp, "w") as fout:
+            fout.write("\n".join(lines)
+                       + "\n# prefix: %s\n" % self.prefix)
+        os.replace(digest_tmp, sidecar)
+        os.replace(tmp, path)
         self.destination = path
         size = os.path.getsize(path)
         if size > 200 * 1024 * 1024:  # reference 200MB warning threshold
@@ -154,18 +234,49 @@ class SnapshotterToFile(SnapshotterBase):
         self.info("snapshot: %s (%d KB)", path, size >> 10)
         link = os.path.join(self.directory, "%s_current.lnk" % self.prefix)
         try:
-            if os.path.islink(link) or os.path.exists(link):
-                os.remove(link)
-            os.symlink(name, link)
+            # atomic resume-pointer update: build the new link under a
+            # temp name and rename over the old one — a crash between
+            # remove and symlink can no longer leave NO pointer at all
+            tmp_link = "%s.tmp%d" % (link, os.getpid())
+            if os.path.lexists(tmp_link):
+                os.remove(tmp_link)
+            os.symlink(name, tmp_link)
+            os.replace(tmp_link, link)
         except OSError:
             pass
 
     @staticmethod
-    def import_(path):
-        """Resume: unpickle and mark restored (reference
-        ``snapshotter.py:411-424``). Returns the workflow."""
-        if os.path.islink(path):
-            path = os.path.join(os.path.dirname(path), os.readlink(path))
+    def _sidecar_prefix(path):
+        """The prefix recorded in a snapshot's sidecar, or None for a
+        legacy/absent sidecar."""
+        sidecar = path + ".sha256"
+        try:
+            with open(sidecar, "r") as fin:
+                for line in fin:
+                    if line.startswith("# prefix:"):
+                        return line[len("# prefix:"):].strip()
+        except OSError:
+            pass
+        return None
+
+    @staticmethod
+    def _load_verified(path):
+        """Unpickle one snapshot, checking its SHA-256 sidecar first
+        when one exists (legacy snapshots without a sidecar still
+        load). The sidecar may vouch for the current AND the previous
+        generation (the export crash-window contract); any listed
+        digest is acceptable. Raises on corruption instead of
+        returning garbage."""
+        sidecar = path + ".sha256"
+        if os.path.isfile(sidecar):
+            with open(sidecar, "r") as fin:
+                want = [line.split()[0] for line in fin
+                        if line.strip() and not line.startswith("#")]
+            got = _sha256_of(path)
+            if want and got not in want:
+                raise SnapshotCorruptError(
+                    "%s: sha256 %s not among sidecar digests %s"
+                    % (path, got, want))
         ext = ""
         for candidate in ("gz", "bz2", "xz"):
             if path.endswith("." + candidate):
@@ -173,6 +284,55 @@ class SnapshotterToFile(SnapshotterBase):
         with CODECS[ext](path, "r") as fin:
             payload = pickle.load(fin)
         return SnapshotterBase._restore(payload)
+
+    @staticmethod
+    def import_(path):
+        """Resume: unpickle and mark restored (reference
+        ``snapshotter.py:411-424``). Returns the workflow.
+
+        The SHA-256 sidecar written at export is verified first; a
+        truncated/corrupt/mismatching snapshot falls back — with a loud
+        warning — to the newest sibling snapshot that verifies, instead
+        of dying and taking the resume with it."""
+        if os.path.islink(path):
+            path = os.path.join(os.path.dirname(path), os.readlink(path))
+        log = logging.getLogger("Snapshotter")
+        try:
+            return SnapshotterToFile._load_verified(path)
+        except Exception as exc:
+            log.warning("snapshot %s is unusable (%s); looking for an "
+                        "intact previous version", path, exc)
+            directory = os.path.dirname(os.path.abspath(path))
+            # restrict candidates to the SAME prefix: a shared snapshot
+            # directory must never silently resume another experiment's
+            # workflow. The exact prefix comes from the sidecar (the
+            # filename alone cannot split prefix from suffix — consider
+            # prefixes "sha" and "sha_twin"); without one (legacy
+            # export) fall back only to the broken file's first "_"
+            # segment, which at least never crosses a leading name.
+            want_prefix = SnapshotterToFile._sidecar_prefix(path)
+            base = os.path.basename(path)
+            stem = base.split("_", 1)[0] + "_" if "_" in base else ""
+            siblings = [
+                p for p in glob.glob(
+                    os.path.join(directory, "%s*.pickle*" % stem))
+                if not p.endswith((".sha256", ".lnk"))
+                and ".tmp" not in os.path.basename(p)
+                and os.path.abspath(p) != os.path.abspath(path)
+                and (want_prefix is None
+                     or SnapshotterToFile._sidecar_prefix(p)
+                     == want_prefix)]
+            siblings.sort(key=os.path.getmtime, reverse=True)
+            for candidate in siblings:
+                try:
+                    workflow = SnapshotterToFile._load_verified(
+                        candidate)
+                except Exception:
+                    continue
+                log.warning("falling back to intact snapshot %s",
+                            candidate)
+                return workflow
+            raise
 
     def export_weights(self, path=None):
         """Plain pytree interchange dump (.npz of every ForwardUnit's

@@ -44,7 +44,8 @@ _PAGE = """<!DOCTYPE html>
 <h1>veles_tpu status</h1>
 <h2>Workflows</h2>
 <table id="wf"><tr><th>name</th><th>mode</th><th>slaves</th>
-<th>runtime (s)</th><th>fleet health</th><th>updated</th></tr>%(rows)s</table>
+<th>runtime (s)</th><th>fleet health</th><th>serving</th>
+<th>updated</th></tr>%(rows)s</table>
 <h2>Workflow graphs</h2><div id="graphs">%(graphs)s</div>
 <h2>Plots</h2><div id="plots">%(plots)s</div>
 <script>
@@ -62,11 +63,12 @@ src.onmessage = function(ev) {
   var state = JSON.parse(ev.data);
   var rows = ['<tr><th>name</th><th>mode</th><th>slaves</th>' +
               '<th>runtime (s)</th><th>fleet health</th>' +
-              '<th>updated</th></tr>'];
+              '<th>serving</th><th>updated</th></tr>'];
   (state.workflows || []).forEach(function(w) {
     rows.push('<tr><td>' + esc(w.name) + '</td><td>' + esc(w.mode) +
               '</td><td>' + (0 | w.slaves) + '</td><td>' +
               Math.round(w.runtime) + '</td><td>' + esc(w.fleet || '') +
+              '</td><td>' + esc(w.serving || '') +
               '</td><td>' + esc(w.updated) + '</td></tr>');
   });
   document.getElementById('wf').innerHTML = rows.join('');
@@ -110,6 +112,34 @@ def format_fleet_health(fleet):
                           for k, v in sorted(chaos.items()) if v)
         if fired:
             parts.append("chaos: " + fired)
+    return " · ".join(parts)
+
+
+def format_serving_health(serving):
+    """A ServingHealth.snapshot() as one table cell (the serving twin of
+    :func:`format_fleet_health`): readiness + breaker state + the
+    non-zero survival counters. Empty for non-serving masters."""
+    if not isinstance(serving, dict):
+        return ""
+    parts = ["ready" if serving.get("ready") else "NOT READY"]
+    breaker = serving.get("breaker")
+    if breaker and breaker != "closed":
+        parts.append("breaker %s" % breaker)
+    try:
+        inflight = int(serving.get("inflight", 0))
+    except (TypeError, ValueError):
+        inflight = 0
+    if inflight:
+        parts.append("%d in flight" % inflight)
+    counters = serving.get("counters")
+    if isinstance(counters, dict):
+        fired = ", ".join("%s %s" % (counters[key], key)
+                          for key in ("completed", "trips", "rebuilds",
+                                      "shed", "expired", "rejected",
+                                      "errors")
+                          if counters.get(key))
+        if fired:
+            parts.append(fired)
     return " · ".join(parts)
 
 
@@ -237,7 +267,8 @@ class WebStatusServer(Logger):
 
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (QuietHandlerMixin, read_body,
+        from veles_tpu.core.httpd import (BodyTooLarge,
+                                          QuietHandlerMixin, read_body,
                                           reply, start_server)
 
         server = self
@@ -249,6 +280,8 @@ class WebStatusServer(Logger):
                     return
                 try:
                     status = json.loads(read_body(self).decode())
+                except BodyTooLarge:
+                    return  # 413 sent before anything was buffered
                 except ValueError:
                     reply(self, {"error": "bad json"}, code=400)
                     return
@@ -395,6 +428,7 @@ class WebStatusServer(Logger):
                 if isinstance(slaves, (list, tuple)) else 0,
                 "runtime": runtime,
                 "fleet": format_fleet_health(s.get("fleet")),
+                "serving": format_serving_health(s.get("serving")),
                 "updated": time.strftime(
                     "%X", time.localtime(s.get("updated", 0)))})
             if isinstance(s.get("graph"), dict):
@@ -431,13 +465,14 @@ class WebStatusServer(Logger):
             slaves = s.get("slaves", [])
             rows.append(
                 "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.0f</td>"
-                "<td>%s</td><td>%s</td></tr>" % (
+                "<td>%s</td><td>%s</td><td>%s</td></tr>" % (
                     escape(str(s.get("name", key))),
                     escape(str(s.get("mode", "?"))),
                     len(slaves) if isinstance(slaves, (list, tuple))
                     else 0,
                     runtime,
                     escape(format_fleet_health(s.get("fleet"))),
+                    escape(format_serving_health(s.get("serving"))),
                     time.strftime("%X",
                                   time.localtime(s.get("updated", 0)))))
         graphs = []
@@ -468,7 +503,7 @@ class WebStatusServer(Logger):
                 plots.append('<img src="/plots/%s?t=%d" alt="%s"/>'
                              % (name, stamp, name))
         return _PAGE % {"rows": "".join(rows) or
-                        "<tr><td colspan=6>none</td></tr>",
+                        "<tr><td colspan=7>none</td></tr>",
                         "graphs": "".join(graphs) or "<p>none</p>",
                         "plots": "".join(plots) or "<p>none</p>"}
 
@@ -508,6 +543,29 @@ class StatusNotifier:
             status["fleet"] = {
                 key: fleet.get(key)
                 for key in ("epoch", "queued_jobs", "ledger", "chaos")}
+        # serving-survival observability (docs/serving_robustness.md):
+        # a serving API mirrors its breaker state and trip/rebuild/
+        # shed/expired counters onto the dashboard. Two attachment
+        # points: a standalone GenerateAPI hung on the launcher as
+        # `launcher.serving_api`, or a serving unit (RESTfulAPI) found
+        # IN the workflow via its `health` attribute.
+        serving_health = getattr(
+            getattr(launcher, "serving_api", None), "health", None)
+        if serving_health is None:
+            try:
+                units = list(launcher.workflow)
+            except TypeError:
+                units = []
+            for unit in units:
+                candidate = getattr(unit, "health", None)
+                if candidate is not None \
+                        and hasattr(candidate, "snapshot") \
+                        and hasattr(candidate, "ready"):
+                    serving_health = candidate
+                    break
+        if serving_health is not None \
+                and hasattr(serving_health, "snapshot"):
+            status["serving"] = serving_health.snapshot()
         # the live unit DAG (+ run counters) for the dashboard's graph
         # view — the reference's viz.js workflow page
         # (web_status.py:113-165), rendered server-side as SVG here
