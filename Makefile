@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: check test entry hooks chaos chaos-serve
+.PHONY: check test entry hooks chaos chaos-serve bench-serve
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -29,6 +29,12 @@ chaos-serve:
 			$(PYTHON) -m pytest tests/test_serving_chaos.py \
 			-m chaos_serve -q || exit 1; \
 	done
+
+# Standalone continuous-batching serving bench (docs/
+# serving_performance.md): one JSON line with the decode_continuous_*
+# keys — tokens/sec, prefill ms, host-overhead fraction.
+bench-serve:
+	$(PYTHON) bench.py --serve
 
 entry:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import jax, __graft_entry__ as g; \

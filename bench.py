@@ -1028,13 +1028,22 @@ def decode_int8_device(batch=8, prompt=512, embed=1024, heads=16,
 
 def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
                       embed=1024, heads=16, blocks=4, vocab=32768,
-                      chunk=64):
+                      chunk=64, quantize=None):
     """Continuous-batching serving throughput (VERDICT r4 #10): the
     ContinuousDecoder drains ``n_requests`` STAGGERED bf16 requests
     (new prompts admitted as slots free up mid-flight) in chunked
     throughput mode. Wall-clock tokens/sec — includes admission
     prefills and the one host round trip per ``chunk`` tokens; best of
-    two runs with the run gap as spread."""
+    two runs with the run gap as spread.
+
+    Extra observability keys (the PR-3 serving-gap trajectory):
+    ``decode_continuous_prefill_ms`` is the best run's total
+    host-blocking admission (bucket prefill) wall time, and
+    ``decode_continuous_host_overhead_fraction`` is the share of the
+    run's wall clock spent OUTSIDE device-facing calls (dispatch,
+    readback, admit) — pure host bookkeeping; near 0 means the device
+    queue stays fed. ``quantize`` forwards to the decoder (the int8 /
+    int8-KV slot tiers)."""
     from veles_tpu.parallel.transformer_step import (
         init_transformer_params)
     from veles_tpu.serving import ContinuousDecoder
@@ -1051,7 +1060,7 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
         # finished slot decode one extra chunk before it recycles
         dec = ContinuousDecoder(params, table, heads, slots=slots,
                                 max_len=prompt + budget + 2 * chunk,
-                                n_tokens=budget)
+                                n_tokens=budget, quantize=quantize)
         # stagger: half the requests up front, the rest trickle in as
         # chunks complete (joining mid-flight is the tier's point)
         pending = list(prompts)
@@ -1061,15 +1070,22 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
         dec.drain_pipelined(
             chunk, admit=lambda: pending and dec.submit(pending.pop()))
         dt = time.perf_counter() - t0
-        return dec.tokens_out / dt
+        return dec.tokens_out / dt, dt, dict(dec.timings)
 
     run()  # compile (admit + chunk programs) + warm
-    rates = [run() for _ in range(2)]
-    best = max(rates)
-    return {"decode_continuous_tokens_per_sec": round(best, 1),
-            "decode_continuous_spread": round(
-                (best - min(rates)) / best, 4),
-            "decode_continuous_config":
+    runs = [run() for _ in range(2)]
+    best_rate, wall, timings = max(runs, key=lambda r: r[0])
+    device_s = sum(timings.values())
+    prefix = ("decode_continuous" if not quantize
+              else "decode_continuous_" + quantize.replace("-", ""))
+    return {prefix + "_tokens_per_sec": round(best_rate, 1),
+            prefix + "_spread": round(
+                (best_rate - min(r[0] for r in runs)) / best_rate, 4),
+            prefix + "_prefill_ms": round(
+                timings["admit_s"] * 1000, 3),
+            prefix + "_host_overhead_fraction": round(
+                max(0.0, 1.0 - device_s / wall), 4),
+            prefix + "_config":
                 "s%d_p%d_b%d_r%d_c%d_e%d_h%d_L%d_v%d"
                 % (slots, prompt, budget, n_requests, chunk, embed,
                    heads, blocks, vocab)}
@@ -1184,5 +1200,26 @@ def main():
     }))
 
 
+def serve_main():
+    """``make bench-serve``: the continuous-batching serving bench
+    standalone (one JSON line) — fast iteration on the slot-engine hot
+    path without paying for the full training bench. Runs the bf16
+    tier and, when the device has the int8 kernels' appetite, the
+    int8-KV slot tier too."""
+    kind = device_info()[0]
+    out = {"metric": "decode_continuous_tokens_per_sec",
+           "unit": "tokens/sec", "device_kind": kind}
+    out.update(_guarded(decode_continuous, fallback={}))
+    out.update(_guarded(decode_continuous, quantize="int8-kv",
+                        fallback={}))
+    out["value"] = out.get("decode_continuous_tokens_per_sec")
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--serve" in sys.argv[1:]:
+        serve_main()
+    else:
+        main()

@@ -139,6 +139,78 @@ def test_generate_sampling_reproducible_and_topk_bounded(model):
                                      numpy.asarray(top1))
 
 
+def test_slot_step_span_tiling_is_inert(model):
+    """The tiled slot attention contract: any span covering the
+    longest live sequence (+1 for the appended token) produces
+    bit-identical state updates and emitted tokens vs attending the
+    whole max_len lane — masked positions contribute exact zeros."""
+    from veles_tpu.parallel.decode import (init_slot_state, slot_admit,
+                                           slot_step)
+
+    params, table = model
+    rng = numpy.random.RandomState(7)
+    state = init_slot_state(BLOCKS, 2, 24, HEADS, EMBED // HEADS, VOCAB)
+    for slot, n in enumerate((5, 3)):
+        prompt = jnp.asarray(rng.randint(0, VOCAB, (1, n)))
+        state = slot_admit(params, table, HEADS, state,
+                           jnp.int32(slot), table[prompt])
+    active = jnp.asarray([True, True])
+    full_state = jax.tree.map(jnp.copy, state)
+    for span in (8, 16, 24):
+        tiled, tok_tiled = slot_step(params, table, HEADS,
+                                     jax.tree.map(jnp.copy, state),
+                                     active, span=span)
+        full, tok_full = slot_step(params, table, HEADS,
+                                   jax.tree.map(jnp.copy, full_state),
+                                   active)
+        numpy.testing.assert_array_equal(numpy.asarray(tok_tiled),
+                                         numpy.asarray(tok_full))
+        numpy.testing.assert_array_equal(
+            numpy.asarray(tiled["logits"]), numpy.asarray(full["logits"]))
+
+
+def test_slot_admit_many_matches_single_admits(model):
+    """One batched same-bucket admission dispatch produces the same
+    slot state as admitting each prompt alone — including duplicate
+    padding rows (the host pads groups to powers of two)."""
+    from veles_tpu.parallel.decode import (init_slot_state, slot_admit,
+                                           slot_admit_many)
+
+    params, table = model
+    rng = numpy.random.RandomState(8)
+    lens = (5, 7, 3)
+    prompts = [rng.randint(0, VOCAB, n) for n in lens]
+    bucket = 8
+    padded = numpy.zeros((4, bucket), numpy.int32)  # padded to 4 rows
+    for j, p in enumerate(prompts + [prompts[-1]]):  # duplicate row
+        padded[j, :len(p)] = p
+    keys = jax.random.split(jax.random.key(3), 4)
+    ref = init_slot_state(BLOCKS, 4, 24, HEADS, EMBED // HEADS, VOCAB)
+    for slot, (p, n) in enumerate(zip(prompts, lens)):
+        row = numpy.zeros(bucket, numpy.int32)
+        row[:n] = p
+        ref = slot_admit(params, table, HEADS, ref, jnp.int32(slot),
+                         table[jnp.asarray(row)][None],
+                         req_key=keys[slot], length=jnp.int32(n))
+    batched = init_slot_state(BLOCKS, 4, 24, HEADS, EMBED // HEADS,
+                              VOCAB)
+    batched = slot_admit_many(
+        params, table, HEADS, batched,
+        jnp.asarray([0, 1, 2, 2], jnp.int32),
+        table[jnp.asarray(padded)],
+        keys.at[3].set(keys[2]),
+        jnp.asarray(list(lens) + [lens[-1]], jnp.int32))
+    numpy.testing.assert_array_equal(numpy.asarray(ref["lengths"]),
+                                     numpy.asarray(batched["lengths"]))
+    numpy.testing.assert_array_equal(numpy.asarray(ref["logits"]),
+                                     numpy.asarray(batched["logits"]))
+    # the written K/V slabs agree wherever a real prompt lives
+    for slot, n in enumerate(lens):
+        numpy.testing.assert_array_equal(
+            numpy.asarray(ref["k"][:, slot, :n]),
+            numpy.asarray(batched["k"][:, slot, :n]))
+
+
 def test_tensor_parallel_decode_smoke_2dev():
     """Cheap TP-decode smoke tier: 2-device mesh, 2 tokens, tiny model —
     fast enough to run on every suite invocation so the TP call path

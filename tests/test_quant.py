@@ -195,6 +195,32 @@ def test_cache_attend_kernel_matches_xla_formulation():
                                   atol=2e-5)
 
 
+def test_cache_attend_per_row_masks_match_per_row_calls():
+    """The (B, T) per-row mask form (the slot engine's per-slot
+    lengths): each row must equal a separate call with that row's
+    1-D mask — on the XLA formulation and the kernel (interpret)."""
+    from veles_tpu.ops.quant import int8_cache_attend
+
+    (q, khm, kshm, vhm, vshm, *_) = _attend_fixture(
+        batch=2, length=128, heads=2, dim=32, seed=12)
+    inv = 1.0 / numpy.sqrt(q.shape[-1])
+    lengths = (50, 97)
+    masks = jnp.stack([
+        jnp.where(jnp.arange(128) <= n, 0.0, -1e30).astype(jnp.float32)
+        for n in lengths])
+    for pallas in (False, True):
+        got = int8_cache_attend(q * inv, khm, kshm, vhm, vshm, masks,
+                                use_pallas=pallas, interpret=True)
+        for row in range(2):
+            want = int8_cache_attend(
+                q[row:row + 1] * inv, khm[row:row + 1],
+                kshm[row:row + 1], vhm[row:row + 1], vshm[row:row + 1],
+                masks[row], use_pallas=pallas, interpret=True)
+            numpy.testing.assert_allclose(
+                numpy.asarray(got[row:row + 1]), numpy.asarray(want),
+                rtol=2e-5, atol=2e-5)
+
+
 def test_quantize_kv_roundtrip_bound():
     from veles_tpu.parallel.decode import _quantize_kv
 

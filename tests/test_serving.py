@@ -529,6 +529,162 @@ class TestContinuousDecoder:
         with pytest.raises(ValueError):
             dec.submit(numpy.arange(12) % vocab)
 
+    def test_batched_admission_one_dispatch_per_bucket(self, model):
+        """The admission perf contract (docs/serving_performance.md):
+        every same-bucket queued prompt admits in ONE slot_admit_many
+        dispatch — the dispatch-counting CI hook proves it — and the
+        streams stay bit-identical to single-request generate()."""
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(11)
+        # three prompts in bucket 16, one in bucket 32
+        prompts = [rng.randint(0, vocab, n) for n in (5, 9, 12, 20)]
+        dec = ContinuousDecoder(params, table, heads, slots=4,
+                                max_len=64, n_tokens=4)
+        ids = [dec.submit(p) for p in prompts]
+        dec.step()  # admits everything queued
+        assert dec.dispatch_counts["admit"] == 2  # one per bucket group
+        assert dec.dispatch_counts["admit_requests"] == 4
+        results = dec.run_until_drained()
+        for rid, prompt in zip(ids, prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=4, max_len=64)
+            assert results[rid] == numpy.asarray(want)[0].tolist()
+
+    def test_tiled_pipelined_join_cancel_bit_identity(self, model):
+        """The full PR-3 composite on the numerical contract: a small
+        span tile (spans vary as sequences grow), batched admission,
+        the lag-1 pipelined drain, requests joining mid-flight AND one
+        cancelled mid-chunk — surviving streams exactly equal greedy
+        generate()."""
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(12)
+        prompts = [rng.randint(0, vocab, n) for n in (4, 6, 5, 3)]
+        budgets = [5, 9, 3, 7]
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=48, n_tokens=9, tile=8)
+        # the victim is submitted FIRST so it owns a slot immediately:
+        # cancelling it at pass 2 happens while the pass-1 chunk that
+        # contains its tokens is still in flight (a true mid-chunk
+        # cancel), and the freed slot re-admits a queued request
+        victim = dec.submit(rng.randint(0, vocab, 5), 9)
+        ids = [dec.submit(prompts[0], budgets[0]),
+               dec.submit(prompts[1], budgets[1])]
+        late = list(zip(prompts[2:], budgets[2:]))
+        state = {"passes": 0}
+
+        def admit():
+            state["passes"] += 1
+            if state["passes"] == 2:
+                # cancel with a chunk in flight: its tail tokens must
+                # be discarded at collect, the slot recycled cleanly
+                assert dec.cancel(victim)
+            if late:
+                prompt, budget = late.pop(0)
+                ids.append(dec.submit(prompt, budget))
+
+        dec.drain_pipelined(chunk=4, admit=admit)
+        assert victim not in dec.results
+        assert not dec.busy
+        for rid, prompt, budget in zip(ids, prompts, budgets):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=budget, max_len=48)
+            assert dec.results[rid] == \
+                numpy.asarray(want)[0].tolist(), \
+                "request %d diverged under tile+pipeline+cancel" % rid
+
+    def test_quantized_slot_streams_match_generate(self, model):
+        """The int8 serving tiers plumbed into the slot engine: with
+        quantize="int8" (W8A16 weights) and "int8-kv" (plus int8 slot
+        KV cache) a request's stream equals generate() under the SAME
+        quantize mode — asserted exactly on CPU."""
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(13)
+        prompts = [rng.randint(0, vocab, n) for n in (5, 3, 7)]
+        for mode in ("int8", "int8-kv"):
+            dec = ContinuousDecoder(params, table, heads, slots=2,
+                                    max_len=32, n_tokens=6,
+                                    quantize=mode)
+            ids = [dec.submit(p) for p in prompts]
+            results = dec.run_until_drained()
+            for rid, prompt in zip(ids, prompts):
+                want, _ = generate(params, table,
+                                   jnp.asarray(prompt)[None], heads,
+                                   n_tokens=6, max_len=32,
+                                   quantize=mode)
+                assert results[rid] == \
+                    numpy.asarray(want)[0].tolist(), \
+                    "quantize=%s request %d diverged" % (mode, rid)
+
+    def test_live_driver_lag1_pipelining_and_bit_identity(self, model):
+        """The GenerateAPI driver is lag-1 double-buffered: the
+        dispatch log shows chunk N+1 dispatched BEFORE chunk N is
+        collected, streams stay bit-identical to generate(), a request
+        joining mid-flight completes, and the health window records
+        ttft/queue-wait percentiles."""
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import GenerateAPI
+        import jax.numpy as jnp
+
+        params, table, heads, vocab = model
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=6, chunk=2, port=0)
+        api.decoder.dispatch_log = log = []
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            rng = numpy.random.RandomState(14)
+            prompts = [rng.randint(0, vocab, n).tolist()
+                       for n in (4, 6, 5)]
+            results = {}
+
+            def call(i):
+                results[i] = post(url, {"tokens": prompts[i]},
+                                  timeout=60)
+
+            threads = [threading.Thread(target=call, args=(0,)),
+                       threading.Thread(target=call, args=(1,))]
+            for t in threads:
+                t.start()
+            # the third request joins while the first two are decoding
+            t_late = threading.Thread(target=call, args=(2,))
+            t_late.start()
+            for t in threads + [t_late]:
+                t.join(timeout=90)
+            for i, prompt in enumerate(prompts):
+                want, _ = generate(params, table,
+                                   jnp.asarray(prompt)[None], heads,
+                                   n_tokens=6, max_len=32)
+                assert results[i]["tokens"] == \
+                    numpy.asarray(want)[0].tolist()
+            # lag-1: somewhere in the trace two dispatches run
+            # back-to-back with no intervening collect (the second
+            # chunk is enqueued while the first is still uncollected)
+            kinds = [entry[0] for entry in log
+                     if entry[0] in ("dispatch", "collect")]
+            assert any(a == b == "dispatch"
+                       for a, b in zip(kinds, kinds[1:])), kinds
+            # the latency windows saw the requests
+            lat = api.health.snapshot()["latency_ms"]
+            assert lat["ttft"]["count"] >= 3
+            assert lat["queue_wait"]["count"] >= 3
+            assert lat["ttft"]["p95"] is not None
+        finally:
+            api.stop()
+
     def test_generate_api_http_roundtrip(self, model):
         """The LLM serving HTTP surface: concurrent POSTs batch into
         the slot pool, each answer equals single-request generate()."""
@@ -597,7 +753,7 @@ class TestContinuousDecoder:
             def boom(*a, **k):
                 raise RuntimeError("injected device failure")
 
-            api.decoder.step_many = boom
+            api.decoder.dispatch_chunk = boom
             with pytest.raises(urllib.error.HTTPError) as err:
                 post(url, {"tokens": [1, 2, 3]}, timeout=30)
             assert err.value.code == 503  # shed, retryable

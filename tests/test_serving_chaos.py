@@ -256,13 +256,13 @@ class TestAdmissionControl:
         api = make_api(model, slots=1, max_queue=2, deadline=60.0)
         api.start()
         gate = threading.Event()
-        real = api.decoder.step_many
+        real = api.decoder.dispatch_chunk
 
         def gated(n):
             gate.wait(20)
             return real(n)
 
-        api.decoder.step_many = gated
+        api.decoder.dispatch_chunk = gated
         try:
             url = "http://127.0.0.1:%d/generate" % api.port
             results = {}
@@ -304,13 +304,13 @@ class TestDeadlines:
     def test_queued_and_active_expiry_free_slots(self, model):
         api = make_api(model, slots=1, chunk=1, deadline=30.0)
         api.start()
-        real = api.decoder.step_many
+        real = api.decoder.dispatch_chunk
 
         def slow(n):  # ~50 ms per decode step: deadlines can lap it
             time.sleep(0.05)
             return real(n)
 
-        api.decoder.step_many = slow
+        api.decoder.dispatch_chunk = slow
         try:
             url = "http://127.0.0.1:%d/generate" % api.port
             results = {}
@@ -383,8 +383,9 @@ class TestDeadlines:
         api.BACKSTOP_GRACE = 0.2
         api.start()
         gate = threading.Event()
-        real = api.decoder.step_many
-        api.decoder.step_many = lambda n: (gate.wait(30), real(n))[1]
+        real = api.decoder.dispatch_chunk
+        api.decoder.dispatch_chunk = lambda n: (gate.wait(30),
+                                                real(n))[1]
         try:
             url = "http://127.0.0.1:%d/generate" % api.port
             code, body, _ = post(
@@ -495,6 +496,80 @@ class TestBreakerRecovery:
         next_before = api.decoder._next_id
         assert api._rebuild()
         assert api.decoder._next_id >= next_before + 1  # + probe
+
+    def test_rebuild_probe_trips_cleanly_on_hung_probe(self, model):
+        """A probe that makes no progress must exhaust its bounded
+        step budget and fail the rebuild — never loop forever on the
+        driver thread."""
+        api = make_api(model)
+        real_drain = ContinuousDecoder.run_until_drained
+
+        def stuck(self, max_steps=100000, chunk=1, before_step=None):
+            # simulate a decoder that dispatches but never finishes:
+            # burn the budget without retiring the probe
+            for _ in range(max_steps):
+                if before_step is not None:
+                    before_step()
+            raise RuntimeError(
+                "decoder did not drain in %d steps" % max_steps)
+
+        ContinuousDecoder.run_until_drained = stuck
+        try:
+            assert not api._rebuild()
+        finally:
+            ContinuousDecoder.run_until_drained = real_drain
+        # with the real drain the same rebuild succeeds
+        assert api._rebuild()
+
+    def test_trip_discards_chunk_in_flight(self, model):
+        """The lag-1 pipelined driver keeps one chunk in flight; when
+        the breaker trips that chunk must be DISCARDED — its tokens
+        never collected into the shed request's results — and the
+        retried request streams bit-identical tokens."""
+        params, table, heads, vocab = model
+        prompt = [1, 2, 3]
+        clean_api = make_api(model).start()
+        try:
+            code, body, _ = post(
+                "http://127.0.0.1:%d/generate" % clean_api.port,
+                {"tokens": prompt}, timeout=60)
+            assert code == 200
+            want = body["tokens"]
+        finally:
+            clean_api.stop()
+
+        api = make_api(model, chunk=2).start()
+        real = api.decoder.dispatch_chunk
+        calls = {"n": 0}
+
+        def flaky(n):
+            calls["n"] += 1
+            if calls["n"] == 2:  # chunk 1 is pending when this raises
+                raise RuntimeError("injected mid-flight failure")
+            return real(n)
+
+        api.decoder.dispatch_chunk = flaky
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            code, body, _ = post(url, {"tokens": prompt}, timeout=60)
+            assert code == 503  # shed with a retryable error...
+            assert "injected" in body["error"]
+            deadline = time.time() + 30
+            while not api.health.ready and time.time() < deadline:
+                time.sleep(0.02)
+            assert api.health.ready, api.health.snapshot()
+            # ...the in-flight chunk was dropped, not collected: no
+            # orphan token stream survives into the rebuilt decoder
+            assert api._pending is None
+            assert api.decoder.results == {}
+            snap = api.health.snapshot()
+            assert snap["counters"]["trips"] == 1
+            assert snap["counters"]["shed"] == 1
+            # the retry decodes the exact same greedy stream
+            code, body, _ = post(url, {"tokens": prompt}, timeout=60)
+            assert code == 200 and body["tokens"] == want
+        finally:
+            api.stop()
 
 
 class TestHostileClients:

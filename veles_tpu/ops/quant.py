@@ -192,8 +192,14 @@ def _pallas_cache_attend(q, k_q, k_scale, v_q, v_scale, mask_addend,
     batch, _, heads, d = q.shape
     t = k_q.shape[-1]
     # q rides as (B,H,D): the (1,H,D) block's trailing dims fill the
-    # array axes; K/V blocks (1,H,D,T) and scales (1,H,T) likewise
+    # array axes; K/V blocks (1,H,D,T) and scales (1,H,T) likewise.
+    # The mask is (1, T) shared or (B, T) per row (the slot engine's
+    # per-slot lengths) — per-row masks index their own block.
     qh = q[:, 0].astype(jnp.float32)
+    mask2d = (mask_addend.reshape(1, -1) if mask_addend.ndim == 1
+              else mask_addend)
+    mask_index = ((lambda b: (b, 0)) if mask2d.shape[0] == batch
+                  and batch > 1 else (lambda b: (0, 0)))
     out = pl.pallas_call(
         _attend_kernel,
         out_shape=jax.ShapeDtypeStruct((batch, heads, d), jnp.float32),
@@ -204,11 +210,11 @@ def _pallas_cache_attend(q, k_q, k_scale, v_q, v_scale, mask_addend,
             pl.BlockSpec((1, heads, t), lambda b: (b, 0, 0)),
             pl.BlockSpec((1, heads, d, t), lambda b: (b, 0, 0, 0)),
             pl.BlockSpec((1, heads, t), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, t), lambda b: (0, 0)),
+            pl.BlockSpec((1, t), mask_index),
         ],
         out_specs=pl.BlockSpec((1, heads, d), lambda b: (b, 0, 0)),
         interpret=interpret,
-    )(qh, k_q, k_scale, v_q, v_scale, mask_addend.reshape(1, -1))
+    )(qh, k_q, k_scale, v_q, v_scale, mask2d)
     return out[:, None]  # (B,1,H,D)
 
 
@@ -218,8 +224,9 @@ def int8_cache_attend(q, k_q, k_scale, v_q, v_scale, mask_addend,
     the head-major (B, H, D, T) layout, dequantization fused into the
     dots. ``q`` (B, 1, H, D) float (already 1/sqrt(D)-scaled by the
     caller); per-(position, head) ``k_scale``/``v_scale`` (B, H, T)
-    f32; ``mask_addend`` (T,) f32 (0 = visible, -1e30 = masked).
-    Returns (B, 1, H, D) f32.
+    f32; ``mask_addend`` f32 (0 = visible, -1e30 = masked) — shape
+    (T,) for one shared mask, or (B, T) for per-row masks (the slot
+    engine's per-slot lengths). Returns (B, 1, H, D) f32.
 
     Default: the XLA formulation — on THIS head-major layout XLA
     keeps the int8 payloads narrow all the way into the dots (the
@@ -242,7 +249,9 @@ def int8_cache_attend(q, k_q, k_scale, v_q, v_scale, mask_addend,
     qh = q[:, 0].astype(compute)                        # (B,H,D)
     s = jnp.einsum("bhd,bhdt->bht", qh, k_q.astype(compute),
                    preferred_element_type=jnp.float32)
-    s = s * k_scale + mask_addend
+    addend = (mask_addend if mask_addend.ndim == 1
+              else mask_addend[:, None, :])             # (B,1,T)
+    s = s * k_scale + addend
     p = jax.nn.softmax(s, axis=-1)
     pv = (p * v_scale).astype(compute)
     out = jnp.einsum("bhdt,bht->bhd", v_q.astype(compute), pv,

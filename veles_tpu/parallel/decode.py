@@ -70,17 +70,18 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), scale[..., 0]
 
 
-def prefill(params, x, heads, cache, length=None):
-    """Run the prompt (B, T, E) once, filling ``cache`` positions
-    [0, T); returns ``(last_logits, cache)`` with ``last_logits``
-    (B, vocab) for the first generated token.
+def _prefill_forward(params, x, heads, length=None):
+    """The prompt forward pass shared by every prefill surface: run
+    ``x`` (B, T, E) through all blocks once and return
+    ``(last_logits, k_all, v_all, cache_len)`` with ``k_all``/``v_all``
+    stacked (L, B, T, H, D) — the caller decides how to store them
+    (full-cache write for :func:`prefill`, bucket-shaped slot slab for
+    :func:`slot_admit_many`).
 
-    ``length`` (traced scalar, default T) supports right-PADDED
-    prompts: the causal mask means pad positions past ``length`` never
-    influence the real positions' K/V, the logits read from position
-    ``length - 1``, and the cache length is ``length`` — so one
-    compiled program serves a whole bucket of prompt lengths (the
-    continuous-batching admission path)."""
+    ``length`` may be ``None`` (use T), a traced scalar (one shared
+    right-padded length), or a traced (B,) vector (per-row true lengths
+    — the batched same-bucket admission path); the logits always read
+    from each row's position ``length - 1``."""
     batch, t, embed = x.shape
     ks, vs = [], []
     for blk in params["blocks"]:
@@ -100,11 +101,30 @@ def prefill(params, x, heads, cache, length=None):
         last = x[:, -1]
         cache_len = jnp.int32(t)
     else:
-        cache_len = jnp.int32(length)
-        last = lax.dynamic_slice_in_dim(x, cache_len - 1, 1,
-                                        axis=1)[:, 0]
+        cache_len = jnp.asarray(length, jnp.int32)
+        if cache_len.ndim == 0:
+            last = lax.dynamic_slice_in_dim(x, cache_len - 1, 1,
+                                            axis=1)[:, 0]
+        else:
+            last = jnp.take_along_axis(
+                x, (cache_len - 1)[:, None, None], axis=1)[:, 0]
     logits = _head(params, last)
-    k_all, v_all = jnp.stack(ks), jnp.stack(vs)
+    return logits, jnp.stack(ks), jnp.stack(vs), cache_len
+
+
+def prefill(params, x, heads, cache, length=None):
+    """Run the prompt (B, T, E) once, filling ``cache`` positions
+    [0, T); returns ``(last_logits, cache)`` with ``last_logits``
+    (B, vocab) for the first generated token.
+
+    ``length`` (traced scalar, default T) supports right-PADDED
+    prompts: the causal mask means pad positions past ``length`` never
+    influence the real positions' K/V, the logits read from position
+    ``length - 1``, and the cache length is ``length`` — so one
+    compiled program serves a whole bucket of prompt lengths (the
+    continuous-batching admission path)."""
+    logits, k_all, v_all, cache_len = _prefill_forward(params, x, heads,
+                                                       length)
     new = {"length": cache_len}
     if "k_scale" in cache:
         for name, val in (("k", k_all), ("v", v_all)):
@@ -339,16 +359,31 @@ def generate(params, embed_table, prompt_tokens, heads, n_tokens,
 # batching" serving recipe (beyond-reference; VELES's serving analogue
 # batches per tick, ``restful_api.py:78-215``). The math per slot is
 # decode_step's exactly (same _block_qkv/_cache_attend/_head), with the
-# scalar cache length generalized to a per-slot vector and the appends
-# generalized from dynamic_update_slice to per-slot scatters.
+# scalar cache length generalized to a per-slot vector, the appends
+# generalized to per-slot dynamic_update_slice at each slot's own
+# length, and the attended span tiled to the longest live sequence
+# (docs/serving_performance.md).
+
+
+#: default attended-span tile (positions). The slot engine's per-step
+#: attention and append traffic scale with
+#: ``ceil((longest live sequence + chunk) / TILE) * TILE`` instead of
+#: ``max_len`` — one compiled program per tile count, the same
+#: compile-bounding trick as the prompt buckets. 128 = the TPU lane
+#: width, and the granule the int8-KV attend kernel's T gate wants.
+SLOT_SPAN_TILE = 128
 
 
 def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
-                    dtype=jnp.float32):
-    """Cache + control state for ``slots`` concurrent sequences."""
-    shape = (n_blocks, slots, max_len, heads, head_dim)
-    return {
-        "k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                    dtype=jnp.float32, quantized=False):
+    """Cache + control state for ``slots`` concurrent sequences.
+
+    ``quantized=True`` stores the slot K/V as int8 with per-(slot,
+    position, head) f32 scales in the head-major (L, S, H, D, T)
+    layout — ``init_kv_cache``'s int8-KV recipe generalized to the
+    slot pool, so continuous serving gets the same halved cache
+    traffic as raw ``generate(quantize="int8-kv")``."""
+    base = {
         "lengths": jnp.zeros((slots,), jnp.int32),
         "logits": jnp.zeros((slots, vocab), jnp.float32),
         # per-slot sampling stream: the request's key + how many tokens
@@ -357,48 +392,90 @@ def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
         "req_key": jax.random.split(jax.random.key(0), slots),
         "step": jnp.zeros((slots,), jnp.int32),
     }
+    if quantized:
+        qshape = (n_blocks, slots, heads, head_dim, max_len)
+        sshape = (n_blocks, slots, heads, max_len)
+        return dict(base,
+                    k=jnp.zeros(qshape, jnp.int8),
+                    v=jnp.zeros(qshape, jnp.int8),
+                    k_scale=jnp.zeros(sshape, jnp.float32),
+                    v_scale=jnp.zeros(sshape, jnp.float32))
+    shape = (n_blocks, slots, max_len, heads, head_dim)
+    return dict(base, k=jnp.zeros(shape, dtype),
+                v=jnp.zeros(shape, dtype))
 
 
 @functools.partial(jax.jit, static_argnames=("heads",),
                    donate_argnames=("state",))
+def slot_admit_many(params, embed_table, heads, state, slots, prompt_x,
+                    req_keys, lengths):
+    """Admit a whole same-bucket group in ONE dispatch: prefill
+    ``prompt_x`` (B, T, E) — each row right-padded to the bucket T —
+    and scatter the K/V slabs into slots ``slots`` (B,) int32.
+
+    The prefill cost scales with the BUCKET (T), not ``max_len``: only
+    positions [0, T) of each slot lane are written. Stale positions
+    beyond the bucket from a retired occupant are harmless — a lane's
+    position is always (re)written by this sequence's own append
+    before its mask first exposes it. One compiled program per
+    (bucket, group size); the host pads a group to a power-of-two size
+    with DUPLICATE rows (identical slot/prompt/key/length), which is
+    well-defined because duplicate scatter writes carry equal values.
+
+    ``req_keys`` (B,) seeds each slot's sampling stream; ``lengths``
+    (B,) are the true prompt lengths inside the padded rows."""
+    t = prompt_x.shape[1]
+    logits, k_all, v_all, lengths = _prefill_forward(params, prompt_x,
+                                                     heads, lengths)
+    new = dict(
+        state,
+        lengths=state["lengths"].at[slots].set(lengths),
+        logits=state["logits"].at[slots].set(
+            logits.astype(jnp.float32)),
+        req_key=state["req_key"].at[slots].set(req_keys),
+        step=state["step"].at[slots].set(jnp.zeros_like(lengths)),
+    )
+    if "k_scale" in state:
+        for name, val in (("k", k_all), ("v", v_all)):
+            q8, scale = _quantize_kv(val)    # (L,B,T,H,D), (L,B,T,H)
+            # head-major, positions-minor slot layout (init_slot_state)
+            new[name] = state[name].at[:, slots, :, :, :t].set(
+                jnp.transpose(q8, (0, 1, 3, 4, 2)))
+            new[name + "_scale"] = \
+                state[name + "_scale"].at[:, slots, :, :t].set(
+                    jnp.transpose(scale, (0, 1, 3, 2)))
+    else:
+        new["k"] = state["k"].at[:, slots, :t].set(
+            k_all.astype(state["k"].dtype))
+        new["v"] = state["v"].at[:, slots, :t].set(
+            v_all.astype(state["v"].dtype))
+    return new
+
+
 def slot_admit(params, embed_table, heads, state, slot, prompt_x,
                req_key=None, length=None):
-    """Prefill ``prompt_x`` (1, T, E) into slot ``slot`` (traced scalar
-    — one compiled program serves every slot). Overwrites the slot's
-    whole cache lane, so stale state from a retired sequence never
-    leaks into the new one. ``req_key`` seeds the slot's sampling
-    stream (ignored by greedy serving); ``length`` (traced) marks the
-    true prompt length of a right-padded ``prompt_x`` — the admission
-    path pads to buckets so a new prompt LENGTH doesn't mean a new XLA
-    compile stalling every in-flight slot."""
-    max_len = state["k"].shape[2]
-    n_blocks = state["k"].shape[0]
-    heads_n, head_dim = state["k"].shape[3], state["k"].shape[4]
-    tmp = init_kv_cache(n_blocks, 1, max_len, heads_n, head_dim,
-                        dtype=state["k"].dtype)
-    logits, tmp = prefill(params, prompt_x, heads, tmp, length=length)
+    """Prefill ``prompt_x`` (1, T, E) into slot ``slot`` — the B=1
+    case of :func:`slot_admit_many` (one compiled program per prompt
+    bucket T; the prefill cost scales with the bucket, not
+    ``max_len``). ``req_key`` seeds the slot's sampling stream
+    (ignored by greedy serving); ``length`` marks the true prompt
+    length of a right-padded ``prompt_x``."""
     if req_key is None:
         req_key = jax.random.key(0)
-    return dict(
-        state,
-        k=lax.dynamic_update_slice(state["k"], tmp["k"],
-                                   (0, slot, 0, 0, 0)),
-        v=lax.dynamic_update_slice(state["v"], tmp["v"],
-                                   (0, slot, 0, 0, 0)),
-        lengths=lax.dynamic_update_slice(
-            state["lengths"], tmp["length"][None], (slot,)),
-        logits=lax.dynamic_update_slice(
-            state["logits"], logits.astype(jnp.float32), (slot, 0)),
-        req_key=state["req_key"].at[slot].set(req_key),
-        step=state["step"].at[slot].set(0),
-    )
+    if length is None:
+        length = prompt_x.shape[1]
+    return slot_admit_many(
+        params, embed_table, heads, state,
+        jnp.reshape(jnp.asarray(slot, jnp.int32), (1,)), prompt_x,
+        jnp.stack([req_key]),
+        jnp.reshape(jnp.asarray(length, jnp.int32), (1,)))
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("heads", "sample", "top_k"),
+                   static_argnames=("heads", "sample", "top_k", "span"),
                    donate_argnames=("state",))
 def slot_step(params, embed_table, heads, state, active,
-              temperature=1.0, sample=False, top_k=0):
+              temperature=1.0, sample=False, top_k=0, span=None):
     """One decode step across ALL slots; ``active`` (S,) bool gates
     which slots advance (inactive slots' lanes are computed but their
     lengths/logits stay frozen and their emitted token is meaningless —
@@ -409,9 +486,26 @@ def slot_step(params, embed_table, heads, state, active,
     (S,))`` where ``emitted[s]`` is the token slot ``s`` generates THIS
     step — picked from the pre-step logits, matching ``generate``'s
     emission order (its first emitted token comes from the prefill
-    logits)."""
+    logits).
+
+    ``span`` (static, default ``max_len``) tiles the attended cache
+    prefix: attention reads positions [0, span) only, so the per-step
+    cost scales with the longest LIVE sequence (rounded up to
+    ``SLOT_SPAN_TILE`` by the host) instead of ``max_len``. The host
+    must pass ``span > max(lengths[active])`` — masked positions
+    beyond a sequence's length contribute exact zeros, so any
+    sufficient span produces identical tokens. Appends still write
+    into the full-length cache. An inactive lane whose length reaches
+    ``max_len`` keeps (harmlessly) rewriting the last position — its
+    output is discarded and a re-admitted slot rewrites every position
+    before attending to it."""
     slots = state["lengths"].shape[0]
-    max_len = state["k"].shape[2]
+    quantized = "k_scale" in state
+    # head-major int8 layout keeps T minor; float layout keeps it at
+    # axis 2 (see init_slot_state)
+    max_len = state["k"].shape[-1] if quantized else state["k"].shape[2]
+    if span is None or span > max_len:
+        span = max_len
     lengths = state["lengths"]
     if sample:
         step_keys = jax.vmap(jax.random.fold_in)(state["req_key"],
@@ -425,23 +519,62 @@ def slot_step(params, embed_table, heads, state, active,
     else:
         tok_in = jnp.argmax(state["logits"], axis=-1)
     x = embed_table[tok_in][:, None, :]
-    # per-slot mask: position p of slot s is visible iff p <= length[s]
-    # (the new token attends to itself at index length[s])
-    mask = (jnp.arange(max_len)[None, :]
-            <= lengths[:, None])[:, None, None, :]
-    rows = jnp.arange(slots)
+    embed = x.shape[-1]
+    # per-slot mask over the span: position p of slot s is visible iff
+    # p <= length[s] (the new token attends to itself at index
+    # length[s])
+    visible = jnp.arange(span)[None, :] <= lengths[:, None]
+    if quantized:
+        mask_addend = jnp.where(visible, 0.0, -1e30).astype(jnp.float32)
+        # python float (weak type): `q * inv_sqrt` must NOT promote a
+        # bf16 q to f32 (see decode_step)
+        inv_sqrt = (embed // heads) ** -0.5
+    else:
+        mask = visible[:, None, None, :]
     new_k, new_v = state["k"], state["v"]
+    new_ks = state.get("k_scale")
+    new_vs = state.get("v_scale")
     for i, blk in enumerate(params["blocks"]):
         q, k, v = _block_qkv(blk, x, heads)
-        # per-slot append at each slot's own length (scatter — the
-        # slots sit at different positions, unlike decode_step's
-        # uniform dynamic_update_slice)
-        new_k = new_k.at[i, rows, lengths].set(
-            k[:, 0].astype(new_k.dtype))
-        new_v = new_v.at[i, rows, lengths].set(
-            v[:, 0].astype(new_v.dtype))
-        att = _cache_attend(q, new_k[i], new_v[i], mask).astype(x.dtype)
-        x = x + matmul_any(att.reshape(slots, 1, -1),
+        # per-slot append at each slot's own length. Unrolled
+        # dynamic_update_slice per slot, NOT one scatter: XLA lowers a
+        # multi-row scatter on TPU far worse than S in-place dus ops
+        # (the single biggest cost of the pre-tiled slot step).
+        if quantized:
+            kq, ks = _quantize_kv(k)         # (S,1,H,D), (S,1,H)
+            vq, vs = _quantize_kv(v)
+            for s in range(slots):
+                pos = lengths[s]
+                new_k = lax.dynamic_update_slice(
+                    new_k, jnp.transpose(kq[s:s + 1], (0, 2, 3, 1))[None],
+                    (i, s, 0, 0, pos))
+                new_v = lax.dynamic_update_slice(
+                    new_v, jnp.transpose(vq[s:s + 1], (0, 2, 3, 1))[None],
+                    (i, s, 0, 0, pos))
+                new_ks = lax.dynamic_update_slice(
+                    new_ks, jnp.transpose(ks[s:s + 1], (0, 2, 1))[None],
+                    (i, s, 0, pos))
+                new_vs = lax.dynamic_update_slice(
+                    new_vs, jnp.transpose(vs[s:s + 1], (0, 2, 1))[None],
+                    (i, s, 0, pos))
+            att = int8_cache_attend(
+                q * inv_sqrt,
+                new_k[i, :, :, :, :span], new_ks[i, :, :, :span],
+                new_v[i, :, :, :, :span], new_vs[i, :, :, :span],
+                mask_addend)
+        else:
+            for s in range(slots):
+                pos = lengths[s]
+                new_k = lax.dynamic_update_slice(
+                    new_k, k[s:s + 1][None].astype(new_k.dtype),
+                    (i, s, pos, 0, 0))
+                new_v = lax.dynamic_update_slice(
+                    new_v, v[s:s + 1][None].astype(new_v.dtype),
+                    (i, s, pos, 0, 0))
+            att = _cache_attend(q, new_k[i][:, :span],
+                                new_v[i][:, :span], mask)
+        att = att.astype(x.dtype)
+        x = x + matmul_any(att.reshape(slots, 1, embed),
                            blk["wout"]) + blk["bout"]
         x = _mlp(blk, x)
     logits = _head(params, x[:, 0]).astype(jnp.float32)
@@ -451,22 +584,29 @@ def slot_step(params, embed_table, heads, state, active,
         logits=jnp.where(active[:, None], logits, state["logits"]),
         step=jnp.where(active, state["step"] + 1, state["step"]),
     )
+    if quantized:
+        new_state["k_scale"] = new_ks
+        new_state["v_scale"] = new_vs
     return new_state, tok_in
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("heads", "n", "sample", "top_k"),
+                   static_argnames=("heads", "n", "sample", "top_k",
+                                    "span"),
                    donate_argnames=("state",))
 def slot_step_many(params, embed_table, heads, state, active, n,
-                   temperature=1.0, sample=False, top_k=0):
+                   temperature=1.0, sample=False, top_k=0, span=None):
     """``n`` lockstep ``slot_step``s as ONE ``lax.scan`` dispatch —
     the throughput mode: admission happens between chunks, so a
     high-RTT host pays one round trip per ``n`` tokens instead of per
-    token. Returns ``(state, emitted (n, S))``; the host discards a
-    slot's tail tokens past its budget/eos."""
+    token. ``span`` (static) must cover the longest live sequence plus
+    the whole chunk (each step appends one position). Returns
+    ``(state, emitted (n, S))``; the host discards a slot's tail
+    tokens past its budget/eos."""
     def body(state, _):
         state, emitted = slot_step(params, embed_table, heads, state,
-                                   active, temperature, sample, top_k)
+                                   active, temperature, sample, top_k,
+                                   span=span)
         return state, emitted
 
     return lax.scan(body, state, None, length=n)

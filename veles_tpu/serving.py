@@ -225,18 +225,34 @@ class ServingHealth:
       rebuilt and probed;
     - ``shed`` — in-flight requests resolved with an error on a trip
       (they never burn out their full timeout);
-    - ``errors`` — requests resolved with any other error."""
+    - ``errors`` — requests resolved with any other error.
+
+    Latency accounting: :meth:`record_latency` feeds per-kind rolling
+    windows (``ttft`` — staged to first generated token on the host;
+    ``queue_wait`` — staged to admitted into a decoder slot), and the
+    snapshot exposes their p50/p95 in milliseconds, so the
+    prefill/admission path's cost is observable on ``/healthz`` and
+    the web-status serving column, not just in bench runs."""
 
     COUNTERS = ("admitted", "completed", "rejected", "expired", "shed",
                 "trips", "rebuilds", "errors")
+    #: rolling-window latency kinds exposed as p50/p95 on /healthz
+    LATENCY_KINDS = ("ttft", "queue_wait")
+    #: rolling-window size per latency kind
+    LATENCY_WINDOW = 512
 
     def __init__(self, name="serving"):
+        import collections
+
         self.name = name
         self._lock = threading.Lock()
         self._ready = False
         self._breaker = "closed"
         self._inflight = 0
         self._counters = {key: 0 for key in self.COUNTERS}
+        self._latencies = {
+            kind: collections.deque(maxlen=self.LATENCY_WINDOW)
+            for kind in self.LATENCY_KINDS}
 
     @property
     def ready(self):
@@ -296,12 +312,37 @@ class ServingHealth:
         with self._lock:
             return self._inflight
 
+    def record_latency(self, kind, seconds):
+        """Feed one sample into the ``kind`` rolling window (seconds;
+        unknown kinds get a window on first use)."""
+        import collections
+
+        with self._lock:
+            if kind not in self._latencies:
+                self._latencies[kind] = collections.deque(
+                    maxlen=self.LATENCY_WINDOW)
+            self._latencies[kind].append(float(seconds))
+
+    @staticmethod
+    def _percentiles_ms(values):
+        if not values:
+            return {"p50": None, "p95": None, "count": 0}
+        ordered = sorted(values)
+        n = len(ordered)
+        p50 = ordered[(n - 1) // 2]
+        p95 = ordered[min(n - 1, int(math.ceil(0.95 * (n - 1))))]
+        return {"p50": round(p50 * 1000.0, 3),
+                "p95": round(p95 * 1000.0, 3), "count": n}
+
     def snapshot(self):
         with self._lock:
             return {"name": self.name, "ready": self._ready,
                     "breaker": self._breaker,
                     "inflight": self._inflight,
-                    "counters": dict(self._counters)}
+                    "counters": dict(self._counters),
+                    "latency_ms": {
+                        kind: self._percentiles_ms(window)
+                        for kind, window in self._latencies.items()}}
 
 
 class RESTfulAPI(Unit):
@@ -473,7 +514,7 @@ class RESTfulAPI(Unit):
 
 class ContinuousDecoder:
     """Continuous-batching LLM serving on the slot engine
-    (``parallel/decode.py`` ``init_slot_state``/``slot_admit``/
+    (``parallel/decode.py`` ``init_slot_state``/``slot_admit_many``/
     ``slot_step``): a fixed pool of KV-cache slots decodes in lockstep
     while new requests prefill into free slots MID-FLIGHT — no
     generation restarts, no waiting for the batch to drain (the
@@ -489,6 +530,17 @@ class ContinuousDecoder:
     optional ``eos`` token that retires a sequence early. Tokens stream
     into ``results[request_id]`` as they are generated.
 
+    The hot path keeps per-step cost proportional to ACTUAL sequence
+    state (docs/serving_performance.md): admission prefills are
+    bucket-shaped and every queued same-bucket prompt admits in one
+    ``slot_admit_many`` dispatch; attention is tiled to the longest
+    live sequence (``tile``, default 128); ``quantize=`` plumbs the
+    int8 weight / int8-KV serving tiers into the slot pool; and
+    :meth:`dispatch_chunk` / :meth:`collect_chunk` split a chunk's
+    enqueue from its readback so callers (:meth:`drain_pipelined`, the
+    :class:`GenerateAPI` driver) overlap the host round trip with
+    device compute.
+
     Numerical contract: a request's stream equals single-request
     ``generate()``'s math-for-math (same sublayer fns, same per-step
     sampling keys) — asserted exactly on CPU. On TPU, batching S slots
@@ -499,18 +551,43 @@ class ContinuousDecoder:
 
     def __init__(self, params, embed_table, heads, slots=4,
                  max_len=512, n_tokens=32, eos=None,
-                 temperature=0.0, top_k=0, key=None):
+                 temperature=0.0, top_k=0, key=None, quantize=None,
+                 tile=None):
         import collections
 
         import jax
 
-        from veles_tpu.parallel.decode import init_slot_state
+        from veles_tpu.parallel.decode import (SLOT_SPAN_TILE,
+                                               init_slot_state,
+                                               quantize_params)
 
+        if quantize not in (None, "none", "int8", "int8-kv"):
+            raise ValueError("quantize must be None, 'int8' or "
+                             "'int8-kv', got %r" % (quantize,))
+        #: quantize="int8" serves the W8A16 tier (weight matrices int8,
+        #: dequant fused into the products via matmul_any);
+        #: "int8-kv" additionally stores the SLOT KV cache as int8 with
+        #: per-(position, head) scales — the same machinery as
+        #: generate(quantize=...), plumbed into continuous batching
+        self.quantize = quantize if quantize != "none" else None
+        if self.quantize and not isinstance(params["head"], dict):
+            params = quantize_params(params)
         self.params = params
         self.embed_table = embed_table
         self.heads = heads
         self.slots = slots
+        if self.quantize == "int8-kv":
+            # whole lane tiles (SLOT_SPAN_TILE == the attend kernel's T
+            # gate granule) so the dequant-fused kernel can engage
+            # (masking keeps the extra positions inert)
+            max_len = -(-max_len // SLOT_SPAN_TILE) * SLOT_SPAN_TILE
         self.max_len = max_len
+        #: attended-span tile: each dispatch attends over
+        #: ceil((longest live sequence + chunk)/tile)*tile positions
+        #: instead of max_len — one compiled program per tile count
+        self.tile = int(tile if tile is not None else SLOT_SPAN_TILE)
+        if self.tile < 1:
+            raise ValueError("tile must be >= 1, got %d" % self.tile)
         self.n_tokens = n_tokens
         self.eos = eos
         #: temperature > 0 samples; each request draws from its OWN
@@ -524,17 +601,34 @@ class ContinuousDecoder:
         embed = embed_table.shape[1]
         vocab = embed_table.shape[0]
         self.state = init_slot_state(
-            n_blocks, slots, max_len, heads, embed // heads, vocab,
-            dtype=embed_table.dtype)
+            n_blocks, slots, self.max_len, heads, embed // heads, vocab,
+            dtype=embed_table.dtype,
+            quantized=self.quantize == "int8-kv")
         self._queue = collections.deque()
         self._free = list(range(slots))
         self._slot_req = {}      # slot -> request id
+        self._slot_len = {}      # slot -> device-side sequence length
         self._budget = {}        # request id -> tokens still wanted
         self.results = {}        # request id -> [token, ...]
+        self.admitted_at = {}    # request id -> monotonic admit stamp
         self._next_id = 0
         self.steps = 0
         self.tokens_out = 0
         self.cancelled = 0
+        #: jitted-dispatch tally on the slot path — the CI hook the
+        #: regression tests assert on (one "admit" per bucket group,
+        #: one "chunk" per slot_step_many)
+        self.dispatch_counts = {"admit": 0, "admit_requests": 0,
+                                "chunk": 0, "step": 0}
+        #: host-blocking wall seconds per call family (admit dispatches,
+        #: chunk dispatches, chunk readbacks) — feeds the bench's
+        #: prefill-ms and host-overhead keys
+        self.timings = {"admit_s": 0.0, "dispatch_s": 0.0,
+                        "collect_s": 0.0}
+        #: set to a list to trace the dispatch/collect interleaving:
+        #: entries ("admit", bucket, group), ("dispatch", chunk),
+        #: ("collect", chunk) — the lag-1 pipelining assert hook
+        self.dispatch_log = None
 
     def submit(self, prompt_tokens, n_tokens=None):
         """Queue one prompt (1-D int sequence); returns the request id.
@@ -584,6 +678,7 @@ class ContinuousDecoder:
                     break
         del self._budget[rid]
         self.results.pop(rid, None)
+        self.admitted_at.pop(rid, None)
         self.cancelled += 1
         return True
 
@@ -600,25 +695,72 @@ class ContinuousDecoder:
         return bucket
 
     def _admit_pending(self):
+        """Admit every queued request that fits a free slot — grouped
+        by prompt bucket, ONE ``slot_admit_many`` dispatch per bucket
+        group (the pre-batched path issued one blocking dispatch per
+        request on the driver thread). Groups are padded to a
+        power-of-two size with duplicate rows so the compile count
+        stays O(buckets x log2(slots))."""
         import jax
 
-        from veles_tpu.parallel.decode import slot_admit
+        from veles_tpu.parallel.decode import slot_admit_many
 
+        if not (self._queue and self._free):
+            return
+        groups = {}
+        order = []
         while self._queue and self._free:
             rid, prompt, _ = self._queue.popleft()
             slot = self._free.pop()
-            true_len = len(prompt)
-            bucket = min(self._bucket(true_len), self.max_len)
-            padded = numpy.zeros(bucket, numpy.int32)
-            padded[:true_len] = prompt
-            x = self.embed_table[jnp.asarray(padded)][None]
-            req_key = jax.random.fold_in(self.base_key, rid)
-            self.state = slot_admit(self.params, self.embed_table,
-                                    self.heads, self.state,
-                                    jnp.int32(slot), x,
-                                    req_key=req_key,
-                                    length=jnp.int32(true_len))
-            self._slot_req[slot] = rid
+            bucket = min(self._bucket(len(prompt)), self.max_len)
+            if bucket not in groups:
+                groups[bucket] = []
+                order.append(bucket)
+            groups[bucket].append((rid, prompt, slot))
+        now = time.monotonic()
+        for bucket in order:
+            group = groups[bucket]
+            padded_n = 1
+            while padded_n < len(group):
+                padded_n *= 2
+            rows = group + [group[-1]] * (padded_n - len(group))
+            prompts = numpy.zeros((padded_n, bucket), numpy.int32)
+            for j, (_, prompt, _) in enumerate(rows):
+                prompts[j, :len(prompt)] = prompt
+            rids = jnp.asarray([r[0] for r in rows], jnp.int32)
+            req_keys = jax.vmap(jax.random.fold_in,
+                                in_axes=(None, 0))(self.base_key, rids)
+            x = self.embed_table[jnp.asarray(prompts)]
+            t0 = time.perf_counter()
+            self.state = slot_admit_many(
+                self.params, self.embed_table, self.heads, self.state,
+                jnp.asarray([r[2] for r in rows], jnp.int32), x,
+                req_keys,
+                jnp.asarray([len(r[1]) for r in rows], jnp.int32))
+            self.timings["admit_s"] += time.perf_counter() - t0
+            self.dispatch_counts["admit"] += 1
+            self.dispatch_counts["admit_requests"] += len(group)
+            if self.dispatch_log is not None:
+                self.dispatch_log.append(("admit", bucket, len(group)))
+            for rid, prompt, slot in group:
+                self._slot_req[slot] = rid
+                self._slot_len[slot] = len(prompt)
+                self.admitted_at[rid] = now
+
+    def _span(self, extra):
+        """Static attended span for the next dispatch: the longest
+        LIVE sequence plus the ``extra`` positions the dispatch will
+        append, rounded up to the tile (one compiled program per tile
+        count) and clamped to ``max_len``."""
+        longest = max(self._slot_len[s] for s in self._slot_req)
+        span = -(-(longest + extra) // self.tile) * self.tile
+        return int(min(span, self.max_len))
+
+    def _active(self):
+        active = numpy.zeros(self.slots, bool)
+        for slot in self._slot_req:
+            active[slot] = True
+        return active
 
     def step(self):
         """Admit what fits, advance every active slot one token; returns
@@ -628,16 +770,19 @@ class ContinuousDecoder:
         self._admit_pending()
         if not self._slot_req:
             return {}
-        active = numpy.zeros(self.slots, bool)
-        for slot in self._slot_req:
-            active[slot] = True
+        snapshot = dict(self._slot_req)
         self.state, emitted = slot_step(
             self.params, self.embed_table, self.heads, self.state,
-            jnp.asarray(active), jnp.float32(self.temperature or 1.0),
-            sample=bool(self.temperature), top_k=self.top_k)
+            jnp.asarray(self._active()),
+            jnp.float32(self.temperature or 1.0),
+            sample=bool(self.temperature), top_k=self.top_k,
+            span=self._span(1))
+        for slot in snapshot:
+            self._slot_len[slot] += 1
+        self.dispatch_counts["step"] += 1
         emitted = numpy.asarray(emitted)
         out = {}
-        for slot, rid in list(self._slot_req.items()):
+        for slot, rid in snapshot.items():
             token = int(emitted[slot])
             self.results[rid].append(token)
             out[rid] = token
@@ -648,6 +793,7 @@ class ContinuousDecoder:
             if done:
                 del self._slot_req[slot]
                 del self._budget[rid]
+                self.admitted_at.pop(rid, None)
                 self._free.append(slot)
         self.steps += 1
         return out
@@ -658,18 +804,24 @@ class ContinuousDecoder:
         Admission happens before the chunk; a request finishing
         mid-chunk has its tail tokens discarded and its slot recycles
         at the chunk boundary. Returns {request_id: [tokens...]}."""
-        dispatched = self._dispatch_chunk(n)
+        dispatched = self.dispatch_chunk(n)
         if dispatched is None:
             return {}
-        return self._collect(*dispatched)
+        return self.collect_chunk(dispatched)
 
-    def _collect(self, emitted, snapshot):
-        """Account one chunk's tokens against the requests that were
-        assigned when it was DISPATCHED (``snapshot``). Requests that
-        finished in a previous chunk (pipelined mode keeps their slot
-        active one extra chunk) are skipped; tail tokens past a budget
-        or eos are discarded."""
+    def collect_chunk(self, dispatched):
+        """Materialize one dispatched chunk (this is the device sync)
+        and account its tokens against the requests that were assigned
+        when it was DISPATCHED. Requests that finished or were
+        cancelled while the chunk was in flight (pipelined mode keeps
+        their slot active one extra chunk) are skipped; tail tokens
+        past a budget or eos are discarded."""
+        emitted, snapshot = dispatched
+        t0 = time.perf_counter()
         emitted = numpy.asarray(emitted)  # (chunk, slots) — syncs
+        self.timings["collect_s"] += time.perf_counter() - t0
+        if self.dispatch_log is not None:
+            self.dispatch_log.append(("collect", emitted.shape[0]))
         out = {}
         for slot, rid in snapshot.items():
             if rid not in self._budget:
@@ -688,30 +840,44 @@ class ContinuousDecoder:
                 and tokens[-1] == self.eos)
             if done:
                 del self._budget[rid]
+                self.admitted_at.pop(rid, None)
                 if self._slot_req.get(slot) == rid:
                     del self._slot_req[slot]
                     self._free.append(slot)
         return out
 
-    def _dispatch_chunk(self, chunk):
-        """Admit what fits and enqueue one chunk; returns the
+    def dispatch_chunk(self, chunk):
+        """Admit what fits and enqueue one chunk WITHOUT waiting for
+        it; returns an opaque handle for :meth:`collect_chunk` (or
+        None when nothing is active). The handle holds the
         un-materialized emitted tokens + the slot assignment at
-        dispatch time (or None when nothing is active)."""
+        dispatch time; the pipelined driver dispatches chunk N+1
+        before collecting chunk N so the readback hides behind device
+        compute."""
         from veles_tpu.parallel.decode import slot_step_many
 
         self._admit_pending()
         if not self._slot_req:
             return None
-        active = numpy.zeros(self.slots, bool)
-        for slot in self._slot_req:
-            active[slot] = True
+        snapshot = dict(self._slot_req)
+        t0 = time.perf_counter()
         self.state, emitted = slot_step_many(
             self.params, self.embed_table, self.heads, self.state,
-            jnp.asarray(active), chunk,
+            jnp.asarray(self._active()), chunk,
             jnp.float32(self.temperature or 1.0),
-            sample=bool(self.temperature), top_k=self.top_k)
+            sample=bool(self.temperature), top_k=self.top_k,
+            span=self._span(chunk))
+        self.timings["dispatch_s"] += time.perf_counter() - t0
+        # mirror the device-side length advance (active lanes advance
+        # every step of the chunk, even past retirement — the span for
+        # the NEXT dispatch only consults live slots)
+        for slot in snapshot:
+            self._slot_len[slot] += chunk
+        self.dispatch_counts["chunk"] += 1
+        if self.dispatch_log is not None:
+            self.dispatch_log.append(("dispatch", chunk))
         self.steps += chunk
-        return emitted, dict(self._slot_req)
+        return emitted, snapshot
 
     def drain_pipelined(self, chunk, max_steps=100000, admit=None):
         """Throughput drain: chunk N's tokens are read back while chunk
@@ -728,9 +894,9 @@ class ContinuousDecoder:
         for _ in range(max_steps):
             if admit is not None:
                 admit()
-            current = self._dispatch_chunk(chunk)
+            current = self.dispatch_chunk(chunk)
             if pending is not None:
-                self._collect(*pending)
+                self.collect_chunk(pending)
             pending = current
             if pending is None:
                 if not self.busy:
@@ -740,12 +906,19 @@ class ContinuousDecoder:
         raise RuntimeError("decoder did not drain in %d steps"
                            % max_steps)
 
-    def run_until_drained(self, max_steps=100000, chunk=1):
+    def run_until_drained(self, max_steps=100000, chunk=1,
+                          before_step=None):
         """Drive the decoder until every submitted request finished
-        (``chunk`` > 1 uses :meth:`step_many` between admissions)."""
+        (``chunk`` > 1 uses :meth:`step_many` between admissions).
+        ``before_step`` is called once per device dispatch (the chaos
+        hook's seat); the ``max_steps`` budget bounds the loop, so a
+        decoder that stops producing progress raises instead of
+        spinning forever."""
         for _ in range(max_steps):
             if not self.busy:
                 return self.results
+            if before_step is not None:
+                before_step()
             if chunk > 1:
                 self.step_many(chunk)
             else:
@@ -763,9 +936,13 @@ class GenerateAPI:
 
     Handler threads only stage requests and block on a per-request
     event; ONE driver thread owns the decoder (it is not thread-safe)
-    — admitting staged prompts and running chunked decode steps while
-    anything is in flight, so concurrent requests batch into the slot
-    pool automatically and new ones join mid-flight.
+    — admitting staged prompts and running lag-1 double-buffered chunk
+    dispatches (chunk N+1 enqueues before chunk N's readback — see
+    :meth:`_drive` and docs/serving_performance.md) while anything is
+    in flight, so concurrent requests batch into the slot pool
+    automatically, new ones join mid-flight, and the device queue
+    stays fed through the host round trip. ``/healthz`` reports
+    rolling p50/p95 time-to-first-token and queue-wait.
 
     Survival layer (docs/serving_robustness.md): admission is bounded
     by ``max_queue`` (429 + ``Retry-After`` beyond it, 503 while not
@@ -790,7 +967,8 @@ class GenerateAPI:
                  eos=None, key=None, port=0, host="127.0.0.1",
                  path="/generate", chunk=8, request_timeout=None,
                  max_queue=None, deadline=None, rebuild_backoff=None,
-                 rebuild_backoff_max=None, chaos=None):
+                 rebuild_backoff_max=None, chaos=None, quantize=None,
+                 tile=None):
         import queue
 
         from veles_tpu.core.config import root
@@ -814,7 +992,8 @@ class GenerateAPI:
         self._decoder_kwargs = dict(
             params=params, embed_table=embed_table, heads=heads,
             slots=slots, max_len=max_len, n_tokens=n_tokens,
-            temperature=temperature, top_k=top_k, eos=eos, key=key)
+            temperature=temperature, top_k=top_k, eos=eos, key=key,
+            quantize=quantize, tile=tile)
         self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
         self.port = port
@@ -843,6 +1022,10 @@ class GenerateAPI:
         self._httpd = None
         self._driver = None
         self._tripped = None  # breaker-open reason (None = closed)
+        #: the lag-1 pipeline's chunk in flight (dispatched, not yet
+        #: collected); discarded — never collected — when the breaker
+        #: trips or the server stops
+        self._pending = None
 
     # -- driver thread (sole owner of the decoder) ------------------------
     def _resolve(self, holder, outcome, **fields):
@@ -920,20 +1103,25 @@ class GenerateAPI:
     def _rebuild(self):
         """Build a fresh decoder from the held params/embed_table and
         prove the device path end to end with a probe decode; only a
-        probed decoder takes traffic again. Returns True on success."""
+        probed decoder takes traffic again. The probe runs through the
+        decoder's own :meth:`ContinuousDecoder.run_until_drained` with
+        a bounded step budget — it exercises whatever step semantics
+        the driver will actually use and RAISES on a hung probe instead
+        of looping silently. Returns True on success."""
         try:
             decoder = ContinuousDecoder(**self._decoder_kwargs)
             # request ids stay monotonic across rebuilds so per-request
             # sampling keys (fold_in(base, rid)) never repeat
             decoder._next_id = self.decoder._next_id
             probe = decoder.submit([0], 1)
-            for _ in range(8):
-                if self.chaos is not None:
-                    self.chaos.before_step()
-                decoder.step()
-                if decoder.done(probe):
-                    break
-            else:
+            before = (self.chaos.before_step if self.chaos is not None
+                      else None)
+            # probe with the DRIVER's chunk size so the chunked
+            # slot_step_many program — what live traffic runs — is
+            # what closes the breaker
+            decoder.run_until_drained(max_steps=8, chunk=self.chunk,
+                                      before_step=before)
+            if not decoder.done(probe):
                 raise RuntimeError("probe decode did not finish")
             decoder.results.pop(probe, None)
         except Exception:
@@ -943,14 +1131,52 @@ class GenerateAPI:
         self.decoder = decoder
         return True
 
+    def _note_progress(self, waiting):
+        """Post-collect bookkeeping: record queue-wait (staged ->
+        admitted into a slot) and time-to-first-token for the health
+        window, and resolve every request whose stream completed."""
+        now = time.monotonic()
+        for rid in list(waiting):
+            holder = waiting[rid]
+            staged_at = holder.get("staged_at")
+            if "queue_waited" not in holder:
+                admitted = self.decoder.admitted_at.get(rid)
+                if admitted is not None:
+                    holder["queue_waited"] = True
+                    if staged_at is not None:
+                        self.health.record_latency(
+                            "queue_wait", max(0.0, admitted - staged_at))
+            if "first_token" not in holder \
+                    and self.decoder.results.get(rid):
+                holder["first_token"] = True
+                if staged_at is not None:
+                    self.health.record_latency(
+                        "ttft", max(0.0, now - staged_at))
+            if self.decoder.done(rid):
+                self._resolve(waiting.pop(rid), "completed",
+                              tokens=self.decoder.results.pop(rid))
+
     def _drive(self):
+        """The lag-1 double-buffered live loop: each pass drains the
+        staged queue, expires deadlines, DISPATCHES chunk N+1, and only
+        then collects chunk N — the device computes the next chunk
+        while the host reads the previous one back, admits, and
+        resolves finished requests (the ``drain_pipelined`` recipe
+        composed with deadlines, cancel, the breaker and the chaos
+        hook). A chunk in flight when the breaker trips or the server
+        stops is DISCARDED, never collected into shed requests'
+        results; a request cancelled mid-chunk is skipped at collect
+        (``collect_chunk`` consults the live budget map)."""
         waiting = {}
         backoff = self.rebuild_backoff
         try:
             while not self._stop.is_set():
                 if self._tripped is not None:
-                    # breaker open: shed stragglers fast, rebuild with
-                    # exponential backoff, close only after the probe
+                    # breaker open: drop the chunk in flight (its
+                    # decoder state is unusable), shed stragglers fast,
+                    # rebuild with exponential backoff, close only
+                    # after the probe
+                    self._pending = None
                     self._fail_all(waiting, self._tripped,
                                    outcome="shed", code=503)
                     if self._stop.wait(backoff):
@@ -967,7 +1193,7 @@ class GenerateAPI:
                     continue
                 waiting.update(self._drain_staged())
                 self._expire_deadlines(waiting)
-                if not self.decoder.busy:
+                if not self.decoder.busy and self._pending is None:
                     if not self._wake.wait(timeout=0.05):
                         continue
                     self._wake.clear()
@@ -975,18 +1201,18 @@ class GenerateAPI:
                 try:
                     if self.chaos is not None:
                         self.chaos.before_step()
-                    self.decoder.step_many(self.chunk)
-                    for rid in [r for r in waiting
-                                if self.decoder.done(r)]:
-                        holder = waiting.pop(rid)
-                        self._resolve(
-                            holder, "completed",
-                            tokens=self.decoder.results.pop(rid))
+                    current = self.decoder.dispatch_chunk(self.chunk)
+                    if self._pending is not None:
+                        self.decoder.collect_chunk(self._pending)
+                    self._pending = current
+                    self._note_progress(waiting)
                 except Exception as exc:  # device/runtime failure
                     import traceback
                     traceback.print_exc()
+                    self._pending = None
                     self._trip(exc, waiting)
         finally:
+            self._pending = None
             self._fail_all(waiting, "server stopped")
 
     # -- HTTP -------------------------------------------------------------
@@ -1066,8 +1292,10 @@ class GenerateAPI:
                            % api.max_queue},
                           code=429, headers={"Retry-After": "1"})
                     return
+                staged_at = time.monotonic()
                 holder = {"event": threading.Event(),
-                          "deadline": time.monotonic() + deadline_s}
+                          "staged_at": staged_at,
+                          "deadline": staged_at + deadline_s}
                 api._staged.put((prompt, budget, holder))
                 api._wake.set()
                 # the DRIVER owns deadline expiry (it frees the slot);

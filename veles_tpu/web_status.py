@@ -140,6 +140,15 @@ def format_serving_health(serving):
                           if counters.get(key))
         if fired:
             parts.append(fired)
+    latency = serving.get("latency_ms")
+    if isinstance(latency, dict):
+        # the serving-performance observability pair (docs/
+        # serving_performance.md): staged->first-token and
+        # staged->slot-admitted p95s over the rolling window
+        for kind, label in (("ttft", "ttft"), ("queue_wait", "queue")):
+            entry = latency.get(kind)
+            if isinstance(entry, dict) and entry.get("count"):
+                parts.append("%s p95 %sms" % (label, entry["p95"]))
     return " · ".join(parts)
 
 
