@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: check test entry hooks chaos chaos-serve bench-serve
+.PHONY: check test entry hooks chaos chaos-serve bench-serve metrics
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -32,9 +32,18 @@ chaos-serve:
 
 # Standalone continuous-batching serving bench (docs/
 # serving_performance.md): one JSON line with the decode_continuous_*
-# keys — tokens/sec, prefill ms, host-overhead fraction.
+# keys — tokens/sec, prefill ms, host-overhead fraction, dispatch
+# tallies and the veles_decode_* histogram summaries.
 bench-serve:
 	$(PYTHON) bench.py --serve
+
+# Observability suite standalone (docs/observability.md): registry
+# concurrency + exposition format, the disabled-path overhead guard
+# (shared null-span identity, zero registry mutations — observability
+# must never silently tax the serving hot path), trace export, and
+# the end-to-end serving/fleet trace-propagation acceptance tests.
+metrics:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_observe.py -q
 
 entry:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import jax, __graft_entry__ as g; \

@@ -1070,11 +1070,13 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
         dec.drain_pipelined(
             chunk, admit=lambda: pending and dec.submit(pending.pop()))
         dt = time.perf_counter() - t0
-        return dec.tokens_out / dt, dt, dict(dec.timings)
+        return (dec.tokens_out / dt, dt, dict(dec.timings),
+                dict(dec.dispatch_counts))
 
     run()  # compile (admit + chunk programs) + warm
     runs = [run() for _ in range(2)]
-    best_rate, wall, timings = max(runs, key=lambda r: r[0])
+    best_rate, wall, timings, dispatch_counts = max(
+        runs, key=lambda r: r[0])
     device_s = sum(timings.values())
     prefix = ("decode_continuous" if not quantize
               else "decode_continuous_" + quantize.replace("-", ""))
@@ -1085,6 +1087,14 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
                 timings["admit_s"] * 1000, 3),
             prefix + "_host_overhead_fraction": round(
                 max(0.0, 1.0 - device_s / wall), 4),
+            # host-overhead attribution between rounds (observability
+            # PR): the best run's per-family host-blocking wall ms and
+            # its dispatch tallies persist into the BENCH json
+            prefix + "_host_ms": {
+                key[:-2] if key.endswith("_s") else key:
+                    round(sec * 1000, 3)
+                for key, sec in sorted(timings.items())},
+            prefix + "_dispatch_counts": dispatch_counts,
             prefix + "_config":
                 "s%d_p%d_b%d_r%d_c%d_e%d_h%d_L%d_v%d"
                 % (slots, prompt, budget, n_requests, chunk, embed,
@@ -1200,26 +1210,57 @@ def main():
     }))
 
 
-def serve_main():
+def serve_main(profile_dir=None):
     """``make bench-serve``: the continuous-batching serving bench
     standalone (one JSON line) — fast iteration on the slot-engine hot
     path without paying for the full training bench. Runs the bf16
     tier and, when the device has the int8 kernels' appetite, the
-    int8-KV slot tier too."""
+    int8-KV slot tier too.
+
+    The metrics registry is enabled for the window, so the decoder's
+    per-dispatch histograms (veles_decode_*_seconds) accumulate across
+    both tiers and their bucketed summaries land in the JSON — the
+    perf trajectory carries host-overhead DISTRIBUTIONS between
+    rounds, not just totals. ``--profile-dir DIR`` additionally wraps
+    the window in a jax profiler capture with span-named device
+    annotations (docs/observability.md)."""
+    from veles_tpu.observe.metrics import get_metrics_registry
+    from veles_tpu.observe.profile import profile_window
+
+    registry = get_metrics_registry()
+    was_enabled = registry.enabled
+    registry.enable()
     kind = device_info()[0]
     out = {"metric": "decode_continuous_tokens_per_sec",
            "unit": "tokens/sec", "device_kind": kind}
-    out.update(_guarded(decode_continuous, fallback={}))
-    out.update(_guarded(decode_continuous, quantize="int8-kv",
-                        fallback={}))
+    try:
+        with profile_window(profile_dir):
+            out.update(_guarded(decode_continuous, fallback={}))
+            out.update(_guarded(decode_continuous, quantize="int8-kv",
+                                fallback={}))
+        out["decode_histograms"] = registry.histogram_summary(
+            "veles_decode")
+    finally:
+        if not was_enabled:
+            registry.disable()
     out["value"] = out.get("decode_continuous_tokens_per_sec")
     print(json.dumps(out))
+
+
+def _flag_value(argv, flag):
+    for i, arg in enumerate(argv):
+        if arg == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith(flag + "="):
+            return arg.split("=", 1)[1]
+    return None
 
 
 if __name__ == "__main__":
     import sys
 
     if "--serve" in sys.argv[1:]:
-        serve_main()
+        serve_main(profile_dir=_flag_value(sys.argv[1:],
+                                           "--profile-dir"))
     else:
         main()

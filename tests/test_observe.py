@@ -1,0 +1,620 @@
+"""Observability-layer tests (docs/observability.md): MetricsRegistry
+semantics + Prometheus exposition, the disabled-path overhead guard,
+EventRecorder buffering, dashboard event tailing, Chrome trace export,
+and end-to-end trace propagation through a real GenerateAPI request and
+a real fleet round trip. ``make metrics`` runs this module standalone."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.core.logger import EventRecorder
+from veles_tpu.observe.metrics import MetricsRegistry, bridge
+from veles_tpu.observe.tracing import (NULL_SPAN, Tracer,
+                                       parse_trace_header)
+from veles_tpu.observe.trace_export import (chrome_trace,
+                                            export_chrome_trace,
+                                            load_events, span_tree)
+
+
+def get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def post(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode()), dict(resp.headers)
+
+
+class TestMetricsRegistry:
+    def test_concurrent_counters_exact(self):
+        """N threads hammering the same counter (and a labeled series)
+        must land on the exact total — the registry's one lock is the
+        whole consistency story."""
+        registry = MetricsRegistry(enabled=True)
+        threads_n, per_thread = 8, 2000
+
+        def work(i):
+            for _ in range(per_thread):
+                registry.incr("veles_test_total")
+                registry.incr("veles_test_labeled_total", 2,
+                              labels={"worker": str(i % 2)})
+                registry.observe("veles_test_seconds", 0.01,
+                                 buckets=(0.005, 0.05))
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        text = registry.expose()
+        assert "veles_test_total %d" % (threads_n * per_thread) in text
+        for worker in ("0", "1"):
+            assert ('veles_test_labeled_total{worker="%s"} %d'
+                    % (worker, threads_n // 2 * per_thread * 2)) in text
+        assert ("veles_test_seconds_count %d"
+                % (threads_n * per_thread)) in text
+
+    def test_exposition_format(self):
+        registry = MetricsRegistry(enabled=True)
+        registry.incr("veles_req_total", 3,
+                      labels={"path": 'a"b\\c\nd'},
+                      help="requests\nby path")
+        registry.set("veles_up", 1, help="liveness")
+        registry.observe("veles_lat_seconds", 0.03,
+                         buckets=(0.01, 0.1, 1.0))
+        registry.observe("veles_lat_seconds", 5.0,
+                         buckets=(0.01, 0.1, 1.0))
+        text = registry.expose()
+        lines = text.splitlines()
+        # HELP escaping: newline survives as \n, backslash doubled
+        assert "# HELP veles_req_total requests\\nby path" in lines
+        assert "# TYPE veles_req_total counter" in lines
+        assert "# TYPE veles_up gauge" in lines
+        assert "# TYPE veles_lat_seconds histogram" in lines
+        # label value escaping: quote, backslash and newline
+        assert ('veles_req_total{path="a\\"b\\\\c\\nd"} 3') in lines
+        # histogram: cumulative monotone buckets, +Inf == count, sum
+        buckets = [line for line in lines
+                   if line.startswith("veles_lat_seconds_bucket")]
+        counts = [int(line.rsplit(" ", 1)[1]) for line in buckets]
+        assert counts == sorted(counts), buckets
+        assert buckets[-1].startswith(
+            'veles_lat_seconds_bucket{le="+Inf"}')
+        assert counts[-1] == 2
+        assert "veles_lat_seconds_count 2" in lines
+        assert "veles_lat_seconds_sum 5.03" in lines
+
+    def test_bridge_unregisters_dead_source(self):
+        registry = MetricsRegistry(enabled=True)
+
+        class Source:
+            pass
+
+        source = Source()
+        bridge(registry, source,
+               lambda reg, live: reg.set("veles_src_up", 1))
+        assert "veles_src_up 1" in registry.expose()
+        assert len(registry._collectors) == 1
+        del source
+        import gc
+        gc.collect()
+        registry.expose()  # the dead collector unregisters itself
+        assert registry._collectors == []
+
+    def test_broken_collector_never_breaks_exposition(self):
+        registry = MetricsRegistry(enabled=True)
+        registry.add_collector(lambda: 1 / 0)
+        registry.incr("veles_ok_total")
+        assert "veles_ok_total 1" in registry.expose()
+
+    def test_kind_collision_drops_the_write(self):
+        """A scalar sample aimed at a histogram family (e.g. a skewed
+        fleet slave re-using a histogram name) must be DROPPED, not
+        poison every later expose()."""
+        registry = MetricsRegistry(enabled=True)
+        registry.observe("veles_h_seconds", 0.1, buckets=(1.0,))
+        registry.counter_set("veles_h_seconds", 7)
+        registry.incr("veles_h_seconds")
+        registry.set("veles_h_seconds", 3)
+        registry.observe("veles_c_total", 0.5, buckets=(1.0,))
+        registry.incr("veles_c_total", 2)  # dropped: histogram exists
+        text = registry.expose()  # must not raise
+        assert "veles_h_seconds_count 1" in text
+        assert "veles_c_total_count 1" in text
+        assert "\nveles_c_total 2" not in text
+        registry.histogram_summary()  # must not raise either
+
+    def test_hostile_slave_rows_cannot_break_master_exposition(self):
+        """The fleet piggyback path: rows with exposition-breaking
+        metric/label names are rejected by slave_metrics; only label
+        VALUES (escaped) get through."""
+        from veles_tpu.fleet.server import Server, SlaveDescription
+
+        server = Server.__new__(Server)
+        slave = SlaveDescription("slave-1", {})
+        server.slaves = {"slave-1": slave}
+        slave.metrics_rows = [
+            ["veles_ok_total", "counter",
+             [["path", 'a"} evil{b="1']], 5],          # hostile VALUE: ok
+            ['veles_x{a="1"} 9 #', "counter", [], 5],  # hostile NAME
+            ["veles_y_total", "counter",
+             [['a"} evil{b="1', "v"]], 5],             # hostile label KEY
+            ["veles_z_total", "counter", [["slave", "slave-9"]], 5],
+            ["veles_b_total", "counter", [], True],    # bool is not a number
+            "not-a-row",
+        ]
+        clean = server.slave_metrics()
+        assert list(clean) == ["slave-1"]
+        assert [row[0] for row in clean["slave-1"]] == ["veles_ok_total"]
+        registry = MetricsRegistry(enabled=True)
+        from veles_tpu.observe.metrics import publish_fleet
+        server.fleet_status = lambda: {"slaves": [], "queued_jobs": 0}
+        publish_fleet(registry, server)
+        text = registry.expose()
+        # the hostile value survives only ESCAPED inside one label —
+        # the quote that would have closed the label set is \" —
+        # so the line still parses as a single sample
+        assert ('veles_ok_total{path="a\\"} evil{b=\\"1",'
+                'slave="slave-1"} 5') in text
+        assert "veles_y_total" not in text
+        assert "veles_z_total" not in text
+
+    def test_piggyback_rows_bounded_and_stale_slaves_pruned(self):
+        from veles_tpu.fleet.server import Server, SlaveDescription
+        from veles_tpu.observe.metrics import publish_fleet
+
+        server = Server.__new__(Server)
+        one, two = (SlaveDescription(sid, {})
+                    for sid in ("slave-1", "slave-2"))
+        server.slaves = {"slave-1": one, "slave-2": two}
+        # volume bound: a hostile slave's giant snapshot truncates
+        one.metrics_rows = [
+            ["veles_r%d_total" % i, "counter", [["v", "x" * 4096]], i]
+            for i in range(Server.METRICS_MAX_ROWS + 500)]
+        two.metrics_rows = [["veles_t_total", "counter", [], 1]]
+        clean = server.slave_metrics()
+        assert len(clean["slave-1"]) == Server.METRICS_MAX_ROWS
+        assert all(len(labels["v"]) <= Server.METRICS_MAX_VALUE_LEN
+                   for _, _, labels, _ in clean["slave-1"])
+        # churn bound: a departed slave's re-exported series retire
+        registry = MetricsRegistry(enabled=True)
+        server.fleet_status = lambda: {
+            "slaves": [s.as_dict() for s in server.slaves.values()],
+            "queued_jobs": 0}
+        publish_fleet(registry, server)
+        assert 'slave="slave-2"' in registry.expose()
+        del server.slaves["slave-2"]
+        publish_fleet(registry, server)
+        text = registry.expose()
+        assert 'slave="slave-2"' not in text
+        assert 'veles_t_total' not in text
+        assert 'slave="slave-1"' in text
+
+
+class TestOverheadGuard:
+    """The `make metrics` guard (ISSUE satellite): disabled-path
+    span()/incr() must be structural no-ops so observability can never
+    silently tax the PR-3 serving hot path."""
+
+    def test_disabled_tracer_returns_shared_null_span(self):
+        tracer = Tracer(enabled=False)
+        spans = {id(tracer.span("a")), id(tracer.span("b", x=1)),
+                 id(tracer.event("c"))}
+        assert spans == {id(NULL_SPAN)}
+        with tracer.span("a") as span:
+            assert span is NULL_SPAN
+            assert span.context() is None
+
+    def test_disabled_registry_mutates_nothing(self):
+        registry = MetricsRegistry(enabled=False)
+        registry.incr("veles_x_total")
+        registry.set("veles_g", 2)
+        registry.observe("veles_h_seconds", 0.1)
+        registry.counter_set("veles_c_total", 9)
+        assert registry._families == {}
+        assert registry.expose() == "\n"
+
+    def test_decoder_disabled_path_uses_null_span(self):
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(0)
+        params = init_transformer_params(rng, 1, 8, 2, 7)
+        table = jnp.asarray(rng.randn(7, 8).astype(numpy.float32))
+        dec = ContinuousDecoder(params, table, 2, slots=1, max_len=32,
+                                n_tokens=2)
+        dec._tracer = Tracer(enabled=False)
+        dec.metrics = MetricsRegistry(enabled=False)
+        assert dec._span("decode.dispatch", [0]) is NULL_SPAN
+        dec.submit([1, 2])
+        dec.run_until_drained(max_steps=8)
+        assert dec.metrics._families == {}
+
+
+class TestEventRecorderBuffer:
+    def test_preopen_buffer_capped_drop_oldest(self, tmp_path,
+                                               monkeypatch):
+        """A recorder configured with a path but never open()ed must
+        cap its buffer (drop-oldest) instead of growing forever."""
+        monkeypatch.setattr(EventRecorder, "MAX_BUFFER", 10)
+        rec = EventRecorder(path=str(tmp_path / "never-opened.jsonl"))
+        for i in range(25):
+            rec.record(name="span-%d" % i, etype="single")
+        assert len(rec._buffer) == 10
+        assert rec._buffer_dropped == 15
+        kept = [json.loads(line)["name"] for line in rec._buffer]
+        assert kept == ["span-%d" % i for i in range(15, 25)]
+        # a late open() flushes exactly the surviving tail
+        out = tmp_path / "opened.jsonl"
+        rec.open(str(out))
+        rec.close()
+        names = [json.loads(line)["name"]
+                 for line in out.read_text().splitlines()]
+        assert names == kept
+
+    def test_record_carries_monotonic_stamp(self, tmp_path):
+        rec = EventRecorder()
+        rec.open(str(tmp_path / "events.jsonl"))
+        before = time.monotonic()
+        rec.record(name="x", etype="single")
+        rec.close()
+        event = json.loads(
+            (tmp_path / "events.jsonl").read_text().splitlines()[0])
+        assert before <= event["mono"] <= time.monotonic()
+
+
+class TestTailEvents:
+    def test_tail_reads_only_the_end_of_a_multi_mb_file(self, tmp_path):
+        from veles_tpu.web_status import WebStatusServer, tail_lines
+
+        path = tmp_path / "events.jsonl"
+        n = 40000  # ~4.6 MB of lines
+        with open(path, "w") as fout:
+            for i in range(n):
+                fout.write(json.dumps(
+                    {"name": "e%06d" % i, "pad": "x" * 80}) + "\n")
+        assert os.path.getsize(path) > 3 * 1024 * 1024
+        server = WebStatusServer.__new__(WebStatusServer)
+        server.events_path = str(path)
+        out = server.tail_events(limit=200)
+        assert len(out) == 200
+        assert [e["name"] for e in out] == \
+            ["e%06d" % i for i in range(n - 200, n)]
+        # bounded reads: the backward scan may touch at most the tail
+        # window plus one block of slack, never megabytes
+        reads = []
+        real_read = os.read
+
+        class CountingFile:
+            def __init__(self, fobj):
+                self._f = fobj
+
+            def __getattr__(self, name):
+                return getattr(self._f, name)
+
+            def read(self, size):
+                reads.append(size)
+                return self._f.read(size)
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                self._f.close()
+
+        import builtins
+        real_open = builtins.open
+        try:
+            builtins.open = lambda *a, **k: CountingFile(
+                real_open(*a, **k))
+            tail_lines(str(path), 200)
+        finally:
+            builtins.open = real_open
+        assert sum(reads) <= 200 * 120 + 2 * 65536, sum(reads)
+        del real_read
+
+    def test_tail_shorter_than_limit(self, tmp_path):
+        from veles_tpu.web_status import tail_lines
+
+        path = tmp_path / "short.jsonl"
+        path.write_text("a\nb\nc\n")
+        assert tail_lines(str(path), 200) == ["a", "b", "c"]
+
+
+class TestTraceExport:
+    def test_begin_end_pairs_become_complete_events(self, tmp_path):
+        events = [
+            {"name": "parent", "etype": "begin", "trace_id": "t1",
+             "span_id": "s1", "parent_id": None, "mono": 1.0, "tid": 7,
+             "pid": 1},
+            {"name": "child", "etype": "begin", "trace_id": "t1",
+             "span_id": "s2", "parent_id": "s1", "mono": 1.1, "tid": 7,
+             "pid": 1},
+            {"name": "child", "etype": "end", "trace_id": "t1",
+             "span_id": "s2", "parent_id": "s1", "mono": 1.4, "tid": 7,
+             "pid": 1},
+            {"name": "mark", "etype": "single", "trace_id": "t1",
+             "span_id": "s3", "parent_id": "s1", "mono": 1.2, "tid": 7,
+             "pid": 1},
+            {"name": "parent", "etype": "end", "trace_id": "t1",
+             "span_id": "s1", "parent_id": None, "mono": 2.0, "tid": 7,
+             "pid": 1},
+        ]
+        src = tmp_path / "events.jsonl"
+        with open(src, "w") as fout:
+            for event in events:
+                fout.write(json.dumps(event) + "\n")
+        out = tmp_path / "trace.json"
+        count = export_chrome_trace(str(src), str(out))
+        trace = json.loads(out.read_text())
+        assert count == len(trace["traceEvents"]) == 3
+        complete = {e["name"]: e for e in trace["traceEvents"]
+                    if e["ph"] == "X"}
+        assert set(complete) == {"parent", "child"}
+        assert complete["child"]["dur"] == pytest.approx(0.3e6)
+        assert complete["parent"]["dur"] == pytest.approx(1.0e6)
+        tree = span_tree(trace)["t1"]
+        assert tree == {"s1": None, "s2": "s1", "s3": "s1"}
+
+    def test_loader_skips_torn_lines(self, tmp_path):
+        src = tmp_path / "events.jsonl"
+        src.write_text('{"name": "ok", "etype": "single"}\n{"trunc')
+        assert [e["name"] for e in load_events(str(src))] == ["ok"]
+
+
+@pytest.fixture
+def observability(tmp_path, monkeypatch):
+    """Fresh global recorder (JSONL in tmp) + enabled tracer + reset
+    registry, restored afterwards — the globals other suites also
+    touch."""
+    from veles_tpu.core import logger as logger_mod
+    from veles_tpu.observe.metrics import get_metrics_registry
+    from veles_tpu.observe.tracing import get_tracer
+
+    events_path = str(tmp_path / "events.jsonl")
+    recorder = EventRecorder()
+    recorder.open(events_path)
+    monkeypatch.setattr(logger_mod, "_event_recorder", recorder)
+    tracer = get_tracer()
+    registry = get_metrics_registry()
+    was_traced, was_metered = tracer.enabled, registry.enabled
+    tracer.enable()
+    registry.reset()
+    registry.enable()
+    yield events_path
+    recorder.close()
+    tracer.enabled = was_traced
+    registry.reset()
+    registry.enabled = was_metered
+
+
+def _walk_to_root(tree, span_id, stop_ids):
+    seen = set()
+    while True:
+        assert span_id not in seen, "parent cycle at %s" % span_id
+        seen.add(span_id)
+        parent = tree.get(span_id, "missing")
+        if parent is None or parent in stop_ids:
+            return parent
+        assert parent != "missing", \
+            "span %s has a parent outside the tree" % span_id
+        span_id = parent
+
+
+class TestServingObservability:
+    @pytest.fixture(scope="class")
+    def model(self):
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(0)
+        heads, embed, vocab = 4, 16, 11
+        params = init_transformer_params(rng, 2, embed, heads, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+        return params, table, heads, vocab
+
+    def test_request_yields_connected_span_tree_and_metrics(
+            self, model, observability, tmp_path):
+        """The acceptance pair: one serving request produces ONE
+        connected trace (admission -> prefill dispatch -> decode chunks
+        -> collect) in the exported Chrome trace, and /metrics on the
+        same surface exposes serving counters + decode histograms."""
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=4, chunk=2, port=0)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            client_trace = "c0ffee01", "ab12"
+            body, headers = post(
+                url + "/generate", {"tokens": [1, 2, 3]},
+                headers={"X-Veles-Trace": "%s/%s" % client_trace})
+            assert len(body["tokens"]) == 4
+            # the response echoes the request's trace id
+            echoed = parse_trace_header(headers.get("X-Veles-Trace"))
+            assert echoed is not None and echoed[0] == client_trace[0]
+            metrics = get(url + "/metrics")
+            assert ('veles_serving_requests_total{api="generate-api"'
+                    ',outcome="completed"} 1') in metrics
+            assert ('veles_serving_requests_total{api="generate-api"'
+                    ',outcome="admitted"} 1') in metrics
+            assert "veles_decode_dispatch_seconds_bucket" in metrics
+            assert "veles_decode_admit_seconds_count" in metrics
+            assert 'veles_decode_dispatches_total{kind="admit"} 1' \
+                in metrics
+        finally:
+            api.stop()
+        out = str(tmp_path / "trace.json")
+        export_chrome_trace(observability, out)
+        trace = json.loads(open(out).read())
+        trees = span_tree(trace)
+        # ONE trace: the client's id, continued through every layer
+        assert list(trees) == [client_trace[0]], list(trees)
+        tree = trees[client_trace[0]]
+        names = {e["args"]["span_id"]: e["name"]
+                 for e in trace["traceEvents"]
+                 if e["args"].get("trace_id") == client_trace[0]}
+        by_name = {}
+        for span_id, name in names.items():
+            by_name.setdefault(name, []).append(span_id)
+        for required in ("serve.request", "serve.submit",
+                         "decode.admit", "decode.dispatch",
+                         "decode.collect", "serve.complete"):
+            assert required in by_name, (required, sorted(by_name))
+        # every span's parent chain terminates at the client's span —
+        # one CONNECTED tree, no orphans
+        stop = {client_trace[1]}
+        for span_id in tree:
+            assert _walk_to_root(tree, span_id, stop) in stop
+        # the request span is the direct child of the client context
+        for span_id in by_name["serve.request"]:
+            assert tree[span_id] == client_trace[1]
+
+    def test_restful_api_mounts_metrics(self, observability):
+        from veles_tpu.dummy import DummyWorkflow
+        from veles_tpu.serving import RESTfulAPI
+
+        api = RESTfulAPI(DummyWorkflow(), port=0, path="/api")
+        api.feed = lambda data, request: None
+        api.requests = []
+        api.initialize()
+        try:
+            metrics = get("http://127.0.0.1:%d/metrics" % api.port)
+            assert 'veles_serving_ready{api="restful-api"} 1' in metrics
+        finally:
+            api.stop()
+
+    def test_web_status_mounts_metrics(self, observability):
+        from veles_tpu.web_status import WebStatusServer
+
+        server = WebStatusServer(port=0).start()
+        try:
+            metrics = get("http://127.0.0.1:%d/metrics" % server.port)
+            assert "# TYPE" in metrics or metrics.strip() == ""
+        finally:
+            server.stop()
+
+    def test_forge_mounts_metrics(self, observability, tmp_path):
+        from veles_tpu.forge.server import ForgeServer
+
+        server = ForgeServer(str(tmp_path / "store"), port=0).start()
+        try:
+            # exposition is live on the forge surface too
+            get("http://127.0.0.1:%d/metrics" % server.port)
+        finally:
+            server.stop()
+
+
+@pytest.mark.slow
+class TestFleetObservability:
+    def test_fleet_round_trip_metrics_and_trace(self, observability,
+                                                tmp_path):
+        """A real master+slave run: the master's /metrics sidecar
+        aggregates fleet state incl. the slave's piggybacked counters,
+        and one job reads master -> slave -> apply as a single
+        connected trace."""
+        from veles_tpu.core import prng
+        from veles_tpu.core.config import root
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.models.mlp import MLPWorkflow
+        from sklearn.datasets import load_digits
+
+        digits = load_digits()
+        kw = dict(
+            layers=(16, 10),
+            loader_kwargs=dict(
+                data=digits.data.astype(numpy.float32),
+                labels=digits.target.astype(numpy.int32),
+                class_lengths=[0, 297, 1500], minibatch_size=300,
+                normalization_type="linear"),
+            learning_rate=0.5, max_epochs=1)
+        saved_port = root.common.observe.get("fleet_metrics_port", None)
+        root.common.observe.fleet_metrics_port = 0
+        try:
+            prng.get("default").seed(42)
+            prng.get("loader").seed(43)
+            master = Launcher(listen_address="127.0.0.1:0")
+            MLPWorkflow(master, name="fleet-obs", **kw)
+            master.initialize()
+            master_thread = threading.Thread(target=master.run,
+                                             daemon=True)
+            master_thread.start()
+            prng.get("default").seed(42)
+            prng.get("loader").seed(43)
+            slave = Launcher(
+                master_address="127.0.0.1:%d" % master.agent.port)
+            MLPWorkflow(slave, name="fleet-obs", **kw)
+            slave.initialize()
+            slave_thread = threading.Thread(target=slave.run,
+                                            daemon=True)
+            slave_thread.start()
+            deadline = time.time() + 120
+            metrics_url = "http://127.0.0.1:%d/metrics" \
+                % master.agent.metrics_port
+            # poll mid-run until the slave's piggybacked rows show up
+            piggybacked = ""
+            while time.time() < deadline:
+                try:
+                    piggybacked = get(metrics_url, timeout=5)
+                except OSError:
+                    break  # master finished and closed the sidecar
+                if 'slave="slave-1"' in piggybacked \
+                        and "veles_fleet_jobs_total" in piggybacked:
+                    break
+                time.sleep(0.2)
+            assert "veles_fleet_jobs_total" in piggybacked
+            assert 'slave="slave-1"' in piggybacked, \
+                piggybacked[-2000:]
+            master_thread.join(timeout=120)
+            slave_thread.join(timeout=120)
+        finally:
+            if saved_port is None:
+                root.common.observe.fleet_metrics_port = None
+            else:
+                root.common.observe.fleet_metrics_port = saved_port
+        events = load_events(observability)
+        issues = [e for e in events if e.get("name") == "fleet.issue"]
+        assert issues, "no fleet.issue events recorded"
+        trace = chrome_trace(events)
+        trees = span_tree(trace)
+        jobs = {e["args"]["span_id"]: e for e in trace["traceEvents"]
+                if e["name"] == "fleet.do_job"}
+        applies = [e for e in trace["traceEvents"]
+                   if e["name"] == "fleet.apply"]
+        assert jobs and applies
+        # every applied update chains master.issue -> slave.do_job ->
+        # master.apply inside ONE trace
+        verified = 0
+        for apply_event in applies:
+            args = apply_event["args"]
+            parent = args.get("parent_id")
+            if parent not in jobs:
+                continue
+            job = jobs[parent]
+            assert job["args"]["trace_id"] == args["trace_id"]
+            issue_id = job["args"].get("parent_id")
+            issue = next(
+                (e for e in trace["traceEvents"]
+                 if e["args"].get("span_id") == issue_id), None)
+            assert issue is not None and issue["name"] == "fleet.issue"
+            assert issue["args"]["trace_id"] == args["trace_id"]
+            verified += 1
+        assert verified > 0

@@ -176,7 +176,15 @@ class Main(Logger):
                                  "JSON lines")
         parser.add_argument("--profile", default=None, metavar="DIR",
                             help="capture a jax profiler trace of the "
-                                 "run (view in TensorBoard/Perfetto)")
+                                 "run (view in TensorBoard/Perfetto); "
+                                 "host spans are annotated into the "
+                                 "device trace by name")
+        parser.add_argument("--trace-events", default=None,
+                            metavar="PATH",
+                            help="enable span tracing: trace_id'd span "
+                                 "events append to this JSONL file "
+                                 "(export with `veles_tpu observe "
+                                 "export-trace PATH`)")
         parser.add_argument("--manhole", action="store_true",
                             help="serve a live debug console on a unix "
                                  "socket (<dirs.run>/manhole-<pid>.sock;"
@@ -338,11 +346,14 @@ class Main(Logger):
         if self.profile_dir:
             # device-level timeline (the reference's Mongo event spans /
             # web timeline role, done the TPU way): a jax profiler trace
-            # viewable in TensorBoard / Perfetto
-            import jax
+            # viewable in TensorBoard / Perfetto; profile_window also
+            # turns on span-named TraceAnnotations so the host span
+            # timeline lines up with the XLA device trace
+            # (docs/observability.md)
+            from veles_tpu.observe.profile import profile_window
             self.info("profiling to %s (open with tensorboard or "
                       "ui.perfetto.dev)", self.profile_dir)
-            with jax.profiler.trace(self.profile_dir):
+            with profile_window(self.profile_dir):
                 self.launcher.run()
         else:
             self.launcher.run()
@@ -390,6 +401,15 @@ class Main(Logger):
         self.visualize = args.visualize
         self.dump_unit_attributes = args.dump_unit_attributes
         self.profile_dir = args.profile
+        if args.trace_events:
+            # opt-in tracing: span events (trace_id/span_id/mono) append
+            # to the JSONL file; export with `veles_tpu observe
+            # export-trace` (docs/observability.md)
+            from veles_tpu.core.logger import enable_event_recording
+            from veles_tpu.observe.tracing import get_tracer
+            enable_event_recording(args.trace_events)
+            get_tracer().enable()
+            self.info("span tracing to %s", args.trace_events)
         # plugins BEFORE the workflow module: a ``veles_tpu_*`` package /
         # ``veles_tpu.plugins`` entry point registers its units through
         # the registry metaclasses, making them constructible by name in
@@ -536,6 +556,9 @@ def main(argv=None):
     if argv and argv[0] == "parity":
         from veles_tpu.parity import main as parity_main
         return parity_main(argv[1:])
+    if argv and argv[0] == "observe":
+        from veles_tpu.observe.trace_export import main as observe_main
+        return observe_main(argv[1:])
     return Main().run(argv)
 
 

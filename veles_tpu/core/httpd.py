@@ -15,7 +15,12 @@ Survival-layer additions shared by every HTTP surface
 - :func:`serve_health` mounts the ``/healthz`` + ``/readyz`` probe pair
   off any object with a ``snapshot()``/``ready`` surface (the serving
   units' ``ServingHealth``), the same contract k8s-style orchestrators
-  expect.
+  expect;
+- :func:`serve_metrics` mounts ``GET /metrics`` (Prometheus text
+  exposition off the process-global MetricsRegistry,
+  ``observe/metrics.py``) — the one telemetry plane every HTTP surface
+  shares (docs/observability.md). Mounting it ENABLES the registry:
+  processes that never start an HTTP server keep the no-op fast path.
 """
 
 import json
@@ -103,6 +108,32 @@ def serve_health(handler, health):
                   code=503, headers={"Retry-After": "1"})
         return True
     return False
+
+
+def serve_metrics(handler, registry=None):
+    """Route ``GET /metrics``: the Prometheus exposition of
+    ``registry`` (default: the process-global one). Returns True when
+    the path was handled. The first mount enables the registry — until
+    some surface can actually be scraped, every ``incr``/``observe``
+    in the hot paths stays a structural no-op."""
+    path = handler.path.split("?")[0]
+    if path != "/metrics":
+        return False
+    if registry is None:
+        from veles_tpu.observe.metrics import get_metrics_registry
+        registry = get_metrics_registry()
+    registry.enable()  # scrapeable == enabled, as documented
+    reply(handler, registry.expose(),
+          content_type="text/plain; version=0.0.4; charset=utf-8")
+    return True
+
+
+def enable_metrics():
+    """Turn the process-global registry on (idempotent); every HTTP
+    surface calls this at start so its counters accumulate from the
+    first request, not the first scrape."""
+    from veles_tpu.observe.metrics import get_metrics_registry
+    return get_metrics_registry().enable()
 
 
 def start_server(handler_cls, host="127.0.0.1", port=0, name="httpd"):

@@ -278,12 +278,20 @@ class EventRecorder:
     session id and wall-clock time; the web-status timeline reads this file.
     """
 
+    #: pre-open buffer cap: a recorder CONFIGURED with a path whose
+    #: open() never comes (misordered startup, crashed initializer)
+    #: must not grow its buffer forever — beyond this the OLDEST spans
+    #: drop (the recent ones are the ones worth flushing) with one
+    #: warning
+    MAX_BUFFER = 10000
+
     def __init__(self, path=None, session=None):
         self.path = path
         self.session = session or "%d" % os.getpid()
         self._lock = threading.Lock()
         self._fd = None
         self._buffer = []
+        self._buffer_dropped = 0
         self._sinks = []
         self._sink_warned = set()
         self.enabled = path is not None
@@ -316,13 +324,29 @@ class EventRecorder:
 
     def record(self, **attrs):
         attrs.setdefault("time", time.time())
+        # monotonic stamp: what the Chrome trace exporter orders and
+        # measures by (wall time can step; span durations must not)
+        attrs.setdefault("mono", time.monotonic())
         attrs.setdefault("session", self.session)
         line = json.dumps(attrs, default=str) + "\n"
+        warn_drop = False
         with self._lock:
             if self._fd is not None:
                 self._fd.write(line)
             elif self.enabled:
+                if len(self._buffer) >= self.MAX_BUFFER:
+                    # drop-oldest: the spans worth flushing at open()
+                    # are the recent ones
+                    del self._buffer[0]
+                    warn_drop = self._buffer_dropped == 0
+                    self._buffer_dropped += 1
                 self._buffer.append(line)
+        if warn_drop:  # once — this can be a high-frequency path
+            logging.getLogger("EventRecorder").warning(
+                "pre-open event buffer full (%d spans); dropping the "
+                "oldest from here on — call open()/"
+                "enable_event_recording to flush (reported once)",
+                self.MAX_BUFFER)
         with self._lock:
             sinks = list(self._sinks)
         for sink in sinks:

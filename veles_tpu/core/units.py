@@ -33,6 +33,7 @@ from veles_tpu.core.errors import AttributeMissingError, VelesError
 from veles_tpu.core.mutable import Bool, link as link_attr
 from veles_tpu.core.registry import UnitCommandLineArgumentsRegistry
 from veles_tpu.core.timing import Timer
+from veles_tpu.observe.tracing import get_tracer
 
 
 class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
@@ -278,8 +279,17 @@ class Unit(Distributable, metaclass=UnitCommandLineArgumentsRegistry):
                     self.debug("-> run (from %s)",
                                src.name if src else "start")
                 timer = self.timers.setdefault("run", Timer())
-                with timer:
-                    self.run()
+                tracer = get_tracer()
+                if tracer.enabled:
+                    # span-per-tick only while tracing is ON (the
+                    # enabled check is the whole disabled-path cost):
+                    # unit runs are THE hot path of the training loop
+                    with tracer.span("unit.run", unit=self.name,
+                                     cls=type(self).__name__), timer:
+                        self.run()
+                else:
+                    with timer:
+                        self.run()
                 self.run_calls += 1
                 if self.timings:
                     self.info("%s run: %.3f ms", self.name,

@@ -26,6 +26,7 @@ from veles_tpu.core.executor import ThreadPool
 from veles_tpu.core.plumbing import EndPoint, StartPoint
 from veles_tpu.core.timing import Timer
 from veles_tpu.core.units import Container, Unit
+from veles_tpu.observe.tracing import get_tracer
 
 
 class Workflow(Container):
@@ -214,16 +215,21 @@ class Workflow(Container):
         self.stopped = False
         self._run_start = time.perf_counter()
         self.event("workflow run", "begin", workflow=self.name)
-        self.start_point.run_dependent()
-        self._sync_event_.wait()
-        # quiesce: finish is signalled by the EndPoint, but sibling units
-        # (snapshotter, plotters) may still be running on pool threads —
-        # don't return to the caller until every run() is out of flight
-        for unit in self._units:
-            lock = getattr(unit, "_run_lock_", None)
-            if lock is not None:
-                with lock:
-                    pass
+        # traced twin of the legacy begin/end pair: carries
+        # trace_id/span_id + monotonic stamps so the run window frames
+        # the unit.run spans in the exported Chrome trace
+        with get_tracer().span("workflow.run", workflow=self.name):
+            self.start_point.run_dependent()
+            self._sync_event_.wait()
+            # quiesce: finish is signalled by the EndPoint, but sibling
+            # units (snapshotter, plotters) may still be running on pool
+            # threads — don't return to the caller until every run() is
+            # out of flight
+            for unit in self._units:
+                lock = getattr(unit, "_run_lock_", None)
+                if lock is not None:
+                    with lock:
+                        pass
         self.event("workflow run", "end", workflow=self.name)
         if self._sync_error_ is not None:
             exc, tb = self._sync_error_

@@ -35,6 +35,8 @@ import threading
 from veles_tpu.core.logger import Logger
 from veles_tpu.fleet.protocol import (
     ProtocolError, machine_id, read_frame, resolve_secret, write_frame)
+from veles_tpu.observe.metrics import get_metrics_registry
+from veles_tpu.observe.tracing import get_tracer, parse_trace_field
 
 
 class Client(Logger):
@@ -244,7 +246,16 @@ class Client(Logger):
                     self.info("no more jobs; exiting")
                     return True
                 job_id = msg.get("job_id")
-                update = await self._do_job(msg["job"])
+                # the master's fleet.issue context rides the job frame;
+                # our do_job span parents to it and our update echoes
+                # OUR context so the master's fleet.apply chains on —
+                # one job, one connected trace (docs/observability.md)
+                job_span = get_tracer().span(
+                    "fleet.do_job",
+                    parent=parse_trace_field(msg.get("trace")),
+                    job_id=job_id, sid=self.sid)
+                with job_span:
+                    update = await self._do_job(msg["job"])
                 if self.chaos is not None:
                     self.chaos.maybe_die(writer)
                 if self.death_probability > 0 \
@@ -254,11 +265,18 @@ class Client(Logger):
                 shm_thr = getattr(self, "_shm_thr_", None)
                 # echo the lease + master epoch: the ledger fences
                 # duplicates, requeued leases and stale-epoch answers
-                await self._write(writer,
-                                  {"type": "update", "update": update,
-                                   "job_id": job_id,
-                                   "epoch": self.master_epoch},
-                                  shm_threshold=shm_thr)
+                frame = {"type": "update", "update": update,
+                         "job_id": job_id, "epoch": self.master_epoch}
+                if job_span.context() is not None:
+                    frame["trace"] = list(job_span.context())
+                registry = get_metrics_registry()
+                if registry.enabled:
+                    # piggyback this slave's counter/gauge snapshot so
+                    # the master's /metrics aggregates the whole fleet
+                    # without another connection or scrape schedule
+                    frame["metrics"] = [
+                        list(row) for row in registry.snapshot()]
+                await self._write(writer, frame, shm_threshold=shm_thr)
                 if self.async_mode:
                     # pipelined: next request goes out with the update
                     await self._write(writer, {"type": "job_request"})

@@ -28,6 +28,8 @@ from veles_tpu.fleet.ledger import JobLedger
 from veles_tpu.fleet.protocol import (
     COMPRESS_THRESHOLD, ProtocolError, machine_id, read_frame,
     resolve_secret, write_frame)
+from veles_tpu.observe.metrics import bridge, publish_fleet
+from veles_tpu.observe.tracing import get_tracer, parse_trace_field
 
 
 class SlaveDescription:
@@ -55,6 +57,10 @@ class SlaveDescription:
         self.job_started = None
         self.paused = False
         self.chaos_counters = None  # latest fault tallies from the slave
+        #: latest counter/gauge snapshot piggybacked on this slave's
+        #: update frames (observe/metrics.py snapshot() rows); the
+        #: master's /metrics re-exports them with a slave label
+        self.metrics_rows = None
 
     def record_job_time(self, duration):
         self.job_times.append(duration)
@@ -80,8 +86,13 @@ class SlaveDescription:
 class Server(Logger):
     """The fleet master (reference ``server.py:659``)."""
 
+    #: per-slave piggybacked-metrics bounds (see :meth:`slave_metrics`)
+    METRICS_MAX_ROWS = 512
+    METRICS_MAX_LABELS = 8
+    METRICS_MAX_VALUE_LEN = 256
+
     def __init__(self, address, workflow, job_timeout=120.0, secret=None,
-                 respawn=False, spawner=None):
+                 respawn=False, spawner=None, metrics_port=None):
         super().__init__(logger_name="fleet.Server")
         host, _, port = address.rpartition(":")
         # loopback by default: an exposed master means remote code
@@ -128,6 +139,15 @@ class Server(Logger):
         self._thread = None
         self._stopped = threading.Event()
         self.on_finished = None  # callback when the job stream is done
+        #: fleet-wide Prometheus sidecar (docs/observability.md): the
+        #: fleet wire protocol is custom asyncio frames, so /metrics
+        #: needs its own tiny HTTP listener. Off by default (None);
+        #: 0 binds an ephemeral port, resolved after start().
+        if metrics_port is None:
+            metrics_port = root.common.observe.get("fleet_metrics_port",
+                                                   None)
+        self.metrics_port = metrics_port
+        self._metrics_httpd = None
 
     # -- lifecycle ------------------------------------------------------------
     def start(self):
@@ -165,7 +185,35 @@ class Server(Logger):
         if stale:
             self.info("removed %d stale shared-memory segments", stale)
         self.info("master listening on %s:%d", self.host, self.port)
+        if self.metrics_port is not None:
+            self._start_metrics_server()
         return self
+
+    def _start_metrics_server(self):
+        """The /metrics HTTP sidecar: fleet_status() + every slave's
+        piggybacked counters in one Prometheus exposition."""
+        from http.server import BaseHTTPRequestHandler
+        from veles_tpu.core.httpd import (QuietHandlerMixin,
+                                          enable_metrics, reply,
+                                          serve_metrics, start_server)
+
+        server = self
+        bridge(enable_metrics(), self, publish_fleet)
+
+        class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
+            def do_GET(self):
+                if serve_metrics(self):
+                    return
+                if self.path.split("?")[0] == "/healthz":
+                    reply(self, server.fleet_status())
+                    return
+                self.send_error(404)
+
+        self._metrics_httpd, self.metrics_port = start_server(
+            Handler, self.host, int(self.metrics_port),
+            name="fleet-metrics")
+        self.info("fleet metrics on http://%s:%d/metrics", self.host,
+                  self.metrics_port)
 
     def kick(self):
         """Replay backpressured job requests. The task farm calls this
@@ -194,6 +242,9 @@ class Server(Logger):
         if self._stopped.is_set():
             return
         self._stopped.set()
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd = None
         if self.respawn_manager is not None:
             self.respawn_manager.stop()
         if self._loop is not None:
@@ -343,9 +394,17 @@ class Server(Logger):
         # must echo the job_id (exactly-once fence) and our epoch
         timeout = slave.timeout(self.job_timeout)
         job_id = self.ledger.issue(slave.id, timeout)
-        await write_frame(writer, {"type": "job", "job": job,
-                                   "job_id": job_id,
-                                   "epoch": self.epoch}, self._secret,
+        frame = {"type": "job", "job": job, "job_id": job_id,
+                 "epoch": self.epoch}
+        # trace propagation (docs/observability.md): the issue event
+        # roots the job's trace; its context rides the frame, the slave
+        # parents its do_job span to it and echoes ITS context in the
+        # update, so one fleet job reads master -> slave -> apply
+        issue = get_tracer().event("fleet.issue", job_id=job_id,
+                                   slave=slave.id)
+        if issue.context() is not None:
+            frame["trace"] = list(issue.context())
+        await write_frame(writer, frame, self._secret,
                           shm_threshold=getattr(slave, "shm_threshold",
                                                 None))
         self._watch_hang(slave, job_id, timeout)
@@ -356,6 +415,12 @@ class Server(Logger):
             # dashboard can prove each configured fault actually fired
             slave.chaos_counters = msg["chaos"]
             self._chaos_reports[(slave.mid, slave.pid)] = msg["chaos"]
+        if isinstance(msg.get("metrics"), list):
+            # counter/gauge snapshot piggybacked on the update frame —
+            # the master's /metrics re-exports it under this slave's
+            # id; truncated at INGESTION so an oversized hostile list
+            # is never retained past the frame
+            slave.metrics_rows = msg["metrics"][:self.METRICS_MAX_ROWS]
         verdict = self._fence_update(slave, msg)
         if verdict is not None:
             self.warning("fenced update from %s: %s (job_id=%r)",
@@ -379,7 +444,11 @@ class Server(Logger):
             self.respawn_manager.notify_reconnected(slave.mid)
         update = msg.get("update")
         if update is not None:
-            await self._in_thread(self._locked_apply, update, slave)
+            with get_tracer().span(
+                    "fleet.apply",
+                    parent=parse_trace_field(msg.get("trace")),
+                    job_id=msg.get("job_id"), slave=slave.id):
+                await self._in_thread(self._locked_apply, update, slave)
         await write_frame(writer, {"type": "update_ack"}, self._secret)
         slave.state = "WAIT"
         await self._retry_pending()
@@ -505,6 +574,50 @@ class Server(Logger):
     def resume_slave(self, sid):
         if sid in self.slaves:
             self.slaves[sid].paused = False
+
+    def slave_metrics(self):
+        """Per-slave piggybacked metric snapshots, validated: the rows
+        came off the wire, so anything not shaped like a snapshot row
+        (``[name, kind, [[k, v], ...], number]``) is dropped — metric
+        and label NAMES must be valid exposition tokens (label values
+        are escaped by the registry), so a hostile or version-skewed
+        slave can at most contribute bogus VALUES, never break the
+        master's exposition. Volume is bounded too: at most
+        ``METRICS_MAX_ROWS`` rows per slave, ``METRICS_MAX_LABELS``
+        labels per row, label values truncated — a GiB-sized hostile
+        snapshot cannot balloon the master's memory or its scrapes."""
+        from veles_tpu.observe.metrics import (LABEL_NAME_RE,
+                                               METRIC_NAME_RE)
+
+        out = {}
+        for slave in list(self.slaves.values()):
+            rows = slave.metrics_rows
+            if not isinstance(rows, list):
+                continue
+            clean = []
+            for row in rows[:self.METRICS_MAX_ROWS]:
+                try:
+                    name, kind, labels, value = row
+                    if not isinstance(name, str) \
+                            or not METRIC_NAME_RE.match(name) \
+                            or kind not in ("counter", "gauge") \
+                            or isinstance(value, bool) \
+                            or not isinstance(value, (int, float)) \
+                            or len(labels) > self.METRICS_MAX_LABELS:
+                        continue
+                    keys = [str(k) for k, _ in labels]
+                    if not all(LABEL_NAME_RE.match(k) and k != "slave"
+                               for k in keys):
+                        continue
+                    clean.append((
+                        name, kind,
+                        {str(k): str(v)[:self.METRICS_MAX_VALUE_LEN]
+                         for k, v in labels}, value))
+                except (TypeError, ValueError):
+                    continue
+            if clean:
+                out[slave.id] = clean
+        return out
 
     def fleet_status(self):
         """Observability snapshot consumed by the web-status dashboard

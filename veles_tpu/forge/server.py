@@ -309,10 +309,12 @@ class ForgeServer(Logger):
 
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (BodyTooLarge,
+        from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
-                                          reply, start_server)
+                                          reply, serve_metrics,
+                                          start_server)
 
+        enable_metrics()
         server = self
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
@@ -323,6 +325,8 @@ class ForgeServer(Logger):
 
             def do_GET(self):
                 path, query = self._query()
+                if serve_metrics(self):
+                    return
                 if path == "/service":
                     if query.get("query") == "list":
                         reply(self, server.list_models())

@@ -155,6 +155,14 @@ class Loader(Unit):
             self._shuffle_train()
         self.analyze_dataset()
         self.create_minibatch_data()
+        # observability bridge (docs/observability.md): epoch progress
+        # and serving tallies on /metrics. Weakly referenced — a loader
+        # that goes away unregisters itself; scrape-time only, so a
+        # run that never mounts /metrics pays nothing here.
+        from veles_tpu.observe.metrics import (bridge,
+                                               get_metrics_registry,
+                                               publish_loader)
+        bridge(get_metrics_registry(), self, publish_loader)
         if self._on_initialized_ is not None:
             self._on_initialized_()
 

@@ -1,0 +1,30 @@
+"""Unified observability layer: metrics + tracing + profiling hooks.
+
+Three coordinated parts (docs/observability.md):
+
+- :mod:`veles_tpu.observe.metrics` — the process-global
+  :class:`MetricsRegistry` with Prometheus text exposition, mounted as
+  ``/metrics`` on every HTTP surface via
+  ``core/httpd.py:serve_metrics``; weak *bridges* re-publish the
+  existing state holders (ServingHealth, ContinuousDecoder, Loader,
+  the fleet master) at scrape time;
+- :mod:`veles_tpu.observe.tracing` — trace_id/span_id spans through
+  the EventRecorder, propagated by the ``X-Veles-Trace`` serving
+  header and the fleet frames' ``trace`` field; exported to Chrome
+  trace JSON by ``veles_tpu observe export-trace``;
+- :mod:`veles_tpu.observe.profile` — ``--profile-dir`` windows around
+  bench/serving with span-named ``jax.profiler.TraceAnnotation``s.
+
+Everything is off by default with a structurally no-op fast path: the
+disabled tracer hands out one shared null span, the disabled registry
+returns before its lock — hot paths pay one attribute check.
+"""
+
+from veles_tpu.observe.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, MetricsRegistry, bridge, get_metrics_registry,
+    publish_decoder, publish_fleet, publish_loader,
+    publish_serving_health)
+from veles_tpu.observe.tracing import (  # noqa: F401
+    NULL_SPAN, TRACE_HEADER, Tracer, current_context,
+    format_trace_header, get_tracer, parse_trace_field,
+    parse_trace_header)

@@ -1,0 +1,66 @@
+"""Opt-in jax.profiler integration: device traces aligned with spans.
+
+``--profile-dir`` (bench.py / the CLI's ``--profile``) wraps a run
+window in ``jax.profiler.trace``; while a capture is active the tracer
+also enters a ``jax.profiler.TraceAnnotation`` named after each span
+(``Span.__enter__``), so the host-side span timeline and the XLA device
+timeline line up by NAME in TensorBoard/Perfetto — "decode.dispatch" on
+the host lane sits over the slot_step_many program on the device lane.
+
+Everything here degrades to a no-op when jax is unavailable or the
+profiler cannot start (a serving box must never crash because a
+capture was requested) — the failure is logged, the run continues.
+"""
+
+import contextlib
+import logging
+
+
+@contextlib.contextmanager
+def profile_window(profile_dir, annotate=True):
+    """Capture a jax profiler trace of the enclosed window into
+    ``profile_dir`` (viewable in TensorBoard or ui.perfetto.dev).
+    ``annotate=True`` additionally turns on span-named
+    TraceAnnotations for the duration so host spans align with the
+    device trace — and ENABLES the tracer for the window if it was
+    off (annotations are emitted by real spans; with the tracer
+    disabled every instrumented site returns the null span and the
+    capture would carry no host names at all). Span events go to
+    whatever EventRecorder is configured; none configured means they
+    are simply dropped while the annotations still fire.
+    ``profile_dir`` of None/"" makes this a no-op — callers wrap
+    unconditionally and the flag decides."""
+    if not profile_dir:
+        yield None
+        return
+    from veles_tpu.observe.tracing import get_tracer
+
+    tracer = get_tracer()
+    saved = tracer.annotate_device
+    saved_enabled = tracer.enabled
+    log = logging.getLogger("observe.profile")
+    try:
+        import jax
+        profiler_cm = jax.profiler.trace(profile_dir)
+        # start INSIDE the guard: jax.profiler.trace constructs lazily
+        # and only start_trace (__enter__) touches the filesystem /
+        # checks for a concurrent capture
+        profiler_cm.__enter__()
+    except Exception:
+        log.exception(
+            "jax profiler unavailable; continuing without a capture")
+        yield None
+        return
+    if annotate:
+        tracer.annotate_device = True
+        tracer.enabled = True
+    try:
+        yield profile_dir
+    finally:
+        tracer.annotate_device = saved
+        tracer.enabled = saved_enabled
+        try:
+            profiler_cm.__exit__(None, None, None)
+        except Exception:
+            log.exception("jax profiler capture failed to finalize; "
+                          "the run itself is unaffected")

@@ -1,0 +1,141 @@
+"""Chrome ``trace_event`` exporter for the JSONL span stream.
+
+``veles_tpu observe export-trace events.jsonl -o trace.json`` converts
+the EventRecorder's span events (begin/end/single with trace ids and
+monotonic stamps — see ``observe/tracing.py``) into the Chrome
+trace-event JSON format, loadable in ``ui.perfetto.dev`` or
+``chrome://tracing``. Spans become complete ("X") events with their
+trace identity in ``args`` (the span-tree test walks those parent
+links); unpaired begins become begin ("B") events so a crashed run's
+half-open spans stay visible; legacy span events without trace ids
+(the pre-observability ``Logger.event`` stream) still export, keyed by
+name+source, so old event files remain loadable.
+"""
+
+import json
+
+
+def load_events(path):
+    """Read the JSONL event stream, skipping undecodable lines (a
+    crashed writer can truncate the last one)."""
+    events = []
+    with open(path, "r") as fin:
+        for line in fin:
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(event, dict):
+                events.append(event)
+    return events
+
+
+def _stamp_us(event, t0):
+    """Microsecond timestamp: prefer the monotonic field (immune to
+    wall-clock steps), fall back to wall time for legacy events."""
+    stamp = event.get("mono")
+    if stamp is None:
+        stamp = event.get("time", 0.0)
+    return (float(stamp) - t0) * 1e6
+
+
+def _args(event):
+    out = {key: value for key, value in event.items()
+           if key not in ("name", "etype", "mono", "tid", "pid")}
+    return out
+
+
+def chrome_trace(events):
+    """Span events -> the ``{"traceEvents": [...]}`` dict."""
+    stamps = [float(e["mono"]) for e in events if "mono" in e] or \
+        [float(e.get("time", 0.0)) for e in events]
+    t0 = min(stamps) if stamps else 0.0
+    open_spans = {}
+    trace_events = []
+    for event in events:
+        etype = event.get("etype")
+        if etype not in ("begin", "end", "single"):
+            continue
+        key = event.get("span_id") or (
+            "%s/%s" % (event.get("name"), event.get("source")))
+        base = {
+            "name": str(event.get("name", "?")),
+            "cat": str(event.get("trace_id") or "events"),
+            "pid": event.get("pid", event.get("session", 0)),
+            "tid": event.get("tid", 0),
+            "args": _args(event),
+        }
+        if etype == "single":
+            trace_events.append(dict(base, ph="i", s="t",
+                                     ts=_stamp_us(event, t0)))
+        elif etype == "begin":
+            open_spans[key] = (event, base)
+        else:  # end
+            begun = open_spans.pop(key, None)
+            if begun is None:
+                # end without begin (rotated file): emit instant
+                trace_events.append(dict(base, ph="i", s="t",
+                                         ts=_stamp_us(event, t0)))
+                continue
+            begin_event, begin_base = begun
+            ts = _stamp_us(begin_event, t0)
+            dur = max(0.0, _stamp_us(event, t0) - ts)
+            merged_args = dict(begin_base["args"])
+            merged_args.update(base["args"])
+            trace_events.append(dict(begin_base, ph="X", ts=ts,
+                                     dur=dur, args=merged_args))
+    # half-open spans (crash mid-span): visible as B events
+    for event, base in open_spans.values():
+        trace_events.append(dict(base, ph="B",
+                                 ts=_stamp_us(event, t0)))
+    trace_events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": trace_events,
+            "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(events_path, out_path):
+    """JSONL span file -> Chrome trace JSON file; returns the event
+    count written."""
+    trace = chrome_trace(load_events(events_path))
+    with open(out_path, "w") as fout:
+        json.dump(trace, fout)
+    return len(trace["traceEvents"])
+
+
+def span_tree(trace):
+    """Walk a Chrome trace dict into ``{trace_id: {span_id: parent_id}}``
+    — the verification view the tests (and quick scripts) use to assert
+    one request yields ONE connected tree."""
+    trees = {}
+    for event in trace.get("traceEvents", []):
+        args = event.get("args", {})
+        trace_id = args.get("trace_id")
+        span_id = args.get("span_id")
+        if not trace_id or not span_id:
+            continue
+        trees.setdefault(trace_id, {})[span_id] = args.get("parent_id")
+    return trees
+
+
+def main(argv=None):
+    """``veles_tpu observe export-trace`` entry point."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu observe",
+        description="observability tooling (docs/observability.md)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    export = sub.add_parser(
+        "export-trace",
+        help="convert a span JSONL file to Chrome trace JSON "
+             "(load in ui.perfetto.dev)")
+    export.add_argument("events", help="events JSONL path (see "
+                                       "enable_event_recording)")
+    export.add_argument("-o", "--output", default=None,
+                        help="output path (default: <events>.trace.json)")
+    args = parser.parse_args(argv)
+    out = args.output or args.events + ".trace.json"
+    count = export_chrome_trace(args.events, out)
+    print("wrote %d trace events to %s (open in ui.perfetto.dev)"
+          % (count, out))
+    return 0

@@ -425,8 +425,12 @@ def slot_admit_many(params, embed_table, heads, state, slots, prompt_x,
     ``req_keys`` (B,) seeds each slot's sampling stream; ``lengths``
     (B,) are the true prompt lengths inside the padded rows."""
     t = prompt_x.shape[1]
-    logits, k_all, v_all, lengths = _prefill_forward(params, prompt_x,
-                                                     heads, lengths)
+    # named after the host-side "decode.admit" span so the XLA device
+    # trace and the span timeline line up in a profiler capture
+    # (observe/profile.py; zero cost post-compile)
+    with jax.named_scope("decode.admit"):
+        logits, k_all, v_all, lengths = _prefill_forward(
+            params, prompt_x, heads, lengths)
     new = dict(
         state,
         lengths=state["lengths"].at[slots].set(lengths),
@@ -609,7 +613,11 @@ def slot_step_many(params, embed_table, heads, state, active, n,
                                    span=span)
         return state, emitted
 
-    return lax.scan(body, state, None, length=n)
+    # named after the host-side "decode.dispatch" span (the profiler
+    # alignment contract — observe/profile.py): the whole chunk scan
+    # shows up as one labeled region in the XLA device trace
+    with jax.named_scope("decode.dispatch"):
+        return lax.scan(body, state, None, length=n)
 
 
 # -- tensor-parallel decode (Megatron-style weight sharding) ------------------

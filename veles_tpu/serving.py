@@ -42,6 +42,17 @@ from veles_tpu.core.config import root
 from veles_tpu.core.mutable import Bool
 from veles_tpu.core.units import Unit
 from veles_tpu.loader.base import Loader, TEST, register_loader
+from veles_tpu.observe.metrics import (bridge, get_metrics_registry,
+                                       publish_decoder,
+                                       publish_serving_health)
+from veles_tpu.observe.tracing import (NULL_SPAN, TRACE_HEADER,
+                                       format_trace_header, get_tracer,
+                                       parse_trace_header)
+
+#: decode host-time histogram buckets (seconds): sub-ms host
+#: bookkeeping through multi-second cold-compile dispatches
+DECODE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
 
 
 @register_loader("restful")
@@ -387,11 +398,14 @@ class RESTfulAPI(Unit):
     def initialize(self, **kwargs):
         from http.server import BaseHTTPRequestHandler
         from veles_tpu.core.httpd import (MAX_BODY, BodyTooLarge,
-                                          QuietHandlerMixin, read_body,
-                                          serve_health, start_server)
+                                          QuietHandlerMixin,
+                                          enable_metrics, read_body,
+                                          serve_health, serve_metrics,
+                                          start_server)
 
         api = self
         limit = self.max_body or MAX_BODY
+        bridge(enable_metrics(), self.health, publish_serving_health)
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_POST(self):
@@ -402,9 +416,15 @@ class RESTfulAPI(Unit):
                     raw = read_body(self, limit=limit)
                 except BodyTooLarge:
                     return  # 413 already sent, nothing buffered
-                api.serve(self, raw)
+                with get_tracer().span(
+                        "restful.request",
+                        parent=parse_trace_header(
+                            self.headers.get(TRACE_HEADER))):
+                    api.serve(self, raw)
 
             def do_GET(self):
+                if serve_metrics(self):
+                    return
                 if not serve_health(self, api.health):
                     self.send_error(404)
 
@@ -629,11 +649,46 @@ class ContinuousDecoder:
         #: entries ("admit", bucket, group), ("dispatch", chunk),
         #: ("collect", chunk) — the lag-1 pipelining assert hook
         self.dispatch_log = None
+        #: observability plane (docs/observability.md): disabled-path
+        #: calls are structural no-ops, so the hot path stays the
+        #: PR-3 hot path until someone mounts /metrics or a tracer
+        self.metrics = get_metrics_registry()
+        self._tracer = get_tracer()
+        self._trace = {}  # request id -> (trace_id, span_id) context
+        #: recently-retired trace contexts, bounded: the lag-1 pipeline
+        #: collects a request's LAST chunk one pass after it retires,
+        #: and that collect's span must still attach to the request's
+        #: trace instead of rooting an orphan
+        self._done_trace = collections.OrderedDict()
 
-    def submit(self, prompt_tokens, n_tokens=None):
+    def _span(self, name, rids, **attrs):
+        """A span parented to the first TRACED request among ``rids``
+        (batch-level dispatches serve many requests; one of them owns
+        the span, all of them ride its ``rids`` attr). Disabled-path:
+        the shared null span, with the parent lookup skipped."""
+        if not self._tracer.enabled:
+            return NULL_SPAN
+        parent = next((self._trace[r] for r in rids
+                       if r in self._trace), None)
+        if parent is None:
+            parent = next((self._done_trace[r] for r in rids
+                           if r in self._done_trace), None)
+        return self._tracer.span(name, parent=parent,
+                                 rids=list(rids), **attrs)
+
+    def _retire_trace(self, rid):
+        trace = self._trace.pop(rid, None)
+        if trace is not None:
+            self._done_trace[rid] = trace
+            while len(self._done_trace) > 4 * self.slots + 8:
+                self._done_trace.popitem(last=False)
+
+    def submit(self, prompt_tokens, n_tokens=None, trace=None):
         """Queue one prompt (1-D int sequence); returns the request id.
         The prompt is admitted into a slot on a later :meth:`step` when
-        one is free."""
+        one is free. ``trace`` optionally carries the submitting
+        request's (trace_id, span_id) so the slot-engine dispatch spans
+        connect to it (docs/observability.md)."""
         prompt = numpy.asarray(prompt_tokens, numpy.int32).reshape(-1)
         budget = n_tokens if n_tokens is not None else self.n_tokens
         if len(prompt) + budget > self.max_len:
@@ -645,6 +700,8 @@ class ContinuousDecoder:
         self._queue.append((rid, prompt, budget))
         self.results[rid] = []
         self._budget[rid] = budget
+        if trace is not None:
+            self._trace[rid] = trace
         return rid
 
     @property
@@ -679,6 +736,7 @@ class ContinuousDecoder:
         del self._budget[rid]
         self.results.pop(rid, None)
         self.admitted_at.pop(rid, None)
+        self._retire_trace(rid)
         self.cancelled += 1
         return True
 
@@ -731,13 +789,24 @@ class ContinuousDecoder:
             req_keys = jax.vmap(jax.random.fold_in,
                                 in_axes=(None, 0))(self.base_key, rids)
             x = self.embed_table[jnp.asarray(prompts)]
-            t0 = time.perf_counter()
-            self.state = slot_admit_many(
-                self.params, self.embed_table, self.heads, self.state,
-                jnp.asarray([r[2] for r in rows], jnp.int32), x,
-                req_keys,
-                jnp.asarray([len(r[1]) for r in rows], jnp.int32))
-            self.timings["admit_s"] += time.perf_counter() - t0
+            # span entered OUTSIDE the timed window: the span's own
+            # begin/end writes (file I/O when tracing) must not inflate
+            # the host-overhead attribution they exist to explain
+            with self._span("decode.admit", [r[0] for r in group],
+                            bucket=bucket, group=len(group)):
+                t0 = time.perf_counter()
+                self.state = slot_admit_many(
+                    self.params, self.embed_table, self.heads,
+                    self.state,
+                    jnp.asarray([r[2] for r in rows], jnp.int32), x,
+                    req_keys,
+                    jnp.asarray([len(r[1]) for r in rows], jnp.int32))
+                elapsed = time.perf_counter() - t0
+            self.timings["admit_s"] += elapsed
+            self.metrics.observe(
+                "veles_decode_admit_seconds", elapsed,
+                buckets=DECODE_BUCKETS,
+                help="host-blocking bucket-prefill dispatch time")
             self.dispatch_counts["admit"] += 1
             self.dispatch_counts["admit_requests"] += len(group)
             if self.dispatch_log is not None:
@@ -747,7 +816,7 @@ class ContinuousDecoder:
                 self._slot_len[slot] = len(prompt)
                 self.admitted_at[rid] = now
 
-    def _span(self, extra):
+    def _attended_span(self, extra):
         """Static attended span for the next dispatch: the longest
         LIVE sequence plus the ``extra`` positions the dispatch will
         append, rounded up to the tile (one compiled program per tile
@@ -776,7 +845,7 @@ class ContinuousDecoder:
             jnp.asarray(self._active()),
             jnp.float32(self.temperature or 1.0),
             sample=bool(self.temperature), top_k=self.top_k,
-            span=self._span(1))
+            span=self._attended_span(1))
         for slot in snapshot:
             self._slot_len[slot] += 1
         self.dispatch_counts["step"] += 1
@@ -794,6 +863,7 @@ class ContinuousDecoder:
                 del self._slot_req[slot]
                 del self._budget[rid]
                 self.admitted_at.pop(rid, None)
+                self._retire_trace(rid)
                 self._free.append(slot)
         self.steps += 1
         return out
@@ -817,9 +887,16 @@ class ContinuousDecoder:
         their slot active one extra chunk) are skipped; tail tokens
         past a budget or eos are discarded."""
         emitted, snapshot = dispatched
-        t0 = time.perf_counter()
-        emitted = numpy.asarray(emitted)  # (chunk, slots) — syncs
-        self.timings["collect_s"] += time.perf_counter() - t0
+        # span writes stay outside the timed window (see decode.admit)
+        with self._span("decode.collect", list(snapshot.values())):
+            t0 = time.perf_counter()
+            emitted = numpy.asarray(emitted)  # (chunk, slots) — syncs
+            elapsed = time.perf_counter() - t0
+        self.timings["collect_s"] += elapsed
+        self.metrics.observe(
+            "veles_decode_collect_seconds", elapsed,
+            buckets=DECODE_BUCKETS,
+            help="chunk readback (device sync) time")
         if self.dispatch_log is not None:
             self.dispatch_log.append(("collect", emitted.shape[0]))
         out = {}
@@ -841,6 +918,7 @@ class ContinuousDecoder:
             if done:
                 del self._budget[rid]
                 self.admitted_at.pop(rid, None)
+                self._retire_trace(rid)
                 if self._slot_req.get(slot) == rid:
                     del self._slot_req[slot]
                     self._free.append(slot)
@@ -860,14 +938,22 @@ class ContinuousDecoder:
         if not self._slot_req:
             return None
         snapshot = dict(self._slot_req)
-        t0 = time.perf_counter()
-        self.state, emitted = slot_step_many(
-            self.params, self.embed_table, self.heads, self.state,
-            jnp.asarray(self._active()), chunk,
-            jnp.float32(self.temperature or 1.0),
-            sample=bool(self.temperature), top_k=self.top_k,
-            span=self._span(chunk))
-        self.timings["dispatch_s"] += time.perf_counter() - t0
+        # span writes stay outside the timed window (see decode.admit)
+        with self._span("decode.dispatch", list(snapshot.values()),
+                        chunk=chunk):
+            t0 = time.perf_counter()
+            self.state, emitted = slot_step_many(
+                self.params, self.embed_table, self.heads, self.state,
+                jnp.asarray(self._active()), chunk,
+                jnp.float32(self.temperature or 1.0),
+                sample=bool(self.temperature), top_k=self.top_k,
+                span=self._attended_span(chunk))
+            elapsed = time.perf_counter() - t0
+        self.timings["dispatch_s"] += elapsed
+        self.metrics.observe(
+            "veles_decode_dispatch_seconds", elapsed,
+            buckets=DECODE_BUCKETS,
+            help="chunk enqueue (host-blocking dispatch) time")
         # mirror the device-side length advance (active lanes advance
         # every step of the chunk, even past retirement — the span for
         # the NEXT dispatch only consults live slots)
@@ -1051,7 +1137,8 @@ class GenerateAPI:
             except queue.Empty:
                 break
             try:
-                rid = self.decoder.submit(prompt, budget)
+                rid = self.decoder.submit(prompt, budget,
+                                          trace=holder.get("trace"))
             except ValueError as exc:
                 # belt-and-braces: the handler pre-validated, but a
                 # failed submit must never kill the driver thread —
@@ -1059,6 +1146,8 @@ class GenerateAPI:
                 self._resolve(holder, "errors", error=str(exc),
                               code=400)
                 continue
+            get_tracer().event("serve.submit",
+                               parent=holder.get("trace"), rid=rid)
             waiting[rid] = holder
         return waiting
 
@@ -1087,6 +1176,8 @@ class GenerateAPI:
                     and now >= h["deadline"]]:
             holder = waiting.pop(rid)
             self.decoder.cancel(rid)
+            get_tracer().event("serve.expire",
+                               parent=holder.get("trace"), rid=rid)
             self._resolve(holder, "expired", error="deadline exceeded",
                           code=504)
 
@@ -1153,8 +1244,12 @@ class GenerateAPI:
                     self.health.record_latency(
                         "ttft", max(0.0, now - staged_at))
             if self.decoder.done(rid):
+                tokens = self.decoder.results.pop(rid)
+                get_tracer().event("serve.complete",
+                                   parent=holder.get("trace"),
+                                   rid=rid, tokens=len(tokens))
                 self._resolve(waiting.pop(rid), "completed",
-                              tokens=self.decoder.results.pop(rid))
+                              tokens=tokens)
 
     def _drive(self):
         """The lag-1 double-buffered live loop: each pass drains the
@@ -1218,15 +1313,26 @@ class GenerateAPI:
     # -- HTTP -------------------------------------------------------------
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (BodyTooLarge,
+        from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
                                           reply, serve_health,
-                                          start_server)
+                                          serve_metrics, start_server)
 
         api = self
+        # the telemetry plane (docs/observability.md): /metrics on this
+        # surface exposes the health counters and the decoder's
+        # dispatch/timing state via weakly-referenced scrape bridges
+        # (api going away unregisters them) — the decoder is read
+        # THROUGH api so a breaker rebuild swaps sources transparently
+        registry = enable_metrics()
+        bridge(registry, self.health, publish_serving_health)
+        bridge(registry, self,
+               lambda reg, live: publish_decoder(reg, live.decoder))
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_GET(self):
+                if serve_metrics(self):
+                    return
                 if not serve_health(self, api.health):
                     self.send_error(404)
 
@@ -1279,14 +1385,30 @@ class GenerateAPI:
                 except (ValueError, TypeError, KeyError) as exc:
                     reply(self, {"error": str(exc)}, code=400)
                     return
+                # trace context: continue the caller's trace from the
+                # X-Veles-Trace header (or root a new one); the span
+                # covers admission -> staged -> resolved, and its
+                # context rides the holder so the driver/decoder spans
+                # parent to it across threads
+                parent = parse_trace_header(
+                    self.headers.get(TRACE_HEADER))
+                with get_tracer().span("serve.request",
+                                       parent=parent) as req_span:
+                    self._serve_admitted(prompt, budget, deadline_s,
+                                         req_span)
+
+            def _serve_admitted(self, prompt, budget, deadline_s,
+                                req_span):
                 # admission: atomic ready + queue-bound check; rejected
                 # requests never stage, so the decoder queue is bounded
                 verdict = api.health.try_admit(api.max_queue)
                 if verdict == "unready":
+                    req_span.annotate(outcome="unready")
                     reply(self, {"error": api._tripped or "not ready"},
                           code=503, headers={"Retry-After": "1"})
                     return
                 if verdict == "full":
+                    req_span.annotate(outcome="rejected")
                     reply(self,
                           {"error": "saturated: %d requests in flight"
                            % api.max_queue},
@@ -1295,9 +1417,16 @@ class GenerateAPI:
                 staged_at = time.monotonic()
                 holder = {"event": threading.Event(),
                           "staged_at": staged_at,
-                          "deadline": staged_at + deadline_s}
+                          "deadline": staged_at + deadline_s,
+                          "trace": req_span.context()}
                 api._staged.put((prompt, budget, holder))
                 api._wake.set()
+                trace_headers = {}
+                header_value = format_trace_header(req_span.context())
+                if header_value:
+                    # echo the trace id so the CLIENT can find this
+                    # request in the exported span timeline
+                    trace_headers[TRACE_HEADER] = header_value
                 # the DRIVER owns deadline expiry (it frees the slot);
                 # the grace here is only a backstop against a wedged
                 # (hung, non-raising) driver thread. The handler then
@@ -1312,11 +1441,17 @@ class GenerateAPI:
                                  error="timed out", code=503)
                 if "error" in holder:
                     code = holder.get("code", 400)
+                    req_span.annotate(outcome="error", code=code)
+                    headers = dict(trace_headers)
+                    if code in (429, 503):
+                        headers["Retry-After"] = "1"
                     reply(self, {"error": holder["error"]}, code=code,
-                          headers={"Retry-After": "1"}
-                          if code in (429, 503) else None)
+                          headers=headers)
                     return
-                reply(self, {"tokens": holder["tokens"]})
+                req_span.annotate(outcome="completed",
+                                  tokens=len(holder["tokens"]))
+                reply(self, {"tokens": holder["tokens"]},
+                      headers=trace_headers)
 
         self._httpd, self.port = start_server(
             Handler, self.host, self.port, name="generate-api")

@@ -91,6 +91,32 @@ src.onmessage = function(ev) {
 </script>
 </body></html>"""
 
+def tail_lines(path, limit, block=65536):
+    """The last ``limit`` text lines of ``path``, reading only from the
+    end: seek backwards in ``block``-byte strides until enough newlines
+    (or the file start) are in hand. Bytes read is bounded by the tail
+    itself, not the file size. Undecodable bytes are replaced, a
+    torn first line (mid-block cut) is dropped by the line split."""
+    with open(path, "rb") as fin:
+        fin.seek(0, os.SEEK_END)
+        size = fin.tell()
+        chunks = []
+        pos = size
+        newlines = 0
+        while pos > 0 and newlines <= limit:
+            step = min(block, pos)
+            pos -= step
+            fin.seek(pos)
+            chunk = fin.read(step)
+            chunks.append(chunk)
+            newlines += chunk.count(b"\n")
+        data = b"".join(reversed(chunks))
+    # when the loop stopped mid-file (pos > 0) it holds > limit
+    # newlines, so the slice always drops the possibly-torn first line
+    lines = data.decode("utf-8", "replace").splitlines()
+    return lines[-limit:]
+
+
 def format_fleet_health(fleet):
     """The master's ledger/chaos counters as one table cell (consumed by
     both the static page and the /stream JS — formatted server-side so
@@ -276,10 +302,12 @@ class WebStatusServer(Logger):
 
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (BodyTooLarge,
+        from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
-                                          reply, start_server)
+                                          reply, serve_metrics,
+                                          start_server)
 
+        enable_metrics()
         server = self
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
@@ -302,7 +330,9 @@ class WebStatusServer(Logger):
                 reply(self, {"ok": True})
 
             def do_GET(self):
-                if self.path.startswith("/service"):
+                if serve_metrics(self):
+                    pass
+                elif self.path.startswith("/service"):
                     reply(self, server.statuses())
                 elif self.path.startswith("/events"):
                     reply(self, server.tail_events())
@@ -448,13 +478,16 @@ class WebStatusServer(Logger):
                 "plots": self.plots_state()}
 
     def tail_events(self, limit=200):
+        """The last ``limit`` events, read by seeking from the END of
+        the JSONL file in fixed blocks — the dashboard polls this every
+        few seconds, and a long run's event log grows to many MB;
+        reading it whole per poll was an accidental O(file) tax on the
+        serving box (the events the page shows are only the tail)."""
         path = self.events_path
         if not path or not os.path.isfile(path):
             return []
-        with open(path, "r") as fin:
-            lines = fin.readlines()[-limit:]
         out = []
-        for line in lines:
+        for line in tail_lines(path, limit):
             try:
                 out.append(json.loads(line))
             except ValueError:
