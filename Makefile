@@ -1,6 +1,7 @@
 PYTHON ?= python
 
-.PHONY: check test entry hooks chaos chaos-serve bench-serve metrics
+.PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
+	regress
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -44,6 +45,17 @@ bench-serve:
 # the end-to-end serving/fleet trace-propagation acceptance tests.
 metrics:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_observe.py -q
+
+# Artifact-proof regression sentinel (docs/observability.md): compare
+# the committed previous-round BENCH json against itself through the
+# full loader (exercising the truncated-tail recovery the r5 artifact
+# needs) — must exit 0 — then run the sentinel suite, whose
+# seeded-regression fixture proves the gate exits NONZERO on a real
+# regression. CI runs this on every push.
+regress:
+	JAX_PLATFORMS=cpu $(PYTHON) -m veles_tpu observe regress \
+		BENCH_r05.json BENCH_r05.json
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_regress.py -q
 
 entry:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import jax, __graft_entry__ as g; \

@@ -1,0 +1,281 @@
+"""Flight-recorder tests (docs/observability.md): the always-on
+bounded ring, atomic black-box dumps, and the trigger wiring — an
+injected breaker trip must leave a loadable dump holding the trip's
+spans and dispatch tail (the ISSUE acceptance), plus the epoch-fence,
+unit-exception and SIGTERM paths and the ``observe blackbox`` CLI."""
+
+import json
+import os
+import signal
+
+import numpy
+import pytest
+
+from veles_tpu.core.config import root
+from veles_tpu.observe.flight import (FlightRecorder, blackbox_main,
+                                      get_flight_recorder,
+                                      install_signal_handlers,
+                                      load_dump)
+
+
+@pytest.fixture
+def flight_home(tmp_path, monkeypatch):
+    """Point the dump dir at tmp and hand out a FRESH global recorder,
+    restoring the shared one afterwards (other suites' notes must not
+    leak into these asserts)."""
+    import veles_tpu.observe.flight as flight_mod
+
+    monkeypatch.setattr(root.common.dirs, "run", str(tmp_path / "run"))
+    recorder = FlightRecorder()
+    monkeypatch.setattr(flight_mod, "_flight", recorder)
+    return recorder, str(tmp_path / "run")
+
+
+class TestRing:
+    def test_bounded_drop_oldest(self):
+        recorder = FlightRecorder(capacity=10)
+        for i in range(25):
+            recorder.note("tick", i=i)
+        entries = recorder.entries()
+        assert len(entries) == 10
+        assert [e["i"] for e in entries] == list(range(15, 25))
+
+    def test_disabled_recorder_records_nothing(self):
+        recorder = FlightRecorder(enabled=False)
+        recorder.note("tick")
+        recorder.note_span({"name": "x"})
+        assert recorder.entries() == []
+
+    def test_entries_carry_stamps_and_kind(self):
+        recorder = FlightRecorder()
+        recorder.note("dispatch", chunk=4)
+        (entry,) = recorder.entries()
+        assert entry["kind"] == "dispatch" and entry["chunk"] == 4
+        assert "t" in entry and "mono" in entry
+
+    def test_spans_land_in_the_ring_when_tracing(self, flight_home,
+                                                 monkeypatch):
+        """Span._record feeds the black box beside the EventRecorder,
+        whatever recorder instance is active."""
+        from veles_tpu.core.logger import EventRecorder
+        from veles_tpu.core import logger as logger_mod
+        from veles_tpu.observe.tracing import Tracer
+        import veles_tpu.observe.tracing as tracing_mod
+
+        recorder, _ = flight_home
+        monkeypatch.setattr(logger_mod, "_event_recorder",
+                            EventRecorder())
+        tracer = Tracer(enabled=True)
+        monkeypatch.setattr(tracing_mod, "_tracer", tracer)
+        with tracer.span("serve.request", rid=7):
+            pass
+        kinds = [(e["kind"], e.get("name"), e.get("etype"))
+                 for e in recorder.entries()]
+        assert ("span", "serve.request", "begin") in kinds
+        assert ("span", "serve.request", "end") in kinds
+
+
+class TestDump:
+    def test_dump_is_atomic_and_loadable(self, flight_home):
+        recorder, run_dir = flight_home
+        recorder.note("dispatch", chunk=2)
+        path = recorder.dump("testing", extra={"k": "v"})
+        assert path and os.path.dirname(path) == run_dir
+        assert not [n for n in os.listdir(run_dir) if ".tmp" in n]
+        doc = load_dump(path)
+        assert doc["schema"] == 1 and doc["reason"] == "testing"
+        assert doc["extra"] == {"k": "v"}
+        assert doc["entries"][-1]["kind"] == "dispatch"
+        assert recorder.last_dump_path == path
+        assert recorder.dumps == 1
+
+    def test_dump_includes_live_registry_snapshot(self, flight_home,
+                                                  monkeypatch):
+        from veles_tpu.observe import metrics as metrics_mod
+        from veles_tpu.observe.metrics import MetricsRegistry
+
+        recorder, _ = flight_home
+        registry = MetricsRegistry(enabled=True)
+        registry.incr("veles_boxed_total", 3)
+        monkeypatch.setattr(metrics_mod, "_registry", registry)
+        doc = load_dump(recorder.dump("with-metrics"))
+        assert ["veles_boxed_total", "counter", [], 3] \
+            in doc["metrics"]
+
+    def test_dump_is_reentrant_from_the_same_thread(self, flight_home):
+        """A repeated SIGTERM re-enters dump() on the main thread while
+        a dump is in flight — the lock must be re-entrant or the
+        process hangs instead of dumping and dying."""
+        recorder, _ = flight_home
+        with recorder._dump_lock:  # simulate mid-dump state
+            path = recorder.dump("nested")
+        assert path is not None
+        assert load_dump(path)["reason"] == "nested"
+
+    def test_dump_failure_is_warned_once_not_raised(self, flight_home,
+                                                    monkeypatch):
+        recorder, _ = flight_home
+        monkeypatch.setattr(root.common.dirs, "run",
+                            "/proc/definitely/not/writable")
+        assert recorder.dump("doomed") is None
+        assert recorder.dump("doomed-again") is None  # silent now
+        assert recorder.dumps == 0
+
+
+class TestTriggers:
+    @pytest.fixture
+    def model(self):
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(0)
+        params = init_transformer_params(rng, 2, 16, 4, 11)
+        table = jnp.asarray(
+            rng.randn(11, 16).astype(numpy.float32) * 0.3)
+        return params, table, 4
+
+    def test_breaker_trip_dumps_spans_and_dispatch_tail(
+            self, model, flight_home, monkeypatch):
+        """The acceptance criterion: an injected breaker trip produces
+        a loadable black-box dump containing the trip's spans and the
+        dispatch tail that led to it."""
+        import urllib.request
+        import veles_tpu.parallel.decode as decode_mod
+        from veles_tpu.core.logger import EventRecorder
+        from veles_tpu.core import logger as logger_mod
+        from veles_tpu.observe.tracing import get_tracer
+        from veles_tpu.serving import GenerateAPI
+
+        recorder, _ = flight_home
+        monkeypatch.setattr(logger_mod, "_event_recorder",
+                            EventRecorder())
+        tracer = get_tracer()
+        was_traced = tracer.enabled
+        tracer.enable()
+        params, table, heads = model
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=4, chunk=2, port=0)
+        api.start()
+        real = decode_mod.slot_step_many
+
+        def injected(*args, **kwargs):
+            raise RuntimeError("injected device failure")
+
+        try:
+            monkeypatch.setattr(decode_mod, "slot_step_many", injected)
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/generate" % api.port,
+                data=json.dumps({"tokens": [1, 2]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 503  # shed, retryable
+        finally:
+            monkeypatch.setattr(decode_mod, "slot_step_many", real)
+            api.stop()
+            tracer.enabled = was_traced
+        path = recorder.last_dump_path
+        assert path is not None, "breaker trip produced no dump"
+        doc = load_dump(path)
+        assert doc["reason"] == "breaker_trip"
+        assert "injected device failure" in doc["extra"]["error"]
+        kinds = [e["kind"] for e in doc["entries"]]
+        # the dispatch tail: the admit dispatch that preceded the trip
+        assert "admit" in kinds
+        assert kinds[-1] == "breaker.trip"
+        # the trip's spans: the request's serving spans are in the ring
+        span_names = {e.get("name") for e in doc["entries"]
+                      if e["kind"] == "span"}
+        assert "serve.request" in span_names
+        assert "serve.submit" in span_names
+
+    def test_unhandled_unit_exception_dumps(self, flight_home):
+        from veles_tpu.dummy import DummyWorkflow
+
+        recorder, _ = flight_home
+        wf = DummyWorkflow(name="boom-wf")
+        wf.on_error(RuntimeError("unit exploded"), None)
+        doc = load_dump(recorder.last_dump_path)
+        assert doc["reason"] == "unit_exception"
+        assert "unit exploded" in doc["extra"]["error"]
+        assert doc["extra"]["workflow"] == "boom-wf"
+
+    def test_stale_epoch_fence_dumps(self, flight_home):
+        from veles_tpu.fleet.ledger import (FENCE_DUPLICATE,
+                                            FENCE_STALE_EPOCH,
+                                            JobLedger)
+        from veles_tpu.fleet.server import Server
+
+        recorder, _ = flight_home
+        server = Server.__new__(Server)
+        server.ledger = JobLedger()
+        server.epoch = "epoch-2"
+        # non-stale verdicts only note (the ring keeps them for a later
+        # dump); the stale-epoch zombie dumps immediately
+        server._note_fence(FENCE_DUPLICATE, "slave-1", 7)
+        assert recorder.last_dump_path is None
+        server._note_fence(FENCE_STALE_EPOCH, "slave-1", 7)
+        doc = load_dump(recorder.last_dump_path)
+        assert doc["reason"] == "epoch_fence"
+        assert doc["extra"]["slave"] == "slave-1"
+        kinds = [(e["kind"], e.get("verdict")) for e in doc["entries"]]
+        assert ("fleet.fence", FENCE_DUPLICATE) in kinds
+        assert ("fleet.fence", FENCE_STALE_EPOCH) in kinds
+
+    def test_sigterm_dumps_and_chains_previous_handler(
+            self, flight_home):
+        recorder, _ = flight_home
+        chained = []
+        original = signal.signal(signal.SIGTERM,
+                                 lambda s, f: chained.append(s))
+        try:
+            previous = install_signal_handlers()
+            assert signal.SIGTERM in previous
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert chained == [signal.SIGTERM]
+            doc = load_dump(recorder.last_dump_path)
+            assert doc["reason"] == "sigterm"
+            assert doc["entries"][-1]["kind"] == "signal"
+        finally:
+            signal.signal(signal.SIGTERM, original)
+
+
+class TestBlackboxCLI:
+    def test_single_dump_summary(self, flight_home, capsys):
+        recorder, _ = flight_home
+        recorder.note("dispatch", chunk=8)
+        path = recorder.dump("testing")
+        assert blackbox_main(path, tail=5) == 0
+        out = capsys.readouterr().out
+        assert "reason: testing" in out
+        assert "dispatch" in out
+
+    def test_directory_listing_newest_first(self, flight_home, capsys):
+        recorder, run_dir = flight_home
+        first = recorder.dump("older")
+        second = recorder.dump("newer")
+        os.utime(first, (1, 1))
+        assert blackbox_main(run_dir) == 0
+        out = capsys.readouterr().out
+        assert out.index(second) < out.index(first)
+
+    def test_empty_directory_exits_one(self, flight_home, capsys):
+        _, run_dir = flight_home
+        os.makedirs(run_dir, exist_ok=True)
+        assert blackbox_main(run_dir) == 1
+        assert "no black-box dumps" in capsys.readouterr().out
+
+    def test_garbage_file_exits_one(self, tmp_path, capsys):
+        bad = tmp_path / "not-a-dump.json"
+        bad.write_text("{]")
+        assert blackbox_main(str(bad)) == 1
+        assert "cannot load" in capsys.readouterr().out
+
+    def test_observe_cli_routes_blackbox(self, flight_home, capsys):
+        from veles_tpu.observe.trace_export import main as observe_main
+
+        recorder, _ = flight_home
+        path = recorder.dump("via-cli")
+        assert observe_main(["blackbox", path]) == 0
+        assert "via-cli" in capsys.readouterr().out

@@ -203,6 +203,188 @@ class TestMetricsRegistry:
         assert 'slave="slave-1"' in text
 
 
+def _assert_valid_exposition(text):
+    """Every scrape must be a parseable exposition: sample lines match
+    the format, and each histogram's cumulative buckets are monotone
+    with +Inf equal to the count — under ANY interleaving with
+    writers."""
+    import re
+
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+        r'(-?[0-9.eE+]+|[+-]Inf|NaN)$')
+    buckets = {}  # (name, label-prefix) -> [counts...]
+    counts = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE ")), line
+            continue
+        assert sample_re.match(line), "unparseable sample: %r" % line
+        name = line.split("{")[0].split(" ")[0]
+        if name.endswith("_bucket"):
+            labels = line[len(name):line.rindex("}") + 1]
+            series = re.sub(r',?le="[^"]*"', "", labels)
+            buckets.setdefault((name, series), []).append(
+                int(line.rsplit(" ", 1)[1]))
+        elif name.endswith("_count"):
+            series = line[len(name):].rsplit(" ", 1)[0]
+            counts[(name[:-len("_count")], series)] = int(
+                line.rsplit(" ", 1)[1])
+    for (name, series), values in buckets.items():
+        assert values == sorted(values), \
+            "non-monotone buckets for %s%s: %r" % (name, series, values)
+        total = counts.get((name[:-len("_bucket")], series))
+        if total is not None:
+            assert values[-1] == total, (name, series, values, total)
+
+
+class TestConcurrentScrape:
+    """ISSUE 5 satellite: N writer threads hammering counters, gauges
+    and histograms while M scrapers read must yield a parseable
+    exposition with monotone cumulative buckets on EVERY scrape — the
+    registry's one lock is the whole consistency story and this is the
+    test that would catch a torn histogram slot."""
+
+    def test_scrapes_stay_consistent_under_mutation(self):
+        registry = MetricsRegistry(enabled=True)
+        stop = threading.Event()
+        failures = []
+        writes = [0] * 4
+
+        def writer(i):
+            while not stop.is_set():
+                registry.incr("veles_cw_total",
+                              labels={"w": str(i % 2)})
+                registry.observe("veles_cw_seconds", 0.003 * (i + 1),
+                                 buckets=(0.005, 0.01, 0.05))
+                registry.set("veles_cw_gauge", i,
+                             labels={"w": str(i)})
+                writes[i] += 1
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    _assert_valid_exposition(registry.expose())
+                except AssertionError as exc:
+                    failures.append(exc)
+                    stop.set()
+                    return
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(4)]
+        threads += [threading.Thread(target=scraper) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not failures, failures[0]
+        # quiesced, the totals are exact: nothing was lost or torn
+        text = registry.expose()
+        _assert_valid_exposition(text)
+        assert "veles_cw_seconds_count %d" % sum(writes) in text
+        total = sum(writes)
+        got = sum(int(line.rsplit(" ", 1)[1])
+                  for line in text.splitlines()
+                  if line.startswith("veles_cw_total{"))
+        assert got == total
+
+
+class TestMetricNamingLint:
+    """ISSUE 5 satellite: pin the veles_* token conventions at the
+    SOURCE level so a new gauge cannot silently break Prometheus
+    scrapers — every literal metric name in the package must be a
+    valid exposition token, counters must end _total, histograms must
+    end _seconds, and literal label keys must be valid (and never the
+    reserved ``le``)."""
+
+    COUNTER_METHODS = {"incr", "counter_set"}
+    HISTOGRAM_METHODS = {"observe"}
+    GAUGE_METHODS = {"set"}
+
+    @staticmethod
+    def _metric_calls():
+        import ast
+        import glob
+
+        package = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "veles_tpu")
+        calls = []
+        for path in glob.glob(os.path.join(package, "**", "*.py"),
+                              recursive=True):
+            tree = ast.parse(open(path).read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                method = node.func.attr
+                if method not in {"incr", "counter_set", "set",
+                                  "observe"}:
+                    continue
+                if not node.args \
+                        or not isinstance(node.args[0], ast.Constant) \
+                        or not isinstance(node.args[0].value, str):
+                    continue
+                name = node.args[0].value
+                if not name.startswith("veles_"):
+                    continue
+                labels = []
+                for keyword in node.keywords:
+                    if keyword.arg == "labels" \
+                            and isinstance(keyword.value, ast.Dict):
+                        for key in keyword.value.keys:
+                            if isinstance(key, ast.Constant):
+                                labels.append(key.value)
+                calls.append((path, node.lineno, method, name, labels))
+        return calls
+
+    def test_conventions_hold_everywhere(self):
+        from veles_tpu.observe.metrics import (LABEL_NAME_RE,
+                                               METRIC_NAME_RE)
+        import re
+
+        calls = self._metric_calls()
+        # the instrumented families must actually be in the scan —
+        # an empty scan would "pass" vacuously
+        names = {name for _, _, _, name, _ in calls}
+        assert "veles_serving_requests_total" in names
+        assert "veles_xla_compiles_total" in names
+        assert "veles_device_memory_bytes" in names
+        token = re.compile(r"^veles_[a-z][a-z0-9_]*$")
+        problems = []
+        for path, line, method, name, labels in calls:
+            where = "%s:%d" % (os.path.basename(path), line)
+            if not METRIC_NAME_RE.match(name) or not token.match(name):
+                problems.append("%s: %r is not a valid lowercase "
+                                "metric token" % (where, name))
+            if method in self.COUNTER_METHODS \
+                    and not name.endswith("_total"):
+                problems.append("%s: counter %r must end _total"
+                                % (where, name))
+            if method in self.HISTOGRAM_METHODS \
+                    and not name.endswith("_seconds"):
+                problems.append("%s: histogram %r must end _seconds"
+                                % (where, name))
+            if method in self.GAUGE_METHODS \
+                    and name.endswith(("_total", "_seconds")):
+                problems.append("%s: gauge %r carries a counter/"
+                                "histogram suffix" % (where, name))
+            for label in labels:
+                if not isinstance(label, str) \
+                        or not LABEL_NAME_RE.match(label) \
+                        or label == "le" \
+                        or label.startswith("__"):
+                    problems.append("%s: bad label key %r on %r"
+                                    % (where, label, name))
+        assert not problems, "\n".join(problems)
+
+
 class TestOverheadGuard:
     """The `make metrics` guard (ISSUE satellite): disabled-path
     span()/incr() must be structural no-ops so observability can never
@@ -243,6 +425,129 @@ class TestOverheadGuard:
         dec.submit([1, 2])
         dec.run_until_drained(max_steps=8)
         assert dec.metrics._families == {}
+
+    def test_flight_default_on_path_stays_structurally_noop(self):
+        """The always-on flight recorder must pass the SAME guard: the
+        decoder's default-on notes touch neither the registry nor the
+        tracer, ring memory is bounded by maxlen, and a note is one
+        flag check + append (no locks, no I/O)."""
+        from veles_tpu.observe.flight import FlightRecorder
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(0)
+        params = init_transformer_params(rng, 1, 8, 2, 7)
+        table = jnp.asarray(rng.randn(7, 8).astype(numpy.float32))
+        dec = ContinuousDecoder(params, table, 2, slots=1, max_len=32,
+                                n_tokens=2)
+        dec._tracer = Tracer(enabled=False)
+        dec.metrics = MetricsRegistry(enabled=False)
+        dec.flight = FlightRecorder(capacity=4)  # default-ON
+        dec.submit([1, 2])
+        dec.run_until_drained(max_steps=8)
+        # the ring recorded the dispatch path...
+        kinds = {e["kind"] for e in dec.flight.entries()}
+        assert "admit" in kinds
+        # ...bounded, and with ZERO registry/tracer traffic
+        assert len(dec.flight.entries()) <= 4
+        assert dec.metrics._families == {}
+
+    def test_instrument_disabled_tracker_is_pure_delegation(self):
+        from veles_tpu.observe.xla_stats import (CompileTracker,
+                                                 instrument)
+        import veles_tpu.observe.xla_stats as xla_stats_mod
+        import jax
+        import jax.numpy as jnp
+
+        saved = xla_stats_mod._tracker
+        tracker = CompileTracker(enabled=False)
+        xla_stats_mod._tracker = tracker
+        try:
+            fn = instrument("veles_test_prog",
+                            jax.jit(lambda x: x + 1))
+            out = fn(jnp.ones(3))
+            assert float(out.sum()) == 6.0
+            assert tracker._compiles == {} and tracker._hits == {}
+        finally:
+            xla_stats_mod._tracker = saved
+
+    def test_instrument_non_jit_callable_returned_unwrapped(self):
+        from veles_tpu.observe.xla_stats import instrument
+
+        def plain(x):
+            return x
+
+        assert instrument("veles_test_plain", plain) is plain
+
+
+class TestCompileTracker:
+    def test_compiles_hits_and_flops_book_per_program(self):
+        from veles_tpu.observe.xla_stats import CompileTracker, instrument
+        import veles_tpu.observe.xla_stats as xla_stats_mod
+        import jax
+        import jax.numpy as jnp
+
+        saved = xla_stats_mod._tracker
+        tracker = CompileTracker(enabled=True)
+        xla_stats_mod._tracker = tracker
+        try:
+            fn = instrument("prog", jax.jit(lambda x: x * 2.0))
+            fn(jnp.ones(4))          # compile (shape 1)
+            fn(jnp.ones(4))          # hit
+            fn(jnp.ones(8))          # compile (shape 2)
+            assert tracker._compiles == {"prog": 2}
+            assert tracker._hits == {"prog": 1}
+            assert tracker._compile_seconds["prog"] > 0
+            # Lowered.cost_analysis FLOPs: 8 for the second shape
+            assert tracker._flops["prog"] == 8.0
+        finally:
+            xla_stats_mod._tracker = saved
+
+    def test_recompilation_storm_detected_and_warned_once(self, caplog):
+        import logging
+
+        from veles_tpu.observe.xla_stats import CompileTracker
+
+        tracker = CompileTracker(enabled=True)
+        with caplog.at_level(logging.WARNING, logger="CompileTracker"):
+            for _ in range(2 * tracker.STORM_THRESHOLD):
+                tracker.record_compile("churner", 0.01)
+        assert tracker._storms == {"churner": 2}
+        warnings = [r for r in caplog.records
+                    if "recompilation storm" in r.getMessage()]
+        assert len(warnings) == 1  # warn-once, counter keeps counting
+
+    def test_mfu_published_from_flops_and_step_ema(self):
+        from veles_tpu.core.config import root
+        from veles_tpu.observe.xla_stats import CompileTracker
+
+        tracker = CompileTracker(enabled=True)
+        tracker.set_program_flops("prog", 2e9)
+        tracker.observe_step("prog", 0.01)  # 200 GFLOP/s
+        saved = root.common.observe.get("peak_tflops", None)
+        root.common.observe.peak_tflops = 1.0  # 1 TFLOP/s peak
+        try:
+            registry = MetricsRegistry(enabled=True)
+            tracker.publish(registry)
+            text = registry.expose()
+            assert 'veles_xla_program_flops{program="prog"} 2000000000' \
+                in text
+            assert 'veles_mfu_ratio{program="prog"} 0.2' in text
+        finally:
+            root.common.observe.peak_tflops = saved
+
+    def test_device_memory_gauges_exist_on_every_backend(self):
+        from veles_tpu.observe.xla_stats import publish_device_stats
+
+        registry = MetricsRegistry(enabled=True)
+        publish_device_stats(registry)
+        text = registry.expose()
+        # CPU has no allocator report: the live-bytes fallback still
+        # gives the family (TPU reports bytes_in_use/peak/limit)
+        assert "veles_device_memory_bytes" in text
+        assert 'kind="' in text
 
 
 class TestEventRecorderBuffer:
@@ -487,6 +792,60 @@ class TestServingObservability:
         # the request span is the direct child of the client context
         for span_id in by_name["serve.request"]:
             assert tree[span_id] == client_trace[1]
+
+    def test_metrics_expose_device_truth(self, observability):
+        """The ISSUE acceptance: /metrics on GenerateAPI exposes
+        compile-count, device-memory and MFU gauges — fed by real
+        compiles of the slot programs and the driver's chunk cadence,
+        not hand-planted samples. A DISTINCT model shape guarantees
+        fresh compiles even when earlier suites warmed the jit caches
+        for the shared toy model."""
+        from veles_tpu.core.config import root
+        from veles_tpu.observe.xla_stats import get_compile_tracker
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        from veles_tpu.serving import GenerateAPI
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(3)
+        heads, embed, vocab = 2, 12, 13
+        params = init_transformer_params(rng, 1, embed, heads, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+        tracker = get_compile_tracker()
+        was_tracking = tracker.enabled
+        tracker.reset()
+        saved_peak = root.common.observe.get("peak_tflops", None)
+        # CPU is not in the peak table; the override supplies the MFU
+        # denominator (the knob unlisted devices use)
+        root.common.observe.peak_tflops = 0.001
+        api = GenerateAPI(params, table, heads, slots=2, max_len=64,
+                          n_tokens=6, chunk=2, port=0)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            body, _ = post(url + "/generate", {"tokens": [1, 2, 3, 4]})
+            assert len(body["tokens"]) == 6
+            metrics = get(url + "/metrics")
+            # compile counts per slot program
+            assert 'veles_xla_compiles_total{program="decode.admit"}' \
+                in metrics
+            assert ('veles_xla_compiles_total'
+                    '{program="decode.dispatch"}') in metrics
+            assert "veles_xla_compile_seconds_total" in metrics
+            # device memory (live-bytes fallback on CPU)
+            assert "veles_device_memory_bytes" in metrics
+            # online MFU: cost_analysis FLOPs over the chunk cadence
+            assert ('veles_xla_program_flops'
+                    '{program="decode.dispatch"}') in metrics
+            assert 'veles_mfu_ratio{program="decode.dispatch"}' \
+                in metrics
+            assert "veles_device_peak_bf16_tflops 0.001" in metrics
+        finally:
+            api.stop()
+            tracker.reset()
+            tracker.enabled = was_tracking
+            root.common.observe.peak_tflops = saved_peak
 
     def test_restful_api_mounts_metrics(self, observability):
         from veles_tpu.dummy import DummyWorkflow

@@ -43,6 +43,101 @@ class TestGemm:
             numpy.testing.assert_allclose(out, a @ b, rtol=1e-4)
 
 
+class TestAutotuneCacheHygiene:
+    """ISSUE 5 satellite (VERDICT r5 #3): the autotune cache must
+    reject physically impossible entries — the two-length slope
+    estimator can go negative under tunnel jitter, and a persisted
+    negative timing gated a product matmul on a measurement that never
+    happened."""
+
+    @pytest.fixture
+    def cache_file(self, tmp_path, monkeypatch):
+        from veles_tpu.core.config import root
+        from veles_tpu.ops import gemm
+
+        path = str(tmp_path / "pallas_tuning.json")
+        monkeypatch.setattr(root.common.engine, "pallas_autotune_cache",
+                            path, raising=False)
+        monkeypatch.setattr(gemm, "_tuning_cache", None, raising=False)
+        monkeypatch.setattr(gemm, "_insane_warned", False,
+                            raising=False)
+        return path
+
+    def test_poisoned_rows_dropped_at_load_and_file_cleaned(
+            self, cache_file, caplog):
+        import json
+        import logging
+
+        from veles_tpu.ops import gemm
+
+        # the literal r5 artifact shape: a negative xla_seconds beside
+        # healthy rows
+        poisoned = {
+            "bfloat16:10": {"blocks": [256, 256, 512],
+                            "seconds": 9.4e-05,
+                            "xla_seconds": -0.000107,
+                            "beats_xla": True},
+            "bfloat16:11": {"blocks": [512, 512, 512],
+                            "seconds": 2e-4, "xla_seconds": 3e-4,
+                            "beats_xla": True},
+            "int8:1024x4096": {"use_pallas": True, "block_n": 512,
+                               "seconds": 0.0},
+        }
+        with open(cache_file, "w") as fout:
+            json.dump(poisoned, fout)
+        with caplog.at_level(logging.WARNING, logger="gemm.autotune"):
+            cache = gemm._load_cache()
+        assert set(cache) == {"bfloat16:11"}
+        # the artifact on disk is cleaned too — it stops advertising
+        # the impossible measurement
+        assert set(json.load(open(cache_file))) == {"bfloat16:11"}
+        warnings = [r for r in caplog.records
+                    if "physically impossible" in r.getMessage()]
+        assert len(warnings) == 1  # warn-once
+
+    def test_dropped_bucket_retunes_as_default(self, cache_file):
+        import json
+
+        from veles_tpu.ops import gemm
+
+        with open(cache_file, "w") as fout:
+            json.dump({"bfloat16:10": {"blocks": [128, 128, 512],
+                                       "seconds": -1.0,
+                                       "beats_xla": True}}, fout)
+        # the poisoned verdict must not engage the kernel...
+        a = jnp.ones((1024, 1024), jnp.bfloat16)
+        assert gemm._tuned_beats_xla(a, a) is False
+        # ...and the block lookup falls back to the defaults
+        assert gemm._tuned_blocks(1024, 1024, 1024, "bfloat16") \
+            == gemm._DEFAULT_BLOCKS
+
+    def test_persist_rejects_insane_rows(self, cache_file):
+        import json
+
+        from veles_tpu.ops import gemm
+
+        gemm._persist_cache({
+            "good": {"blocks": [1, 1, 1], "seconds": 1e-4,
+                     "xla_seconds": 2e-4, "beats_xla": True},
+            "negative": {"blocks": [1, 1, 1], "seconds": -1e-4},
+            "zero": {"blocks": [1, 1, 1], "seconds": 0.0},
+            "nan": {"blocks": [1, 1, 1], "seconds": float("nan")},
+            "inf": {"blocks": [1, 1, 1], "xla_seconds": float("inf")},
+            "not-a-dict": 7,
+        })
+        assert set(json.load(open(cache_file))) == {"good"}
+
+    def test_sane_entry_predicate(self):
+        from veles_tpu.ops import gemm
+
+        assert gemm._sane_entry({"seconds": 1e-5, "xla_seconds": 2e-5})
+        assert gemm._sane_entry({"blocks": [1, 2, 3]})  # no timings
+        assert not gemm._sane_entry({"seconds": -1e-5})
+        assert not gemm._sane_entry({"xla_seconds": 0})
+        assert not gemm._sane_entry({"seconds": True})
+        assert not gemm._sane_entry([1, 2])
+
+
 class TestActivations:
     @pytest.mark.parametrize("name", list(activations.ACTIVATIONS))
     def test_deriv_matches_autodiff(self, name):

@@ -383,6 +383,11 @@ class Main(Logger):
         args = parser.parse_args(argv)
         import logging
         setup_logging(level=logging.DEBUG if args.verbose else logging.INFO)
+        # black box on SIGTERM (observe/flight.py): an orchestrator
+        # killing this run leaves the last spans/dispatches on disk —
+        # CLI runs only, library embedders keep their own signal policy
+        from veles_tpu.observe.flight import install_signal_handlers
+        install_signal_handlers()
         if args.coordinator:
             # BEFORE the workflow module import (whose jax use would
             # initialize the backend single-process)

@@ -123,6 +123,10 @@ def serve_metrics(handler, registry=None):
         from veles_tpu.observe.metrics import get_metrics_registry
         registry = get_metrics_registry()
     registry.enable()  # scrapeable == enabled, as documented
+    # device truth rides every mounted surface: the compile tracker
+    # turns on and the XLA/memory/MFU collector attaches (idempotent)
+    from veles_tpu.observe.xla_stats import ensure_registered
+    ensure_registered(registry)
     reply(handler, registry.expose(),
           content_type="text/plain; version=0.0.4; charset=utf-8")
     return True
@@ -131,9 +135,12 @@ def serve_metrics(handler, registry=None):
 def enable_metrics():
     """Turn the process-global registry on (idempotent); every HTTP
     surface calls this at start so its counters accumulate from the
-    first request, not the first scrape."""
+    first request, not the first scrape. Also enables the device-truth
+    plane (compile tracking, memory/MFU gauges — observe/xla_stats.py)
+    so a scrape of any surface sees what the chip is doing."""
     from veles_tpu.observe.metrics import get_metrics_registry
-    return get_metrics_registry().enable()
+    from veles_tpu.observe.xla_stats import ensure_registered
+    return ensure_registered(get_metrics_registry().enable())
 
 
 def start_server(handler_cls, host="127.0.0.1", port=0, name="httpd"):

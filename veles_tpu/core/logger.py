@@ -92,6 +92,11 @@ class Logger:
         assert etype in ("begin", "end", "single"), etype
         get_event_recorder().record(
             name=name, etype=etype, source=type(self).__name__, **attrs)
+        # the always-on black box keeps the last events too; lazy
+        # import — observe.tracing imports THIS module at its top
+        from veles_tpu.observe.flight import get_flight_recorder
+        get_flight_recorder().note("event", name=name, etype=etype,
+                                   source=type(self).__name__)
 
 
 _setup_done = False

@@ -240,8 +240,15 @@ class Workflow(Container):
 
     def on_error(self, exc, tb):
         """Worker exception: stop everything (reference thread-pool errback
-        semantics, ``thread_pool.py:59-68``)."""
+        semantics, ``thread_pool.py:59-68``). The flight recorder dumps
+        its black box first — an unhandled unit exception is exactly
+        the moment the last spans/dispatches are worth keeping (lazy
+        import: observe.tracing imports this package at its top)."""
         self._sync_error_ = (exc, tb)
+        from veles_tpu.observe.flight import get_flight_recorder
+        get_flight_recorder().dump(
+            "unit_exception",
+            extra={"error": repr(exc), "workflow": self.name})
         self.on_workflow_finished()
 
     def on_workflow_finished(self):

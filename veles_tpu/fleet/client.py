@@ -273,7 +273,13 @@ class Client(Logger):
                 if registry.enabled:
                     # piggyback this slave's counter/gauge snapshot so
                     # the master's /metrics aggregates the whole fleet
-                    # without another connection or scrape schedule
+                    # without another connection or scrape schedule;
+                    # the device-truth collector rides along — the
+                    # master re-exports each slave's compile counts
+                    # and memory gauges under its slave label
+                    from veles_tpu.observe.xla_stats import (
+                        ensure_registered)
+                    ensure_registered(registry)
                     frame["metrics"] = [
                         list(row) for row in registry.snapshot()]
                 await self._write(writer, frame, shm_threshold=shm_thr)

@@ -24,10 +24,11 @@ import uuid
 
 from veles_tpu.core.config import root
 from veles_tpu.core.logger import Logger
-from veles_tpu.fleet.ledger import JobLedger
+from veles_tpu.fleet.ledger import FENCE_STALE_EPOCH, JobLedger
 from veles_tpu.fleet.protocol import (
     COMPRESS_THRESHOLD, ProtocolError, machine_id, read_frame,
     resolve_secret, write_frame)
+from veles_tpu.observe.flight import get_flight_recorder
 from veles_tpu.observe.metrics import bridge, publish_fleet
 from veles_tpu.observe.tracing import get_tracer, parse_trace_field
 
@@ -425,6 +426,7 @@ class Server(Logger):
         if verdict is not None:
             self.warning("fenced update from %s: %s (job_id=%r)",
                          slave.id, verdict, msg.get("job_id"))
+            self._note_fence(verdict, slave.id, msg.get("job_id"))
             # still ack (flagged) so a sync slave doesn't stall — it
             # skips the job_request for fenced acks
             await write_frame(writer, {"type": "update_ack",
@@ -461,6 +463,30 @@ class Server(Logger):
         if msg.get("epoch") != self.epoch:
             return self.ledger.count_stale_epoch()
         return self.ledger.settle(msg.get("job_id"), slave.id)
+
+    def _note_fence(self, verdict, sid, job_id):
+        """Fence verdicts go to the black box; a STALE-EPOCH fence —
+        a zombie answering a previous master incarnation — dumps it,
+        because by then the interesting history is about to scroll out
+        of the ring (docs/observability.md). Dumped ONCE per slave: a
+        zombie replaying stale frames must not turn each one into
+        synchronous dump I/O on the event loop (later frames still
+        note into the ring)."""
+        flight = get_flight_recorder()
+        flight.note("fleet.fence", verdict=verdict, slave=sid,
+                    job_id=job_id)
+        if verdict != FENCE_STALE_EPOCH:
+            return
+        dumped = getattr(self, "_fence_dumped_", None)
+        if dumped is None:
+            dumped = self._fence_dumped_ = set()
+        if sid in dumped:
+            return
+        dumped.add(sid)
+        flight.dump("epoch_fence",
+                    extra={"slave": sid, "job_id": job_id,
+                           "epoch": self.epoch,
+                           "ledger": self.ledger.snapshot()})
 
     def _locked_apply(self, update, slave):
         with self._update_lock:

@@ -25,6 +25,7 @@ import jax
 
 from veles_tpu.core.units import Unit
 from veles_tpu.memory import Array
+from veles_tpu.observe.xla_stats import instrument
 
 
 class JitUnit(Unit):
@@ -58,7 +59,13 @@ class JitUnit(Unit):
             from veles_tpu.core.config import root
             if root.common.engine.get("force_cpu", False):
                 backend = "cpu"
-            self._jitted_ = jax.jit(self.compute, backend=backend)
+            # per-unit compile/hit telemetry (observe/xla_stats.py): a
+            # unit whose input shape churns every tick is the classic
+            # recompilation storm; the tracker names it so /metrics
+            # and the black box can point at the culprit
+            self._jitted_ = instrument(
+                "unit.%s" % type(self).__name__,
+                jax.jit(self.compute, backend=backend))
         return self._jitted_
 
     # -- slot plumbing --------------------------------------------------------

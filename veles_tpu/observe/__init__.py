@@ -13,13 +13,27 @@ Three coordinated parts (docs/observability.md):
   header and the fleet frames' ``trace`` field; exported to Chrome
   trace JSON by ``veles_tpu observe export-trace``;
 - :mod:`veles_tpu.observe.profile` — ``--profile-dir`` windows around
-  bench/serving with span-named ``jax.profiler.TraceAnnotation``s.
+  bench/serving with span-named ``jax.profiler.TraceAnnotation``s;
+- :mod:`veles_tpu.observe.xla_stats` — device truth: XLA compile/cache
+  counters with recompilation-storm detection, per-device memory
+  gauges, online MFU from ``cost_analysis`` FLOPs;
+- :mod:`veles_tpu.observe.flight` — the always-on bounded flight
+  recorder that dumps a black-box JSON on breaker trips, epoch fences,
+  unit exceptions and SIGTERM (``veles_tpu observe blackbox``);
+- :mod:`veles_tpu.observe.regress` — the artifact-proof bench sentinel:
+  incremental atomic BENCH writes with SHA-256 sidecars, and the
+  ``veles_tpu observe regress`` comparison gate (``make regress``).
 
 Everything is off by default with a structurally no-op fast path: the
 disabled tracer hands out one shared null span, the disabled registry
-returns before its lock — hot paths pay one attribute check.
+returns before its lock — hot paths pay one attribute check. The one
+exception is the flight recorder, which is ON by default but records
+only at the already-ms-scale dispatch/span sites with a bounded
+lock-free append (the overhead guard covers it too).
 """
 
+from veles_tpu.observe.flight import (  # noqa: F401
+    FlightRecorder, get_flight_recorder)
 from veles_tpu.observe.metrics import (  # noqa: F401
     DEFAULT_BUCKETS, MetricsRegistry, bridge, get_metrics_registry,
     publish_decoder, publish_fleet, publish_loader,
@@ -28,3 +42,6 @@ from veles_tpu.observe.tracing import (  # noqa: F401
     NULL_SPAN, TRACE_HEADER, Tracer, current_context,
     format_trace_header, get_tracer, parse_trace_field,
     parse_trace_header)
+from veles_tpu.observe.xla_stats import (  # noqa: F401
+    CompileTracker, ensure_registered, get_compile_tracker,
+    instrument, program_flops)

@@ -462,9 +462,10 @@ def publish_fleet(registry, server):
                              help="updates rejected by the fence")
     for row in status.get("slaves", []):
         sid = str(row.get("id"))
-        registry.counter_set("veles_fleet_slave_jobs_done", row.get(
-            "jobs_done", 0), labels={"slave": sid},
-            help="jobs completed per connected slave")
+        registry.counter_set("veles_fleet_slave_jobs_done_total",
+                             row.get("jobs_done", 0),
+                             labels={"slave": sid},
+                             help="jobs completed per connected slave")
         registry.set("veles_fleet_slave_power", row.get("power", 0.0),
                      labels={"slave": sid},
                      help="reported computing power per slave")

@@ -118,7 +118,9 @@ def span_tree(trace):
 
 
 def main(argv=None):
-    """``veles_tpu observe export-trace`` entry point."""
+    """``veles_tpu observe`` entry point: ``export-trace`` (Chrome
+    trace), ``blackbox`` (flight-recorder dumps) and ``regress`` (the
+    bench sentinel gate)."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -133,7 +135,36 @@ def main(argv=None):
                                        "enable_event_recording)")
     export.add_argument("-o", "--output", default=None,
                         help="output path (default: <events>.trace.json)")
+    blackbox = sub.add_parser(
+        "blackbox",
+        help="inspect flight-recorder black-box dumps (observe/"
+             "flight.py): a dump file, or a directory to list "
+             "(default: the run dir)")
+    blackbox.add_argument("path", nargs="?", default=None,
+                          help="dump file or directory")
+    blackbox.add_argument("--tail", type=int, default=20,
+                          help="ring entries to show from the newest "
+                               "dump (default 20)")
+    regress = sub.add_parser(
+        "regress",
+        help="compare two BENCH artifacts with spread-aware per-key "
+             "tolerances; exit 1 on regression (observe/regress.py)")
+    regress.add_argument("old", help="previous-round BENCH json")
+    regress.add_argument("new", help="candidate BENCH json")
+    regress.add_argument("--tolerance", type=float, default=0.1,
+                         help="base relative tolerance before the "
+                              "per-key spread allowance (default 0.1)")
+    regress.add_argument("--json", action="store_true",
+                         help="machine-readable findings")
     args = parser.parse_args(argv)
+    if args.command == "blackbox":
+        from veles_tpu.observe.flight import blackbox_main
+        return blackbox_main(args.path, tail=args.tail)
+    if args.command == "regress":
+        from veles_tpu.observe.regress import compare_main
+        return compare_main(args.old, args.new,
+                            tolerance=args.tolerance,
+                            as_json=args.json)
     out = args.output or args.events + ".trace.json"
     count = export_chrome_trace(args.events, out)
     print("wrote %d trace events to %s (open in ui.perfetto.dev)"

@@ -32,6 +32,7 @@ import time
 import uuid
 
 from veles_tpu.core.logger import get_event_recorder
+from veles_tpu.observe.flight import get_flight_recorder
 
 #: the serving trace header: "<trace_id>/<span_id>" (hex)
 TRACE_HEADER = "X-Veles-Trace"
@@ -105,11 +106,15 @@ class Span:
         return self
 
     def _record(self, etype):
-        get_event_recorder().record(
+        payload = dict(
             name=self.name, etype=etype, trace_id=self.trace_id,
             span_id=self.span_id, parent_id=self.parent_id,
             mono=time.monotonic(), tid=threading.get_ident(),
             pid=os.getpid(), **self.attrs)
+        get_event_recorder().record(**payload)
+        # the black box holds the last spans regardless of which
+        # EventRecorder instance is active (flight.py; bounded append)
+        get_flight_recorder().note_span(payload)
 
     def __enter__(self):
         self._token = _current.set(self)

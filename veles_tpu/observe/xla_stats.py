@@ -1,0 +1,483 @@
+"""Device-truth telemetry: XLA compile events, device memory, online MFU.
+
+PR 4 gave the stack host-side metrics and traces; this module closes the
+loop on what the COMPILER and the CHIP are actually doing — the
+reference VELES made device behavior first-class observable state
+(per-device benchmark kernels feeding fleet balancing, ``SURVEY.md``
+§2.2), and a production JAX serving stack treats recompilation storms
+and HBM pressure as primary SLO signals. Three coordinated parts:
+
+- **compile tracking**: :func:`instrument` wraps a jitted callable; each
+  call consults the jit cache size (``fn._cache_size()``) so a growing
+  cache books one compile (with its wall seconds and, via
+  ``Lowered.cost_analysis()``, the program's FLOPs) and a steady cache
+  books one hit. N compiles of the same program name inside a sliding
+  window is a *recompilation storm* — warned once per name, counted
+  forever (a shape-churning unit silently recompiling every tick is the
+  classic way a TPU run loses 100x throughput);
+- **device gauges**: :func:`publish_xla_stats` (a scrape-time collector,
+  like every other bridge) samples ``device.memory_stats()`` per local
+  device — bytes in use, peak, limit. Backends without an allocator
+  report (CPU) fall back to live-buffer accounting so the gauge family
+  exists everywhere;
+- **online MFU**: the tracked FLOPs of a program divided by its
+  observed step seconds (:meth:`CompileTracker.observe_step`, fed by
+  the serving driver's chunk cadence) against the device's published
+  bf16 peak — ``veles_mfu_ratio{program=...}`` on ``/metrics``, live,
+  not just in bench runs.
+
+Everything is disabled by default with the same structurally-no-op
+contract as the registry: an instrumented callable costs one attribute
+check until a ``/metrics`` surface is mounted
+(:func:`ensure_registered`, called by ``core/httpd.py``).
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+
+#: published peak dense-matmul throughput per chip (TFLOP/s), bf16 — the
+#: MXU's native precision and the honest MFU ceiling. ORDERED
+#: most-specific-first: substring matching must let "TPU v4 lite" (v4i)
+#: claim its own peak before the plain "TPU v4" entry does. The bench
+#: (``bench.py``) and the online MFU gauge share THIS one table.
+PEAK_BF16_TFLOPS = (
+    ("TPU v4 lite", 138.0),
+    ("TPU v4", 275.0),
+    ("TPU v5 lite", 197.0),
+    ("TPU v5e", 197.0),
+    ("TPU v5p", 459.0),
+    ("TPU v5", 459.0),
+    ("TPU v6 lite", 918.0),
+    ("TPU v6e", 918.0),
+)
+
+#: device.memory_stats() keys re-published as gauges (when present)
+_MEMORY_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+
+
+def peak_tflops(device_kind=None):
+    """The bf16 peak for ``device_kind`` (default: the first local
+    device), or ``root.common.observe.peak_tflops`` when set (the
+    override for unlisted chips — and for CPU test runs that want a
+    deterministic MFU denominator). None when unknown."""
+    from veles_tpu.core.config import root
+
+    override = root.common.observe.get("peak_tflops", None)
+    if override:
+        try:
+            return float(override)
+        except (TypeError, ValueError):
+            pass
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    for name, tflops in PEAK_BF16_TFLOPS:
+        if name.lower() in str(device_kind).lower():
+            return tflops
+    return None
+
+
+def abstractify(args, kwargs):
+    """Shape/dtype skeletons of a call's operands: arrays (or tracers)
+    become ``ShapeDtypeStruct``, everything else passes through — what
+    ``fn.lower`` needs to cost a program without touching (possibly
+    donated-and-deleted) buffers."""
+    import jax
+
+    def conv(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return (jax.tree.map(conv, args), jax.tree.map(conv, kwargs))
+
+
+def program_flops(fn, *args, **kwargs):
+    """FLOPs of ``fn``'s program for these operand shapes via
+    ``Lowered.cost_analysis()`` (no XLA compile — the lowering is a
+    trace). None when the backend/version can't say."""
+    try:
+        a_args, a_kwargs = abstractify(args, kwargs)
+        analysis = fn.lower(*a_args, **a_kwargs).cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = analysis.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+class CompileTracker:
+    """Thread-safe per-program compile/hit/storm/FLOPs/step bookkeeping.
+
+    Disabled (the default) the instrumented call sites cost one
+    attribute check. Enabled, each call pays one cheap C-level
+    ``_cache_size()`` read plus a lock on the (rare) compile path."""
+
+    #: a storm = this many compiles of the SAME program name...
+    STORM_THRESHOLD = 5
+    #: ...within this sliding window (seconds)
+    STORM_WINDOW = 60.0
+    #: step-seconds EMA weight of the newest observation
+    STEP_EMA = 0.2
+
+    def __init__(self, enabled=False):
+        self.enabled = enabled
+        #: compute program FLOPs (one extra trace) at each compile;
+        #: operators can turn it off for huge graphs
+        self.estimate_flops = True
+        self._lock = threading.Lock()
+        self._compiles = {}         # name -> count
+        self._compile_seconds = {}  # name -> total wall seconds
+        self._hits = {}             # name -> count
+        self._storms = {}           # name -> storm count
+        self._stamps = {}           # name -> deque of recent stamps
+        self._storm_warned = set()
+        self._flops = {}            # name -> latest program FLOPs
+        self._step_ema = {}         # name -> EMA of step seconds
+        self._step_count = {}       # name -> observations
+
+    def enable(self):
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def reset(self):
+        """Drop all state (test isolation); keeps the enabled flag."""
+        with self._lock:
+            for store in (self._compiles, self._compile_seconds,
+                          self._hits, self._storms, self._stamps,
+                          self._flops, self._step_ema,
+                          self._step_count):
+                store.clear()
+            self._storm_warned.clear()
+
+    # -- recording --------------------------------------------------------
+    def record_compile(self, name, seconds, flops=None):
+        warn = False
+        with self._lock:
+            self._compiles[name] = self._compiles.get(name, 0) + 1
+            self._compile_seconds[name] = \
+                self._compile_seconds.get(name, 0.0) + float(seconds)
+            if flops:
+                self._flops[name] = float(flops)
+            stamps = self._stamps.get(name)
+            if stamps is None:
+                stamps = self._stamps[name] = deque(
+                    maxlen=self.STORM_THRESHOLD)
+            now = time.monotonic()
+            stamps.append(now)
+            if len(stamps) == self.STORM_THRESHOLD \
+                    and now - stamps[0] <= self.STORM_WINDOW:
+                self._storms[name] = self._storms.get(name, 0) + 1
+                stamps.clear()  # re-arm: count whole storms, not tails
+                warn = name not in self._storm_warned
+                self._storm_warned.add(name)
+        if warn:
+            logging.getLogger("CompileTracker").warning(
+                "recompilation storm: %r compiled %d times within %.0fs "
+                "— a churning shape is defeating the jit cache "
+                "(reported once per program; veles_xla_recompile_"
+                "storms_total keeps counting)",
+                name, self.STORM_THRESHOLD, self.STORM_WINDOW)
+
+    def record_hit(self, name):
+        with self._lock:
+            self._hits[name] = self._hits.get(name, 0) + 1
+
+    def observe_step(self, name, seconds):
+        """Feed one measured step wall time for ``name`` (the serving
+        driver's chunk cadence); the MFU gauge divides the program's
+        FLOPs by this EMA."""
+        seconds = float(seconds)
+        if seconds <= 0:
+            return
+        with self._lock:
+            ema = self._step_ema.get(name)
+            self._step_ema[name] = seconds if ema is None else (
+                (1 - self.STEP_EMA) * ema + self.STEP_EMA * seconds)
+            self._step_count[name] = self._step_count.get(name, 0) + 1
+
+    def set_program_flops(self, name, flops):
+        """Pin a program's FLOPs explicitly (callers with analytic
+        counts, e.g. the bench's model formulas)."""
+        if flops and flops > 0:
+            with self._lock:
+                self._flops[name] = float(flops)
+
+    # -- views ------------------------------------------------------------
+    def snapshot(self):
+        """Plain-dict view for the web-status dashboard and black-box
+        dumps."""
+        # peak lookup OUTSIDE the lock: it can touch jax.devices()
+        # (backend init takes seconds cold) and every instrumented
+        # hot-path call would queue behind it
+        peak = peak_tflops()
+        with self._lock:
+            mfu = {}
+            for name, flops in self._flops.items():
+                ema = self._step_ema.get(name)
+                if ema:
+                    fps = flops / ema
+                    mfu[name] = {"flops_per_sec": fps}
+                    if peak:
+                        mfu[name]["mfu"] = fps / (peak * 1e12)
+            return {"compiles": dict(self._compiles),
+                    "compile_seconds": {
+                        k: round(v, 4)
+                        for k, v in self._compile_seconds.items()},
+                    "hits": dict(self._hits),
+                    "storms": dict(self._storms),
+                    "flops": dict(self._flops),
+                    "mfu": mfu}
+
+    def publish(self, registry):
+        """Scrape-time re-publication into ``registry`` (the bridge
+        contract: the tracker stays the source of truth)."""
+        with self._lock:
+            compiles = dict(self._compiles)
+            seconds = dict(self._compile_seconds)
+            hits = dict(self._hits)
+            storms = dict(self._storms)
+            flops = dict(self._flops)
+            step_ema = dict(self._step_ema)
+        for name, count in compiles.items():
+            registry.counter_set(
+                "veles_xla_compiles_total", count,
+                labels={"program": name},
+                help="XLA compiles per instrumented program")
+        for name, total in seconds.items():
+            registry.counter_set(
+                "veles_xla_compile_seconds_total", round(total, 6),
+                labels={"program": name},
+                help="wall seconds spent compiling per program")
+        for name, count in hits.items():
+            registry.counter_set(
+                "veles_xla_cache_hits_total", count,
+                labels={"program": name},
+                help="jit cache hits per instrumented program")
+        for name, count in storms.items():
+            registry.counter_set(
+                "veles_xla_recompile_storms_total", count,
+                labels={"program": name},
+                help="recompilation storms (N same-name compiles in a "
+                     "sliding window)")
+        peak = peak_tflops()
+        for name, value in flops.items():
+            registry.set("veles_xla_program_flops", value,
+                         labels={"program": name},
+                         help="cost_analysis FLOPs of the latest "
+                              "compiled program")
+            ema = step_ema.get(name)
+            if ema:
+                fps = value / ema
+                registry.set(
+                    "veles_program_flops_per_second", fps,
+                    labels={"program": name},
+                    help="program FLOPs over the measured step-time EMA")
+                if peak:
+                    registry.set(
+                        "veles_mfu_ratio", fps / (peak * 1e12),
+                        labels={"program": name},
+                        help="model FLOPs utilization vs the device "
+                             "bf16 peak")
+
+
+_tracker = CompileTracker(enabled=False)
+
+
+def get_compile_tracker():
+    return _tracker
+
+
+def instrument(name, fn):
+    """Wrap a jitted callable so compiles/hits book into the process
+    tracker under ``name``. Disabled-tracker calls delegate after one
+    attribute check; callables without a ``_cache_size`` introspection
+    hook (non-jit objects, older jax) are returned unwrapped."""
+    import functools
+
+    tracker = get_compile_tracker()
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:
+        return fn
+
+    @functools.wraps(fn, assigned=("__doc__",), updated=())
+    def wrapper(*args, **kwargs):
+        if not tracker.enabled:
+            return fn(*args, **kwargs)
+        before = cache_size()
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        if cache_size() > before:
+            flops = (program_flops(fn, *args, **kwargs)
+                     if tracker.estimate_flops else None)
+            tracker.record_compile(name, time.perf_counter() - t0,
+                                   flops=flops)
+        else:
+            tracker.record_hit(name)
+        return out
+
+    wrapper.__wrapped__ = fn
+    wrapper.program_name = name
+    return wrapper
+
+
+# -- device gauges ----------------------------------------------------------
+
+def _live_bytes_by_device():
+    """Fallback memory accounting for backends without an allocator
+    report (CPU): sum the live jax buffers per device. A sharded
+    array's bytes split evenly over its devices."""
+    out = {}
+    try:
+        import jax
+        for arr in jax.live_arrays():
+            try:
+                devs = list(arr.devices())
+                share = arr.nbytes / max(1, len(devs))
+                for dev in devs:
+                    out[dev.id] = out.get(dev.id, 0) + share
+            except Exception:
+                continue
+    except Exception:
+        return {}
+    return out
+
+
+def _sample_device_memory():
+    """One pass over the local devices: ``{device_id: stats_dict}``
+    with ``memory_stats()`` keys where the backend reports them, or a
+    ``{"live_bytes": n}`` fallback (CPU has no allocator report). ONE
+    copy of the sampling loop for the gauges, the dashboard summary
+    and the black box."""
+    out = {}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    live = None
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[dev.id] = {key: stats[key] for key in _MEMORY_KEYS
+                           if stats.get(key) is not None}
+        else:
+            if live is None:
+                live = _live_bytes_by_device()
+            out[dev.id] = {"live_bytes": int(live.get(dev.id, 0))}
+    return out
+
+
+def publish_device_stats(registry):
+    """Per-device memory gauges at scrape time. TPU/GPU backends report
+    through ``memory_stats()``; CPU falls back to live-buffer bytes so
+    ``veles_device_memory_bytes`` exists on every backend."""
+    for dev_id, stats in _sample_device_memory().items():
+        for kind, value in stats.items():
+            registry.set(
+                "veles_device_memory_bytes", value,
+                labels={"device": str(dev_id), "kind": kind},
+                help="device allocator stats per local device")
+    peak = peak_tflops()
+    if peak:
+        registry.set("veles_device_peak_bf16_tflops", peak,
+                     help="published bf16 peak of the bench device")
+
+
+def publish_xla_stats(registry):
+    """The full device-truth collector: compile/hit/storm counters, MFU
+    and memory gauges — registered once per registry by
+    :func:`ensure_registered`."""
+    get_compile_tracker().publish(registry)
+    publish_device_stats(registry)
+
+
+def ensure_registered(registry=None):
+    """Idempotently attach the device-truth collector to ``registry``
+    (default: the process-global one) and enable the tracker — called
+    by every ``/metrics`` mount (``core/httpd.py``), so processes that
+    never serve HTTP keep the disabled fast path."""
+    from veles_tpu.observe.metrics import get_metrics_registry
+
+    if registry is None:
+        registry = get_metrics_registry()
+    tracker = get_compile_tracker()
+    tracker.enabled = True
+    collector = getattr(registry, "_xla_stats_collector", None)
+    if collector is None:
+        def collector():
+            publish_xla_stats(registry)
+        registry._xla_stats_collector = collector
+    # registry.reset() (test isolation) clears collectors, so membership
+    # is re-checked per mount rather than remembered
+    if collector not in registry._collectors:
+        registry.add_collector(collector)
+    return registry
+
+
+def device_summary():
+    """One compact dict for the web-status dashboard: memory per
+    device, compile totals, storms, the best live MFU."""
+    snap = get_compile_tracker().snapshot()
+    memory = {}
+    for dev_id, stats in _sample_device_memory().items():
+        if stats.get("bytes_in_use") is not None:
+            memory[str(dev_id)] = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit")}
+    mfu = None
+    for entry in snap["mfu"].values():
+        ratio = entry.get("mfu")
+        if ratio is not None and (mfu is None or ratio > mfu):
+            mfu = ratio
+    return {"memory": memory,
+            "compiles": sum(snap["compiles"].values()),
+            "compile_seconds": round(
+                sum(snap["compile_seconds"].values()), 3),
+            "storms": sum(snap["storms"].values()),
+            "mfu": round(mfu, 4) if mfu is not None else None}
+
+
+def format_device_stats(device):
+    """A ``device_summary()`` dict as one dashboard table cell (the
+    device twin of ``format_serving_health``); empty for masters that
+    report none."""
+    if not isinstance(device, dict):
+        return ""
+    parts = []
+    memory = device.get("memory")
+    if isinstance(memory, dict) and memory:
+        used = sum(m.get("bytes_in_use") or 0 for m in memory.values()
+                   if isinstance(m, dict))
+        limit = sum(m.get("bytes_limit") or 0 for m in memory.values()
+                    if isinstance(m, dict))
+        if limit:
+            parts.append("hbm %.1f/%.1f GiB"
+                         % (used / 2 ** 30, limit / 2 ** 30))
+        elif used:
+            parts.append("hbm %.1f GiB" % (used / 2 ** 30))
+    compiles = device.get("compiles")
+    if compiles:
+        parts.append("%d compiles (%.1fs)"
+                     % (compiles, device.get("compile_seconds") or 0.0))
+    storms = device.get("storms")
+    if storms:
+        parts.append("%d RECOMPILE STORMS" % storms)
+    mfu = device.get("mfu")
+    if mfu is not None:
+        parts.append("mfu %.2f" % mfu)
+    return " · ".join(parts)

@@ -19,6 +19,8 @@ Kahan (+9%) / multi-partial (+90%) summation tiers):
 
 import functools
 import json
+import logging
+import math
 import os
 
 import jax
@@ -28,6 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from veles_tpu.core.config import root
+from veles_tpu.observe.xla_stats import instrument
 
 _PRECISIONS = {
     0: lax.Precision.DEFAULT,
@@ -186,6 +189,11 @@ def pallas_matmul(a, b, out_dtype=jnp.float32, bm=None, bn=None, bk=None,
     return out
 
 
+# compile/hit telemetry for the blocked kernel (observe/xla_stats.py);
+# delegates after one attribute check while device telemetry is off
+pallas_matmul = instrument("gemm.pallas_matmul", pallas_matmul)
+
+
 # -- fused dense epilogue -----------------------------------------------------
 
 def _mm_epilogue_kernel(activation):
@@ -253,6 +261,9 @@ def pallas_dense(a, b, bias, activation="linear", out_dtype=jnp.float32,
     if pm or pn:
         out = out[:m, :n]
     return out
+
+
+pallas_dense = instrument("gemm.pallas_dense", pallas_dense)
 
 
 @functools.lru_cache(maxsize=None)
@@ -338,6 +349,51 @@ def _cache_path():
         os.path.expanduser("~/.veles_tpu/cache/pallas_tuning.json"))
 
 
+#: the timing fields every autotune entry may carry; all must be
+#: positive finite seconds — a negative "measurement" is the two-length
+#: slope estimator going underwater on tunnel jitter, not physics
+_TIMING_KEYS = ("seconds", "xla_seconds")
+_insane_warned = False
+
+
+def _sane_entry(entry):
+    """True when an autotune row is physically possible: a dict whose
+    timing fields (if present) are positive finite numbers. The
+    VERDICT r5 artifact — a persisted NEGATIVE xla_seconds — gated a
+    product matmul on a measurement that never happened."""
+    if not isinstance(entry, dict):
+        return False
+    for key in _TIMING_KEYS:
+        if key in entry:
+            value = entry[key]
+            if isinstance(value, bool) \
+                    or not isinstance(value, (int, float)) \
+                    or not math.isfinite(value) or value <= 0:
+                return False
+    return True
+
+
+def _drop_insane(cache, where):
+    """Remove physically impossible rows in place (warn once); the
+    dropped bucket simply re-tunes on its next autotune run — default
+    blocks and the XLA path serve it meanwhile."""
+    global _insane_warned
+    bad = [key for key, entry in cache.items()
+           if not _sane_entry(entry)]
+    for key in bad:
+        del cache[key]
+    if bad and not _insane_warned:
+        _insane_warned = True
+        logging.getLogger("gemm.autotune").warning(
+            "dropped %d physically impossible autotune entr%s %s "
+            "(non-positive or non-finite timing — the slope estimator "
+            "went underwater on jitter): %s; affected buckets re-tune "
+            "on next use (reported once)",
+            len(bad), "y" if len(bad) == 1 else "ies", where,
+            ", ".join(sorted(bad)))
+    return bad
+
+
 def _load_cache():
     global _tuning_cache
     if _tuning_cache is None:
@@ -346,13 +402,23 @@ def _load_cache():
                 _tuning_cache = json.load(fin)
         except (OSError, ValueError):
             _tuning_cache = {}
+        if not isinstance(_tuning_cache, dict):
+            _tuning_cache = {}
+        # hygiene at load: poisoned rows from older rounds are dropped
+        # AND the cleaned cache is persisted back so the artifact on
+        # disk stops advertising the impossible measurement
+        if _drop_insane(_tuning_cache, "at load"):
+            _persist_cache(_tuning_cache)
     return _tuning_cache
 
 
 def _persist_cache(cache):
     """Write the (already-updated) tuning cache to disk; shared by the
-    GEMM and int8-matvec autotuners."""
+    GEMM and int8-matvec autotuners. Insane rows (non-positive /
+    non-finite timings) are rejected here too, so no caller can
+    re-poison the artifact."""
     global _tuning_cache
+    _drop_insane(cache, "at persist")
     _tuning_cache = cache
     path = _cache_path()
     try:
@@ -510,11 +576,24 @@ def autotune_matmul(m, n, k, dtype=jnp.bfloat16, iters=4):
             v, b, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(dtype), rng_a,
         repeats=iters)
-    cache = _load_cache()
-    # require a clear margin: a tie-level "win" (sub-noise) must not
-    # flip a product matmul onto the kernel
-    cache["%s:%d" % (str(jnp.dtype(dtype)), _size_bucket(m, n, k))] = {
+    entry = {
         "blocks": list(best), "seconds": best_dt,
-        "xla_seconds": xla_dt, "beats_xla": best_dt < 0.97 * xla_dt}
+        "xla_seconds": xla_dt,
+        # require a clear margin: a tie-level "win" (sub-noise) must
+        # not flip a product matmul onto the kernel
+        "beats_xla": best_dt < 0.97 * xla_dt}
+    if not _sane_entry(entry):
+        # the slope estimator went underwater (tunnel jitter can make
+        # the long scan finish "faster" than the short one): a
+        # physically impossible number must never be persisted as a
+        # tuning verdict — keep the previous entry, re-tune later
+        logging.getLogger("gemm.autotune").warning(
+            "autotune %dx%dx%d measured an impossible timing "
+            "(pallas %.3g s, xla %.3g s); verdict NOT persisted — "
+            "re-run autotune for this shape", m, n, k, best_dt, xla_dt)
+        return best
+    cache = _load_cache()
+    cache["%s:%d" % (str(jnp.dtype(dtype)),
+                     _size_bucket(m, n, k))] = entry
     _persist_cache(cache)
     return best

@@ -28,6 +28,7 @@ from jax import lax
 from veles_tpu.ops.attention import attention
 from veles_tpu.ops.quant import (int8_cache_attend, matmul_any,
                                  quantize_int8)
+from veles_tpu.observe.xla_stats import instrument
 # ONE copy of the sublayer math, shared with the training-side full
 # forward — the equivalence the module contract promises is structural
 from veles_tpu.parallel.transformer_step import _block_qkv, _head, _mlp
@@ -618,6 +619,17 @@ def slot_step_many(params, embed_table, heads, state, active, n,
     # shows up as one labeled region in the XLA device trace
     with jax.named_scope("decode.dispatch"):
         return lax.scan(body, state, None, length=n)
+
+
+# compile/cache-hit/FLOPs telemetry per slot program
+# (observe/xla_stats.py): each name matches its host span and
+# named_scope, so the veles_xla_* counters, the profiler timeline and
+# the trace vocabulary line up. The wrappers delegate after one
+# attribute check while device telemetry is off.
+_generate_jit = instrument("decode.generate", _generate_jit)
+slot_admit_many = instrument("decode.admit", slot_admit_many)
+slot_step = instrument("decode.step", slot_step)
+slot_step_many = instrument("decode.dispatch", slot_step_many)
 
 
 # -- tensor-parallel decode (Megatron-style weight sharding) ------------------

@@ -42,12 +42,14 @@ from veles_tpu.core.config import root
 from veles_tpu.core.mutable import Bool
 from veles_tpu.core.units import Unit
 from veles_tpu.loader.base import Loader, TEST, register_loader
+from veles_tpu.observe.flight import get_flight_recorder
 from veles_tpu.observe.metrics import (bridge, get_metrics_registry,
                                        publish_decoder,
                                        publish_serving_health)
 from veles_tpu.observe.tracing import (NULL_SPAN, TRACE_HEADER,
                                        format_trace_header, get_tracer,
                                        parse_trace_header)
+from veles_tpu.observe.xla_stats import get_compile_tracker
 
 #: decode host-time histogram buckets (seconds): sub-ms host
 #: bookkeeping through multi-second cold-compile dispatches
@@ -277,6 +279,10 @@ class ServingHealth:
     def set_breaker(self, state):
         with self._lock:
             self._breaker = state
+        # breaker transitions are exactly what a post-mortem wants in
+        # the black box (flight.py; bounded, lock-free append)
+        get_flight_recorder().note("breaker", state=state,
+                                   api=self.name)
 
     def incr(self, key, n=1):
         with self._lock:
@@ -654,6 +660,14 @@ class ContinuousDecoder:
         #: PR-3 hot path until someone mounts /metrics or a tracer
         self.metrics = get_metrics_registry()
         self._tracer = get_tracer()
+        #: the always-on black box: dispatch entries land in its
+        #: bounded ring so a breaker trip can dump the tail that led
+        #: to it (flight.py — one flag check + append per dispatch)
+        self.flight = get_flight_recorder()
+        #: device-truth plane: chunk cadence feeds the online MFU
+        #: gauge once /metrics is mounted (observe/xla_stats.py)
+        self._xla = get_compile_tracker()
+        self._last_chunk_done = None
         self._trace = {}  # request id -> (trace_id, span_id) context
         #: recently-retired trace contexts, bounded: the lag-1 pipeline
         #: collects a request's LAST chunk one pass after it retires,
@@ -809,6 +823,8 @@ class ContinuousDecoder:
                 help="host-blocking bucket-prefill dispatch time")
             self.dispatch_counts["admit"] += 1
             self.dispatch_counts["admit_requests"] += len(group)
+            self.flight.note("admit", bucket=bucket, group=len(group),
+                             ms=round(elapsed * 1000, 3))
             if self.dispatch_log is not None:
                 self.dispatch_log.append(("admit", bucket, len(group)))
             for rid, prompt, slot in group:
@@ -849,6 +865,7 @@ class ContinuousDecoder:
         for slot in snapshot:
             self._slot_len[slot] += 1
         self.dispatch_counts["step"] += 1
+        self.flight.note("step", rids=list(snapshot.values()))
         emitted = numpy.asarray(emitted)
         out = {}
         for slot, rid in snapshot.items():
@@ -897,6 +914,19 @@ class ContinuousDecoder:
             "veles_decode_collect_seconds", elapsed,
             buckets=DECODE_BUCKETS,
             help="chunk readback (device sync) time")
+        self.flight.note("collect", chunk=int(emitted.shape[0]),
+                         ms=round(elapsed * 1000, 3))
+        # online MFU (observe/xla_stats.py): wall time between chunk
+        # completions is the steady-state per-chunk step time under the
+        # lag-1 pipeline (the device computes continuously); the
+        # tracker divides the chunk program's cost_analysis FLOPs by
+        # this cadence for the veles_mfu_ratio gauge
+        if self._xla.enabled:
+            done = time.monotonic()
+            if self._last_chunk_done is not None:
+                self._xla.observe_step("decode.dispatch",
+                                       done - self._last_chunk_done)
+            self._last_chunk_done = done
         if self.dispatch_log is not None:
             self.dispatch_log.append(("collect", emitted.shape[0]))
         out = {}
@@ -960,6 +990,9 @@ class ContinuousDecoder:
         for slot in snapshot:
             self._slot_len[slot] += chunk
         self.dispatch_counts["chunk"] += 1
+        self.flight.note("dispatch", chunk=chunk,
+                         rids=list(snapshot.values()),
+                         ms=round(elapsed * 1000, 3))
         if self.dispatch_log is not None:
             self.dispatch_log.append(("dispatch", chunk))
         self.steps += chunk
@@ -1184,7 +1217,15 @@ class GenerateAPI:
     def _trip(self, exc, waiting):
         """Open the circuit: the decoder's donated state is unusable.
         Shed everyone now queued/in-flight — loudly, with a retryable
-        503 — instead of wedging each behind its full deadline."""
+        503 — instead of wedging each behind its full deadline. The
+        flight recorder dumps its black box FIRST, so the ring still
+        holds the dispatch tail and spans that led here."""
+        flight = get_flight_recorder()
+        flight.note("breaker.trip", error=str(exc)[:500],
+                    inflight=len(waiting))
+        flight.dump("breaker_trip",
+                    extra={"error": str(exc)[:2000],
+                           "health": self.health.snapshot()})
         self.health.incr("trips")
         self.health.set_breaker("open")
         self.health.set_ready(False)
@@ -1289,6 +1330,10 @@ class GenerateAPI:
                 waiting.update(self._drain_staged())
                 self._expire_deadlines(waiting)
                 if not self.decoder.busy and self._pending is None:
+                    # idle: the MFU cadence baseline must not span the
+                    # gap, or the first chunk of the next burst feeds
+                    # the whole idle wall time into the step-time EMA
+                    self.decoder._last_chunk_done = None
                     if not self._wake.wait(timeout=0.05):
                         continue
                     self._wake.clear()

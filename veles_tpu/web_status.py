@@ -31,6 +31,7 @@ import urllib.request
 
 from veles_tpu.core.config import root
 from veles_tpu.core.logger import Logger
+from veles_tpu.observe.xla_stats import format_device_stats
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>veles_tpu status</title>
@@ -45,7 +46,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Workflows</h2>
 <table id="wf"><tr><th>name</th><th>mode</th><th>slaves</th>
 <th>runtime (s)</th><th>fleet health</th><th>serving</th>
-<th>updated</th></tr>%(rows)s</table>
+<th>device</th><th>updated</th></tr>%(rows)s</table>
 <h2>Workflow graphs</h2><div id="graphs">%(graphs)s</div>
 <h2>Plots</h2><div id="plots">%(plots)s</div>
 <script>
@@ -63,12 +64,13 @@ src.onmessage = function(ev) {
   var state = JSON.parse(ev.data);
   var rows = ['<tr><th>name</th><th>mode</th><th>slaves</th>' +
               '<th>runtime (s)</th><th>fleet health</th>' +
-              '<th>serving</th><th>updated</th></tr>'];
+              '<th>serving</th><th>device</th><th>updated</th></tr>'];
   (state.workflows || []).forEach(function(w) {
     rows.push('<tr><td>' + esc(w.name) + '</td><td>' + esc(w.mode) +
               '</td><td>' + (0 | w.slaves) + '</td><td>' +
               Math.round(w.runtime) + '</td><td>' + esc(w.fleet || '') +
               '</td><td>' + esc(w.serving || '') +
+              '</td><td>' + esc(w.device || '') +
               '</td><td>' + esc(w.updated) + '</td></tr>');
   });
   document.getElementById('wf').innerHTML = rows.join('');
@@ -468,6 +470,7 @@ class WebStatusServer(Logger):
                 "runtime": runtime,
                 "fleet": format_fleet_health(s.get("fleet")),
                 "serving": format_serving_health(s.get("serving")),
+                "device": format_device_stats(s.get("device")),
                 "updated": time.strftime(
                     "%X", time.localtime(s.get("updated", 0)))})
             if isinstance(s.get("graph"), dict):
@@ -507,7 +510,7 @@ class WebStatusServer(Logger):
             slaves = s.get("slaves", [])
             rows.append(
                 "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.0f</td>"
-                "<td>%s</td><td>%s</td><td>%s</td></tr>" % (
+                "<td>%s</td><td>%s</td><td>%s</td><td>%s</td></tr>" % (
                     escape(str(s.get("name", key))),
                     escape(str(s.get("mode", "?"))),
                     len(slaves) if isinstance(slaves, (list, tuple))
@@ -515,6 +518,7 @@ class WebStatusServer(Logger):
                     runtime,
                     escape(format_fleet_health(s.get("fleet"))),
                     escape(format_serving_health(s.get("serving"))),
+                    escape(format_device_stats(s.get("device"))),
                     time.strftime("%X",
                                   time.localtime(s.get("updated", 0)))))
         graphs = []
@@ -545,7 +549,7 @@ class WebStatusServer(Logger):
                 plots.append('<img src="/plots/%s?t=%d" alt="%s"/>'
                              % (name, stamp, name))
         return _PAGE % {"rows": "".join(rows) or
-                        "<tr><td colspan=7>none</td></tr>",
+                        "<tr><td colspan=8>none</td></tr>",
                         "graphs": "".join(graphs) or "<p>none</p>",
                         "plots": "".join(plots) or "<p>none</p>"}
 
@@ -608,6 +612,16 @@ class StatusNotifier:
         if serving_health is not None \
                 and hasattr(serving_health, "snapshot"):
             status["serving"] = serving_health.snapshot()
+        # device-truth column (observe/xla_stats.py): memory, compile
+        # totals, storms, live MFU — only once the tracker is on (a
+        # /metrics mount), so idle masters don't pay the device poll
+        try:
+            from veles_tpu.observe.xla_stats import (device_summary,
+                                                     get_compile_tracker)
+            if get_compile_tracker().enabled:
+                status["device"] = device_summary()
+        except Exception:
+            pass
         # the live unit DAG (+ run counters) for the dashboard's graph
         # view — the reference's viz.js workflow page
         # (web_status.py:113-165), rendered server-side as SVG here
