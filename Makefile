@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress
+	regress mesh
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -30,6 +30,16 @@ chaos-serve:
 			$(PYTHON) -m pytest tests/test_serving_chaos.py \
 			-m chaos_serve -q || exit 1; \
 	done
+
+# Mesh/sharding correctness suite (docs/sharded_serving.md) on the
+# 8-device virtual CPU platform: reshard schedule exactness + byte
+# accounting, sharded slot-engine bit-identity (incl. mid-flight joins
+# and the int8-KV tier), dispatch-count/recompile-storm guards, and
+# the train-dp -> reshard -> serve-tp composite — sharding correctness
+# proven in CI without TPUs.
+mesh:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_reshard.py \
+		tests/test_mesh_serving.py -m mesh -q
 
 # Standalone continuous-batching serving bench (docs/
 # serving_performance.md): one JSON line with the decode_continuous_*

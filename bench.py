@@ -629,6 +629,22 @@ def longctx_device(batch=1, seq=8192, embed=1024, heads=8):
                                                          embed, heads)}
 
 
+def _cpu8_env():
+    """Environment for an 8-device virtual-CPU child bench: force the
+    host platform AND drop the axon site customization from PYTHONPATH
+    — it pins the tunnel TPU backend, which the CPU child must not
+    import (same filter as __graft_entry__). One helper for every
+    CPU-8 subprocess section (``pod_cpu8_tick_ms``, ``reshard_bench``)
+    so the filter can't drift between copies."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+           if p and ".axon_site" not in p])
+    return env
+
+
 def pod_overhead():
     """VERDICT r3 #7: prove the pod-mode wrapper costs ~nothing at n=1.
 
@@ -740,15 +756,7 @@ def pod_overhead():
         "    p,m=step(p,data,labels,mask)\n"
         "jax.block_until_ready(m)\n"
         "print((time.perf_counter()-t0)*10)\n")
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    # the axon site customization pins the tunnel TPU backend; the CPU
-    # child must not import it (same filter as __graft_entry__)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.dirname(os.path.abspath(__file__))]
-        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-           if p and ".axon_site" not in p])
-    proc = subprocess.run([sys.executable, "-c", child], env=env,
+    proc = subprocess.run([sys.executable, "-c", child], env=_cpu8_env(),
                           capture_output=True, text=True, timeout=600)
     if proc.returncode == 0:
         out["pod_cpu8_tick_ms"] = round(
@@ -1107,6 +1115,144 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
                    heads, blocks, vocab)}
 
 
+def reshard_section(blocks=2, embed=256, heads=8, vocab=2048,
+                    slots=4, budget=24, chunk=8, repeats=5):
+    """The train↔serve layout transition, measured (ROADMAP item 1 /
+    docs/sharded_serving.md): one transformer checkpoint moves between
+    the fused train layout (params replicated over the mesh — the
+    data-parallel tick's P() spec) and the slot-serving layout (params
+    tensor-parallel on ``model``, per ``decode.slot_param_specs``)
+    through ``parallel/reshard.py``'s collective schedules, both
+    directions, against the naive ``device_put`` formulation on the
+    same tree. Plus the sharded slot engine's decode step time — the
+    tensor-parallel continuous-batching path finally gets a bench
+    number beside the single-chip ``decode_continuous_*`` family.
+
+    Requires >= 2 devices (the bench driver falls back to an 8-device
+    virtual-CPU subprocess via :func:`reshard_bench`); keys:
+
+    - ``reshard_train_to_serve_ms`` / ``reshard_serve_to_train_ms``
+      (min-of-``repeats`` wall, compile excluded) + ``_bytes`` each and
+      the combined ``reshard_bytes`` (lower is better — the schedule's
+      bytes-on-the-wire, registered direction-aware in
+      ``observe/regress.py``);
+    - ``reshard_naive_*_ms``: the ``device_put`` baseline;
+    - ``decode_continuous_sharded_step_ms`` / ``_tokens_per_sec``: the
+      sharded slot engine draining a staggered request mix.
+    """
+    from veles_tpu.parallel import reshard as rs
+    from veles_tpu.parallel.decode import slot_param_specs
+    from veles_tpu.parallel.mesh import build_mesh
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import ContinuousDecoder
+    from jax.sharding import PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    n = len(devices)
+    while heads % n or vocab % n:
+        n -= 1
+    mesh = build_mesh(devices=devices[:n], data=1, model=n)
+    rng = numpy.random.RandomState(0)
+    params = init_transformer_params(rng, blocks, embed, heads, vocab)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.02)
+    serve_specs = slot_param_specs(params)
+    train_specs = P()  # the fused tick's replicated-params layout
+    # place the checkpoint in the train layout once; the measured
+    # transitions then start and end ON the mesh
+    train_tree, _ = rs.reshard(params, mesh, train_specs,
+                               label="bench.place")
+    out = {}
+    transitions = (
+        ("reshard_train_to_serve", train_tree, serve_specs),
+        ("reshard_serve_to_train",
+         rs.reshard(train_tree, mesh, serve_specs,
+                    label="bench.warm")[0], train_specs),
+    )
+    total_bytes = 0
+    for key, src_tree, dst_specs in transitions:
+        times = []
+        stats = None
+        for _ in range(repeats + 1):  # first call compiles
+            _, stats = rs.reshard(src_tree, mesh, dst_specs,
+                                  label=key)
+            times.append(stats["seconds"])
+        times = sorted(times[1:])
+        out[key + "_ms"] = round(times[0] * 1000, 3)
+        out[key + "_spread"] = round((times[1] - times[0])
+                                     / max(times[0], 1e-9), 4)
+        out[key + "_bytes"] = stats["bytes"]
+        total_bytes += stats["bytes"]
+        naive = min(rs.naive_reshard(src_tree, mesh, dst_specs)[1]
+                    for _ in range(repeats))
+        out[key.replace("reshard_", "reshard_naive_") + "_ms"] = \
+            round(naive * 1000, 3)
+    out["reshard_bytes"] = total_bytes
+    out["reshard_config"] = "model%d_L%d_e%d_h%d_v%d" % (
+        n, blocks, embed, heads, vocab)
+
+    # sharded continuous decode: the same staggered-drain recipe as
+    # decode_continuous, on the tensor-parallel slot engine
+    prompts = [rng.randint(0, vocab, p) for p in (24, 48, 32, 40, 28,
+                                                  36, 44, 20)]
+
+    def run():
+        dec = ContinuousDecoder(params, table, heads, slots=slots,
+                                max_len=64 + budget + 2 * chunk,
+                                n_tokens=budget, mesh=mesh)
+        pending = list(prompts)
+        for _ in range(min(slots, len(pending))):
+            dec.submit(pending.pop())
+        t0 = time.perf_counter()
+        dec.drain_pipelined(
+            chunk, admit=lambda: pending and dec.submit(pending.pop()))
+        dt = time.perf_counter() - t0
+        step_s = ((dec.timings["dispatch_s"] + dec.timings["collect_s"])
+                  / max(dec.steps, 1))
+        return dec.tokens_out / dt, step_s
+
+    run()  # compile the sharded admit + chunk programs
+    runs = [run() for _ in range(2)]
+    best_rate, step_s = max(runs, key=lambda r: r[0])
+    out["decode_continuous_sharded_step_ms"] = round(step_s * 1000, 3)
+    out["decode_continuous_sharded_tokens_per_sec"] = round(best_rate, 1)
+    out["decode_continuous_sharded_spread"] = round(
+        (best_rate - min(r[0] for r in runs)) / best_rate, 4)
+    out["decode_continuous_sharded_config"] = \
+        "model%d_s%d_b%d_c%d_L%d_e%d_h%d_v%d" % (
+            n, slots, budget, chunk, blocks, embed, heads, vocab)
+    return out
+
+
+def reshard_bench():
+    """``reshard_section`` keys, wherever the bench runs: in-process on
+    a multi-device backend; on a single-chip device (the tunneled bench
+    TPU) via an 8-device virtual-CPU subprocess — the transition
+    schedule and its byte accounting are device-count facts, so the CPU
+    mesh records honest bytes and CI-comparable latencies (the same
+    doctrine as ``pod_cpu8_tick_ms``)."""
+    import subprocess
+    import sys
+
+    if len(jax.devices()) >= 2:
+        return reshard_section()
+    child = ("import json, bench\n"
+             "print(json.dumps(bench.reshard_section()))\n")
+    proc = subprocess.run([sys.executable, "-c", child], env=_cpu8_env(),
+                          capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        print(proc.stderr[-2000:], file=sys.stderr)
+        return {}
+    keys = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not keys:
+        return {}
+    keys["reshard_config"] = keys.get("reshard_config", "") + "_cpu8"
+    return keys
+
+
 def _guarded(fn, *args, fallback=(None, []), **kwargs):
     """One failed section must not kill the headline line — but the
     failure has to be visible somewhere (stderr; stdout stays one JSON
@@ -1194,6 +1340,7 @@ def main(artifact_path=None):
     _add(_guarded(decode_int8_device, fallback={}))
     _add(_guarded(decode_int8_device, kv_quant=True, fallback={}))
     _add(_guarded(decode_continuous, fallback={}))
+    _add(_guarded(reshard_bench, fallback={}))
     _add(_guarded(pod_overhead, fallback={}))
     _add(_guarded(pallas_epilogue_compare, fallback={}))
     gflops = device_keys.get("fused_step_gflops")
@@ -1284,6 +1431,13 @@ def serve_main(profile_dir=None, artifact_path=None):
             artifact.update(section)
             section = _guarded(decode_continuous, quantize="int8-kv",
                                fallback={})
+            out.update(section)
+            artifact.update(section)
+            # the mesh tier (docs/sharded_serving.md): train<->serve
+            # reshard bytes/latency + the sharded slot engine's step
+            # time ride the serving bench too, so `make bench-serve`
+            # alone guards the whole serving surface incl. the pod path
+            section = _guarded(reshard_bench, fallback={})
             out.update(section)
             artifact.update(section)
         out["decode_histograms"] = registry.histogram_summary(

@@ -1,0 +1,182 @@
+"""parallel/reshard.py: portable collective resharding (arxiv
+2112.01075 translation) — schedules, bit-exact round trips, byte
+accounting, metrics. Runs on the suite's 8-device virtual CPU mesh
+(`make mesh` mirrors `make chaos` for this file + test_mesh_serving)."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veles_tpu.observe.metrics import MetricsRegistry
+from veles_tpu.parallel import reshard as rs
+from veles_tpu.parallel.mesh import build_mesh
+
+pytestmark = pytest.mark.mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(devices=jax.devices()[:8], data=2, model=4)
+
+
+def _plan_one(shape, src, dst, mesh, dtype=numpy.float32):
+    plan = rs.plan_reshard(jnp.zeros(shape, dtype), mesh, dst, src)
+    return plan.leaves[0], plan
+
+
+class TestSchedule:
+    def test_transpose_resharding_is_one_all_to_all(self, mesh):
+        """The paper's headline case: an axis moving between tensor
+        dims must plan ONE all_to_all — never gather + slice (which
+        materializes the full array and moves n-1x the bytes)."""
+        leaf, _ = _plan_one((16, 32), P(None, "model"),
+                            P("model", None), mesh)
+        assert [s[0] for s in leaf.steps] == ["all_to_all"]
+        # each device exchanges (n-1)/n of its shard: 3/4 of the bytes
+        # the data-replicated model sharding leaves per device, x8 devs
+        assert leaf.bytes == 8 * (16 * 32 * 4 // 4) * 3 // 4
+
+    def test_slice_only_transition_is_free(self, mesh):
+        leaf, _ = _plan_one((16, 32), P(), P(None, "model"), mesh)
+        assert [s[0] for s in leaf.steps] == ["slice"]
+        assert leaf.bytes == 0
+
+    def test_gather_books_bytes(self, mesh):
+        leaf, _ = _plan_one((16, 32), P("data", None), P(), mesh)
+        assert [s[0] for s in leaf.steps] == ["all_gather"]
+        assert leaf.bytes == 8 * (16 * 32 * 4 // 2) * (2 - 1)
+
+    def test_nested_tuple_gathers_minor_first(self, mesh):
+        """A ("data","model") nested dim must gather model (the minor
+        axis) before data, or the blocks reassemble out of order."""
+        leaf, _ = _plan_one((16, 32), P(("data", "model"), None), P(),
+                            mesh)
+        assert [(s[0], s[1]) for s in leaf.steps] == \
+            [("all_gather", "model"), ("all_gather", "data")]
+
+    def test_same_spec_is_keep(self, mesh):
+        leaf, _ = _plan_one((16, 32), P("data", None), P("data", None),
+                            mesh)
+        assert [s[0] for s in leaf.steps] == ["keep"]
+        assert leaf.bytes == 0
+
+    @pytest.mark.parametrize("src,dst", [
+        (P("model"), P("model", None)),
+        (P(), P(None)),
+        (P(("model",), None), P("model")),
+    ])
+    def test_equal_layouts_spelled_differently_are_keep(self, mesh,
+                                                        src, dst):
+        """jax reports a live array's spec in any of several equal
+        spellings (trailing Nones, 1-tuple entries); the planner must
+        compare LAYOUTS — a spelling change is a keep, never an empty
+        schedule (which used to crash reshard())."""
+        leaf, _ = _plan_one((16, 32), src, dst, mesh)
+        assert [s[0] for s in leaf.steps] == ["keep"]
+        assert leaf.bytes == 0
+
+    def test_indivisible_spec_raises_named_error(self, mesh):
+        """An indivisible dst spec must fail with an error naming the
+        shape and spec — never as an opaque partitioner frame."""
+        with pytest.raises(ValueError, match="cannot shard"):
+            _plan_one((15, 32), P(), P("data", None), mesh)
+
+    def test_entangled_swap_lowers_to_gather_slice(self, mesh):
+        """An axis swap inside one dim pair cannot ride a tiled
+        all_to_all (the nesting scrambles); it must lower to the
+        provable gather+slice form."""
+        leaf, _ = _plan_one((16, 32), P("model", "data"),
+                            P("data", "model"), mesh)
+        kinds = [s[0] for s in leaf.steps]
+        assert "all_to_all" not in kinds
+        assert kinds.count("all_gather") == 2
+        assert kinds.count("slice") == 2
+
+
+class TestReshard:
+    CASES = [
+        (P(), P(None, "model")),
+        (P(None, "model"), P("model", None)),
+        (P("data", None), P(None, "model")),
+        (P(("data", "model"), None), P()),
+        (P("model", "data"), P("data", "model")),
+    ]
+
+    @pytest.mark.parametrize("src,dst", CASES)
+    def test_round_trip_bit_exact(self, mesh, src, dst):
+        """Any spec change round-trips to the exact original values —
+        the schedule moves data, it never computes."""
+        rng = numpy.random.RandomState(0)
+        w = rng.randn(16, 32).astype(numpy.float32)
+        arr = jax.device_put(jnp.asarray(w), NamedSharding(mesh, src))
+        there, stats = rs.reshard(arr, mesh, dst, src)
+        assert not numpy.isnan(stats["seconds"])
+        back, _ = rs.reshard(there, mesh, src)
+        numpy.testing.assert_array_equal(numpy.asarray(there), w)
+        numpy.testing.assert_array_equal(numpy.asarray(back), w)
+
+    def test_tree_transition_train_to_serve_and_back(self, mesh):
+        """The product transition: a transformer checkpoint moves from
+        the replicated train layout to the tensor-parallel serving
+        layout and back, every leaf exact (the acceptance contract)."""
+        from veles_tpu.parallel.decode import slot_param_specs
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+
+        rng = numpy.random.RandomState(1)
+        params = init_transformer_params(rng, 2, 32, 8, 16)
+        serve_specs = slot_param_specs(params)
+        served, stats = rs.reshard(params, mesh, serve_specs,
+                                   label="train_to_serve")
+        # replicated -> sharded is slice-only: zero interconnect bytes
+        assert stats["bytes"] == 0
+        assert stats["counts"].get("slice")
+        wqkv = served["blocks"][0]["wqkv"]
+        assert not wqkv.sharding.is_fully_replicated
+        back, stats_back = rs.reshard(served, mesh, P(),
+                                      label="serve_to_train")
+        assert stats_back["bytes"] > 0  # gathers pay real bytes
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            numpy.testing.assert_array_equal(numpy.asarray(a),
+                                             numpy.asarray(b))
+
+    def test_respelled_dst_spec_is_a_no_op(self, mesh):
+        """A dst spec spelling the array's CURRENT layout differently
+        (P('model') vs P('model', None)) must pass the leaf through —
+        the raw tuple comparison used to plan an empty schedule and
+        crash."""
+        w = numpy.arange(64, dtype=numpy.float32).reshape(8, 8)
+        arr = jax.device_put(jnp.asarray(w),
+                             NamedSharding(mesh, P("model")))
+        out, stats = rs.reshard(arr, mesh, P("model", None))
+        assert stats["bytes"] == 0
+        assert stats["counts"] == {"keep": 1}
+        numpy.testing.assert_array_equal(numpy.asarray(out), w)
+
+    def test_unplaced_host_leaves_are_placed_first(self, mesh):
+        w = numpy.arange(64, dtype=numpy.float32).reshape(8, 8)
+        out, _ = rs.reshard(jnp.asarray(w), mesh, P("data", None))
+        numpy.testing.assert_array_equal(numpy.asarray(out), w)
+        assert not out.sharding.is_fully_replicated
+
+    def test_indivisible_leaf_raises(self, mesh):
+        w = numpy.arange(15 * 4, dtype=numpy.float32).reshape(15, 4)
+        with pytest.raises(ValueError, match="cannot shard"):
+            rs.reshard(jnp.asarray(w), mesh, P("data", None))
+
+    def test_metrics_surface(self, mesh):
+        """Every transition books veles_reshard_bytes_total and a
+        veles_reshard_seconds observation under its label."""
+        registry = MetricsRegistry(enabled=True)
+        arr = jax.device_put(
+            jnp.zeros((16, 32), jnp.float32),
+            NamedSharding(mesh, P("data", None)))
+        rs.reshard(arr, mesh, P(), label="t2s-test",
+                   registry=registry)
+        text = registry.expose()
+        assert 'veles_reshard_bytes_total{transition="t2s-test"}' in text
+        assert "veles_reshard_seconds_bucket" in text
+        assert 'transition="t2s-test"' in text

@@ -151,6 +151,13 @@ class Main(Logger):
                            metavar="S", help="default per-request "
                            "serving deadline in seconds; an expired "
                            "request frees its decoder slot (504)")
+        serve.add_argument("--serve-mesh", default=None,
+                           metavar="AXIS=N[,AXIS=N...]",
+                           help="serve the slot engine sharded over a "
+                           "device mesh, e.g. --serve-mesh model=8 "
+                           "(params tensor-parallel, slot KV sharded "
+                           "over heads; -1 absorbs the remaining "
+                           "devices — docs/sharded_serving.md)")
         serve.add_argument("--chaos-serve-seed", type=int, default=None,
                            metavar="N", help="serving chaos RNG seed")
         serve.add_argument("--chaos-serve-step-fail", type=float,
@@ -434,19 +441,22 @@ class Main(Logger):
         self.override_config(args.overrides)
         if args.mesh:
             # after the config layering: the flag wins over config files
-            from veles_tpu.parallel.mesh import AXIS_ORDER
-            for part in args.mesh.split(","):
-                axis, _, size = part.partition("=")
-                axis = axis.strip()
-                if axis not in AXIS_ORDER:
-                    parser.error("--mesh: unknown axis %r (valid: %s)"
-                                 % (axis, ", ".join(AXIS_ORDER)))
-                try:
-                    size = int(size)
-                except ValueError:
-                    parser.error("--mesh expects AXIS=N[,AXIS=N...], "
-                                 "got %r" % args.mesh)
+            from veles_tpu.parallel.mesh import parse_axes
+            try:
+                overrides = parse_axes(args.mesh, flag="--mesh")
+            except ValueError as exc:
+                parser.error(str(exc))
+            for axis, size in overrides.items():
                 setattr(root.common.mesh.axes, axis, size)
+        if args.serve_mesh:
+            # validate NOW (same early-failure contract as --mesh); the
+            # string itself lands in config below and GenerateAPI
+            # re-parses it via serving.build_serve_mesh
+            from veles_tpu.parallel.mesh import parse_axes
+            try:
+                parse_axes(args.serve_mesh, flag="--serve-mesh")
+            except ValueError as exc:
+                parser.error(str(exc))
         # chaos flags AFTER the config layering: the CLI wins over
         # root.common.fleet.chaos.* set by config files
         for flag, key in (("chaos_seed", "seed"),
@@ -462,6 +472,7 @@ class Main(Logger):
         for flag, node, key in (
                 ("serve_max_queue", root.common.serve, "max_queue"),
                 ("serve_deadline", root.common.serve, "deadline"),
+                ("serve_mesh", root.common.serve, "mesh"),
                 ("chaos_serve_seed", root.common.serve.chaos, "seed"),
                 ("chaos_serve_step_fail", root.common.serve.chaos,
                  "step_fail"),

@@ -276,12 +276,25 @@ class Client(Logger):
                     # without another connection or scrape schedule;
                     # the device-truth collector rides along — the
                     # master re-exports each slave's compile counts
-                    # and memory gauges under its slave label
+                    # and memory gauges under its slave label. Each row
+                    # additionally carries this process's mesh
+                    # coordinates (process index + active mesh shape)
+                    # so a master scrape distinguishes the SHARDS of a
+                    # pod-mode slave, not just the slaves.
                     from veles_tpu.observe.xla_stats import (
                         ensure_registered)
+                    from veles_tpu.parallel.mesh import (
+                        mesh_coordinate_labels)
                     ensure_registered(registry)
+                    coords = sorted(mesh_coordinate_labels().items())
                     frame["metrics"] = [
-                        list(row) for row in registry.snapshot()]
+                        [name, kind,
+                         [list(kv) for kv in labels]
+                         + [[k, v] for k, v in coords
+                            if k not in dict(labels)],
+                         value]
+                        for name, kind, labels, value
+                        in registry.snapshot()]
                 await self._write(writer, frame, shm_threshold=shm_thr)
                 if self.async_mode:
                     # pipelined: next request goes out with the update

@@ -37,9 +37,11 @@ SCHEMA_VERSION = 1
 
 #: numeric key suffixes where LOWER is better (times, overhead
 #: shares). NOT "_sec" alone: throughput keys end in "tokens_per_sec";
-#: "_sec_mean" covers the headline's epoch_sec_mean (seconds/epoch)
+#: "_sec_mean" covers the headline's epoch_sec_mean (seconds/epoch);
+#: "_bytes" covers the reshard keys (bytes on the wire per transition
+#: — a schedule that starts moving more data regressed)
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
-                 "_overhead_pct", "_std")
+                 "_overhead_pct", "_std", "_bytes")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

@@ -92,7 +92,17 @@ def abstractify(args, kwargs):
 
     def conv(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            # keep the operand's sharding: a layout-pinned program
+            # (sharded slot serving) must be costed from the SPMD
+            # lowering it actually runs, and a lowering without input
+            # shardings can't honor donation against pinned
+            # out_shardings (spurious donated-buffer warnings)
+            sharding = getattr(x, "sharding", None)
+            try:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sharding)
+            except (TypeError, ValueError):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
         return x
 
     return (jax.tree.map(conv, args), jax.tree.map(conv, kwargs))
@@ -396,6 +406,18 @@ def publish_device_stats(registry):
     if peak:
         registry.set("veles_device_peak_bf16_tflops", peak,
                      help="published bf16 peak of the bench device")
+    # the active mesh shape (parallel/mesh.py): which pod layout this
+    # process computes under — scraped beside the memory gauges so a
+    # fleet dashboard can tell a dp8 slave from a tp8 serving replica
+    from veles_tpu.parallel.mesh import active_mesh_info
+    mesh = active_mesh_info()
+    if mesh:
+        for axis, size in mesh["axes"].items():
+            registry.set("veles_mesh_axis_size", size,
+                         labels={"axis": axis},
+                         help="active device-mesh axis sizes")
+        registry.set("veles_mesh_devices", mesh["devices"],
+                     help="devices spanned by the active mesh")
 
 
 def publish_xla_stats(registry):
@@ -444,11 +466,13 @@ def device_summary():
         ratio = entry.get("mfu")
         if ratio is not None and (mfu is None or ratio > mfu):
             mfu = ratio
+    from veles_tpu.parallel.mesh import mesh_shape_label
     return {"memory": memory,
             "compiles": sum(snap["compiles"].values()),
             "compile_seconds": round(
                 sum(snap["compile_seconds"].values()), 3),
             "storms": sum(snap["storms"].values()),
+            "mesh": mesh_shape_label(),
             "mfu": round(mfu, 4) if mfu is not None else None}
 
 
@@ -470,6 +494,9 @@ def format_device_stats(device):
                          % (used / 2 ** 30, limit / 2 ** 30))
         elif used:
             parts.append("hbm %.1f GiB" % (used / 2 ** 30))
+    mesh = device.get("mesh")
+    if mesh:
+        parts.append("mesh %s" % mesh)
     compiles = device.get("compiles")
     if compiles:
         parts.append("%d compiles (%.1fs)"

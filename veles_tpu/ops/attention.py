@@ -176,7 +176,8 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     ``axis_name``. Returns the local block of the attention output."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    axis_size = lax.axis_size(axis_name)
+    from veles_tpu.parallel.mesh import axis_size as _axis_size
+    axis_size = _axis_size(axis_name)
     my_index = lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_pos = my_index * t_local + jnp.arange(t_local)
@@ -220,13 +221,13 @@ def make_ring_attention(mesh, axis_name="seq", causal=False):
     """shard_map-wrapped ring attention over ``mesh``: takes/returns
     sequence-sharded (B, T, H, D) arrays."""
     from jax.sharding import PartitionSpec as P
+    from veles_tpu.parallel.mesh import shard_map
 
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal)
-    return jax.jit(jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False))
+    return jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
 
 
 # -- Ulysses (all-to-all) sequence parallelism --------------------------------
@@ -245,7 +246,8 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     lengths), but it requires
     ``heads % axis_size == 0`` and materializes the full sequence per
     device for its head slice (HBM scales with T, not T/n)."""
-    n = lax.axis_size(axis_name)
+    from veles_tpu.parallel.mesh import axis_size as _axis_size
+    n = _axis_size(axis_name)
     heads = q.shape[2]
     if heads % n:
         raise ValueError("ulysses needs heads (%d) divisible by the "
@@ -266,10 +268,10 @@ def make_ulysses_attention(mesh, axis_name="seq", causal=False):
     sequence-sharded (B, T, H, D) arrays (same contract as
     :func:`make_ring_attention` — the two are drop-in alternatives)."""
     from jax.sharding import PartitionSpec as P
+    from veles_tpu.parallel.mesh import shard_map
 
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal)
-    return jax.jit(jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False))
+    return jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))

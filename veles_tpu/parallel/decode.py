@@ -20,6 +20,7 @@ identical parameter pytree and sublayer math.
 """
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,7 @@ from veles_tpu.ops.attention import attention
 from veles_tpu.ops.quant import (int8_cache_attend, matmul_any,
                                  quantize_int8)
 from veles_tpu.observe.xla_stats import instrument
+from veles_tpu.parallel.mesh import shard_map
 # ONE copy of the sublayer math, shared with the training-side full
 # forward — the equivalence the module contract promises is structural
 from veles_tpu.parallel.transformer_step import _block_qkv, _head, _mlp
@@ -376,14 +378,20 @@ SLOT_SPAN_TILE = 128
 
 
 def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
-                    dtype=jnp.float32, quantized=False):
+                    dtype=jnp.float32, quantized=False, mesh=None,
+                    mesh_axis="model"):
     """Cache + control state for ``slots`` concurrent sequences.
 
     ``quantized=True`` stores the slot K/V as int8 with per-(slot,
     position, head) f32 scales in the head-major (L, S, H, D, T)
     layout — ``init_kv_cache``'s int8-KV recipe generalized to the
     slot pool, so continuous serving gets the same halved cache
-    traffic as raw ``generate(quantize="int8-kv")``."""
+    traffic as raw ``generate(quantize="int8-kv")``.
+
+    ``mesh`` creates the state already in the serving layout: the KV
+    slab (and the int8 tier's scales) sharded over their heads dim on
+    ``mesh_axis``, control leaves replicated — per-device slot-cache
+    HBM then scales with H/n (:func:`slot_state_specs`)."""
     base = {
         "lengths": jnp.zeros((slots,), jnp.int32),
         "logits": jnp.zeros((slots, vocab), jnp.float32),
@@ -396,20 +404,23 @@ def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
     if quantized:
         qshape = (n_blocks, slots, heads, head_dim, max_len)
         sshape = (n_blocks, slots, heads, max_len)
-        return dict(base,
-                    k=jnp.zeros(qshape, jnp.int8),
-                    v=jnp.zeros(qshape, jnp.int8),
-                    k_scale=jnp.zeros(sshape, jnp.float32),
-                    v_scale=jnp.zeros(sshape, jnp.float32))
-    shape = (n_blocks, slots, max_len, heads, head_dim)
-    return dict(base, k=jnp.zeros(shape, dtype),
-                v=jnp.zeros(shape, dtype))
+        state = dict(base,
+                     k=jnp.zeros(qshape, jnp.int8),
+                     v=jnp.zeros(qshape, jnp.int8),
+                     k_scale=jnp.zeros(sshape, jnp.float32),
+                     v_scale=jnp.zeros(sshape, jnp.float32))
+    else:
+        shape = (n_blocks, slots, max_len, heads, head_dim)
+        state = dict(base, k=jnp.zeros(shape, dtype),
+                     v=jnp.zeros(shape, dtype))
+    if mesh is not None:
+        state = shard_slot_tree(
+            state, mesh, slot_state_specs(quantized, axis=mesh_axis))
+    return state
 
 
-@functools.partial(jax.jit, static_argnames=("heads",),
-                   donate_argnames=("state",))
-def slot_admit_many(params, embed_table, heads, state, slots, prompt_x,
-                    req_keys, lengths):
+def _slot_admit_many(params, embed_table, heads, state, slots,
+                     prompt_x, req_keys, lengths):
     """Admit a whole same-bucket group in ONE dispatch: prefill
     ``prompt_x`` (B, T, E) — each row right-padded to the bucket T —
     and scatter the K/V slabs into slots ``slots`` (B,) int32.
@@ -476,11 +487,8 @@ def slot_admit(params, embed_table, heads, state, slot, prompt_x,
         jnp.reshape(jnp.asarray(length, jnp.int32), (1,)))
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("heads", "sample", "top_k", "span"),
-                   donate_argnames=("state",))
-def slot_step(params, embed_table, heads, state, active,
-              temperature=1.0, sample=False, top_k=0, span=None):
+def _slot_step(params, embed_table, heads, state, active,
+               temperature=1.0, sample=False, top_k=0, span=None):
     """One decode step across ALL slots; ``active`` (S,) bool gates
     which slots advance (inactive slots' lanes are computed but their
     lengths/logits stay frozen and their emitted token is meaningless —
@@ -595,12 +603,8 @@ def slot_step(params, embed_table, heads, state, active,
     return new_state, tok_in
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("heads", "n", "sample", "top_k",
-                                    "span"),
-                   donate_argnames=("state",))
-def slot_step_many(params, embed_table, heads, state, active, n,
-                   temperature=1.0, sample=False, top_k=0, span=None):
+def _slot_step_many(params, embed_table, heads, state, active, n,
+                    temperature=1.0, sample=False, top_k=0, span=None):
     """``n`` lockstep ``slot_step``s as ONE ``lax.scan`` dispatch —
     the throughput mode: admission happens between chunks, so a
     high-RTT host pays one round trip per ``n`` tokens instead of per
@@ -609,9 +613,9 @@ def slot_step_many(params, embed_table, heads, state, active, n,
     ``(state, emitted (n, S))``; the host discards a slot's tail
     tokens past its budget/eos."""
     def body(state, _):
-        state, emitted = slot_step(params, embed_table, heads, state,
-                                   active, temperature, sample, top_k,
-                                   span=span)
+        state, emitted = _slot_step(params, embed_table, heads, state,
+                                    active, temperature, sample, top_k,
+                                    span=span)
         return state, emitted
 
     # named after the host-side "decode.dispatch" span (the profiler
@@ -620,6 +624,21 @@ def slot_step_many(params, embed_table, heads, state, active, n,
     with jax.named_scope("decode.dispatch"):
         return lax.scan(body, state, None, length=n)
 
+
+# the single-chip jitted surface. One compiled program per (bucket,
+# group) via the jit cache; the sharded layouts get their own jit
+# objects with PINNED output shardings (sharded_slot_fns below), so a
+# donated state can never drift off the canonical layout and defeat
+# the cache.
+slot_admit_many = functools.partial(
+    jax.jit, static_argnames=("heads",),
+    donate_argnames=("state",))(_slot_admit_many)
+slot_step = functools.partial(
+    jax.jit, static_argnames=("heads", "sample", "top_k", "span"),
+    donate_argnames=("state",))(_slot_step)
+slot_step_many = functools.partial(
+    jax.jit, static_argnames=("heads", "n", "sample", "top_k", "span"),
+    donate_argnames=("state",))(_slot_step_many)
 
 # compile/cache-hit/FLOPs telemetry per slot program
 # (observe/xla_stats.py): each name matches its host span and
@@ -801,11 +820,10 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
         # the TABLE is replicated (every device embeds the full token
         # vector); the VOCAB sharding lives in params["head"], whose
         # local logits all_gather back to full width
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             device_run, mesh=mesh,
             in_specs=(param_specs, P(), P(), cache_specs),
-            out_specs=P(),
-            check_vma=False))
+            out_specs=P()))
         # place the shards explicitly (shard_map would otherwise
         # require pre-sharded inputs for non-replicated specs)
         packed = jax.tree.map(
@@ -820,3 +838,178 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
         return fn(packed, table_sharded, prompt_x, cache)
 
     return run
+
+
+# -- mesh-sharded slot serving (layout path) ----------------------------------
+#
+# The continuous-batching engine above goes multi-chip by LAYOUT, not by
+# a second implementation: the params shard tensor-parallel over the
+# mesh's ``model`` axis, the slot KV slab shards over its HEADS dim,
+# and the ONE copy of the slot math (slot_admit_many / slot_step /
+# slot_step_many) runs unchanged — XLA's SPMD partitioner splits the
+# sharded matmuls and the head-sharded cache ops along the operand
+# shardings and inserts the psum/all-gather collectives. Token streams
+# stay identical to the single-chip engine (the collectives only
+# reassociate reductions, below token granularity — the same contract
+# the TP generate tests pin). One compiled program exists per
+# (bucket, group, layout): jit specializes on operand shardings, so the
+# instrument() compile counters and the dispatch-count CI hooks keep
+# working per layout. docs/sharded_serving.md is the recipe.
+#
+# Known layout cost vs the hand-written make_tp_generate partition: the
+# fused qkv matrix (E, 3E) shards by FLAT columns, whose chunk
+# boundaries straddle the q/k/v and head boundaries — the partitioner
+# then reshards the (small) qkv activation around the per-head
+# reshape/split instead of handing each device whole heads. Fixing it
+# needs the head-major repack _repack_block does, i.e. a repacked
+# variant of the shared sublayer math — a measured follow-on, not a
+# spec change (tracked in docs/sharded_serving.md Limits).
+
+def validate_slot_mesh(mesh, heads, params, embed_table, axis="model"):
+    """Fail a bad serving mesh at build time with an error naming the
+    offending dimension — never as an opaque partitioner error from
+    inside the first admit dispatch."""
+    n = dict(mesh.shape).get(axis, 1)
+    if n <= 1:
+        return n
+    blk = params["blocks"][0]
+    w1 = blk["w1"]["q8"] if isinstance(blk["w1"], dict) else blk["w1"]
+    ffn_hidden = w1.shape[1]
+    vocab = embed_table.shape[0]
+    if heads % n or ffn_hidden % n or vocab % n:
+        raise ValueError(
+            "sharded slot serving needs heads (%d), ffn hidden (%d) "
+            "and vocab (%d) divisible by the %r axis size %d"
+            % (heads, ffn_hidden, vocab, axis, n))
+    return n
+
+
+def slot_param_specs(params, axis="model"):
+    """PartitionSpec pytree (same structure as ``params``) for
+    tensor-parallel slot serving: attention qkv/FFN-up columns and the
+    vocab head shard over ``axis``, out-proj/FFN-down rows shard over
+    ``axis``, norms and post-reduction biases replicate. int8-quantized
+    leaves (``{"q8", "scale"}``) shard the payload like the float
+    matrix; per-output-column scales follow their columns."""
+    from jax.sharding import PartitionSpec as P
+
+    def mat(leaf, spec, scale_spec):
+        if isinstance(leaf, dict):
+            return {"q8": spec, "scale": scale_spec}
+        return spec
+
+    blocks = []
+    for blk in params["blocks"]:
+        specs = {
+            "ln1_w": P(), "ln1_b": P(),
+            "wqkv": mat(blk["wqkv"], P(None, axis), P(axis)),
+            "bqkv": P(axis),
+            "wout": mat(blk["wout"], P(axis, None), P()),
+            "bout": P(),
+            "ln2_w": P(), "ln2_b": P(),
+            "w1": mat(blk["w1"], P(None, axis), P(axis)),
+            "b1": P(axis),
+            "w2": mat(blk["w2"], P(axis, None), P()),
+            "b2": P(),
+        }
+        blocks.append(specs)
+    return {"blocks": blocks, "lnf_w": P(), "lnf_b": P(),
+            "head": mat(params["head"], P(None, axis), P(axis))}
+
+
+def slot_state_specs(quantized=False, axis="model"):
+    """PartitionSpec dict for the slot state: the KV slab (and the
+    int8 tier's scales) shard over their HEADS dim, control leaves
+    (lengths/logits/req_key/step) replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    if quantized:
+        kv = P(None, None, axis, None, None)   # (L, S, H, D, T)
+        scale = P(None, None, axis, None)      # (L, S, H, T)
+        extra = {"k_scale": scale, "v_scale": scale}
+    else:
+        kv = P(None, None, None, axis, None)   # (L, S, T, H, D)
+        extra = {}
+    return dict({"k": kv, "v": kv, "lengths": P(), "logits": P(),
+                 "req_key": P(), "step": P()}, **extra)
+
+
+def shard_slot_tree(tree, mesh, specs):
+    """``device_put`` a pytree into ``mesh`` under a matching spec
+    pytree (fresh placement — callers moving LIVE state between
+    layouts use ``parallel/reshard.reshard``, which rides collectives
+    and is measured)."""
+    from jax.sharding import NamedSharding
+
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: not isinstance(x, (dict, list, tuple)))
+    return jax.device_put(tree, shardings)
+
+
+def shard_slot_params(params, embed_table, heads, mesh, axis="model"):
+    """Place decode params + embed table into the serving layout:
+    params tensor-parallel over ``axis``, table replicated. Returns
+    ``(params, embed_table)``; validates divisibility first."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    validate_slot_mesh(mesh, heads, params, embed_table, axis=axis)
+    params = shard_slot_tree(params, mesh, slot_param_specs(params, axis))
+    return params, jax.device_put(embed_table, NamedSharding(mesh, P()))
+
+
+#: (mesh, axis, quantized) -> (admit, step, step_many) jit objects with
+#: the state's output shardings PINNED to the canonical serving layout.
+#: Without the pin, the compiler is free to hand a donated state back
+#: in whatever layout the last program preferred — the next call then
+#: misses the jit cache and every admit recompiles (a recompile storm
+#: by construction). One entry per layout keeps the compile count at
+#: one program per (bucket, group, mesh), which is what the
+#: dispatch-count and storm regression tests assert — so the
+#: check-then-insert is LOCKED: two tiers of the same layout built
+#: concurrently (a bf16 and an int8 GenerateAPI, a breaker rebuild
+#: racing a new API) must share one jit object, not compile twice.
+_SHARDED_SLOT_FNS = {}
+_SHARDED_SLOT_LOCK = threading.Lock()
+
+
+def sharded_slot_fns(mesh, mesh_axis="model", quantized=False):
+    """The sharded slot engine's jitted call surface: the SAME raw
+    functions as the single-chip ``slot_admit_many``/``slot_step``/
+    ``slot_step_many`` (one copy of the math — the bit-identity
+    contract), jitted per layout with the state outputs pinned to
+    :func:`slot_state_specs` and the emitted tokens replicated.
+    Instrumented under the same program names, so the veles_xla_*
+    counters, profiler spans and flight-recorder vocabulary are
+    layout-blind."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = (mesh, mesh_axis, bool(quantized))
+    with _SHARDED_SLOT_LOCK:
+        fns = _SHARDED_SLOT_FNS.get(key)
+    if fns is not None:
+        return fns
+    state_sh = {
+        name: NamedSharding(mesh, spec)
+        for name, spec in slot_state_specs(quantized,
+                                           axis=mesh_axis).items()}
+    replicated = NamedSharding(mesh, P())
+    admit = instrument("decode.admit", jax.jit(
+        _slot_admit_many, static_argnames=("heads",),
+        donate_argnames=("state",), out_shardings=state_sh))
+    step = instrument("decode.step", jax.jit(
+        _slot_step,
+        static_argnames=("heads", "sample", "top_k", "span"),
+        donate_argnames=("state",),
+        out_shardings=(state_sh, replicated)))
+    step_many = instrument("decode.dispatch", jax.jit(
+        _slot_step_many,
+        static_argnames=("heads", "n", "sample", "top_k", "span"),
+        donate_argnames=("state",),
+        out_shardings=(state_sh, replicated)))
+    fns = (admit, step, step_many)
+    with _SHARDED_SLOT_LOCK:
+        # a racing builder may have won; keep ITS jit objects (their
+        # compiled programs are already cached)
+        fns = _SHARDED_SLOT_FNS.setdefault(key, fns)
+    return fns

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from veles_tpu.parallel.mesh import shard_map
+
 
 def init_moe_params(rng, n_experts, d_model, d_hidden):
     """Gate + per-expert FFN weights (host numpy in, pytree out)."""
@@ -57,11 +59,11 @@ def make_moe_ffn(mesh, n_experts, capacity_factor=2.0):
     assert n_experts % ep == 0, "n_experts must divide the expert axis"
     e_local = n_experts // ep
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=({"gate": P(), "w1": P("expert"), "b1": P("expert"),
                         "w2": P("expert"), "b2": P("expert")},
                        P("expert")),
-             out_specs=(P("expert"), P()), check_vma=False)
+             out_specs=(P("expert"), P()))
     def moe(p, x_local):
         t_local, d_model = x_local.shape
         capacity = max(1, int(t_local * capacity_factor / n_experts))

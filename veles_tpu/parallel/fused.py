@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from veles_tpu.core.units import Unit
+from veles_tpu.parallel.mesh import shard_map
 from veles_tpu.loader.base import TRAIN, VALID
 from veles_tpu.ops import activations as act_lib, losses
 from veles_tpu.ops.gather import gather_minibatch
@@ -481,17 +482,16 @@ def build_tick(specs, norm_type="none", mesh=None,
     train_specs = (P(),) + eval_specs + (P(),)  # + seed
     eval_sweep_specs = (P(), P(), P(), P(), P(None, "data"), P(), P())
     train_sweep_specs = (P(),) + eval_sweep_specs + (P(),)  # + seeds
-    train = jax.shard_map(local_train, mesh=mesh, in_specs=train_specs,
-                          out_specs=(P(), (P(), P())), check_vma=False)
-    evaluate = jax.shard_map(local_eval, mesh=mesh, in_specs=eval_specs,
-                             out_specs=(P(), P(), P()),
-                             check_vma=False)
-    train_sweep = jax.shard_map(
+    train = shard_map(local_train, mesh=mesh, in_specs=train_specs,
+                      out_specs=(P(), (P(), P())))
+    evaluate = shard_map(local_eval, mesh=mesh, in_specs=eval_specs,
+                         out_specs=(P(), P(), P()))
+    train_sweep = shard_map(
         local_train_sweep, mesh=mesh, in_specs=train_sweep_specs,
-        out_specs=(P(), (P(), P())), check_vma=False)
-    eval_sweep = jax.shard_map(
+        out_specs=(P(), (P(), P())))
+    eval_sweep = shard_map(
         local_eval_sweep, mesh=mesh, in_specs=eval_sweep_specs,
-        out_specs=(P(), P(), P()), check_vma=False)
+        out_specs=(P(), P(), P()))
     steps = (jax.jit(train, donate_argnums=(0,)), jax.jit(evaluate),
              jax.jit(train_sweep, donate_argnums=(0,)),
              jax.jit(eval_sweep))

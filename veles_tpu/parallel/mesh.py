@@ -12,6 +12,8 @@ The reference has no analogue (its DP is host-level); this is the
 scaling-book-style mesh the whole pod-mode design hangs off.
 """
 
+import threading
+
 import numpy
 
 import jax
@@ -20,6 +22,53 @@ from jax.sharding import Mesh
 from veles_tpu.core.config import root
 
 AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+
+
+#: resolved once: (implementation, name of its replication-check
+#: kwarg). Feature-detected by SIGNATURE, not try/except — a genuine
+#: TypeError from a caller's bad mesh/specs must surface as itself,
+#: never as a bogus "unexpected keyword" retry artifact.
+_SHARD_MAP_IMPL = None
+
+
+def _shard_map_impl():
+    global _SHARD_MAP_IMPL
+    if _SHARD_MAP_IMPL is None:
+        import inspect
+        impl = getattr(jax, "shard_map", None)
+        if impl is None:
+            from jax.experimental.shard_map import shard_map as impl
+        try:
+            params = inspect.signature(impl).parameters
+        except (TypeError, ValueError):
+            params = {}
+        kwarg = "check_vma" if "check_vma" in params else (
+            "check_rep" if "check_rep" in params else None)
+        _SHARD_MAP_IMPL = (impl, kwarg)
+    return _SHARD_MAP_IMPL
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: newer jax exposes it at
+    the top level (replication checking via ``check_vma``), older jax
+    under ``jax.experimental.shard_map`` (``check_rep``). Every
+    shard_map in the tree routes through here so a jax upgrade is one
+    edit, not eight."""
+    impl, kwarg = _shard_map_impl()
+    kwargs = {kwarg: False} if kwarg else {}
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs)
+
+
+def axis_size(axis_name):
+    """Static mesh-axis size from inside a shard_map body, across jax
+    versions (``lax.axis_size`` is newer jax; older jax reads the axis
+    environment)."""
+    impl = getattr(jax.lax, "axis_size", None)
+    if impl is not None:
+        return impl(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
 
 
 def mesh_axes():
@@ -75,18 +124,64 @@ def is_primary():
         return True
 
 
-def build_mesh(devices=None, **overrides):
+def parse_axes(spec, flag="--mesh"):
+    """Parse an ``AXIS=N[,AXIS=N...]`` mesh string into an override
+    dict — ONE parser for ``--mesh`` and ``--serve-mesh`` (and their
+    config twins), so the syntax cannot drift between flags. Raises
+    ``ValueError`` naming ``flag``; sizes stay unvalidated here —
+    :func:`build_mesh` owns the integer/positivity checks."""
+    overrides = {}
+    for part in str(spec).split(","):
+        axis, eq, size = part.partition("=")
+        axis = axis.strip()
+        if not eq or axis not in AXIS_ORDER:
+            raise ValueError(
+                "%s expects AXIS=N[,AXIS=N...] with axes from %s, "
+                "got %r" % (flag, ", ".join(AXIS_ORDER), spec))
+        try:
+            overrides[axis] = int(size)
+        except ValueError:
+            raise ValueError("%s: size %r of axis %s is not an integer"
+                             % (flag, size, axis))
+    return overrides
+
+
+def build_mesh(devices=None, flag="root.common.mesh.axes / --mesh",
+               **overrides):
     """Build a Mesh over ``devices`` with configured axis sizes.
 
     Axis sizes multiply to the device count; a single -1 axis absorbs the
     remainder (like a reshape). Axes of size 1 are kept (they cost nothing
     and make in/out specs uniform).
+
+    Every size is validated here with an error naming the config knob —
+    ``flag`` (the training default, or ``--serve-mesh``'s twin via
+    :func:`veles_tpu.serving.build_serve_mesh`) — a bad value must fail
+    as "axis data=0 is invalid", never as an opaque numpy reshape
+    exception three layers down.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     sizes = mesh_axes()
-    sizes.update({k: int(v) for k, v in overrides.items()})
+    for key, value in overrides.items():
+        if key not in sizes:
+            raise ValueError(
+                "unknown mesh axis %r (valid: %s) — check %s"
+                % (key, ", ".join(AXIS_ORDER), flag))
+        sizes[key] = value
+    for key, value in sizes.items():
+        try:
+            as_int = int(value)
+        except (TypeError, ValueError):
+            as_int = None
+        if as_int is None or as_int != value or (
+                as_int < 1 and as_int != -1):
+            raise ValueError(
+                "mesh axis %s=%r is invalid: sizes must be positive "
+                "integers (or -1 to absorb the remaining devices) — "
+                "check %s" % (key, value, flag))
+        sizes[key] = as_int
     wildcard = [k for k, v in sizes.items() if v == -1]
     fixed = int(numpy.prod([v for v in sizes.values() if v != -1]))
     if len(wildcard) > 1:
@@ -94,12 +189,78 @@ def build_mesh(devices=None, **overrides):
     if wildcard:
         if n % fixed:
             raise ValueError(
-                "%d devices not divisible by fixed axes %s" % (n, sizes))
+                "mesh axes %s: the fixed sizes multiply to %d, which "
+                "does not divide the %d available devices — check %s"
+                % (sizes, fixed, n, flag))
         sizes[wildcard[0]] = n // fixed
     elif fixed != n:
         raise ValueError(
-            "mesh axes %s multiply to %d but %d devices present"
-            % (sizes, fixed, n))
+            "mesh axes %s multiply to %d but %d devices present — "
+            "check %s" % (sizes, fixed, n, flag))
     shape = tuple(sizes[name] for name in AXIS_ORDER)
     dev_array = numpy.asarray(devices).reshape(shape)
-    return Mesh(dev_array, AXIS_ORDER)
+    mesh = Mesh(dev_array, AXIS_ORDER)
+    note_active_mesh(mesh)
+    return mesh
+
+
+# -- active-mesh registry ----------------------------------------------------
+#
+# The LAST mesh built in this process, kept as plain data (no Device
+# refs): the /metrics mesh gauges, the web-status device column and the
+# fleet slaves' metric-row coordinates all read it (a master scrape must
+# be able to tell WHICH shard a process is, not just which slave).
+
+_active_lock = threading.Lock()
+_active_mesh = None
+
+
+def note_active_mesh(mesh):
+    """Record ``mesh`` as the process's active mesh (called by
+    :func:`build_mesh`; callers constructing a Mesh by hand can call it
+    directly)."""
+    global _active_mesh
+    info = {"axes": {name: int(size)
+                     for name, size in dict(mesh.shape).items()},
+            "devices": int(mesh.size)}
+    with _active_lock:
+        _active_mesh = info
+
+
+def active_mesh_info():
+    """``{"axes": {name: size}, "devices": n}`` of the last mesh built
+    in this process, or None when nothing meshed yet."""
+    with _active_lock:
+        return None if _active_mesh is None else {
+            "axes": dict(_active_mesh["axes"]),
+            "devices": _active_mesh["devices"]}
+
+
+def mesh_shape_label(info=None):
+    """Compact ``data2.model4`` string of the non-trivial axes (label
+    value for /metrics rows and the dashboard cell); None when no mesh
+    is active or every axis is 1."""
+    if info is None:
+        info = active_mesh_info()
+    if not info:
+        return None
+    parts = ["%s%d" % (name, size)
+             for name in AXIS_ORDER
+             for size in [info["axes"].get(name, 1)] if size != 1]
+    return ".".join(parts) or None
+
+
+def mesh_coordinate_labels():
+    """Label dict identifying this process's place in the pod:
+    ``{"process": i, "mesh": "data2.model4"}`` — merged into the
+    metric rows a fleet slave piggybacks on update frames so a master
+    scrape distinguishes shards, not just slaves. Empty when no mesh
+    is active (single-chip slaves keep their old label set)."""
+    label = mesh_shape_label()
+    if label is None:
+        return {}
+    try:
+        process = jax.process_index()
+    except RuntimeError:
+        process = 0
+    return {"process": str(process), "mesh": label}

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu.parallel.mesh import shard_map
+
 
 def _pipeline_body(stage_fn, n_stages, n_microbatches, remat):
     """The shared shard_map-local forward: returns the full pipelined
@@ -84,10 +86,9 @@ def make_pipeline(mesh, stage_fn, n_microbatches, remat=False):
     """
     n_stages = mesh.shape["pipe"]
 
-    _pipeline = jax.shard_map(
+    _pipeline = shard_map(
         _pipeline_body(stage_fn, n_stages, n_microbatches, remat),
-        mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
-        check_vma=False)
+        mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P())
 
     def pipeline(stage_weights, batch):
         _validate(stage_weights, batch, n_stages, n_microbatches)
@@ -165,10 +166,10 @@ def make_pipeline_train_step(mesh, stage_fn, n_microbatches, loss_fn,
         return new, loss
 
     batch_spec = P("data") if data_ax > 1 else P()
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         local_step, mesh=mesh,
         in_specs=(P("pipe"), batch_spec, batch_spec),
-        out_specs=(P("pipe"), P()), check_vma=False))
+        out_specs=(P("pipe"), P())))
 
     def train_step(stage_weights, batch, targets):
         _validate(stage_weights, batch, n_stages, n_microbatches,

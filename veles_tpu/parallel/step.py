@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from veles_tpu.ops import activations as act_lib, losses
+from veles_tpu.parallel.mesh import shard_map
 from veles_tpu.ops.gemm import matmul
 
 
@@ -158,8 +159,8 @@ def build_train_step(layer_spec, mesh=None, donate=True):
                    "vw": [wspec] * n_layers, "vb": [bspec] * n_layers}
     in_specs = (param_specs, P("data"), P("data"), P("data"))
     out_specs = (param_specs, (P(), P()))
-    fused = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=False)
+    fused = shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
     return jax.jit(fused, **jit_kwargs)
 
@@ -174,7 +175,8 @@ def _model_shard(err, model_ax):
     """Slice this device's column block out of a full-width error."""
     if model_ax == 1:
         return err
-    cols = err.shape[1] // jax.lax.axis_size("model")
+    from veles_tpu.parallel.mesh import axis_size
+    cols = err.shape[1] // axis_size("model")
     idx = jax.lax.axis_index("model")
     return jax.lax.dynamic_slice_in_dim(err, idx * cols, cols, axis=1)
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from veles_tpu.ops.quant import matmul_any
+from veles_tpu.parallel.mesh import shard_map
 from veles_tpu.ops.attention import (attention, ring_attention,
                                      ulysses_attention)
 
@@ -148,9 +149,8 @@ def build_transformer_train_step(heads, mesh=None, learning_rate=0.1,
     xspec = P("data", "seq", None)
     in_specs = (P(), xspec, P("data", "seq"))
     out_specs = (P(), (P(), P()))
-    return jax.jit(jax.shard_map(local_step, mesh=mesh,
-                                 in_specs=in_specs, out_specs=out_specs,
-                                 check_vma=False))
+    return jax.jit(shard_map(local_step, mesh=mesh,
+                             in_specs=in_specs, out_specs=out_specs))
 
 
 def shard_tokens(arrays, mesh):

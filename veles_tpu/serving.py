@@ -538,6 +538,38 @@ class RESTfulAPI(Unit):
             responder["event"].set()
 
 
+def build_serve_mesh(spec):
+    """Build the SERVING mesh from ``--serve-mesh`` /
+    ``root.common.serve.mesh``: an ``AXIS=N[,AXIS=N...]`` string (the
+    shared ``--mesh`` parser; -1 absorbs the remaining devices), a
+    dict of axis sizes, or None/"" (no mesh — single-chip serving, the
+    default). Validation errors name the flag, not a reshape frame;
+    sizes are validated by ``build_mesh`` itself (a 2.5 must raise,
+    never silently truncate to 2).
+
+    The serve mesh is built from ALL-1 axes plus exactly what the spec
+    names — never seeded from the TRAINING config
+    (``root.common.mesh.axes``): a pod-training ``data=2`` leaking into
+    ``--serve-mesh model=4`` would silently replicate the slot engine's
+    compute and HBM across the data axis (or blame the serve flag for a
+    device-count mismatch it didn't cause)."""
+    if not spec:
+        return None
+    from veles_tpu.parallel.mesh import AXIS_ORDER, build_mesh, parse_axes
+
+    if isinstance(spec, str):
+        spec = parse_axes(spec, flag="--serve-mesh")
+    elif hasattr(spec, "__content__"):
+        spec = spec.__content__()
+    spec = dict(spec)
+    if not spec:
+        return None  # an empty config subtree configures nothing
+    axes = {name: 1 for name in AXIS_ORDER}
+    axes.update(spec)
+    return build_mesh(flag="root.common.serve.mesh / --serve-mesh",
+                      **axes)
+
+
 class ContinuousDecoder:
     """Continuous-batching LLM serving on the slot engine
     (``parallel/decode.py`` ``init_slot_state``/``slot_admit_many``/
@@ -578,14 +610,15 @@ class ContinuousDecoder:
     def __init__(self, params, embed_table, heads, slots=4,
                  max_len=512, n_tokens=32, eos=None,
                  temperature=0.0, top_k=0, key=None, quantize=None,
-                 tile=None):
+                 tile=None, mesh=None, mesh_axis="model"):
         import collections
 
         import jax
 
         from veles_tpu.parallel.decode import (SLOT_SPAN_TILE,
                                                init_slot_state,
-                                               quantize_params)
+                                               quantize_params,
+                                               shard_slot_params)
 
         if quantize not in (None, "none", "int8", "int8-kv"):
             raise ValueError("quantize must be None, 'int8' or "
@@ -598,6 +631,18 @@ class ContinuousDecoder:
         self.quantize = quantize if quantize != "none" else None
         if self.quantize and not isinstance(params["head"], dict):
             params = quantize_params(params)
+        #: serving mesh (docs/sharded_serving.md): params go
+        #: tensor-parallel over ``mesh_axis``, the slot KV shards over
+        #: heads, and every dispatch below runs the SAME slot programs
+        #: under the sharded layout (one compiled program per layout —
+        #: token streams stay identical to the single-chip engine).
+        #: Quantization above ran on the FULL weights, so the int8
+        #: payload each shard holds is bit-identical to single-chip.
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        if mesh is not None:
+            params, embed_table = shard_slot_params(
+                params, embed_table, heads, mesh, axis=mesh_axis)
         self.params = params
         self.embed_table = embed_table
         self.heads = heads
@@ -629,7 +674,19 @@ class ContinuousDecoder:
         self.state = init_slot_state(
             n_blocks, slots, self.max_len, heads, embed // heads, vocab,
             dtype=embed_table.dtype,
-            quantized=self.quantize == "int8-kv")
+            quantized=self.quantize == "int8-kv",
+            mesh=mesh, mesh_axis=mesh_axis)
+        if mesh is not None:
+            # layout-pinned jit surface: output state shardings stay on
+            # the canonical serving layout so donated state never
+            # drifts and every (bucket, group) compiles exactly once
+            from veles_tpu.parallel.decode import sharded_slot_fns
+            self._sharded_fns = sharded_slot_fns(
+                mesh, mesh_axis, quantized=self.quantize == "int8-kv")
+        else:
+            # single-chip: resolved per call from the module (late
+            # binding — the chaos/fault-injection seam tests patch)
+            self._sharded_fns = None
         self._queue = collections.deque()
         self._free = list(range(slots))
         self._slot_req = {}      # slot -> request id
@@ -777,6 +834,8 @@ class ContinuousDecoder:
 
         from veles_tpu.parallel.decode import slot_admit_many
 
+        admit = (self._sharded_fns[0] if self._sharded_fns
+                 else slot_admit_many)
         if not (self._queue and self._free):
             return
         groups = {}
@@ -809,7 +868,7 @@ class ContinuousDecoder:
             with self._span("decode.admit", [r[0] for r in group],
                             bucket=bucket, group=len(group)):
                 t0 = time.perf_counter()
-                self.state = slot_admit_many(
+                self.state = admit(
                     self.params, self.embed_table, self.heads,
                     self.state,
                     jnp.asarray([r[2] for r in rows], jnp.int32), x,
@@ -852,11 +911,12 @@ class ContinuousDecoder:
         {request_id: token} for the tokens generated this step."""
         from veles_tpu.parallel.decode import slot_step
 
+        step = self._sharded_fns[1] if self._sharded_fns else slot_step
         self._admit_pending()
         if not self._slot_req:
             return {}
         snapshot = dict(self._slot_req)
-        self.state, emitted = slot_step(
+        self.state, emitted = step(
             self.params, self.embed_table, self.heads, self.state,
             jnp.asarray(self._active()),
             jnp.float32(self.temperature or 1.0),
@@ -964,6 +1024,8 @@ class ContinuousDecoder:
         compute."""
         from veles_tpu.parallel.decode import slot_step_many
 
+        step_many = (self._sharded_fns[2] if self._sharded_fns
+                     else slot_step_many)
         self._admit_pending()
         if not self._slot_req:
             return None
@@ -972,7 +1034,7 @@ class ContinuousDecoder:
         with self._span("decode.dispatch", list(snapshot.values()),
                         chunk=chunk):
             t0 = time.perf_counter()
-            self.state, emitted = slot_step_many(
+            self.state, emitted = step_many(
                 self.params, self.embed_table, self.heads, self.state,
                 jnp.asarray(self._active()), chunk,
                 jnp.float32(self.temperature or 1.0),
@@ -1087,12 +1149,27 @@ class GenerateAPI:
                  path="/generate", chunk=8, request_timeout=None,
                  max_queue=None, deadline=None, rebuild_backoff=None,
                  rebuild_backoff_max=None, chaos=None, quantize=None,
-                 tile=None):
+                 tile=None, mesh=None, mesh_axis="model"):
         import queue
 
         from veles_tpu.core.config import root
 
         serve_cfg = root.common.serve
+        #: serving mesh (--serve-mesh / root.common.serve.mesh, or an
+        #: explicit Mesh): the decoder this API drives — and every
+        #: decoder a breaker rebuild constructs — serves tensor-parallel
+        #: over it (docs/sharded_serving.md). Built HERE (not in the
+        #: decoder) so the rebuild path reuses one mesh object and its
+        #: compiled-program cache entries. Raw attribute read, NOT
+        #: serve_cfg.get(): get() collapses Config SUBTREES to the
+        #: default, which would silently ignore a dict-style
+        #: ``root.common.serve.mesh.model = 8`` config.
+        if mesh is None:
+            try:
+                mesh_spec = object.__getattribute__(serve_cfg, "mesh")
+            except AttributeError:
+                mesh_spec = None
+            mesh = build_serve_mesh(mesh_spec)
         #: default per-request deadline (seconds); ``request_timeout``
         #: is the legacy name for the same knob. Validated BEFORE the
         #: (expensive) decoder build, so a server misconfiguration
@@ -1112,7 +1189,8 @@ class GenerateAPI:
             params=params, embed_table=embed_table, heads=heads,
             slots=slots, max_len=max_len, n_tokens=n_tokens,
             temperature=temperature, top_k=top_k, eos=eos, key=key,
-            quantize=quantize, tile=tile)
+            quantize=quantize, tile=tile, mesh=mesh,
+            mesh_axis=mesh_axis)
         self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
         self.port = port
