@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh
+	regress mesh paged
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -40,6 +40,16 @@ chaos-serve:
 mesh:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_reshard.py \
 		tests/test_mesh_serving.py -m mesh -q
+
+# Paged-KV serving suite (docs/paged_kv.md): page-pool bit-identity vs
+# the dense engine and greedy generate() (bf16 + int8-KV, single-chip
+# and the 8-device CPU mesh), shared-prefix tail/hit admissions with
+# divergence, cancel/eviction page accounting, the pool-aware admission
+# gate's no-deadlock invariant, and the dispatch-economy /
+# zero-recompile-storm bound for the paged programs.
+paged:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_paged.py \
+		-m paged -q
 
 # Standalone continuous-batching serving bench (docs/
 # serving_performance.md): one JSON line with the decode_continuous_*

@@ -1,0 +1,748 @@
+"""Paged KV pool + shared-prefix serving (docs/paged_kv.md): the page
+pool engine must stream bit-identical tokens to the dense slot engine
+and to greedy `generate()` on CPU — bf16/f32 and int8-KV tiers,
+shared-prefix admissions with mid-stream divergence, mid-flight joins,
+cancel returning pages, LRU eviction under pool pressure, and the
+8-device CPU mesh (pool pages sharded over heads) — plus the dispatch
+economy / zero-recompile-storm bound for the paged programs and the
+pool-aware admission gate's no-deadlock invariant. `make paged` runs
+this file standalone, mirroring `make mesh`."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.observe.xla_stats import get_compile_tracker
+from veles_tpu.parallel.kv_pool import PagePool, pages_for
+from veles_tpu.parallel.mesh import build_mesh
+from veles_tpu.parallel.transformer_step import init_transformer_params
+from veles_tpu.serving import ContinuousDecoder, ServingHealth
+
+pytestmark = pytest.mark.paged
+
+PS = 8  # page size: tiny so short prompts span several pages
+
+
+def post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestPagePool:
+    """Host-side page table: free list, refcounts, reservations, the
+    release-rate window — the invariants the serving gate relies on."""
+
+    def test_alloc_release_refcounts(self):
+        pool = PagePool(8, PS)
+        assert pool.capacity == 7  # page 0 is scratch
+        pages = pool.alloc(3)
+        assert len(pages) == 3 and 0 not in pages
+        assert (pool.used_pages, pool.free_pages) == (3, 4)
+        pool.retain(pages)  # a second holder
+        pool.release(pages)
+        assert pool.used_pages == 3  # still held once
+        pool.release(pages)
+        assert (pool.used_pages, pool.free_pages) == (0, 7)
+
+    def test_alloc_refuses_past_capacity(self):
+        pool = PagePool(4, PS)
+        assert pool.alloc(3) is not None
+        assert pool.alloc(1) is None  # empty free list, nothing to evict
+
+    def test_reservations_bound_by_capacity(self):
+        pool = PagePool(6, PS)
+        assert pool.try_reserve(3)
+        assert pool.try_reserve(2)
+        assert not pool.try_reserve(1)  # 3 + 2 + 1 > capacity 5
+        pool.unreserve(2)
+        assert pool.try_reserve(1)
+
+    def test_retry_after_priced_from_release_rate(self):
+        pool = PagePool(8, PS)
+        # cold window: the fallback, floored at 1 s
+        assert pool.retry_after(4, fallback=2.5) == 2.5
+        pages = pool.alloc(4)
+        pool.release(pages)
+        # 4 pages released just now -> a high observed rate -> the
+        # clamp floor, never the fallback constant
+        assert pool.retry_after(4) == 1.0
+
+    def test_rejects_degenerate_shapes(self):
+        with pytest.raises(ValueError, match="scratch"):
+            PagePool(1, PS)
+        with pytest.raises(ValueError, match="page_size"):
+            PagePool(4, 0)
+
+    def test_boundary_keys_match_prefix_key(self):
+        """The O(T) incremental boundary hash must produce digests
+        byte-identical to _prefix_key of each whole-page prefix — the
+        cache keys written by insert() and probed by lookup()."""
+        from veles_tpu.parallel.kv_pool import (_boundary_keys,
+                                                _prefix_key)
+
+        tokens = numpy.arange(5 * PS + 3, dtype=numpy.int32)
+        keys = _boundary_keys(tokens, PS, 5)
+        assert keys == [_prefix_key(tokens[:k * PS])
+                        for k in range(1, 6)]
+
+    def test_hit_counter_monotone_under_rollback(self):
+        """A lookup rolled back by unlookup (tail-page alloc failed)
+        must not move the hits counter: it is exported as a Prometheus
+        counter, and a decrement reads as a counter reset — rate()
+        would book the whole value as spurious hits."""
+        pool = PagePool(8, PS)
+        tokens = numpy.arange(PS, dtype=numpy.int32)
+        pages = pool.alloc(1)
+        pool.insert(tokens, pages, {"k": jnp.zeros((1, 8, PS, 1, 1)),
+                                    "v": jnp.zeros((1, 8, PS, 1, 1))})
+        longer = numpy.arange(2 * PS, dtype=numpy.int32)
+        entry, shared = pool.lookup(longer)
+        assert entry is not None and shared == PS
+        pool.unlookup(entry)  # rollback: no pages for the tail
+        assert pool.cache.counters["hits"] == 0
+        entry, shared = pool.lookup(longer)
+        pool.book_hit()       # the retried admission commits once
+        assert pool.cache.counters["hits"] == 1
+
+
+class TestCacheRestore:
+    """restore_entries adopts a previous decoder's prefix cache into a
+    fresh pool — including one SMALLER than the cached page set."""
+
+    def _seeded_pool(self, entries, pool_pages=32):
+        pool = PagePool(pool_pages, PS)
+        state = {"k": jnp.zeros((1, pool_pages, PS, 1, 1)),
+                 "v": jnp.zeros((1, pool_pages, PS, 1, 1))}
+        for i in range(entries):
+            tokens = numpy.full(PS, i, numpy.int32)
+            pages = pool.alloc(1)
+            pool.insert(tokens, pages, state)
+            pool.release(pages)  # the "slot" retires; cache ref stays
+        # the rebuild prelude: shadows are captured from the dying
+        # pool's state, never on the admission path
+        pool.capture_shadows(state)
+        return pool
+
+    def test_restore_into_smaller_pool_keeps_newest(self):
+        """A fresh pool too small for every cached page drops OLDEST
+        entries (never a crash, never a full wipe) and restores the
+        survivors — alloc()'s own LRU eviction cannot free old-pool
+        page ids, so the drop loop must size against the free list."""
+        old = self._seeded_pool(entries=5)
+        assert len(old.cache) == 5
+        fresh = PagePool(4, PS, cache=old.cache)  # room for 3 pages
+        restored = []
+        state = fresh.restore_entries(
+            {"k": jnp.zeros((1, 4, PS, 1, 1)),
+             "v": jnp.zeros((1, 4, PS, 1, 1))},
+            lambda st, ids, vals: restored.append(list(ids)) or st)
+        assert len(fresh.cache) == 3  # newest three survive
+        # rebuild-pressure drops book as evictions (the exported
+        # counter must move when entries vanish)
+        assert fresh.cache.counters["evictions"] == 2
+        kept = {int(e["tokens"][0])
+                for e in fresh.cache.entries.values()}
+        assert kept == {2, 3, 4}
+        assert restored and len(restored[0]) == 3
+        assert fresh.used_pages == 3
+        # the survivors are live: an exact re-lookup hits
+        entry, shared = fresh.lookup(numpy.full(PS, 4, numpy.int32))
+        assert entry is None or shared == PS  # logits-less full match
+        # and a pool with no room at all ends up empty, not crashed
+        tiny = PagePool(2, PS, cache=self._seeded_pool(3).cache)
+        tiny.alloc(1)  # occupy the only page
+        state = tiny.restore_entries(
+            {"k": jnp.zeros((1, 2, PS, 1, 1)),
+             "v": jnp.zeros((1, 2, PS, 1, 1))},
+            lambda st, ids, vals: st)
+        assert len(tiny.cache) == 0
+
+
+class TestPagedBitIdentity:
+    """The acceptance composite: every paged admission family and the
+    paged step must reproduce the dense engine's tokens exactly."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        rng = numpy.random.RandomState(0)
+        heads, embed, vocab = 4, 16, 11
+        params = init_transformer_params(rng, 2, embed, heads, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+        return params, table, heads, vocab
+
+    @pytest.mark.parametrize("quantize", [None, "int8-kv"])
+    def test_composite_matches_dense_and_generate(self, model,
+                                                  quantize):
+        """Staggered submissions joining mid-flight through the
+        pipelined chunked drain: paged streams equal the dense
+        engine's AND single-request generate() — both KV tiers."""
+        from veles_tpu.parallel.decode import generate
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(1)
+        prompts = [rng.randint(0, vocab, n) for n in (5, 3, 16, 4, 9)]
+        decs = []
+        for paged in (False, True):
+            dec = ContinuousDecoder(params, table, heads, slots=2,
+                                    max_len=32, n_tokens=6,
+                                    quantize=quantize, paged=paged,
+                                    page_size=PS)
+            pending = list(prompts)
+            for _ in range(2):
+                dec.submit(pending.pop(0))
+            dec.drain_pipelined(
+                4, admit=lambda dec=dec, pending=pending:
+                    pending and dec.submit(pending.pop(0)))
+            decs.append(dec)
+        dense, paged_dec = decs
+        assert dense.results == paged_dec.results
+        for rid, prompt in enumerate(prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=6, max_len=32,
+                               quantize=quantize)
+            assert paged_dec.results[rid] == \
+                numpy.asarray(want)[0].tolist(), \
+                "quantize=%s request %d diverged" % (quantize, rid)
+        # every retired slot returned its pages (minus what the
+        # prefix cache intentionally keeps resident)
+        held = {page
+                for entry in paged_dec.pool.cache.entries.values()
+                for page in entry["pages"]}
+        assert paged_dec.pool.snapshot()["pages_used"] == len(held)
+        assert not paged_dec._slot_pages
+
+    def test_shared_prefix_tail_hit_and_divergence(self, model):
+        """The prefix-reuse families: a page-aligned system prompt is
+        prefilled once; later admissions sharing it run tail-only
+        prefills (divergent suffixes — copy-on-write degenerating to
+        fresh-page allocation) or, for the exact page-aligned prompt,
+        a zero-prefill control-row hit — all bit-identical to
+        generate()."""
+        from veles_tpu.parallel.decode import generate
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(2)
+        system = rng.randint(0, vocab, 2 * PS)  # two whole pages
+        tails = [rng.randint(0, vocab, n) for n in (5, 3, 9)]
+        prompts = [system.copy()]  # cold: publishes pages AND logits
+        prompts += [numpy.concatenate([system, t]) for t in tails]
+        prompts.append(system.copy())  # exact page-aligned re-admit
+
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=64, n_tokens=6, paged=True,
+                                page_size=PS)
+        first = dec.submit(prompts[0], 6)
+        dec.run_until_drained()
+        shared_pages = dec.pool.cache.entries[next(iter(
+            dec.pool.cache.entries))]["pages"]
+        rest = [dec.submit(p, 6) for p in prompts[1:]]
+        dec.run_until_drained(chunk=4)
+        for rid, prompt in zip([first] + rest, prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=6, max_len=64)
+            assert dec.results[rid] == \
+                numpy.asarray(want)[0].tolist(), \
+                "request %d diverged from generate()" % rid
+        # the divergent tails really did reuse the pooled prefix
+        # (tail prefills + one full hit, never a second cold prefill
+        # of the system pages)
+        assert dec.dispatch_counts["admit_tail"] >= 1
+        assert dec.dispatch_counts["admit_hit"] >= 1
+        snap = dec.pool.snapshot()
+        assert snap["prefix_hits"] >= 3
+        # shared pages stayed where the cold admission put them: the
+        # cache entry still names the SAME page ids (sharing never
+        # re-allocates or mutates the prefix — docs/paged_kv.md)
+        assert dec.pool.cache.entries[next(iter(
+            dec.pool.cache.entries))]["pages"] == shared_pages
+
+    def test_int8_kv_reuses_exact_prompts_only(self, model):
+        """The int8-KV pool stores ROUNDED K/V, so partial-prefix
+        tails would not be bit-identical — the tier must take
+        exact-prompt hits only, and those must match generate()."""
+        from veles_tpu.parallel.decode import generate
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(3)
+        system = rng.randint(0, vocab, 2 * PS)
+        longer = numpy.concatenate([system, rng.randint(0, vocab, 4)])
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=64, n_tokens=5, paged=True,
+                                page_size=PS, quantize="int8-kv")
+        a = dec.submit(system, 5)
+        dec.run_until_drained()
+        b = dec.submit(longer, 5)   # shares the prefix: must go COLD
+        c = dec.submit(system, 5)   # exact prompt: the hit path
+        dec.run_until_drained(chunk=4)
+        assert dec.dispatch_counts["admit_tail"] == 0
+        assert dec.dispatch_counts["admit_hit"] == 1
+        for rid, prompt in ((a, system), (b, longer), (c, system)):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=5, max_len=64,
+                               quantize="int8-kv")
+            assert dec.results[rid] == \
+                numpy.asarray(want)[0].tolist()
+
+    def test_cancel_returns_pages(self, model):
+        """cancel() — the path deadline expiry also routes through —
+        must return the slot's pages to the pool and feed the
+        release-rate window that prices Retry-After."""
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(4)
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=16, paged=True,
+                                page_size=PS)
+        rid = dec.submit(rng.randint(0, vocab, 12), 16)
+        dec.step()
+        dec.step()
+        held = dict(dec._slot_pages)
+        assert held  # the live slot maps real pages
+        before = dec.pool.free_pages
+        dec.cancel(rid)
+        assert not dec._slot_pages
+        assert dec.pool.free_pages > before
+        assert dec.pool.release_rate() > 0
+        # the freed slot admits a fresh request cleanly
+        rid2 = dec.submit(rng.randint(0, vocab, 5), 3)
+        dec.run_until_drained()
+        assert len(dec.results[rid2]) == 3
+
+    def test_eviction_under_pool_pressure(self, model):
+        """A pool too small for every cached prefix must evict LRU
+        refcount-0 entries to admit new work — and the streams stay
+        exact throughout."""
+        from veles_tpu.parallel.decode import generate
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(5)
+        # budget 4 + chunkless steps: each prompt needs
+        # pages_for(16 + 4) = 3 pages; 7-page capacity holds at most
+        # two cached 2-page prefixes -> wave three forces eviction
+        dec = ContinuousDecoder(params, table, heads, slots=1,
+                                max_len=24, n_tokens=4, paged=True,
+                                page_size=PS, pool_pages=8)
+        prompts = [rng.randint(0, vocab, 2 * PS) for _ in range(4)]
+        rids = [dec.submit(p, 4) for p in prompts]
+        dec.run_until_drained()
+        snap = dec.pool.snapshot()
+        assert snap["prefix_evictions"] >= 1
+        for rid, prompt in zip(rids, prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=4, max_len=24)
+            assert dec.results[rid] == \
+                numpy.asarray(want)[0].tolist()
+
+    def test_repeated_extended_prompt_converges_to_hit(self, model):
+        """A tail admission publishes the EXTENDED prompt too (prefix
+        pages + tail whole pages hold exactly a cold prefill's bytes),
+        so the SECOND admission of system+tail is a zero-prefill hit —
+        not a tail re-prefill forever — and streams stay exact."""
+        from veles_tpu.parallel.decode import generate
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(12)
+        system = rng.randint(0, vocab, 2 * PS)
+        extended = numpy.concatenate(
+            [system, rng.randint(0, vocab, PS)])
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=64, n_tokens=6, paged=True,
+                                page_size=PS)
+        dec.submit(system, 6)
+        dec.run_until_drained()
+        r1 = dec.submit(extended, 6)   # tail family
+        dec.run_until_drained()
+        assert dec.dispatch_counts.get("admit_tail", 0) == 1
+        hits = dec.dispatch_counts.get("admit_hit", 0)
+        r2 = dec.submit(extended, 6)   # published by the tail admit
+        dec.run_until_drained()
+        assert dec.dispatch_counts.get("admit_hit", 0) == hits + 1
+        assert dec.dispatch_counts.get("admit_tail", 0) == 1
+        want, _ = generate(params, table, jnp.asarray(extended)[None],
+                           heads, n_tokens=6, max_len=64)
+        assert dec.results[r1] == dec.results[r2] == \
+            numpy.asarray(want)[0].tolist()
+
+    def test_default_pool_serves_slab_parity_workload(self, model):
+        """The default pool must serve every workload the dense slab
+        serves: slots running ``prompt + budget == max_len`` under the
+        lag-1 pipelined drain overshoot ``max_len`` by up to two
+        chunks per slot (lanes advance past retirement), which the
+        slab absorbs with a clamped in-place write. A pool sized
+        without the ``2 * n_tokens`` slack raises 'kv page pool
+        exhausted mid-decode' here."""
+        from veles_tpu.parallel.decode import generate
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(11)
+        prompts = [rng.randint(0, vocab, 32 - 6) for _ in range(2)]
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=6, paged=True,
+                                page_size=PS)
+        rids = [dec.submit(p, 6) for p in prompts]
+        dec.drain_pipelined(4)  # never raises: the slack covers it
+        for rid, prompt in zip(rids, prompts):
+            want, _ = generate(params, table,
+                               jnp.asarray(prompt)[None], heads,
+                               n_tokens=6, max_len=32)
+            assert dec.results[rid] == \
+                numpy.asarray(want)[0].tolist()
+
+    def test_page_size_must_match_span_tile_on_tpu(self, model,
+                                                   monkeypatch):
+        """--serve-page-size not a multiple of SLOT_SPAN_TILE fails at
+        construction on TPU backends, naming the knob — not as an
+        opaque XLA tiling error in the first dispatch. (CPU keeps
+        arbitrary page sizes: the whole suite runs PS=8.)"""
+        params, table, heads, _ = model
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with pytest.raises(ValueError, match="serve-page-size"):
+            ContinuousDecoder(params, table, heads, slots=2,
+                              max_len=256, paged=True, page_size=100)
+        # aligned sizes construct fine under the same backend
+        ContinuousDecoder(params, table, heads, slots=2, max_len=256,
+                          paged=True, page_size=128)
+
+    def test_dispatch_economy_and_zero_recompile_storm(self, model):
+        """One admit dispatch per (kind, shape) group, one chunk
+        dispatch per slot_step_many — and driving SIX same-shape waves
+        compiles each paged program at most twice (layout + one jit
+        fastpath committedness variant) with ZERO recompile storms:
+        the (bucket, group, pages bucket) keying really bounds the
+        compile set."""
+        params, table, heads, vocab = model
+        waves = 6
+        tracker = get_compile_tracker()
+        was_enabled = tracker.enabled
+        tracker.reset()
+        tracker.enabled = True
+        try:
+            rng = numpy.random.RandomState(6)
+            dec = ContinuousDecoder(params, table, heads, slots=2,
+                                    max_len=32, n_tokens=4,
+                                    paged=True, page_size=PS)
+            for _ in range(waves):
+                for _ in range(2):
+                    dec.submit(rng.randint(0, vocab, 6))
+                dec.run_until_drained(chunk=4)
+            snap = tracker.snapshot()
+        finally:
+            tracker.reset()
+            tracker.enabled = was_enabled
+        assert sum(snap["storms"].values()) == 0
+        assert dec.dispatch_counts["admit"] <= waves  # one per wave
+        assert dec.dispatch_counts["admit_requests"] == 2 * waves
+        for program in ("paged.admit", "paged.dispatch"):
+            compiles = snap["compiles"].get(program, 0)
+            hits = snap["hits"].get(program, 0)
+            assert compiles <= 2, \
+                "%s retraced %d times over %d same-shape waves" % (
+                    program, compiles, waves)
+            assert hits >= waves - 2, \
+                "%s only hit %d times" % (program, hits)
+
+
+class TestPagedMesh:
+    """PR-6 composition: pool pages shard over HEADS under the serve
+    mesh exactly like the dense slab."""
+
+    @pytest.fixture(scope="class")
+    def model8(self):
+        rng = numpy.random.RandomState(0)
+        heads, embed, vocab = 8, 32, 16
+        params = init_transformer_params(rng, 2, embed, heads, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+        return params, table, heads, vocab
+
+    def test_mesh_paged_streams_and_stay_sharded(self, model8):
+        """The 8-device CPU mesh: the paged engine streams the exact
+        single-chip dense tokens (mid-flight joins, prefix hit
+        included) and the pool leaves STAY sharded over heads across
+        admit/step/chunk round trips."""
+        params, table, heads, vocab = model8
+        mesh = build_mesh(devices=jax.devices()[:8], data=1, model=8)
+        rng = numpy.random.RandomState(7)
+        prompts = [rng.randint(0, vocab, n) for n in (2 * PS, 19, 5)]
+
+        ref = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=64, n_tokens=5)
+        got = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=64, n_tokens=5, paged=True,
+                                page_size=PS, mesh=mesh)
+        for dec in (ref, got):
+            pending = [p for p in prompts]
+            for _ in range(2):
+                dec.submit(pending.pop(0))
+            dec.drain_pipelined(
+                4, admit=lambda dec=dec, pending=pending:
+                    pending and dec.submit(pending.pop(0)))
+        assert ref.results == got.results
+        assert not got.state["k"].sharding.is_fully_replicated
+        # the page-aligned prompt re-admits as a zero-prefill hit
+        # under the mesh, still bit-identical
+        rid = got.submit(prompts[0])
+        got.run_until_drained()
+        assert got.results[rid] == ref.results[0]
+        assert got.dispatch_counts["admit_hit"] == 1
+        assert not got.state["k"].sharding.is_fully_replicated
+
+
+class TestPoolAwareAdmission:
+    """Satellite: ServingHealth.try_admit extended to KV page
+    pressure — a full pool 429s with an honest Retry-After, and an
+    ADMITTED request can never deadlock waiting for pages it was
+    promised (its worst case is reserved under the admission lock)."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        rng = numpy.random.RandomState(0)
+        heads, embed, vocab = 4, 16, 11
+        params = init_transformer_params(rng, 2, embed, heads, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+        return params, table, heads, vocab
+
+    def test_try_admit_pool_verdict(self):
+        health = ServingHealth(name="t")
+        health.set_ready(True)
+        # gate admits: pages reserved, request counted in
+        assert health.try_admit(None, pool_gate=lambda: None) is None
+        # gate refuses: the ("pool", retry_after) verdict, counted as
+        # a rejection, inflight unchanged
+        verdict = health.try_admit(None, pool_gate=lambda: 7.5)
+        assert verdict == ("pool", 7.5)
+        snap = health.snapshot()
+        assert snap["inflight"] == 1
+        assert snap["counters"]["rejected"] == 1
+
+    def test_worst_case_pages_covers_tail_family(self, model):
+        """The reservation must dominate TAIL holdings too: prefix
+        whole pages + a re-bucketed tail can exceed the cold prompt
+        bucket when power-of-two rounding and the max_len clamp
+        interact — under-reserving would let _ensure_tail_pages
+        exhaust the pool mid-decode, the exact failure the gate
+        promises is unreachable."""
+        params, table, heads, vocab = model
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=770, n_tokens=1, paged=True,
+                                page_size=128)
+        # 769-token prompt, 512-token page-aligned cached prefix:
+        # holdings = 4 prefix pages + pages_for(bucket(257)=512) = 8,
+        # while the cold bound is only ceil((770+1+16)/128) = 7
+        assert dec.worst_case_pages(769, 1, chunk=8) >= 8
+        # short prompts keep the tight cold bound (no tail split fits)
+        assert dec.worst_case_pages(3, 12, chunk=2) == \
+            pages_for(min(16, 770) + 12 + 4, 128)
+
+    def test_pool_gate_runs_after_queue_bound(self):
+        """A queue-full rejection must NOT reserve pages: the gate
+        only runs for requests that are otherwise admitted."""
+        health = ServingHealth(name="t2")
+        health.set_ready(True)
+        ran = []
+        assert health.try_admit(1, pool_gate=lambda: None) is None
+        verdict = health.try_admit(
+            1, pool_gate=lambda: ran.append(1) or None)
+        assert verdict == "full"
+        assert not ran
+
+    def test_http_pool_full_429_with_priced_retry_after(self, model):
+        """A pool sized for one in-flight request: the second
+        concurrent POST must 429 with a Retry-After header (pool
+        verdict), never hang — and the pool snapshot rides /healthz
+        through the attached health."""
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+        # one request's worst case: the 16-token minimum prompt
+        # bucket, the 12-token budget, the lag-1 pipeline's two
+        # chunks of slack — exactly what worst_case_pages reserves
+        need = pages_for(16 + 12 + 2 * 2, PS)
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=12, chunk=2, port=0, paged=True,
+                          page_size=PS, pool_pages=need + 1)
+        # wedge the driver so the first request stays in flight while
+        # the second arrives (reservations held until resolve)
+        gate = threading.Event()
+        orig = api.decoder.dispatch_chunk
+
+        def slow_chunk(chunk):
+            gate.wait(timeout=30)
+            return orig(chunk)
+        api.decoder.dispatch_chunk = slow_chunk
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            first = {}
+            t = threading.Thread(target=lambda: first.update(
+                post(url, {"tokens": [1, 2, 3], "n_tokens": 12},
+                     timeout=60)))
+            t.start()
+            # wait until the first request's reservation is booked
+            deadline = threading.Event()
+            for _ in range(200):
+                if api.decoder.pool.snapshot()["reserved_pages"]:
+                    break
+                deadline.wait(0.02)
+            assert api.decoder.pool.snapshot()["reserved_pages"] == need
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(url, {"tokens": [4, 5], "n_tokens": 12},
+                     timeout=30)
+            assert err.value.code == 429
+            assert "pool" in err.value.read().decode()
+            assert int(err.value.headers["Retry-After"]) >= 1
+            snap = api.health.snapshot()
+            assert snap["pool"]["pages_total"] == need
+            gate.set()
+            t.join(timeout=60)
+            assert len(first["tokens"]) == 12
+            # resolution released the reservation
+            assert api.decoder.pool.snapshot()["reserved_pages"] == 0
+        finally:
+            gate.set()
+            api.stop()
+
+    def test_admitted_requests_never_deadlock(self, model):
+        """The no-deadlock invariant under pressure: many concurrent
+        POSTs against a small pool — every response is either a full
+        token stream or an immediate 429, and every admitted request
+        COMPLETES (nothing blocks waiting for pages it was promised,
+        because admission reserved its worst case up front)."""
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+        need = pages_for(16 + 6 + 2 * 2, PS)  # min bucket 16
+        api = GenerateAPI(params, table, heads, slots=4, max_len=32,
+                          n_tokens=6, chunk=2, port=0, paged=True,
+                          page_size=PS, pool_pages=2 * need + 1)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            rng = numpy.random.RandomState(8)
+            outcomes = {}
+
+            def call(i, prompt):
+                try:
+                    outcomes[i] = post(
+                        url, {"tokens": prompt, "n_tokens": 6},
+                        timeout=60)["tokens"]
+                except urllib.error.HTTPError as err:
+                    outcomes[i] = err.code
+            threads = [
+                threading.Thread(target=call, args=(
+                    i, rng.randint(0, vocab, 5).tolist()))
+                for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            assert not any(t.is_alive() for t in threads), \
+                "an admitted request deadlocked waiting for pages"
+            done = [o for o in outcomes.values() if isinstance(o, list)]
+            shed = [o for o in outcomes.values() if o == 429]
+            assert len(done) + len(shed) == 8
+            assert done  # progress was made under pressure
+            assert all(len(tokens) == 6 for tokens in done)
+            assert api.decoder.pool.snapshot()["reserved_pages"] == 0
+        finally:
+            api.stop()
+
+    def test_breaker_rebuild_preserves_prefix_cache(self, model):
+        """The breaker's rebuild path must carry the prefix cache into
+        the fresh decoder's pool by page copy — the cached system
+        prompt admits as a HIT after the trip, never a re-prefill, and
+        its stream still equals generate()."""
+        import time
+
+        from veles_tpu.parallel.decode import generate
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+        rng = numpy.random.RandomState(9)
+        system = rng.randint(0, vocab, 2 * PS).tolist()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=64,
+                          n_tokens=4, chunk=2, port=0, paged=True,
+                          page_size=PS, rebuild_backoff=0.02)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            first = post(url, {"tokens": system}, timeout=60)
+            old_decoder = api.decoder
+            assert old_decoder.pool.snapshot()["prefix_entries"] >= 1
+
+            def boom(*a, **k):
+                raise RuntimeError("injected device failure")
+            api.decoder.dispatch_chunk = boom
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post(url, {"tokens": [1, 2, 3]}, timeout=30)
+            assert err.value.code == 503
+            deadline = time.time() + 30
+            while not api.health.ready and time.time() < deadline:
+                time.sleep(0.02)
+            assert api.health.ready, api.health.snapshot()
+            assert api.decoder is not old_decoder
+            # the fresh pool adopted the cache: same entries, restored
+            # pages, ZERO cold admissions for the cached prompt (the
+            # rebuild's probe decode books one cold admit of its own —
+            # the delta across the re-admission must stay zero)
+            cold_before = api.decoder.dispatch_counts["admit"]
+            again = post(url, {"tokens": system}, timeout=60)
+            assert again["tokens"] == first["tokens"]
+            want, _ = generate(params, table,
+                               jnp.asarray(system)[None], heads,
+                               n_tokens=4, max_len=64)
+            assert again["tokens"] == numpy.asarray(want)[0].tolist()
+            assert api.decoder.dispatch_counts["admit_hit"] == 1
+            assert api.decoder.dispatch_counts["admit"] == cold_before
+            # /healthz mirrors the FRESH pool
+            assert api.health.snapshot()["pool"]["prefix_hits"] >= 1
+        finally:
+            api.stop()
+
+
+class TestPagedObservability:
+    """Satellite: pool gauges + prefix counters on /metrics, page
+    occupancy and hit rate in the web-status serving column."""
+
+    def test_pool_gauges_on_metrics(self):
+        from veles_tpu.observe.metrics import (MetricsRegistry,
+                                               publish_kv_pool)
+
+        pool = PagePool(8, PS)
+        pool.alloc(3)
+        pool.cache.counters.update(hits=2, misses=1, evictions=1)
+        registry = MetricsRegistry(enabled=True)
+        publish_kv_pool(registry, pool)
+        text = registry.expose()
+        assert "veles_kv_pages_used 3" in text
+        assert "veles_kv_pages_free 4" in text
+        assert "veles_kv_page_size %d" % PS in text
+        assert "veles_prefix_cache_hits_total 2" in text
+        assert "veles_prefix_cache_misses_total 1" in text
+        assert "veles_prefix_cache_evictions_total 1" in text
+
+    def test_web_status_serving_column_shows_pool(self):
+        from veles_tpu.web_status import format_serving_health
+
+        line = format_serving_health({
+            "ready": True, "breaker": "closed", "inflight": 0,
+            "counters": {}, "latency_ms": {},
+            "pool": {"pages_used": 3, "pages_total": 7,
+                     "prefix_hit_rate": 0.5}})
+        assert "pages 3/7" in line
+        assert "prefix hit 50%" in line

@@ -158,6 +158,23 @@ class Main(Logger):
                            "(params tensor-parallel, slot KV sharded "
                            "over heads; -1 absorbs the remaining "
                            "devices — docs/sharded_serving.md)")
+        serve.add_argument("--serve-paged", action="store_true",
+                           default=None,
+                           help="back the slot engine with the paged "
+                           "KV pool + shared-prefix admission instead "
+                           "of the dense per-slot slab "
+                           "(docs/paged_kv.md)")
+        serve.add_argument("--serve-page-size", type=int, default=None,
+                           metavar="N", help="positions per KV page "
+                           "(default SLOT_SPAN_TILE=128; must be a "
+                           "multiple of the span tile on TPU)")
+        serve.add_argument("--serve-pool-pages", type=int, default=None,
+                           metavar="N", help="total pages in the KV "
+                           "pool incl. the scratch page (default: the "
+                           "dense-slab-equivalent slots x "
+                           "ceil((max_len + 2*n_tokens)/page_size) + 1 "
+                           "— sized for dispatch chunks up to "
+                           "n_tokens)")
         serve.add_argument("--chaos-serve-seed", type=int, default=None,
                            metavar="N", help="serving chaos RNG seed")
         serve.add_argument("--chaos-serve-step-fail", type=float,
@@ -473,6 +490,9 @@ class Main(Logger):
                 ("serve_max_queue", root.common.serve, "max_queue"),
                 ("serve_deadline", root.common.serve, "deadline"),
                 ("serve_mesh", root.common.serve, "mesh"),
+                ("serve_paged", root.common.serve, "paged"),
+                ("serve_page_size", root.common.serve, "page_size"),
+                ("serve_pool_pages", root.common.serve, "pool_pages"),
                 ("chaos_serve_seed", root.common.serve.chaos, "seed"),
                 ("chaos_serve_step_fail", root.common.serve.chaos,
                  "step_fail"),

@@ -419,6 +419,34 @@ def publish_decoder(registry, decoder):
     registry.counter_set("veles_decode_cancelled_total",
                          decoder.cancelled,
                          help="requests cancelled before completion")
+    pool = getattr(decoder, "pool", None)
+    if pool is not None:
+        publish_kv_pool(registry, pool)
+
+
+def publish_kv_pool(registry, pool):
+    """PagePool occupancy + prefix-cache traffic -> veles_kv_* /
+    veles_prefix_cache_* (docs/paged_kv.md). Rides every /metrics
+    mount through :func:`publish_decoder`, and fleet slaves piggyback
+    these rows exactly like the mesh/device gauges (the snapshot walks
+    the whole registry)."""
+    snap = pool.snapshot()
+    registry.set("veles_kv_pages_used", snap["pages_used"],
+                 help="allocated pages in the paged KV pool")
+    registry.set("veles_kv_pages_free", snap["pages_free"],
+                 help="free pages in the paged KV pool")
+    registry.set("veles_kv_pages_reserved", snap["reserved_pages"],
+                 help="pages reserved by admitted in-flight requests")
+    registry.set("veles_kv_page_size", snap["page_size"],
+                 help="positions per KV page")
+    registry.set("veles_prefix_cache_entries", snap["prefix_entries"],
+                 help="live prefix-cache entries (page-boundary "
+                 "prefixes)")
+    for key in ("hits", "misses", "evictions"):
+        registry.counter_set(
+            "veles_prefix_cache_%s_total" % key,
+            snap["prefix_" + key],
+            help="prefix-cache %s across decoder rebuilds" % key)
 
 
 def publish_loader(registry, loader):

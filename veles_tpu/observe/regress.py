@@ -40,8 +40,13 @@ SCHEMA_VERSION = 1
 #: "_sec_mean" covers the headline's epoch_sec_mean (seconds/epoch);
 #: "_bytes" covers the reshard keys (bytes on the wire per transition
 #: — a schedule that starts moving more data regressed)
+#: "_hit_fraction" is the paged admission ratio (hit admit wall over
+#: cold prefill wall — a cache that stops saving work regressed) and
+#: "_flatness" the paged step-time max/min across the length sweep
+#: (docs/paged_kv.md; decode_paged in bench.py)
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
-                 "_overhead_pct", "_std", "_bytes")
+                 "_overhead_pct", "_std", "_bytes", "_hit_fraction",
+                 "_flatness")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

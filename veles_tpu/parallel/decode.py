@@ -379,7 +379,8 @@ SLOT_SPAN_TILE = 128
 
 def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
                     dtype=jnp.float32, quantized=False, mesh=None,
-                    mesh_axis="model"):
+                    mesh_axis="model", paged=False, pages=None,
+                    page_size=None):
     """Cache + control state for ``slots`` concurrent sequences.
 
     ``quantized=True`` stores the slot K/V as int8 with per-(slot,
@@ -391,7 +392,28 @@ def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
     ``mesh`` creates the state already in the serving layout: the KV
     slab (and the int8 tier's scales) sharded over their heads dim on
     ``mesh_axis``, control leaves replicated — per-device slot-cache
-    HBM then scales with H/n (:func:`slot_state_specs`)."""
+    HBM then scales with H/n (:func:`slot_state_specs`).
+
+    ``paged=True`` swaps the dense per-slot slab for the page-pool
+    layout (``parallel/kv_pool.py``): one ``pages`` x ``page_size``
+    pool (default: the slab-equivalent ``slots x ceil((max_len + 2) /
+    page_size)`` plus the scratch page; the serving decoder sizes its
+    own default with ``chunk=n_tokens`` dispatch slack) shared by
+    every slot through a
+    host page table, created in-layout under ``mesh`` exactly like the
+    slab (pool pages shard over HEADS)."""
+    if paged:
+        from veles_tpu.parallel.kv_pool import (default_pool_pages,
+                                                init_paged_state)
+
+        if page_size is None:
+            page_size = SLOT_SPAN_TILE
+        if pages is None:
+            pages = default_pool_pages(slots, max_len, page_size)
+        return init_paged_state(
+            n_blocks, pages, page_size, heads, head_dim, vocab, slots,
+            dtype=dtype, quantized=quantized, mesh=mesh,
+            mesh_axis=mesh_axis)
     base = {
         "lengths": jnp.zeros((slots,), jnp.int32),
         "logits": jnp.zeros((slots, vocab), jnp.float32),

@@ -263,6 +263,7 @@ class ServingHealth:
         self._breaker = "closed"
         self._inflight = 0
         self._counters = {key: 0 for key in self.COUNTERS}
+        self._pool_ref = None
         self._latencies = {
             kind: collections.deque(maxlen=self.LATENCY_WINDOW)
             for kind in self.LATENCY_KINDS}
@@ -288,12 +289,34 @@ class ServingHealth:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + n
 
-    def try_admit(self, limit):
+    def attach_pool(self, pool):
+        """Mirror a paged KV pool's occupancy/prefix-cache state into
+        the health snapshot (weakly referenced — a rebuilt decoder's
+        fresh pool re-attaches, a dead one silently drops out), so
+        ``/healthz``, the web-status serving column and the chaos
+        asserts see page pressure next to the survival counters."""
+        import weakref
+
+        with self._lock:
+            self._pool_ref = weakref.ref(pool) if pool is not None \
+                else None
+
+    def try_admit(self, limit, pool_gate=None):
         """One atomic admission decision: returns ``None`` and counts
         the request in, or the rejection kind (``"unready"`` -> 503,
         ``"full"`` -> 429) — checked and booked under one lock so a
         burst cannot race past the queue bound. ``limit`` of ``None``
-        or <= 0 means UNBOUNDED admission (load shedding off)."""
+        or <= 0 means UNBOUNDED admission (load shedding off).
+
+        ``pool_gate`` extends the decision to KV page pressure: a
+        zero-arg callable returning ``None`` (pages reserved, admit)
+        or a retry-after in seconds (pool full — the caller 429s with
+        ``Retry-After`` priced from the observed page-release rate,
+        not a constant). It runs under the admission lock AFTER the
+        queue bound, so a reservation is only ever made for a request
+        that is otherwise admitted — the no-deadlock invariant: every
+        admitted request has its worst-case page demand reserved, so
+        it can never block forever on pages it was promised."""
         with self._lock:
             if not self._ready:
                 self._counters["rejected"] += 1
@@ -302,6 +325,11 @@ class ServingHealth:
                     and self._inflight >= limit:
                 self._counters["rejected"] += 1
                 return "full"
+            if pool_gate is not None:
+                retry_after = pool_gate()
+                if retry_after is not None:
+                    self._counters["rejected"] += 1
+                    return ("pool", retry_after)
             self._inflight += 1
             self._counters["admitted"] += 1
             return None
@@ -353,13 +381,18 @@ class ServingHealth:
 
     def snapshot(self):
         with self._lock:
-            return {"name": self.name, "ready": self._ready,
+            snap = {"name": self.name, "ready": self._ready,
                     "breaker": self._breaker,
                     "inflight": self._inflight,
                     "counters": dict(self._counters),
                     "latency_ms": {
                         kind: self._percentiles_ms(window)
                         for kind, window in self._latencies.items()}}
+            pool = self._pool_ref() if self._pool_ref is not None \
+                else None
+        if pool is not None:
+            snap["pool"] = pool.snapshot()
+        return snap
 
 
 class RESTfulAPI(Unit):
@@ -610,7 +643,8 @@ class ContinuousDecoder:
     def __init__(self, params, embed_table, heads, slots=4,
                  max_len=512, n_tokens=32, eos=None,
                  temperature=0.0, top_k=0, key=None, quantize=None,
-                 tile=None, mesh=None, mesh_axis="model"):
+                 tile=None, mesh=None, mesh_axis="model", paged=False,
+                 page_size=None, pool_pages=None, prefix_cache=None):
         import collections
 
         import jax
@@ -659,6 +693,43 @@ class ContinuousDecoder:
         self.tile = int(tile if tile is not None else SLOT_SPAN_TILE)
         if self.tile < 1:
             raise ValueError("tile must be >= 1, got %d" % self.tile)
+        #: paged KV pool (docs/paged_kv.md): the slab becomes a page
+        #: pool + host page table, prefix reuse becomes an admission
+        #: path. ``pool_pages`` defaults to the slab-equivalent HBM
+        #: (slots x ceil((max_len + 2*n_tokens)/page_size) plus the
+        #: scratch page — the 2*n_tokens term covers the lag-1
+        #: pipeline's dispatch overshoot for any chunk <= n_tokens);
+        #: sizing it independently of slots x max_len is the point —
+        #: concurrency is then bounded by LIVE tokens, not the slab.
+        self.paged = bool(paged)
+        self.page_size = int(page_size if page_size is not None
+                             else SLOT_SPAN_TILE) if paged else None
+        if paged and self.page_size < 1:
+            raise ValueError("page_size must be >= 1, got %d"
+                             % self.page_size)
+        if paged and self.page_size % SLOT_SPAN_TILE \
+                and jax.default_backend() in ("tpu", "axon"):
+            # gathered paged spans are pages x page_size; the attend
+            # kernel gates lanes at SLOT_SPAN_TILE granules on TPU, so
+            # a misaligned page size surfaces as an opaque XLA tiling
+            # failure deep in the first dispatch — fail at construction
+            # with the knob's name instead
+            raise ValueError(
+                "page_size/--serve-page-size must be a multiple of "
+                "SLOT_SPAN_TILE (%d) on TPU, got %d"
+                % (SLOT_SPAN_TILE, self.page_size))
+        if paged:
+            from veles_tpu.parallel.kv_pool import default_pool_pages
+            # the default covers dispatch chunks up to n_tokens (a
+            # chunk larger than any request's budget buys nothing);
+            # drivers chunking past that must size pool_pages
+            self.pool_pages = (int(pool_pages)
+                               if pool_pages is not None else
+                               default_pool_pages(slots, max_len,
+                                                  self.page_size,
+                                                  chunk=n_tokens))
+        else:
+            self.pool_pages = None
         self.n_tokens = n_tokens
         self.eos = eos
         #: temperature > 0 samples; each request draws from its OWN
@@ -675,8 +746,31 @@ class ContinuousDecoder:
             n_blocks, slots, self.max_len, heads, embed // heads, vocab,
             dtype=embed_table.dtype,
             quantized=self.quantize == "int8-kv",
-            mesh=mesh, mesh_axis=mesh_axis)
-        if mesh is not None:
+            mesh=mesh, mesh_axis=mesh_axis, paged=self.paged,
+            pages=self.pool_pages, page_size=self.page_size)
+        self.pool = None
+        self._paged_fns = None
+        self._slot_pages = {}    # slot -> [page id, ...] logical order
+        if self.paged:
+            from veles_tpu.parallel.kv_pool import (PagePool,
+                                                    paged_restore,
+                                                    sharded_paged_fns)
+            self.pool = PagePool(self.pool_pages, self.page_size,
+                                 cache=prefix_cache)
+            if mesh is not None:
+                self._paged_fns = sharded_paged_fns(
+                    mesh, mesh_axis,
+                    quantized=self.quantize == "int8-kv")
+            if prefix_cache is not None and len(prefix_cache):
+                # breaker-rebuild path: the previous decoder's prefix
+                # cache restores into THIS pool by page copy — never a
+                # re-prefill (re-prefilling every cached prompt after
+                # a trip would defeat the cache)
+                restore = (self._paged_fns[5] if self._paged_fns
+                           else paged_restore)
+                self.state = self.pool.restore_entries(self.state,
+                                                       restore)
+        if mesh is not None and not self.paged:
             # layout-pinned jit surface: output state shardings stay on
             # the canonical serving layout so donated state never
             # drifts and every (bucket, group) compiles exactly once
@@ -703,6 +797,11 @@ class ContinuousDecoder:
         #: one "chunk" per slot_step_many)
         self.dispatch_counts = {"admit": 0, "admit_requests": 0,
                                 "chunk": 0, "step": 0}
+        if self.paged:
+            # the two prefix-reuse admission families (the dense keys
+            # stay byte-identical for dense artifacts)
+            self.dispatch_counts["admit_tail"] = 0
+            self.dispatch_counts["admit_hit"] = 0
         #: host-blocking wall seconds per call family (admit dispatches,
         #: chunk dispatches, chunk readbacks) — feeds the bench's
         #: prefill-ms and host-overhead keys
@@ -803,6 +902,7 @@ class ContinuousDecoder:
                 if owner == rid:
                     del self._slot_req[slot]
                     self._free.append(slot)
+                    self._release_slot_pages(slot)
                     break
         del self._budget[rid]
         self.results.pop(rid, None)
@@ -824,6 +924,11 @@ class ContinuousDecoder:
         return bucket
 
     def _admit_pending(self):
+        if self.paged:
+            return self._admit_pending_paged()
+        return self._admit_pending_dense()
+
+    def _admit_pending_dense(self):
         """Admit every queued request that fits a free slot — grouped
         by prompt bucket, ONE ``slot_admit_many`` dispatch per bucket
         group (the pre-batched path issued one blocking dispatch per
@@ -851,11 +956,8 @@ class ContinuousDecoder:
         now = time.monotonic()
         for bucket in order:
             group = groups[bucket]
-            padded_n = 1
-            while padded_n < len(group):
-                padded_n *= 2
-            rows = group + [group[-1]] * (padded_n - len(group))
-            prompts = numpy.zeros((padded_n, bucket), numpy.int32)
+            rows = self._pad_group(group)
+            prompts = numpy.zeros((len(rows), bucket), numpy.int32)
             for j, (_, prompt, _) in enumerate(rows):
                 prompts[j, :len(prompt)] = prompt
             rids = jnp.asarray([r[0] for r in rows], jnp.int32)
@@ -891,6 +993,275 @@ class ContinuousDecoder:
                 self._slot_len[slot] = len(prompt)
                 self.admitted_at[rid] = now
 
+    # -- paged admission (docs/paged_kv.md) -------------------------------
+    def _book_admit(self, kind, elapsed, group, bucket):
+        """Shared admission bookkeeping: timings, metrics, flight ring,
+        dispatch log — one copy for the cold/tail/hit families."""
+        self.timings["admit_s"] += elapsed
+        self.metrics.observe(
+            "veles_decode_admit_seconds", elapsed,
+            buckets=DECODE_BUCKETS, labels={"kind": kind},
+            help="host-blocking admission dispatch time")
+        self.dispatch_counts[
+            "admit" if kind == "cold" else "admit_" + kind] += 1
+        self.dispatch_counts["admit_requests"] += len(group)
+        self.flight.note("admit", family=kind, bucket=bucket,
+                         group=len(group),
+                         ms=round(elapsed * 1000, 3))
+        if self.dispatch_log is not None:
+            self.dispatch_log.append(
+                ("admit" if kind == "cold" else "admit_" + kind,
+                 bucket, len(group)))
+
+    @staticmethod
+    def _pad_group(group):
+        """Pad an admission group to a power-of-two size with
+        duplicate rows (duplicate scatter writes carry equal values —
+        the dense engine's compile-bounding idiom)."""
+        padded_n = 1
+        while padded_n < len(group):
+            padded_n *= 2
+        return group + [group[-1]] * (padded_n - len(group))
+
+    def _admit_pending_paged(self):
+        """The paged admission path: each queued request is classified
+        against the prefix cache — ``hit`` (whole prompt cached:
+        control rows only, ~0 admission), ``tail`` (page-aligned
+        prefix cached: prefill only the unique tail against the pooled
+        prefix), or ``cold`` (full bucket prefill scattered into fresh
+        pages) — then dispatched in ONE program per (kind, shape)
+        group. Page allocation failures (even after LRU eviction)
+        requeue the request at the FRONT and stop admitting: pool
+        pressure backs up into the queue, never into a torn slot. The
+        int8-KV tier reuses exact prompts only (its pool stores
+        rounded K/V — partial-hit tails would break bit-identity)."""
+        import jax
+
+        from veles_tpu.parallel import kv_pool
+
+        fns = self._paged_fns
+        admit = fns[0] if fns else kv_pool.paged_admit_many
+        admit_tail = fns[1] if fns else kv_pool.paged_admit_tail
+        admit_hit = fns[2] if fns else kv_pool.paged_admit_hit
+        if not (self._queue and self._free):
+            return
+        ps = self.pool.page_size
+        allow_partial = self.quantize != "int8-kv"
+        cold, tails, hits = {}, {}, []
+        cold_order, tail_order = [], []
+        while self._queue and self._free:
+            rid, prompt, budget = self._queue[0]
+            entry, shared = self.pool.lookup(prompt,
+                                             allow_partial=allow_partial)
+            if entry is not None and shared == len(prompt):
+                self._queue.popleft()
+                slot = self._free.pop()
+                self.pool.book_hit()
+                hits.append((rid, prompt, slot, entry))
+                continue
+            if entry is not None:
+                tail_bucket = min(self._bucket(len(prompt) - shared),
+                                  self.max_len)
+                pages = self.pool.alloc(
+                    kv_pool.pages_for(tail_bucket, ps))
+                if pages is None:
+                    self.pool.unlookup(entry)
+                    break
+                self._queue.popleft()
+                slot = self._free.pop()
+                self.pool.book_hit()
+                key = (len(entry["pages"]), tail_bucket)
+                if key not in tails:
+                    tails[key] = []
+                    tail_order.append(key)
+                tails[key].append((rid, prompt, slot, entry, shared,
+                                   pages))
+                continue
+            bucket = min(self._bucket(len(prompt)), self.max_len)
+            pages = self.pool.alloc(kv_pool.pages_for(bucket, ps))
+            if pages is None:
+                break
+            self._queue.popleft()
+            slot = self._free.pop()
+            self.pool.book_miss()
+            if bucket not in cold:
+                cold[bucket] = []
+                cold_order.append(bucket)
+            cold[bucket].append((rid, prompt, slot, pages))
+        now = time.monotonic()
+
+        def fold_keys(rows):
+            rids = jnp.asarray([r[0] for r in rows], jnp.int32)
+            return jax.vmap(jax.random.fold_in,
+                            in_axes=(None, 0))(self.base_key, rids)
+
+        for bucket in cold_order:
+            group = cold[bucket]
+            rows = self._pad_group(group)
+            prompts = numpy.zeros((len(rows), bucket), numpy.int32)
+            for j, (_, prompt, _, _) in enumerate(rows):
+                prompts[j, :len(prompt)] = prompt
+            x = self.embed_table[jnp.asarray(prompts)]
+            with self._span("paged.admit", [r[0] for r in group],
+                            bucket=bucket, group=len(group)):
+                t0 = time.perf_counter()
+                self.state = admit(
+                    self.params, self.embed_table, self.heads,
+                    self.state,
+                    jnp.asarray([r[2] for r in rows], jnp.int32),
+                    jnp.asarray([r[3] for r in rows], jnp.int32), x,
+                    fold_keys(rows),
+                    jnp.asarray([len(r[1]) for r in rows], jnp.int32))
+                elapsed = time.perf_counter() - t0
+            self._book_admit("cold", elapsed, group, bucket)
+            for rid, prompt, slot, pages in group:
+                self._slot_req[slot] = rid
+                self._slot_len[slot] = len(prompt)
+                self._slot_pages[slot] = list(pages)
+                self.admitted_at[rid] = now
+                # publish the prompt's whole pages (and, when the
+                # prompt is page-aligned, its last-position logits)
+                # so the NEXT admission of this prefix is a hit
+                self.pool.insert(prompt, pages, self.state,
+                                 logits=self.state["logits"][slot])
+        for key in tail_order:
+            pp, tail_bucket = key
+            group = tails[key]
+            rows = self._pad_group(group)
+            tail_tokens = numpy.zeros((len(rows), tail_bucket),
+                                      numpy.int32)
+            for j, (_, prompt, _, _, shared, _) in enumerate(rows):
+                tail = prompt[shared:]
+                tail_tokens[j, :len(tail)] = tail
+            tail_x = self.embed_table[jnp.asarray(tail_tokens)]
+            with self._span("paged.admit_tail", [r[0] for r in group],
+                            bucket=tail_bucket, group=len(group),
+                            prefix_pages=pp):
+                t0 = time.perf_counter()
+                self.state = admit_tail(
+                    self.params, self.embed_table, self.heads,
+                    self.state,
+                    jnp.asarray([r[2] for r in rows], jnp.int32),
+                    jnp.asarray([r[3]["pages"] for r in rows],
+                                jnp.int32),
+                    jnp.asarray([r[5] for r in rows], jnp.int32),
+                    tail_x, fold_keys(rows),
+                    jnp.asarray([len(r[1]) for r in rows], jnp.int32))
+                elapsed = time.perf_counter() - t0
+            self._book_admit("tail", elapsed, group, tail_bucket)
+            for rid, prompt, slot, entry, shared, pages in group:
+                self._slot_req[slot] = rid
+                self._slot_len[slot] = len(prompt)
+                self._slot_pages[slot] = list(entry["pages"]) \
+                    + list(pages)
+                self.admitted_at[rid] = now
+                # publish the EXTENDED prompt too (prefix pages + the
+                # tail's whole pages hold exactly a cold prefill's
+                # bytes — the tail ran the same math behind the
+                # prefix-offset mask), so a repeated extended prompt
+                # converges to a hit instead of re-prefilling its
+                # tail forever
+                self.pool.insert(prompt, self._slot_pages[slot],
+                                 self.state,
+                                 logits=self.state["logits"][slot])
+        if hits:
+            group = hits
+            rows = self._pad_group(group)
+            with self._span("paged.admit_hit", [r[0] for r in group],
+                            group=len(group)):
+                t0 = time.perf_counter()
+                self.state = admit_hit(
+                    self.state,
+                    jnp.asarray([r[2] for r in rows], jnp.int32),
+                    jnp.asarray([len(r[1]) for r in rows], jnp.int32),
+                    jnp.stack([r[3]["logits"] for r in rows]),
+                    fold_keys(rows))
+                elapsed = time.perf_counter() - t0
+            self._book_admit("hit", elapsed, group, 0)
+            for rid, prompt, slot, entry in group:
+                self._slot_req[slot] = rid
+                self._slot_len[slot] = len(prompt)
+                self._slot_pages[slot] = list(entry["pages"])
+                self.admitted_at[rid] = now
+
+    def _release_slot_pages(self, slot):
+        """Return a retired/cancelled slot's pages to the pool (shared
+        prefix pages just drop the slot's ref; the cache's own refs
+        keep them resident)."""
+        if self.pool is None:
+            return
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self.pool.release(pages)
+
+    def _ensure_tail_pages(self, extra):
+        """Pre-map every page the next dispatch's appends can touch:
+        each live slot's table must cover its length plus ``extra``
+        positions (appends never consult the free list in-program).
+        Raises when the pool cannot satisfy even after eviction —
+        unreachable behind the reservation-gated HTTP admission
+        (docs/paged_kv.md), loud for direct drivers."""
+        from veles_tpu.parallel.kv_pool import pages_for
+
+        ps = self.pool.page_size
+        for slot in self._slot_req:
+            need = pages_for(self._slot_len[slot] + extra, ps)
+            have = len(self._slot_pages.get(slot) or ())
+            if need > have:
+                got = self.pool.alloc(need - have)
+                if got is None:
+                    raise RuntimeError(
+                        "kv page pool exhausted mid-decode (%d pages, "
+                        "%d free): raise pool_pages/--serve-pool-pages "
+                        "or admit through GenerateAPI's pool-aware "
+                        "gate" % (self.pool.capacity,
+                                  self.pool.free_pages))
+                self._slot_pages.setdefault(slot, []).extend(got)
+
+    def _page_table_array(self, extra):
+        """The (slots, PB) page-table operand for the next dispatch:
+        PB pages cover the longest live sequence plus ``extra``
+        appends (the pages-per-slot bucket — one compiled program per
+        PB, the paged analogue of the span tile). Rows of freed lanes
+        stay scratch so their harmless writes never touch live
+        pages."""
+        from veles_tpu.parallel.kv_pool import pages_for
+
+        self._ensure_tail_pages(extra)
+        ps = self.pool.page_size
+        pb = max(pages_for(self._slot_len[s] + extra, ps)
+                 for s in self._slot_req)
+        table = numpy.zeros((self.slots, pb), numpy.int32)
+        for slot in self._slot_req:
+            pages = self._slot_pages[slot][:pb]
+            table[slot, :len(pages)] = pages
+        return jnp.asarray(table)
+
+    def worst_case_pages(self, prompt_len, budget, chunk=1):
+        """Upper bound on the pages one request can hold at once —
+        what the pool-aware admission gate reserves, so the sum over
+        admitted requests never exceeds the pool (the no-deadlock
+        invariant). The max over the admission families:
+
+        - cold: the prompt bucket, grown to the token budget plus the
+          lag-1 pipeline's two chunks of slack;
+        - tail, at every possible page-aligned split: the shared
+          prefix's whole pages (the slot refs pin them) PLUS the
+          re-bucketed tail — which can exceed the cold bound when
+          bucket rounding/clamping make ``pages(prefix) +
+          pages(tail_bucket) > pages(prompt_bucket)``."""
+        from veles_tpu.parallel.kv_pool import pages_for
+
+        ps = self.page_size
+        bucket = min(self._bucket(prompt_len), self.max_len)
+        worst = pages_for(bucket + budget + 2 * chunk, ps)
+        for shared in range(ps, prompt_len, ps):
+            tail_bucket = min(self._bucket(prompt_len - shared),
+                              self.max_len)
+            worst = max(worst,
+                        shared // ps + pages_for(tail_bucket, ps))
+        return worst
+
     def _attended_span(self, extra):
         """Static attended span for the next dispatch: the longest
         LIVE sequence plus the ``extra`` positions the dispatch will
@@ -911,17 +1282,28 @@ class ContinuousDecoder:
         {request_id: token} for the tokens generated this step."""
         from veles_tpu.parallel.decode import slot_step
 
-        step = self._sharded_fns[1] if self._sharded_fns else slot_step
         self._admit_pending()
         if not self._slot_req:
             return {}
         snapshot = dict(self._slot_req)
-        self.state, emitted = step(
-            self.params, self.embed_table, self.heads, self.state,
-            jnp.asarray(self._active()),
-            jnp.float32(self.temperature or 1.0),
-            sample=bool(self.temperature), top_k=self.top_k,
-            span=self._attended_span(1))
+        if self.paged:
+            from veles_tpu.parallel.kv_pool import paged_slot_step
+            step = (self._paged_fns[3] if self._paged_fns
+                    else paged_slot_step)
+            self.state, emitted = step(
+                self.params, self.embed_table, self.heads, self.state,
+                self._page_table_array(1), jnp.asarray(self._active()),
+                jnp.float32(self.temperature or 1.0),
+                sample=bool(self.temperature), top_k=self.top_k)
+        else:
+            step = (self._sharded_fns[1] if self._sharded_fns
+                    else slot_step)
+            self.state, emitted = step(
+                self.params, self.embed_table, self.heads, self.state,
+                jnp.asarray(self._active()),
+                jnp.float32(self.temperature or 1.0),
+                sample=bool(self.temperature), top_k=self.top_k,
+                span=self._attended_span(1))
         for slot in snapshot:
             self._slot_len[slot] += 1
         self.dispatch_counts["step"] += 1
@@ -942,6 +1324,7 @@ class ContinuousDecoder:
                 self.admitted_at.pop(rid, None)
                 self._retire_trace(rid)
                 self._free.append(slot)
+                self._release_slot_pages(slot)
         self.steps += 1
         return out
 
@@ -984,8 +1367,10 @@ class ContinuousDecoder:
         if self._xla.enabled:
             done = time.monotonic()
             if self._last_chunk_done is not None:
-                self._xla.observe_step("decode.dispatch",
-                                       done - self._last_chunk_done)
+                self._xla.observe_step(
+                    "paged.dispatch" if self.paged
+                    else "decode.dispatch",
+                    done - self._last_chunk_done)
             self._last_chunk_done = done
         if self.dispatch_log is not None:
             self.dispatch_log.append(("collect", emitted.shape[0]))
@@ -1012,6 +1397,7 @@ class ContinuousDecoder:
                 if self._slot_req.get(slot) == rid:
                     del self._slot_req[slot]
                     self._free.append(slot)
+                    self._release_slot_pages(slot)
         return out
 
     def dispatch_chunk(self, chunk):
@@ -1024,22 +1410,35 @@ class ContinuousDecoder:
         compute."""
         from veles_tpu.parallel.decode import slot_step_many
 
-        step_many = (self._sharded_fns[2] if self._sharded_fns
-                     else slot_step_many)
         self._admit_pending()
         if not self._slot_req:
             return None
         snapshot = dict(self._slot_req)
         # span writes stay outside the timed window (see decode.admit)
-        with self._span("decode.dispatch", list(snapshot.values()),
-                        chunk=chunk):
+        with self._span("paged.dispatch" if self.paged
+                        else "decode.dispatch",
+                        list(snapshot.values()), chunk=chunk):
             t0 = time.perf_counter()
-            self.state, emitted = step_many(
-                self.params, self.embed_table, self.heads, self.state,
-                jnp.asarray(self._active()), chunk,
-                jnp.float32(self.temperature or 1.0),
-                sample=bool(self.temperature), top_k=self.top_k,
-                span=self._attended_span(chunk))
+            if self.paged:
+                from veles_tpu.parallel.kv_pool import \
+                    paged_slot_step_many
+                step_many = (self._paged_fns[4] if self._paged_fns
+                             else paged_slot_step_many)
+                self.state, emitted = step_many(
+                    self.params, self.embed_table, self.heads,
+                    self.state, self._page_table_array(chunk),
+                    jnp.asarray(self._active()), chunk,
+                    jnp.float32(self.temperature or 1.0),
+                    sample=bool(self.temperature), top_k=self.top_k)
+            else:
+                step_many = (self._sharded_fns[2] if self._sharded_fns
+                             else slot_step_many)
+                self.state, emitted = step_many(
+                    self.params, self.embed_table, self.heads,
+                    self.state, jnp.asarray(self._active()), chunk,
+                    jnp.float32(self.temperature or 1.0),
+                    sample=bool(self.temperature), top_k=self.top_k,
+                    span=self._attended_span(chunk))
             elapsed = time.perf_counter() - t0
         self.timings["dispatch_s"] += elapsed
         self.metrics.observe(
@@ -1149,7 +1548,8 @@ class GenerateAPI:
                  path="/generate", chunk=8, request_timeout=None,
                  max_queue=None, deadline=None, rebuild_backoff=None,
                  rebuild_backoff_max=None, chaos=None, quantize=None,
-                 tile=None, mesh=None, mesh_axis="model"):
+                 tile=None, mesh=None, mesh_axis="model", paged=None,
+                 page_size=None, pool_pages=None):
         import queue
 
         from veles_tpu.core.config import root
@@ -1185,12 +1585,24 @@ class GenerateAPI:
                 "serve deadline (--serve-deadline / deadline=) must "
                 "be a positive number of seconds (at most 1e7), "
                 "got %r" % deadline)
+        #: paged KV pool serving (docs/paged_kv.md): --serve-paged /
+        #: root.common.serve.paged turns the dense slot slab into a
+        #: page pool with shared-prefix admission; --serve-page-size /
+        #: --serve-pool-pages size it. Resolved HERE so the breaker's
+        #: rebuild path reconstructs the same tier.
+        if paged is None:
+            paged = bool(serve_cfg.get("paged", False))
+        if page_size is None:
+            page_size = serve_cfg.get("page_size", None)
+        if pool_pages is None:
+            pool_pages = serve_cfg.get("pool_pages", None)
         self._decoder_kwargs = dict(
             params=params, embed_table=embed_table, heads=heads,
             slots=slots, max_len=max_len, n_tokens=n_tokens,
             temperature=temperature, top_k=top_k, eos=eos, key=key,
             quantize=quantize, tile=tile, mesh=mesh,
-            mesh_axis=mesh_axis)
+            mesh_axis=mesh_axis, paged=bool(paged),
+            page_size=page_size, pool_pages=pool_pages)
         self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
         self.port = port
@@ -1213,6 +1625,8 @@ class GenerateAPI:
             chaos = ServingChaosMonkey.from_config()
         self.chaos = chaos
         self.health = ServingHealth(name="generate-api")
+        if self.decoder.pool is not None:
+            self.health.attach_pool(self.decoder.pool)
         self._staged = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -1235,6 +1649,11 @@ class GenerateAPI:
         if holder.setdefault("resolved", token) is not token:
             return
         holder.update(fields)
+        reserved = holder.pop("pool_reserved", 0)
+        if reserved:
+            pool = holder.get("pool")
+            if pool is not None:
+                pool.unreserve(reserved)
         self.health.release(outcome)
         holder["event"].set()
 
@@ -1247,6 +1666,40 @@ class GenerateAPI:
                 prompt, budget, holder = self._staged.get_nowait()
             except queue.Empty:
                 break
+            # the request may have been admitted (worst-case pages
+            # reserved) against a PREVIOUS decoder's pool with a
+            # breaker rebuild racing its staging: move the reservation
+            # to the pool it will actually decode on. The pop is the
+            # CLAIM — _resolve pops the same key, so exactly one side
+            # ever releases (a handler-backstop timeout firing during
+            # the move must not double-unreserve or strand pages on
+            # the fresh pool).
+            reserved = holder.pop("pool_reserved", 0)
+            if reserved:
+                pool = self.decoder.pool
+                if pool is not None and holder.get("pool") is not pool:
+                    holder["pool"].unreserve(reserved)
+                    if pool.try_reserve(reserved):
+                        holder["pool"] = pool
+                    else:
+                        # the fresh pool is already promised to
+                        # capacity (a straggler staged across the trip
+                        # while new admissions filled it): shed
+                        # retryable like any other trip casualty — an
+                        # unconditional reserve here would overcommit
+                        # past capacity and break the no-deadlock
+                        # invariant for EVERY admitted request
+                        self._resolve(
+                            holder, "shed",
+                            error="rebuild raced admission: page "
+                            "reservation lost; retry", code=503)
+                        continue
+                holder["pool_reserved"] = reserved
+                if "resolved" in holder \
+                        and holder.pop("pool_reserved", 0):
+                    # _resolve ran between the claim and the give-back
+                    # and found nothing to release — release here
+                    holder["pool"].unreserve(reserved)
             try:
                 rid = self.decoder.submit(prompt, budget,
                                           trace=holder.get("trace"))
@@ -1319,7 +1772,27 @@ class GenerateAPI:
         the driver will actually use and RAISES on a hung probe instead
         of looping silently. Returns True on success."""
         try:
-            decoder = ContinuousDecoder(**self._decoder_kwargs)
+            kwargs = dict(self._decoder_kwargs)
+            if self.decoder.pool is not None:
+                # the prefix cache OUTLIVES the decoder: its entries
+                # (tokens, logits, per-page payload shadows) restore
+                # into the fresh pool by page copy, so a breaker trip
+                # never costs a re-prefill of every cached prompt.
+                # Shadows are captured HERE, from the dying decoder —
+                # not per cold admission (cached pages are read-only,
+                # so trip-time bytes equal publication-time bytes)
+                try:
+                    self.decoder.pool.capture_shadows(
+                        self.decoder.state)
+                except Exception:
+                    # a sick device can refuse the D2H reads; entries
+                    # left unshadowed are dropped by restore_entries
+                    # (the fresh decoder cold-prefills them again)
+                    # rather than failing the whole rebuild
+                    import traceback
+                    traceback.print_exc()
+                kwargs["prefix_cache"] = self.decoder.pool.cache
+            decoder = ContinuousDecoder(**kwargs)
             # request ids stay monotonic across rebuilds so per-request
             # sampling keys (fold_in(base, rid)) never repeat
             decoder._next_id = self.decoder._next_id
@@ -1339,6 +1812,9 @@ class GenerateAPI:
             traceback.print_exc()
             return False
         self.decoder = decoder
+        if decoder.pool is not None:
+            # /healthz + the pool gauges must mirror the FRESH pool
+            self.health.attach_pool(decoder.pool)
         return True
 
     def _note_progress(self, waiting):
@@ -1523,8 +1999,36 @@ class GenerateAPI:
             def _serve_admitted(self, prompt, budget, deadline_s,
                                 req_span):
                 # admission: atomic ready + queue-bound check; rejected
-                # requests never stage, so the decoder queue is bounded
-                verdict = api.health.try_admit(api.max_queue)
+                # requests never stage, so the decoder queue is bounded.
+                # The paged tier extends the decision to KV pages: the
+                # request's WORST-CASE page demand is reserved under the
+                # same lock (released when the request resolves), so an
+                # admitted request can never deadlock waiting for pages
+                # it was promised — a full pool 429s here instead, with
+                # Retry-After priced from the observed page-release
+                # rate (docs/paged_kv.md).
+                booked = {}
+                pool_gate = None
+                if api.decoder.pool is not None:
+                    limit = (budget if budget is not None
+                             else api.decoder.n_tokens)
+
+                    def pool_gate():
+                        # resolve the decoder INSIDE the gate (under
+                        # the admission lock): a breaker rebuild swaps
+                        # api.decoder concurrently, and reserving on
+                        # the dead pool would leave the fresh pool's
+                        # accounting skewed and the request unbacked
+                        decoder = api.decoder
+                        pool = booked["pool"] = decoder.pool
+                        need = booked["need"] = decoder.worst_case_pages(
+                            len(prompt), limit, api.chunk)
+                        if pool.try_reserve(need):
+                            booked["reserved"] = True
+                            return None
+                        return pool.retry_after(need)
+                verdict = api.health.try_admit(api.max_queue,
+                                               pool_gate=pool_gate)
                 if verdict == "unready":
                     req_span.annotate(outcome="unready")
                     reply(self, {"error": api._tripped or "not ready"},
@@ -1537,11 +2041,25 @@ class GenerateAPI:
                            % api.max_queue},
                           code=429, headers={"Retry-After": "1"})
                     return
+                if isinstance(verdict, tuple) and verdict[0] == "pool":
+                    req_span.annotate(outcome="pool_full")
+                    reply(self,
+                          {"error": "kv page pool exhausted: need %d "
+                           "pages, %d free"
+                           % (booked["need"],
+                              booked["pool"].free_pages)},
+                          code=429,
+                          headers={"Retry-After":
+                                   "%d" % max(1, round(verdict[1]))})
+                    return
                 staged_at = time.monotonic()
                 holder = {"event": threading.Event(),
                           "staged_at": staged_at,
                           "deadline": staged_at + deadline_s,
                           "trace": req_span.context()}
+                if booked.get("reserved"):
+                    holder["pool"] = booked["pool"]
+                    holder["pool_reserved"] = booked["need"]
                 api._staged.put((prompt, budget, holder))
                 api._wake.set()
                 trace_headers = {}

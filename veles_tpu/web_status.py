@@ -177,6 +177,18 @@ def format_serving_health(serving):
             entry = latency.get(kind)
             if isinstance(entry, dict) and entry.get("count"):
                 parts.append("%s p95 %sms" % (label, entry["p95"]))
+    pool = serving.get("pool")
+    if isinstance(pool, dict):
+        # the paged-KV pair (docs/paged_kv.md): page occupancy and the
+        # prefix-cache hit rate, next to the survival counters
+        try:
+            parts.append("pages %d/%d" % (pool.get("pages_used", 0),
+                                          pool.get("pages_total", 0)))
+        except TypeError:
+            pass
+        rate = pool.get("prefix_hit_rate")
+        if isinstance(rate, (int, float)):
+            parts.append("prefix hit %d%%" % round(rate * 100))
     return " · ".join(parts)
 
 
