@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged
+	regress mesh paged fleet-mr
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -50,6 +50,17 @@ mesh:
 paged:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_paged.py \
 		-m paged -q
+
+# Compiler-visible fleet aggregation suite (docs/compiler_fleet.md):
+# the mapreduce primitives (f32 bit-exact vs psum, bf16/int8 quantized
+# all-reduce tiers with error bounds + convergence parity), the
+# instrumented fleet_train_step, and the control-plane fleet's
+# bit-identity vs the single-process fused step on the 8-device CPU
+# mesh — clean AND under the chaos harness (death/zombie/duplicate
+# with the rollback protocol).
+fleet-mr:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_mapreduce.py \
+		tests/test_fleet_chaos.py -m fleet_mr -q
 
 # Standalone continuous-batching serving bench (docs/
 # serving_performance.md): one JSON line with the decode_continuous_*

@@ -1416,6 +1416,206 @@ def reshard_bench():
     return keys
 
 
+def fleet_section(in_f=784, hidden=1024, classes=10, batch=1024,
+                  repeats=12):
+    """In-program fleet aggregation vs the measured host-aggregation
+    baseline (ROADMAP item 3 / docs/compiler_fleet.md), same gradient
+    tree, same device count. Requires >= 2 devices (the driver falls
+    back to the 8-device virtual-CPU subprocess via
+    :func:`fleet_bench`); keys:
+
+    - ``fleet_reduce_ms`` / ``fleet_reduce_bytes``: one in-program
+      all-reduce of the 2-layer MLP gradient tree over the full mesh
+      (f32 tier == the product-default psum; min-of-``repeats`` wall,
+      compile excluded) and its analytic wire bytes; ``_bf16_`` /
+      ``_int8_`` twins for the compressed tiers;
+    - ``fleet_host_baseline_ms``: the SAME tree through the data-plane
+      host path one update takes — device→host, fleet-protocol frame
+      encode (pickle+gzip, ``fleet/protocol.py``), decode, host→device,
+      merge under the update-lock semantics — the per-step cost the
+      control-plane refit deletes;
+    - ``fleet_inprogram_speedup``: baseline / in-program (must stay
+      strictly > 1 — the acceptance bar);
+    - ``fleet_step_ms`` / ``fleet_step_mfu``: the full
+      ``mapreduce.fleet_train_step`` (fused forward+backward+reduce+
+      update as ONE program) per-step wall and its MFU from
+      ``observe/xla_stats`` cost analysis (on the CPU-8 fallback the
+      peak is a pinned nominal 1.0 TFLOP/s so the ratio is a stable
+      regression number, not a hardware claim — ``fleet_config`` says
+      which).
+    """
+    from veles_tpu.core.config import root
+    from veles_tpu.fleet.protocol import decode_frame_bytes, encode_frame
+    from veles_tpu.observe import xla_stats
+    from veles_tpu.parallel import mapreduce as mr
+    from veles_tpu.parallel.mesh import build_mesh, shard_map
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return None
+    mesh = build_mesh(devices=devices, data=n)
+    rng = numpy.random.RandomState(0)
+    grads = {"w1": rng.randn(n, in_f, hidden).astype(numpy.float32),
+             "b1": rng.randn(n, hidden).astype(numpy.float32),
+             "w2": rng.randn(n, hidden, classes).astype(numpy.float32),
+             "b2": rng.randn(n, classes).astype(numpy.float32)}
+    sharded = jax.device_put(
+        grads, NamedSharding(mesh, P("data")))
+    one_replica = jax.tree.map(lambda x: x[0], grads)
+
+    out = {}
+    for tier in ("f32", "bf16", "int8"):
+        def body(t, tier=tier):
+            local = jax.tree.map(lambda x: x[0], t)
+            return mr.reduce_sum(local, "data", precision=tier)
+        fn = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=(P("data"),), out_specs=P()))
+        jax.block_until_ready(fn(sharded))  # compile + warm
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(sharded))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        suffix = "" if tier == "f32" else "_" + tier
+        out["fleet_reduce%s_ms" % suffix] = round(times[0] * 1000, 3)
+        out["fleet_reduce%s_spread" % suffix] = round(
+            (times[1] - times[0]) / max(times[0], 1e-9), 4)
+        out["fleet_reduce%s_bytes" % suffix] = mr.reduce_wire_bytes(
+            one_replica, n, tier)
+
+    # the measured host-aggregation baseline: what ONE data-plane
+    # update costs the master per step on the same tree — the exact
+    # device→frame→device→merge path fleet/server.py ran before the
+    # control-plane refit
+    key = b"bench-fleet"
+    device_tree = jax.device_put(one_replica)
+    master_tree = jax.device_put(one_replica)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        host = jax.device_get(device_tree)            # slave: .mem
+        frame = encode_frame({"type": "update", "update": host}, key)
+        update = decode_frame_bytes(frame, key)["update"]  # master
+        merged = jax.tree.map(                        # _locked_apply
+            lambda cur, new: (cur + jnp.asarray(new)) * 0.5,
+            master_tree, update)
+        jax.block_until_ready(merged)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    out["fleet_host_baseline_ms"] = round(times[0] * 1000, 3)
+    out["fleet_host_baseline_spread"] = round(
+        (times[1] - times[0]) / max(times[0], 1e-9), 4)
+    out["fleet_inprogram_speedup"] = round(
+        out["fleet_host_baseline_ms"] / max(out["fleet_reduce_ms"],
+                                            1e-9), 2)
+
+    # the full in-program fleet step, MFU from cost analysis: a dense
+    # 2-layer tick through mapreduce.fleet_train_step (the product
+    # path the control-plane slave runs)
+    tracker = xla_stats.get_compile_tracker()
+    was_enabled = tracker.enabled
+    tracker.enabled = True
+    nominal_peak = False
+    if xla_stats.peak_tflops() is None:
+        # CPU fallback: pin a nominal denominator so the ratio is a
+        # stable regression number (fleet_config records the pin)
+        root.common.observe.peak_tflops = 1.0
+        nominal_peak = True
+    try:
+        specs = [
+            {"kind": "dense", "activation": "tanh",
+             "leaves": (("w", "weights", "_velocity_w", False, True),
+                        ("b", "bias", "_velocity_b", True, False)),
+             "has_params": True, "solver": "momentum"},
+            {"kind": "dense", "activation": "linear",
+             "leaves": (("w", "weights", "_velocity_w", False, True),
+                        ("b", "bias", "_velocity_b", True, False)),
+             "has_params": True, "solver": "momentum"},
+        ]
+        steps = mr.fleet_train_step(mesh, specs, "none",
+                                    with_confusion=False,
+                                    reduce_precision="f32")
+        train_step = steps[0]
+        params = []
+        fan = in_f
+        for width in (hidden, classes):
+            w = jnp.asarray(rng.randn(fan, width)
+                            .astype(numpy.float32) * 0.05)
+            params.append({"p": {"w": w,
+                                 "b": jnp.zeros(width, jnp.float32)},
+                           "v": {"w": jnp.zeros_like(w),
+                                 "b": jnp.zeros(width, jnp.float32)}})
+            fan = width
+        hyper = jnp.asarray([0.03, 0.03, 0.0, 0.0, 0.9, 0.9, 0.999,
+                             1e-8], jnp.float32)
+        hypers = [hyper, hyper]
+        data = jnp.asarray(rng.rand(batch, in_f)
+                           .astype(numpy.float32))
+        labels = jnp.asarray(rng.randint(0, classes, batch))
+        indices = jnp.arange(batch, dtype=jnp.int64)
+        valid = numpy.float32(batch)
+        seed = numpy.int64(0)
+        params, metrics = train_step(params, hypers, {}, data, labels,
+                                     indices, valid, seed)
+        jax.block_until_ready(metrics)  # compile + warm
+        step_times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            params, metrics = train_step(params, hypers, {}, data,
+                                         labels, indices, valid, seed)
+            jax.block_until_ready(metrics)
+            dt = time.perf_counter() - t0
+            step_times.append(dt)
+            # no manual observe_step here: the fleet_train_step
+            # wrapper already feeds the MFU EMA with its call cadence
+            # (== the blocked wall in this loop)
+        step_times.sort()
+        out["fleet_step_ms"] = round(step_times[0] * 1000, 3)
+        out["fleet_step_spread"] = round(
+            (step_times[1] - step_times[0])
+            / max(step_times[0], 1e-9), 4)
+        mfu = tracker.snapshot()["mfu"].get("mapreduce.fleet_train_step",
+                                            {})
+        if mfu.get("mfu") is not None:
+            out["fleet_step_mfu"] = round(mfu["mfu"], 4)
+    finally:
+        tracker.enabled = was_enabled
+        if nominal_peak:
+            root.common.observe.peak_tflops = None
+    out["fleet_config"] = "data%d_i%d_h%d_c%d_b%d%s" % (
+        n, in_f, hidden, classes, batch,
+        "_nominal_peak1" if nominal_peak else "")
+    return out
+
+
+def fleet_bench():
+    """``fleet_section`` keys wherever the bench runs: in-process on a
+    multi-device backend, else via the 8-device virtual-CPU subprocess
+    (the ``reshard_bench`` doctrine — collective cost and wire bytes
+    are device-count facts the CPU mesh measures honestly)."""
+    import subprocess
+    import sys
+
+    if len(jax.devices()) >= 2:
+        return fleet_section()
+    child = ("import json, bench\n"
+             "print(json.dumps(bench.fleet_section()))\n")
+    proc = subprocess.run([sys.executable, "-c", child], env=_cpu8_env(),
+                          capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        print(proc.stderr[-2000:], file=sys.stderr)
+        return {}
+    keys = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not keys:
+        return {}
+    keys["fleet_config"] = keys.get("fleet_config", "") + "_cpu8"
+    return keys
+
+
 def _guarded(fn, *args, fallback=(None, []), **kwargs):
     """One failed section must not kill the headline line — but the
     failure has to be visible somewhere (stderr; stdout stays one JSON
@@ -1504,6 +1704,7 @@ def main(artifact_path=None):
     _add(_guarded(decode_int8_device, kv_quant=True, fallback={}))
     _add(_guarded(decode_continuous, fallback={}))
     _add(_guarded(reshard_bench, fallback={}))
+    _add(_guarded(fleet_bench, fallback={}))
     _add(_guarded(pod_overhead, fallback={}))
     _add(_guarded(pallas_epilogue_compare, fallback={}))
     gflops = device_keys.get("fused_step_gflops")

@@ -427,6 +427,391 @@ def chaos_config_reset():
     root.common.fleet.chaos.update(saved)
 
 
+def _control_kw(max_epochs=3):
+    """Like :func:`_synthetic_kw` but with a minibatch size the
+    8-device data axis divides (the sharded fused tick's
+    requirement)."""
+    rng = numpy.random.RandomState(0)
+    data = rng.rand(320, 8).astype(numpy.float32)
+    labels = (data[:, 0] > 0.5).astype(numpy.int32)
+    return dict(
+        layers=(8, 2),
+        loader_kwargs=dict(data=data, labels=labels,
+                           class_lengths=[0, 64, 256],
+                           minibatch_size=64,
+                           normalization_type="linear"),
+        learning_rate=0.3, max_epochs=max_epochs)
+
+
+def _final_weights(wf):
+    return [numpy.asarray(gd.weights.mem).copy() for gd in wf.gds]
+
+
+def _run_standalone_pod(kw):
+    """The single-process reference: the SAME fused step on the SAME
+    8-device CPU mesh, per-minibatch serving (fused_sweep=False mirrors
+    the fleet's per-job cadence) — the bit-identity anchor for the
+    in-program fleet runs."""
+    import jax
+    from veles_tpu.parallel.mesh import build_mesh
+
+    _seed_training()
+    launcher = Launcher()
+    wf = MLPWorkflow(launcher, name="mr-chaos",
+                     mesh=build_mesh(devices=jax.devices()[:8], data=8),
+                     fused_sweep=False, fused_pipeline=False, **kw)
+    launcher.initialize()
+    launcher.run()
+    weights = _final_weights(wf)
+    best = wf.decision.best_n_err[VALID]
+    launcher.stop()
+    return weights, best
+
+
+def _run_fleet_control(kw, chaos=None):
+    """One control-plane master + one mesh-sharded slave over loopback.
+    Returns (master weights, slave weights, best, status, client)."""
+    import jax
+    from veles_tpu.parallel.mesh import build_mesh
+
+    _seed_training()
+    master = Launcher(listen_address="127.0.0.1:0")
+    wf_m = MLPWorkflow(master, name="mr-chaos", **kw)
+    master.initialize()
+    thread = threading.Thread(target=master.run, daemon=True)
+    thread.start()
+    _seed_training()
+    slave = Launcher(master_address="127.0.0.1:%d" % master.agent.port,
+                     chaos=chaos)
+    wf_s = MLPWorkflow(slave, name="mr-chaos",
+                       mesh=build_mesh(devices=jax.devices()[:8],
+                                       data=8), **kw)
+    slave.initialize()
+    slave.run()
+    thread.join(180)
+    assert not thread.is_alive(), "master did not finish"
+    status = master.agent.fleet_status()
+    master_weights = _final_weights(wf_m)
+    slave_weights = _final_weights(wf_s)
+    best = wf_m.decision.best_n_err[VALID]
+    client = slave.agent
+    master.stop()
+    slave.stop()
+    return master_weights, slave_weights, best, status, client
+
+
+@pytest.fixture
+def control_plane_mode():
+    from veles_tpu.core.config import root
+    saved = root.common.fleet.get("plane", "data")
+    root.common.fleet.plane = "control"
+    yield
+    root.common.fleet.plane = saved
+
+
+class _FrameWriter:
+    """Captures written frames, decoded."""
+
+    def __init__(self, key=b"mr-test"):
+        self.key = key
+        self.frames = []
+
+    def write(self, data):
+        from veles_tpu.fleet.protocol import decode_frame_bytes
+        self.frames.append(decode_frame_bytes(data, self.key))
+
+    async def drain(self):
+        pass
+
+
+class _SyncRecordingWorkflow:
+    """Master-side workflow double for the sync/payload unit tests."""
+
+    checksum = "mr-test"
+
+    def __init__(self):
+        self.applied = []
+        self.synced = []
+
+    def apply_data_from_slave(self, data, slave=None):
+        self.applied.append(data)
+
+    def apply_sync_from_slave(self, data, slave=None):
+        self.synced.append(data)
+
+    def has_more_jobs(self):
+        return True
+
+
+class TestInProgramReduceChaos:
+    """ROADMAP item 3's acceptance family (docs/compiler_fleet.md):
+    the control-plane fleet runs the data-parallel math as ONE
+    compiled program on the slave's mesh, and under chaos — slave
+    death mid-step, duplicate update replay, frame drops — the run
+    stays BIT-IDENTICAL to the fault-free single-process fused step on
+    the same 8-device CPU mesh. The PR 1 idiom, with the math in
+    XLA."""
+
+    pytestmark = pytest.mark.fleet_mr
+
+    def test_control_plane_chaos_bit_identical(self, chaos_config_reset,
+                                               control_plane_mode):
+        kw = _control_kw(max_epochs=3)
+        ref_weights, ref_best = _run_standalone_pod(kw)
+
+        # fault-free fleet first: the wire refit alone must not move a
+        # bit vs the single-process run, and the fences must sync the
+        # master to the slave's replica every epoch
+        (m_clean, s_clean, clean_best, clean_status,
+         _) = _run_fleet_control(kw)
+        assert clean_status["plane"] == "control"
+        assert clean_status["sync"]["applied"] == 3  # one per epoch
+        assert clean_status["ledger"]["fenced_total"] == 0
+        assert clean_best == ref_best
+        for got, expected in zip(s_clean, ref_weights):
+            numpy.testing.assert_array_equal(got, expected)
+        for got, expected in zip(m_clean, ref_weights):
+            numpy.testing.assert_array_equal(got, expected)
+
+        # now with chaos: mid-step deaths (disconnect), dropped
+        # frames, duplicate replay, stragglers
+        chaos = dict(enabled=True, seed=CHAOS_SEED,
+                     death=0.18, death_mode="disconnect",
+                     frame_drop=0.04, frame_delay=0.10,
+                     frame_delay_ms=5.0,
+                     duplicate_update=0.25,
+                     slow_job=0.25, slow_job_ms=20.0)
+        (m_chaos, s_chaos, chaos_best, status,
+         client) = _run_fleet_control(kw, chaos=chaos)
+
+        counters = client.chaos.counters
+        assert counters["deaths"] >= 1, counters
+        assert counters["updates_duplicated"] >= 1, counters
+        ledger = status["ledger"]
+        # deaths/drops -> lease requeue -> re-issued work -> the
+        # rollback protocol realigned the slave's local replica
+        assert ledger["requeued"] >= 1, ledger
+        assert ledger["fenced"]["duplicate"] >= 1, ledger
+        assert client.rollbacks >= 1
+        # every epoch fence still synced the master (resend-until-ack)
+        assert status["sync"]["applied"] >= 3, status["sync"]
+        # no weight payload ever crossed the post-handshake wire
+        assert status.get("payload_rejects", 0) == 0
+
+        # the point of it all, now with the math in XLA: bit-identical
+        # to the fault-free SINGLE-PROCESS run
+        assert chaos_best == ref_best
+        for got, expected in zip(s_chaos, ref_weights):
+            numpy.testing.assert_array_equal(got, expected)
+        for got, expected in zip(m_chaos, ref_weights):
+            numpy.testing.assert_array_equal(got, expected)
+
+    def test_update_with_weight_payload_rejected(self):
+        """Satellite: a control-plane master must REJECT (not silently
+        ignore) a frame carrying the data-plane ``update`` key — a
+        zombie cannot park stale weights a future refactor might
+        apply. The lease stays OUTSTANDING (liveness: the hang timer
+        requeues it)."""
+        from veles_tpu.fleet.server import Server, SlaveDescription
+
+        wf = _SyncRecordingWorkflow()
+        server = Server("127.0.0.1:0", wf, secret="mr-test",
+                        plane="control")
+        server.epoch = "epoch-A"
+        slave = SlaveDescription("slave-1", {})
+        job = server.ledger.issue(slave.id, timeout=60.0)
+        writer = _FrameWriter()
+        msg = {"type": "update", "job_id": job, "epoch": "epoch-A",
+               "update": [{"weights": [1.0]}], "tick": 1}
+
+        async def drive():
+            server._loop = asyncio.get_running_loop()
+            await server._apply_update(slave, writer, msg)
+
+        asyncio.run(drive())
+        assert server._payload_rejects == 1
+        assert wf.applied == []  # never touched master state
+        assert slave.jobs_done == 0
+        assert server.ledger.state_of(job) == OUTSTANDING
+        assert writer.frames[-1]["fenced"] == "payload-rejected"
+        assert server.fleet_status()["payload_rejects"] == 1
+
+    def test_keepalive_frame_not_counted_as_work(self):
+        """Satellite: completed-work bookkeeping (jobs_done, job
+        timing, respawn-budget reset) happens AFTER the payload branch
+        — a metrics-only keepalive must not masquerade as a finished
+        job in fleet_status(). Holds on BOTH planes."""
+        from veles_tpu.fleet.server import Server, SlaveDescription
+
+        for plane, payload_key in (("data", "update"),
+                                   ("control", "results")):
+            wf = _SyncRecordingWorkflow()
+            server = Server("127.0.0.1:0", wf, secret="mr-test",
+                            plane=plane)
+            server.epoch = "epoch-A"
+            slave = SlaveDescription("slave-1", {})
+            slave.job_started = time.time()
+            writer = _FrameWriter()
+            lease = server.ledger.issue(slave.id, 60.0)
+            keepalive = {"type": "update", "job_id": lease,
+                         "epoch": "epoch-A",
+                         "metrics": [["veles_x", "gauge", [], 1.0]]}
+
+            async def drive(msg):
+                server._loop = asyncio.get_running_loop()
+                await server._apply_update(slave, writer, msg)
+
+            asyncio.run(drive(keepalive))
+            assert slave.jobs_done == 0, plane
+            assert slave.job_times == [], plane
+            assert wf.applied == [], plane
+            # ...and the lease is NOT consumed: settling a resultless
+            # frame would silently drop that minibatch from the run —
+            # the hang timer requeues it instead
+            assert server.ledger.state_of(lease) == OUTSTANDING, plane
+            assert writer.frames[-1]["fenced"] == "no-results", plane
+            # a REAL update still books the work
+            real = {"type": "update",
+                    "job_id": server.ledger.issue(slave.id, 60.0),
+                    "epoch": "epoch-A", payload_key: [{"n_err": 1}],
+                    "tick": 1}
+            asyncio.run(drive(real))
+            assert slave.jobs_done == 1, plane
+            assert wf.applied == [[{"n_err": 1}]], plane
+
+    def test_zombie_sync_fenced(self):
+        """The stale-epoch-zombie family: fence syncs from a previous
+        master incarnation, or chasing a job this master never
+        accepted from that process, are rejected — master weights
+        stay untouched."""
+        from veles_tpu.fleet.server import Server, SlaveDescription
+
+        wf = _SyncRecordingWorkflow()
+        server = Server("127.0.0.1:0", wf, secret="mr-test",
+                        plane="control")
+        server.epoch = "epoch-A"
+        slave = SlaveDescription("slave-1", {})
+        writer = _FrameWriter()
+
+        async def drive(msg):
+            server._loop = asyncio.get_running_loop()
+            await server._apply_sync(slave, writer, msg)
+
+        # zombie from the previous master incarnation
+        asyncio.run(drive({"type": "sync", "job_id": 3,
+                           "epoch": "epoch-OLD",
+                           "sync": [{"weights": [9.0]}]}))
+        assert writer.frames[-1]["fenced"] == FENCE_STALE_EPOCH
+        # right epoch, but the job was never accepted from this process
+        asyncio.run(drive({"type": "sync", "job_id": 3,
+                           "epoch": "epoch-A",
+                           "sync": [{"weights": [9.0]}]}))
+        assert writer.frames[-1]["fenced"] == "unsettled-job"
+        assert wf.synced == []
+        assert server._sync_counters["fenced"] == 2
+        # the accepted fence applies (idempotent on resend)
+        server._accepted_jobs[(slave.mid, slave.pid)] = 3
+        for _ in range(2):
+            asyncio.run(drive({"type": "sync", "job_id": 3,
+                               "epoch": "epoch-A",
+                               "sync": [{"weights": [7.0]}]}))
+        assert writer.frames[-1].get("fenced") is None
+        assert wf.synced == [[{"weights": [7.0]}]] * 2
+        assert server._sync_counters["applied"] == 2
+
+    def test_reduce_stats_reach_master_scrape(self, control_plane_mode):
+        """Observability end to end: with the metrics plane enabled,
+        the slave's in-program reduce counters (veles_fleet_reduce_*,
+        chip idle) piggyback on update frames, land in the master's
+        fleet_status()["reduce"] summary, and re-export slave-labeled
+        from the master's registry."""
+        from veles_tpu.observe.metrics import (MetricsRegistry,
+                                               get_metrics_registry,
+                                               publish_fleet)
+        from veles_tpu.observe.xla_stats import get_compile_tracker
+        from veles_tpu.parallel.mapreduce import get_reduce_stats
+
+        registry = get_metrics_registry()
+        tracker = get_compile_tracker()
+        was_metered, was_tracked = registry.enabled, tracker.enabled
+        registry.enable()
+        tracker.enabled = True
+        get_reduce_stats().reset()
+        try:
+            kw = _control_kw(max_epochs=1)
+            _, _, _, status, _ = _run_fleet_control(kw)
+            reduce_rows = status.get("reduce") or {}
+            assert reduce_rows, status
+            entry = next(iter(reduce_rows.values()))
+            assert entry["steps"] >= 1
+            assert entry["bytes"] > 0
+            # the master-side exposition re-exports the slave's rows
+            scrape = MetricsRegistry(enabled=True)
+
+            class _Server:
+                def fleet_status(self):
+                    return status
+
+                def slave_metrics(self):
+                    return {"slave-1": [
+                        ("veles_fleet_reduce_steps_total", "counter",
+                         {"precision": "f32"}, entry["steps"])]}
+
+            publish_fleet(scrape, _Server())
+            text = scrape.expose()
+            assert 'veles_fleet_reduce_steps_total{precision="f32",' \
+                'slave="slave-1"}' in text
+        finally:
+            if not was_metered:
+                registry.disable()
+            tracker.enabled = was_tracked
+            get_reduce_stats().reset()
+
+    def test_dashboard_renders_control_plane_cell(self):
+        """The web-status fleet column shows the plane, fence syncs
+        and the per-slave in-program reduce summary."""
+        from veles_tpu.web_status import format_fleet_health
+        cell = format_fleet_health({
+            "plane": "control",
+            "ledger": {"issued": 15, "done": 15},
+            "sync": {"applied": 3, "fenced": 1},
+            "reduce": {"slave-1": {"steps": 15, "bytes": 1.2e6,
+                                   "idle": 0.04}}})
+        assert "control-plane" in cell
+        assert "3 syncs (1 fenced)" in cell
+        assert "in-program reduce: 15 steps" in cell
+        assert "1.2 MB wire" in cell
+        assert "idle 4%" in cell
+        # data-plane cells are unchanged (no plane/reduce noise)
+        cell = format_fleet_health({"ledger": {"issued": 2, "done": 1}})
+        assert cell == "1/2 jobs done"
+
+    def test_plane_mismatch_fails_handshake(self):
+        """A mixed data/control fleet must fail loudly at the
+        handshake, naming the knob — not stall mid-run."""
+        from veles_tpu.fleet.client import Client
+        from veles_tpu.fleet.server import Server
+
+        server = Server("127.0.0.1:0", _ScriptedWorkflow([1]),
+                        secret="chaos-restart", plane="control").start()
+        try:
+            client = Client("127.0.0.1:%d" % server.port,
+                            _ScriptedWorkflow([]),
+                            secret="chaos-restart", chaos=False,
+                            plane="data")
+            finished = threading.Event()
+            client.on_finished = finished.set
+            client.start()
+            assert finished.wait(10), "client never finished"
+            assert client.refusal is not None
+            assert "fleet plane mismatch" in client.refusal
+            assert "root.common.fleet.plane" in client.refusal
+            assert not server.slaves
+            client.stop()
+        finally:
+            server.stop()
+
+
 class TestChaosConvergence:
     """THE acceptance test: faults fire, training result is unchanged."""
 

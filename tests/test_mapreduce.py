@@ -1,0 +1,454 @@
+"""Compiler-visible fleet aggregation: the mapreduce primitive layer.
+
+``parallel/mapreduce.py`` (docs/compiler_fleet.md): broadcast / map_fn
+/ reduce_sum / reduce_mean over the ``"data"`` mesh axis, the
+bf16/int8 quantized-all-reduce wire tiers with per-leaf scales, the
+analytic wire-byte accounting, the instrumented ``fleet_train_step``
+(xla_stats compiles/FLOPs/MFU + the veles_fleet_reduce_* metric
+families), and the int8 tier's convergence parity against bf16 through
+real pod-mode training. Runs on the 8-device virtual CPU mesh
+(``make fleet-mr``).
+"""
+
+import time
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from veles_tpu.core.config import root
+from veles_tpu.parallel import mapreduce as mr
+from veles_tpu.parallel.mesh import build_mesh, shard_map
+
+pytestmark = pytest.mark.fleet_mr
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N, "conftest must force 8 CPU devices"
+    return build_mesh(devices=jax.devices()[:N], data=N)
+
+
+def _tree(rng):
+    return {"w": rng.randn(N, 96, 32).astype(numpy.float32),
+            "b": rng.randn(N, 33).astype(numpy.float32)}
+
+
+def _run_reduce(mesh, tree, precision, mean=False):
+    """Each device reduces its own distinct shard slice; the output
+    keeps a leading device dim so the test can ASSERT replication
+    instead of trusting the out_spec."""
+    reducer = mr.reduce_mean if mean else mr.reduce_sum
+
+    def body(t):
+        local = jax.tree.map(lambda x: x[0], t)
+        out = reducer(local, "data", precision=precision)
+        return jax.tree.map(lambda x: x[None], out)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                           out_specs=P("data")))
+    return jax.tree.map(numpy.asarray, fn(tree))
+
+
+class TestPrimitives:
+    def test_f32_reduce_is_bit_identical_to_psum(self, mesh):
+        """The default tier IS lax.psum — the pre-existing pod-mode
+        gradient merge must not change by a single bit."""
+        tree = _tree(numpy.random.RandomState(0))
+
+        def psum_body(t):
+            local = jax.tree.map(lambda x: x[0], t)
+            out = lax.psum(local, "data")
+            return jax.tree.map(lambda x: x[None], out)
+
+        ref_fn = jax.jit(shard_map(psum_body, mesh=mesh,
+                                   in_specs=(P("data"),),
+                                   out_specs=P("data")))
+        ref = jax.tree.map(numpy.asarray, ref_fn(tree))
+        got = _run_reduce(mesh, tree, "f32")
+        for key in tree:
+            numpy.testing.assert_array_equal(got[key], ref[key])
+
+    def test_reduce_mean(self, mesh):
+        tree = _tree(numpy.random.RandomState(1))
+        got = _run_reduce(mesh, tree, "f32", mean=True)
+        summed = _run_reduce(mesh, tree, "f32")
+        for key in tree:
+            numpy.testing.assert_allclose(got[key][0],
+                                          summed[key][0] / N,
+                                          rtol=1e-6)
+
+    @pytest.mark.parametrize("precision", ["bf16", "int8"])
+    def test_compressed_tiers_replicate_identically(self, mesh,
+                                                    precision):
+        """Determinism is what keeps lockstep replicas in lockstep:
+        every device must hold the exact same reduced bytes."""
+        tree = _tree(numpy.random.RandomState(2))
+        got = _run_reduce(mesh, tree, precision)
+        for key in tree:
+            for row in range(N):
+                numpy.testing.assert_array_equal(got[key][row],
+                                                 got[key][0])
+
+    def test_bf16_tier_error_bounded(self, mesh):
+        tree = _tree(numpy.random.RandomState(3))
+        exact = {k: v.sum(0, dtype=numpy.float64) for k, v in
+                 tree.items()}
+        got = _run_reduce(mesh, tree, "bf16")
+        for key in tree:
+            numpy.testing.assert_allclose(got[key][0], exact[key],
+                                          rtol=0.05, atol=0.5)
+
+    def test_int8_tier_error_bound(self, mesh):
+        """Two bounded rounding stages: per-element error <=
+        n*scale1/2 (stage-1 quantization summed over n shards) +
+        scale2/2 (re-quantizing the reduced chunk)."""
+        tree = _tree(numpy.random.RandomState(4))
+        got = _run_reduce(mesh, tree, "int8")
+        for key, value in tree.items():
+            exact = value.sum(0, dtype=numpy.float64)
+            scale1 = numpy.abs(value).max() / 127.0
+            scale2 = numpy.abs(exact).max() / 127.0
+            bound = N * scale1 / 2 + scale2 / 2
+            err = numpy.abs(got[key][0].astype(numpy.float64)
+                            - exact).max()
+            assert err <= bound * 1.05, (key, err, bound)
+
+    def test_int_leaves_always_exact(self, mesh):
+        """Non-float leaves (error counts, confusion increments) take
+        the exact psum regardless of the requested tier."""
+        tree = {"n": numpy.arange(N, dtype=numpy.int32)
+                .reshape(N, 1) * 1000 + 7}
+        for precision in ("bf16", "int8"):
+            got = _run_reduce(mesh, tree, precision)
+            assert int(got["n"][0][0]) == sum(i * 1000 + 7
+                                              for i in range(N))
+
+    def test_broadcast_and_map_fn(self, mesh):
+        """broadcast is the replication identity; map_fn is the
+        shard_map seam — together a psum of a broadcast value times
+        the per-shard index sums the index range."""
+        value = jnp.float32(3.0)
+
+        def body(v):
+            shard = mr.broadcast(v) * lax.axis_index("data")
+            return mr.reduce_sum(shard, "data")[None]
+
+        fn = jax.jit(mr.map_fn(body, mesh, in_specs=(P(),),
+                               out_specs=P("data")))
+        out = numpy.asarray(fn(value))
+        assert out[0] == pytest.approx(3.0 * sum(range(N)))
+
+    def test_bad_precision_rejected(self, mesh):
+        with pytest.raises(ValueError, match="reduce precision"):
+            mr.reduce_sum({"x": jnp.zeros(4)}, "data", precision="fp4")
+        with pytest.raises(ValueError, match="fleet.reduce"):
+            mr.reduce_precision_of("fp4")
+        saved = root.common.fleet.get("reduce", None)
+        root.common.fleet.reduce = "bogus"
+        try:
+            with pytest.raises(ValueError, match="--fleet-reduce"):
+                mr.reduce_precision_of()
+        finally:
+            root.common.fleet.reduce = saved if saved is not None \
+                else "f32"
+
+
+class TestWireBytes:
+    def test_formulas(self):
+        tree = {"w": numpy.zeros((96, 32), numpy.float32),
+                "b": numpy.zeros(33, numpy.float32)}
+        elems = 96 * 32 + 33
+        assert mr.reduce_wire_bytes(tree, 8, "f32") \
+            == 2 * 7 * elems * 4
+        assert mr.reduce_wire_bytes(tree, 8, "bf16") \
+            == 2 * 7 * elems * 2
+        int8 = mr.reduce_wire_bytes(tree, 8, "int8")
+        # int8 payloads (padded to the axis) + 2 scalar pmaxes per leaf
+        padded = (96 * 32) + (33 + (-33) % 8)
+        assert int8 == 2 * 7 * padded + 2 * (2 * 2 * 7 * 4)
+        # ordering: the whole point of the tiers
+        assert mr.reduce_wire_bytes(tree, 8, "int8") \
+            < mr.reduce_wire_bytes(tree, 8, "bf16") \
+            < mr.reduce_wire_bytes(tree, 8, "f32")
+
+    def test_single_device_is_zero(self):
+        assert mr.reduce_wire_bytes({"x": numpy.zeros(10)}, 1) == 0
+
+    def test_int_leaf_never_compressed(self):
+        tree = {"n": numpy.zeros(16, numpy.int32)}
+        assert mr.reduce_wire_bytes(tree, 8, "int8") \
+            == mr.reduce_wire_bytes(tree, 8, "f32")
+
+
+def _dense_specs():
+    leaves = (("w", "weights", "_velocity_w", False, True),
+              ("b", "bias", "_velocity_b", True, False))
+    return [{"kind": "dense", "activation": "tanh", "leaves": leaves,
+             "has_params": True, "solver": "momentum"},
+            {"kind": "dense", "activation": "linear", "leaves": leaves,
+             "has_params": True, "solver": "momentum"}]
+
+
+def _dense_params(rng, in_f=64, hidden=32, classes=10):
+    params = []
+    fan = in_f
+    for width in (hidden, classes):
+        w = jnp.asarray(rng.randn(fan, width).astype(numpy.float32)
+                        * 0.05)
+        params.append({"p": {"w": w,
+                             "b": jnp.zeros(width, jnp.float32)},
+                       "v": {"w": jnp.zeros_like(w),
+                             "b": jnp.zeros(width, jnp.float32)}})
+        fan = width
+    return params
+
+
+def _step_args(rng, batch=128, in_f=64, classes=10):
+    hyper = jnp.asarray([0.05, 0.05, 0.0, 0.0, 0.9, 0.9, 0.999, 1e-8],
+                        jnp.float32)
+    data = jnp.asarray(rng.rand(batch, in_f).astype(numpy.float32))
+    labels = jnp.asarray(rng.randint(0, classes, batch))
+    indices = jnp.arange(batch, dtype=jnp.int64)
+    return ([hyper, hyper], {}, data, labels, indices,
+            numpy.float32(batch), numpy.int64(0))
+
+
+class TestFleetTrainStep:
+    def test_instrumented_and_metered(self, mesh):
+        """The compiled step books compiles + FLOPs under the
+        mapreduce program name, per-step wire bytes/cadence land in
+        ReduceStats, and the scrape path exposes the
+        veles_fleet_reduce_* families + the chip-idle gauge."""
+        from veles_tpu.observe.metrics import MetricsRegistry
+        from veles_tpu.observe.xla_stats import get_compile_tracker
+
+        tracker = get_compile_tracker()
+        was_enabled = tracker.enabled
+        tracker.enabled = True
+        # the tracker is process-global and CUMULATIVE: other suites
+        # (the fleet chaos family) book the same program names — the
+        # absolute compile/hit counts below need a clean slate
+        tracker.reset()
+        stats = mr.get_reduce_stats()
+        stats.reset()
+        rng = numpy.random.RandomState(0)
+        try:
+            steps = mr.fleet_train_step(mesh, _dense_specs(), "none",
+                                        with_confusion=False,
+                                        reduce_precision="f32")
+            train_step = steps[0]
+            assert train_step.program_name == \
+                "mapreduce.fleet_train_step"
+            # UNIQUE shapes (in_f=80): other tests share this wrapped
+            # program, and pytest-randomly can order them first — a
+            # fresh shape guarantees the compile (and its FLOPs) books
+            # into the just-reset tracker regardless of order
+            params = _dense_params(rng, in_f=80)
+            args = _step_args(rng, in_f=80)
+            for _ in range(3):
+                params, metrics = train_step(params, *args)
+                jax.block_until_ready(metrics)
+            snap = tracker.snapshot()
+            # two compiles, not three: the first call places
+            # uncommitted host params, the second sees the donated
+            # mesh-sharded outputs (steady state), the third HITS —
+            # i.e. no per-step recompile storm
+            assert snap["compiles"].get(
+                "mapreduce.fleet_train_step") <= 2
+            assert snap["hits"].get("mapreduce.fleet_train_step", 0) \
+                >= 1
+            # cost analysis produced program FLOPs for the SPMD tick
+            assert snap["flops"].get("mapreduce.fleet_train_step", 0) \
+                > 0
+            reduce_snap = stats.snapshot()
+            assert reduce_snap["f32"]["steps"] == 3
+            grads = [entry["p"] for entry in params]
+            expected = mr.reduce_wire_bytes(grads, N, "f32")
+            assert reduce_snap["f32"]["bytes"] == 3 * expected
+            assert stats.idle_fraction() is not None
+            registry = MetricsRegistry(enabled=True)
+            mr.publish_reduce_stats(registry)
+            text = registry.expose()
+            assert "veles_fleet_reduce_steps_total" in text
+            assert "veles_fleet_reduce_bytes_total" in text
+            assert "veles_fleet_chip_idle_fraction" in text
+        finally:
+            tracker.enabled = was_enabled
+            stats.reset()
+
+    def test_idle_fraction_tracks_host_gaps(self, mesh):
+        """The chip-idle gauge must read LOW for a chip-bound loop and
+        HIGH when the host dawdles between steps — i.e. busy is the
+        synced step wall, not the async dispatch microseconds (which
+        would book every run as ~100% idle)."""
+        from veles_tpu.observe.xla_stats import get_compile_tracker
+
+        tracker = get_compile_tracker()
+        was_enabled = tracker.enabled
+        tracker.enabled = True
+        stats = mr.get_reduce_stats()
+        rng = numpy.random.RandomState(5)
+        try:
+            train_step = mr.fleet_train_step(
+                mesh, _dense_specs(), "none", with_confusion=False,
+                reduce_precision="f32")[0]
+            params = _dense_params(rng)
+            args = _step_args(rng)
+            params, _ = train_step(params, *args)  # compile + place
+            params, _ = train_step(params, *args)
+
+            stats.reset()
+            for _ in range(5):
+                params, _ = train_step(params, *args)
+            tight = stats.idle_fraction()
+            # generous absolute bound (a loaded CI box stretches the
+            # python loop between steps); the RELATIVE ordering below
+            # is the discriminating assertion
+            assert tight is not None and tight < 0.75, tight
+
+            stats.reset()
+            for _ in range(4):
+                params, _ = train_step(params, *args)
+                time.sleep(0.15)  # a dawdling host protocol
+            gappy = stats.idle_fraction()
+            assert gappy is not None, gappy
+            assert gappy > tight + 0.1, (tight, gappy)
+            assert gappy > 0.5, gappy
+        finally:
+            tracker.enabled = was_enabled
+            stats.reset()
+
+    def test_f32_step_bit_identical_to_raw_tick(self, mesh):
+        """fleet_train_step is the SAME compiled program as
+        build_tick(mesh=...) at the default tier — instrumentation
+        must not perturb a single bit."""
+        from veles_tpu.parallel import fused
+
+        rng = numpy.random.RandomState(1)
+        params_a = _dense_params(rng)
+        params_b = jax.tree.map(jnp.copy, params_a)
+        args = _step_args(numpy.random.RandomState(2))
+        wrapped = mr.fleet_train_step(mesh, _dense_specs(), "none",
+                                      with_confusion=False,
+                                      reduce_precision="f32")[0]
+        raw = fused.build_tick(_dense_specs(), "none", mesh=mesh,
+                               with_confusion=False,
+                               grad_reduce="f32")[0]
+        out_a, m_a = wrapped(params_a, *args)
+        out_b, m_b = raw(params_b, *args)
+        for layer_a, layer_b in zip(out_a, out_b):
+            for leaf in layer_a["p"]:
+                numpy.testing.assert_array_equal(
+                    numpy.asarray(layer_a["p"][leaf]),
+                    numpy.asarray(layer_b["p"][leaf]))
+        assert float(m_a[0]) == float(m_b[0])
+
+    def test_in_program_reduce_beats_host_roundtrip(self, mesh):
+        """The acceptance bar in miniature: one in-program all-reduce
+        of a gradient-sized tree must beat the data-plane host path
+        (device->frame encode->decode->device->merge) on the same
+        tree."""
+        from veles_tpu.fleet.protocol import (decode_frame_bytes,
+                                              encode_frame)
+
+        rng = numpy.random.RandomState(3)
+        tree = {"w1": rng.randn(N, 784, 256).astype(numpy.float32),
+                "b1": rng.randn(N, 256).astype(numpy.float32)}
+        sharded = jax.device_put(tree, NamedSharding(mesh, P("data")))
+
+        def body(t):
+            local = jax.tree.map(lambda x: x[0], t)
+            return mr.reduce_sum(local, "data")
+
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                               out_specs=P()))
+        jax.block_until_ready(fn(sharded))
+        in_program = min(_timed(lambda: jax.block_until_ready(
+            fn(sharded))) for _ in range(7))
+
+        replica = jax.device_put(jax.tree.map(lambda x: x[0], tree))
+        master = jax.device_put(jax.tree.map(lambda x: x[0], tree))
+
+        def host_path():
+            host = jax.device_get(replica)
+            frame = encode_frame({"update": host}, b"k")
+            update = decode_frame_bytes(frame, b"k")["update"]
+            merged = jax.tree.map(
+                lambda cur, new: (cur + jnp.asarray(new)) * 0.5,
+                master, update)
+            jax.block_until_ready(merged)
+
+        host_path()
+        host = min(_timed(host_path) for _ in range(7))
+        assert in_program < host, (
+            "in-program reduce %.1fms not faster than host "
+            "aggregation %.1fms" % (in_program * 1e3, host * 1e3))
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+class TestInt8ConvergenceParity:
+    def test_int8_training_tracks_bf16(self):
+        """The quantized-reduce tier's pinned convergence-parity bar
+        (docs/compiler_fleet.md): the SAME pod-mode training run under
+        int8 gradient reduce must track the bf16 tier's loss curve
+        within tolerance and reach the same best-error
+        neighborhood."""
+        from veles_tpu.core import prng
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.loader.base import VALID
+        from veles_tpu.models.mlp import MLPWorkflow
+
+        def run(tier):
+            saved = root.common.fleet.get("reduce", "f32")
+            root.common.fleet.reduce = tier
+            try:
+                prng.get("default").seed(42)
+                prng.get("loader").seed(43)
+                rng = numpy.random.RandomState(0)
+                data = rng.rand(320, 8).astype(numpy.float32)
+                labels = (data[:, 0] > 0.5).astype(numpy.int32)
+                launcher = Launcher()
+                wf = MLPWorkflow(
+                    launcher, layers=(8, 2), name="int8-parity",
+                    loader_kwargs=dict(
+                        data=data, labels=labels,
+                        class_lengths=[0, 64, 256],
+                        minibatch_size=64,
+                        normalization_type="linear"),
+                    learning_rate=0.3, max_epochs=3,
+                    mesh=build_mesh(devices=jax.devices()[:N],
+                                    data=N))
+                launcher.initialize()
+                launcher.run()
+                best = wf.decision.best_n_err[VALID]
+                loss = float(wf.decision.last_epoch_loss[VALID])
+                weights = [numpy.asarray(gd.weights.mem).copy()
+                           for gd in wf.gds]
+                launcher.stop()
+                return best, loss, weights
+            finally:
+                root.common.fleet.reduce = saved
+
+        bf16_best, bf16_loss, bf16_w = run("bf16")
+        int8_best, int8_loss, int8_w = run("int8")
+        # pinned parity bars: the compressed run converges to the same
+        # neighborhood (loss within 15% rel, best-error within 3
+        # samples of 64), weights stay close
+        assert abs(int8_loss - bf16_loss) <= 0.15 * abs(bf16_loss), \
+            (int8_loss, bf16_loss)
+        assert abs(int8_best - bf16_best) <= 3, (int8_best, bf16_best)
+        for got, ref in zip(int8_w, bf16_w):
+            numpy.testing.assert_allclose(got, ref, atol=0.08)

@@ -162,6 +162,30 @@ class TestCompare:
                    and f["key"] == "extra_tokens_per_sec"
                    for f in findings)
 
+    def test_fleet_mapreduce_key_directions(self):
+        """The fleet section's keys (bench.py fleet_section /
+        docs/compiler_fleet.md) compare with the right better-
+        directions: reduce/baseline/step times and wire bytes regress
+        UP, MFU and the in-program speedup regress DOWN."""
+        old = {"fleet_reduce_ms": 10.0, "fleet_reduce_bytes": 1000,
+               "fleet_reduce_int8_bytes": 250,
+               "fleet_host_baseline_ms": 100.0,
+               "fleet_step_ms": 50.0, "fleet_step_mfu": 0.5,
+               "fleet_inprogram_speedup": 10.0}
+        worse = {"fleet_reduce_ms": 20.0, "fleet_reduce_bytes": 2000,
+                 "fleet_reduce_int8_bytes": 500,
+                 "fleet_host_baseline_ms": 200.0,
+                 "fleet_step_ms": 100.0, "fleet_step_mfu": 0.25,
+                 "fleet_inprogram_speedup": 5.0}
+        bad = {f["key"] for f in regressions(compare(old, worse))}
+        assert bad == set(old)
+        better = {"fleet_reduce_ms": 5.0, "fleet_reduce_bytes": 500,
+                  "fleet_reduce_int8_bytes": 100,
+                  "fleet_host_baseline_ms": 100.0,
+                  "fleet_step_ms": 25.0, "fleet_step_mfu": 0.9,
+                  "fleet_inprogram_speedup": 20.0}
+        assert regressions(compare(old, better)) == []
+
     def test_type_change_is_a_regression(self):
         new = dict(self.OLD, decode_step_ms="fast")
         assert regressions(compare(self.OLD, new))[0]["verdict"] \

@@ -114,6 +114,28 @@ class Main(Logger):
                                  "hosts; slave: ship the relaunch recipe")
         parser.add_argument("--slave-death-probability", type=float,
                             default=0.0, help="fault injection")
+        parser.add_argument("--fleet-plane", default=None,
+                            choices=("data", "control"),
+                            help="fleet wire plane (set IDENTICALLY on "
+                                 "master and slaves): 'data' ships "
+                                 "weights in every job/update frame "
+                                 "(reference protocol); 'control' "
+                                 "ships batch assignments + scalar "
+                                 "metrics only — the gradient merge "
+                                 "runs in-program on the slave's mesh "
+                                 "(parallel/mapreduce.py) and weights "
+                                 "cross the wire only at handshake and "
+                                 "epoch fences (docs/compiler_fleet"
+                                 ".md)")
+        parser.add_argument("--fleet-reduce", default=None,
+                            choices=("f32", "bf16", "int8"),
+                            help="in-program gradient all-reduce wire "
+                                 "tier for meshed ticks: f32 (exact, "
+                                 "default), bf16 (half the bytes), or "
+                                 "int8 (quantized all-reduce with "
+                                 "per-leaf scales, ~4x fewer bytes — "
+                                 "see docs/compiler_fleet.md for the "
+                                 "convergence caveats)")
         chaos = parser.add_argument_group(
             "chaos harness", "slave-side deterministic fault injection "
             "(fleet/chaos.py; probabilities in [0,1], one seeded RNG "
@@ -487,6 +509,8 @@ class Main(Logger):
                 setattr(root.common.fleet.chaos, key, value)
         # serving survival flags, same layering rule
         for flag, node, key in (
+                ("fleet_plane", root.common.fleet, "plane"),
+                ("fleet_reduce", root.common.fleet, "reduce"),
                 ("serve_max_queue", root.common.serve, "max_queue"),
                 ("serve_deadline", root.common.serve, "deadline"),
                 ("serve_mesh", root.common.serve, "mesh"),

@@ -74,5 +74,20 @@ class Distributable(Pickleable):
     def drop_slave(self, slave=None):
         pass
 
+    # -- control-plane fleet extensions (docs/compiler_fleet.md) -------------
+    # Optional hooks with safe defaults: the handshake payload (shipped
+    # ONCE at connect — in control-plane mode the per-job wire omits
+    # weights, so initial state must travel here) and the epoch-fence
+    # bulk sync (slave -> master weight checkpoint, applied by
+    # overwrite — the slave replica is canonical between fences).
+    def generate_handshake_data(self, slave=None):
+        return self.generate_data_for_slave(slave)
+
+    def generate_sync_for_master(self):
+        return None
+
+    def apply_sync_from_slave(self, data, slave=None):
+        pass
+
 
 TriviallyDistributable = Distributable

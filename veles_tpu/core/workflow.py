@@ -357,7 +357,11 @@ class Workflow(Container):
             unit.drop_slave(slave)
 
     def generate_initial_data_for_slave(self, slave=None):
-        return [u.generate_data_for_slave(slave)
+        # the handshake hook defaults to generate_data_for_slave, so
+        # pre-existing negotiating units are unchanged; control-plane
+        # units (GradientDescent) override it to ship their FULL state
+        # once while the per-job payload omits weights
+        return [u.generate_handshake_data(slave)
                 for u in self._units if u.negotiates_on_connect]
 
     def apply_initial_data_from_master(self, data):
@@ -365,6 +369,50 @@ class Workflow(Container):
         for unit, payload in zip(targets, data):
             if payload is not None:
                 unit.apply_data_from_master(payload)
+
+    # -- control-plane fleet (docs/compiler_fleet.md) -------------------------
+    def take_fence_sync(self):
+        """Slave side, control-plane mode: after a job that ended an
+        epoch, collect the bulk weight-sync payload (per-unit
+        ``generate_sync_for_master``) the client ships in a ``sync``
+        frame. ``None`` between fences (or when no unit carries
+        distributable weights)."""
+        loader = getattr(self, "loader", None)
+        if loader is None or not bool(getattr(loader, "epoch_ended",
+                                              False)):
+            return None
+        payload = [u.generate_sync_for_master()
+                   for u in self.distribution_order()]
+        return payload if any(p is not None for p in payload) else None
+
+    def apply_sync_from_slave(self, data, slave=None):
+        """Master side: apply an epoch-fence weight sync. Always an
+        OVERWRITE (the slave replica is canonical between fences —
+        unlike per-job updates there is nothing meaningful to merge)."""
+        order = self.distribution_order()
+        if len(data) != len(order):
+            raise VelesError(
+                "Sync payload has %d entries for %d units — "
+                "master/slave workflow mismatch" % (len(data),
+                                                    len(order)))
+        for unit, payload in zip(order, data):
+            if payload is not None:
+                unit.lock_data()
+                try:
+                    unit.apply_sync_from_slave(payload, slave)
+                finally:
+                    unit.unlock_data()
+        return True
+
+    def rollback_job(self):
+        """Slave side, control-plane mode: undo the LAST job's local
+        application (the master re-issued work whose update never
+        arrived). Delegates to the fused tick's one-slot rollback;
+        returns True when state was actually restored."""
+        tick = getattr(self, "fused_tick", None)
+        if tick is not None and hasattr(tick, "rollback_job"):
+            return bool(tick.rollback_job())
+        return False
 
     def do_job(self, data, callback):
         """Slave side: apply the job, run the whole graph locally, then call

@@ -23,9 +23,40 @@ with the master's per-start ``epoch`` UUID fencing across restarts. The
 deterministic chaos harness (``chaos.py``) injects frame delay/drop,
 stragglers, duplicate replay and mid-job death from one seeded RNG
 stream so recovery is testable bit-for-bit.
+
+Wire planes (``root.common.fleet.plane``, docs/compiler_fleet.md):
+
+- ``data`` (default) — the reference protocol: jobs carry master
+  weights, updates carry trained weights, the master merges host-side.
+  Per-minibatch durability; the chip idles through every reduce.
+- ``control`` — the compiler-visible refit: jobs carry batch
+  *assignments* + epoch fences (plus learning rates), updates carry
+  scalar metrics, and the parameter math lives entirely in XLA
+  collectives on the slave's mesh (``parallel/mapreduce.py``). Weights
+  cross the wire only in the handshake (initial state) and at epoch
+  fences (the ``sync`` frame). The ledger/lease/fencing/chaos/respawn
+  machinery is identical in both planes.
 """
 
-from veles_tpu.fleet.server import Server  # noqa: F401
-from veles_tpu.fleet.client import Client  # noqa: F401
-from veles_tpu.fleet.ledger import JobLedger  # noqa: F401
-from veles_tpu.fleet.chaos import ChaosConfig, ChaosMonkey  # noqa: F401
+
+def fleet_control_plane():
+    """True when the fleet runs the control-plane-only wire protocol
+    (``root.common.fleet.plane = "control"``). Validates the knob."""
+    from veles_tpu.core.config import root
+    plane = root.common.fleet.get("plane", "data")
+    if plane not in ("data", "control"):
+        raise ValueError(
+            "root.common.fleet.plane / --fleet-plane must be 'data' or "
+            "'control', got %r" % (plane,))
+    return plane == "control"
+
+
+def fleet_plane():
+    """The configured plane name ("data"/"control"), validated."""
+    return "control" if fleet_control_plane() else "data"
+
+
+from veles_tpu.fleet.server import Server  # noqa: F401,E402
+from veles_tpu.fleet.client import Client  # noqa: F401,E402
+from veles_tpu.fleet.ledger import JobLedger  # noqa: F401,E402
+from veles_tpu.fleet.chaos import ChaosConfig, ChaosMonkey  # noqa: F401,E402

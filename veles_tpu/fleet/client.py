@@ -25,6 +25,21 @@ Robustness additions over the reference:
 - the single ``death_probability`` hook generalizes to the seeded
   deterministic chaos harness (``fleet/chaos.py``) wrapping the
   post-handshake frame traffic and the job loop.
+
+Control-plane mode (``root.common.fleet.plane = "control"``,
+``docs/compiler_fleet.md``): update frames carry ``results`` (scalar
+metrics) plus a local ``tick`` counter instead of weight payloads —
+the gradient math lives in XLA collectives on this slave's mesh. The
+client keeps exactly-once application without weights on the wire via
+the *rollback protocol*: every job frame echoes the master's highest
+ACCEPTED tick; a local tick ahead of it means our last application was
+never accepted (lost update), so the workflow rolls back its one-slot
+params stash before re-applying (sync-mode pipelining bounds the gap
+to one job — control-plane mode therefore forces ``async_mode`` off).
+Weights cross the wire only at epoch fences (``sync`` frames, resent
+until acked) and in the handshake's initial payload — which a
+REJOINING client (same master epoch, local ticks applied) skips, since
+its device-resident replica is ahead of the master's fence copy.
 """
 
 import asyncio
@@ -50,7 +65,8 @@ class Client(Logger):
 
     def __init__(self, address, workflow, power=1.0, async_mode=False,
                  death_probability=0.0, max_reconnect_attempts=7,
-                 secret=None, enable_respawn=False, chaos=None):
+                 secret=None, enable_respawn=False, chaos=None,
+                 plane=None):
         super().__init__(logger_name="fleet.Client")
         self.enable_respawn = enable_respawn
         host, _, port = address.rpartition(":")
@@ -59,7 +75,29 @@ class Client(Logger):
         self.workflow = workflow
         self._secret = resolve_secret(workflow, secret)
         self.power = power
+        if plane is None:
+            from veles_tpu.fleet import fleet_plane
+            plane = fleet_plane()
+        self.plane = plane
+        self.control_plane = plane == "control"
+        if self.control_plane and async_mode:
+            # the one-slot rollback covers exactly ONE in-flight job;
+            # pipelined requests would raise the unacknowledged depth
+            # past what the stash can replay
+            self.warning("control-plane fleet mode is sync-only: "
+                         "disabling --async-slave pipelining")
+            async_mode = False
         self.async_mode = async_mode
+        #: control-plane accounting: locally-applied job count (ships
+        #: as ``tick`` in updates; reset when the master epoch changes)
+        self._applied_ticks_ = 0
+        #: pending epoch-fence weight sync, resent until acked
+        self._pending_sync_ = None
+        #: rollback-protocol events (re-issued work realigned against
+        #: the master's acked tick; the chaos tests assert on this)
+        self.rollbacks = 0
+        #: the master's handshake-refusal reason, if any (testability)
+        self.refusal = None
         self.death_probability = death_probability
         self.max_reconnect_attempts = max_reconnect_attempts
         if chaos is None:
@@ -191,6 +229,9 @@ class Client(Logger):
             # plane only when uid and shm directory match too — a
             # same-machine different-user peer cannot read 0o600 segments
             "uid": sharedio.owner_uid(), "shm_dir": sharedio.shm_dir(),
+            # wire-plane agreement is checked at the handshake: a mixed
+            # data/control fleet must fail loudly, not stall
+            "plane": self.plane,
             "checksum": getattr(self.workflow, "checksum", None)}
         if self.enable_respawn:
             # relaunch recipe for the master's --respawn (reference
@@ -200,11 +241,21 @@ class Client(Logger):
         await write_frame(writer, hello, self._secret)
         welcome = await read_frame(reader, self._secret)
         if welcome.get("type") == "error":
-            self.error("master refused: %s", welcome.get("error"))
+            self.refusal = welcome.get("error")
+            self.error("master refused: %s", self.refusal)
             return True
         self._handshaked_ = True
         self.sid = welcome["id"]
         epoch = welcome.get("epoch")
+        # control plane: a rejoin under the SAME master epoch with
+        # local applications on record means our device-resident
+        # replica is AHEAD of the master's last fence copy — the
+        # handshake's initial weights must not clobber it (the
+        # rollback protocol realigns any lost tick instead)
+        rejoining = (self.control_plane
+                     and self.master_epoch is not None
+                     and epoch == self.master_epoch
+                     and self._applied_ticks_ > 0)
         if self.master_epoch is not None and epoch != self.master_epoch:
             # a NEW epoch means the master restarted (not a network
             # blip): this handshake is a clean re-join — restore the
@@ -213,18 +264,33 @@ class Client(Logger):
                       "restarted, re-handshaking cleanly",
                       self.master_epoch, epoch)
             self._attempts = 0
+            # the successor's accounting starts fresh: its ledger and
+            # acked-tick table know nothing of our prior applications,
+            # and its initial payload (applied below) re-seeds state
+            self._applied_ticks_ = 0
+            self._pending_sync_ = None
         self.master_epoch = epoch
         # master confirmed the same-host shared-memory data plane
         from veles_tpu.fleet.protocol import COMPRESS_THRESHOLD
         self._shm_thr_ = (COMPRESS_THRESHOLD if welcome.get("shm")
                           else None)
         initial = welcome.get("initial")
-        if initial:
+        if initial and not rejoining:
             self.workflow.apply_initial_data_from_master(initial)
+        elif initial and rejoining:
+            self.info("rejoining the same master epoch with %d local "
+                      "tick(s) applied: keeping the device-resident "
+                      "replica (handshake weights skipped)",
+                      self._applied_ticks_)
         self.info("connected as %s", self.sid)
         # the handshake above never routes through chaos — a fault must
         # not masquerade as an authentication failure; everything below
         # does (self._read/self._write)
+        if self.control_plane:
+            # an epoch-fence sync the previous connection never got
+            # acked goes out FIRST, before any new job can advance the
+            # master's accepted-job record past its fence
+            await self._flush_sync(writer)
         await self._write(writer, {"type": "job_request"})
         pause_streak = 0
         while not self._stopped.is_set():
@@ -243,9 +309,19 @@ class Client(Logger):
                     await self._write(writer, {"type": "job_request"})
                     continue
                 if msg.get("job") is None:
+                    if self.control_plane \
+                            and self._pending_sync_ is not None:
+                        # belt and braces: never exit with an unacked
+                        # fence sync — fire it once more (idempotent
+                        # overwrite on the master) so the final
+                        # weights cannot stay an epoch stale behind a
+                        # lost ack
+                        await self._flush_sync(writer)
                     self.info("no more jobs; exiting")
                     return True
                 job_id = msg.get("job_id")
+                if self.control_plane:
+                    self._maybe_rollback(msg)
                 # the master's fleet.issue context rides the job frame;
                 # our do_job span parents to it and our update echoes
                 # OUR context so the master's fleet.apply chains on —
@@ -256,6 +332,12 @@ class Client(Logger):
                     job_id=job_id, sid=self.sid)
                 with job_span:
                     update = await self._do_job(msg["job"])
+                if self.control_plane:
+                    # booked the moment the local application exists —
+                    # a death between here and the update write leaves
+                    # tick > acked, which is exactly what arms the
+                    # rollback on the re-issued job
+                    self._applied_ticks_ += 1
                 if self.chaos is not None:
                     self.chaos.maybe_die(writer)
                 if self.death_probability > 0 \
@@ -264,9 +346,17 @@ class Client(Logger):
                     os._exit(1)
                 shm_thr = getattr(self, "_shm_thr_", None)
                 # echo the lease + master epoch: the ledger fences
-                # duplicates, requeued leases and stale-epoch answers
-                frame = {"type": "update", "update": update,
+                # duplicates, requeued leases and stale-epoch answers.
+                # Control plane: scalar results + the local tick — the
+                # weight payload is omitted ENTIRELY (the master
+                # rejects frames that carry one)
+                frame = {"type": "update",
                          "job_id": job_id, "epoch": self.master_epoch}
+                if self.control_plane:
+                    frame["results"] = update
+                    frame["tick"] = self._applied_ticks_
+                else:
+                    frame["update"] = update
                 if job_span.context() is not None:
                     frame["trace"] = list(job_span.context())
                 registry = get_metrics_registry()
@@ -296,6 +386,18 @@ class Client(Logger):
                         for name, kind, labels, value
                         in registry.snapshot()]
                 await self._write(writer, frame, shm_threshold=shm_thr)
+                if self.control_plane:
+                    # epoch fence? the workflow hands over the bulk
+                    # weight sync exactly once per fence; it is resent
+                    # on every (re)connection until the master acks it
+                    take = getattr(self.workflow, "take_fence_sync",
+                                   None)
+                    payload = take() if callable(take) else None
+                    if payload is not None:
+                        self._pending_sync_ = {
+                            "job_id": job_id, "sync": payload,
+                            "tick": self._applied_ticks_}
+                    await self._flush_sync(writer)
                 if self.async_mode:
                     # pipelined: next request goes out with the update
                     await self._write(writer, {"type": "job_request"})
@@ -308,7 +410,59 @@ class Client(Logger):
                                  msg["fenced"])
                 elif not self.async_mode:
                     await self._write(writer, {"type": "job_request"})
+            elif mtype == "sync_ack":
+                if msg.get("fenced"):
+                    # the master refused the fence payload (stale epoch
+                    # / unaccepted job): a later fence supersedes it —
+                    # retrying a refused sync would replay the refusal
+                    self.warning("master fenced our sync: %s",
+                                 msg["fenced"])
+                self._pending_sync_ = None
         return False
+
+    def _maybe_rollback(self, msg):
+        """Control-plane rollback protocol: the job frame echoes the
+        master's highest ACCEPTED local tick; if we applied more than
+        that, our last application's update was lost (death/drop after
+        the local math ran) and the incoming job re-issues that work —
+        roll the one-slot stash back so the replay is bit-identical."""
+        acked = msg.get("acked")
+        if not isinstance(acked, int) or isinstance(acked, bool) \
+                or self._applied_ticks_ <= acked:
+            return
+        behind = self._applied_ticks_ - acked
+        if behind > 1:
+            # cannot happen in sync mode (one job in flight); if it
+            # ever does, continuing silently would double-apply work
+            self.error(
+                "%d unacknowledged local applications but only a "
+                "one-slot rollback — local state may have diverged; "
+                "re-handshake with a fresh master to re-seed", behind)
+            return
+        rollback = getattr(self.workflow, "rollback_job", None)
+        rolled = bool(rollback()) if callable(rollback) else False
+        self.rollbacks += 1
+        self._applied_ticks_ = acked
+        self.warning(
+            "master re-issued unacknowledged work (local tick %d -> "
+            "acked %d): %s", acked + 1, acked,
+            "rolled params back one job" if rolled
+            else "eval tick, nothing to restore")
+
+    async def _flush_sync(self, writer):
+        """Ship the pending epoch-fence weight sync (if any). Kept
+        pending until the master's ``sync_ack`` arrives, so a
+        connection lost mid-sync resends it on the next handshake —
+        the master's final weights cannot silently stay one epoch
+        stale because a fence frame hit a chaos drop."""
+        if self._pending_sync_ is None:
+            return
+        frame = dict(self._pending_sync_)
+        frame["type"] = "sync"
+        frame["epoch"] = self.master_epoch
+        await self._write(writer, frame,
+                          shm_threshold=getattr(self, "_shm_thr_",
+                                                None))
 
     async def _read(self, reader):
         if self.chaos is not None:

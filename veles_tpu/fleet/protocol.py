@@ -18,11 +18,24 @@ Message schema (master <-> slave, after the hello/welcome handshake):
   ``epoch`` (the master's per-start fencing UUID), ``initial``;
 - ``job``: ``job`` (payload list, ``None`` = no more jobs), ``job_id``
   (monotonic lease id, see ``fleet/ledger.py``), ``epoch``, ``paused``;
-- ``update``: ``update`` (payload list), ``job_id`` + ``epoch`` echoed
-  from the job (the master fences mismatches instead of applying them),
-  optional ``chaos`` (fault-injection tallies, ``fleet/chaos.py``);
+- ``update``: ``job_id`` + ``epoch`` echoed from the job (the master
+  fences mismatches instead of applying them), optional ``chaos``
+  (fault-injection tallies, ``fleet/chaos.py``). Payload by wire
+  plane (``root.common.fleet.plane``, docs/compiler_fleet.md):
+  ``update`` (the data-plane per-unit payload list, weights included)
+  or — control plane — ``results`` (scalar metrics list) + ``tick``
+  (the slave's local applied-job counter; a control-plane master
+  REJECTS frames carrying an ``update`` key);
 - ``update_ack``: optional ``fenced`` (the rejection verdict — the
   slave must not answer a fenced ack with another job_request);
+- ``sync`` (control plane only): ``sync`` (per-unit epoch-fence weight
+  payload), ``job_id`` (the accepted fence job it chases), ``epoch``,
+  ``tick`` — the only post-handshake frames that carry weights;
+  answered by ``sync_ack`` (optional ``fenced``);
+- ``job`` additionally carries ``acked`` in control-plane mode (the
+  master's highest accepted slave tick — the rollback protocol);
+- ``hello`` carries ``plane``; the master fails the handshake on a
+  mismatch;
 - ``job_request`` / ``power`` / ``bye``: as in the reference.
 
 Security: EVERY frame — including the pre-handshake hello — is
@@ -215,6 +228,30 @@ async def read_frame(reader, key, max_frame=MAX_FRAME):
 async def write_frame(writer, message, key, shm_threshold=None):
     writer.write(encode_frame(message, key, shm_threshold))
     await writer.drain()
+
+
+def decode_frame_bytes(data, key, max_frame=MAX_FRAME):
+    """Synchronous decode of ONE encoded frame (the buffer twin of
+    :func:`read_frame`, same MAC/codec/bounds rules) — for benches and
+    tests that hold the whole frame in memory instead of a stream."""
+    if len(data) < _HEADER.size + _MAC_SIZE:
+        raise ProtocolError("truncated frame")
+    length, codec = _HEADER.unpack(data[:_HEADER.size])
+    if length > max_frame:
+        raise ProtocolError("frame length %d exceeds limit %d"
+                            % (length, max_frame))
+    mac = data[_HEADER.size:_HEADER.size + _MAC_SIZE]
+    payload = data[_HEADER.size + _MAC_SIZE:]
+    if len(payload) != length:
+        raise ProtocolError("frame length mismatch")
+    if not hmac_lib.compare_digest(mac, _mac(key, codec, payload)):
+        raise ProtocolError("frame failed HMAC authentication")
+    if codec not in (0, 1, 2, 3):
+        raise ProtocolError("unknown frame codec %d" % codec)
+    if codec in (1, 3):
+        payload = _bounded_gunzip(payload, max_frame)
+        codec -= 1
+    return _deserialize(payload, codec)
 
 
 def machine_id():

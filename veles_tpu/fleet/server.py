@@ -93,8 +93,43 @@ class Server(Logger):
     METRICS_MAX_VALUE_LEN = 256
 
     def __init__(self, address, workflow, job_timeout=120.0, secret=None,
-                 respawn=False, spawner=None, metrics_port=None):
+                 respawn=False, spawner=None, metrics_port=None,
+                 plane=None):
         super().__init__(logger_name="fleet.Server")
+        #: wire plane (docs/compiler_fleet.md): "data" (reference
+        #: protocol, weights ride every frame) or "control" (batch
+        #: assignments + scalar metrics only; weights cross the wire in
+        #: the handshake and at epoch-fence ``sync`` frames while the
+        #: gradient math lives in XLA collectives on the slave). Both
+        #: sides must agree — the handshake rejects mismatches.
+        if plane is None:
+            from veles_tpu.fleet import fleet_plane
+            plane = fleet_plane()
+        self.plane = plane
+        self.control_plane = plane == "control"
+        #: control-plane rollback protocol: last ACCEPTED local-tick
+        #: counter and last accepted job per client PROCESS (mid, pid)
+        #: — keyed by process, not sid, so the accounting survives
+        #: reconnects (a re-joined slave gets a fresh sid)
+        self._acked_ticks = {}
+        self._accepted_jobs = {}
+        #: epoch-fence weight-sync accounting (control plane)
+        self._sync_counters = {"applied": 0, "fenced": 0}
+        #: accepted results since the last applied fence sync — a
+        #: FRESH process joining with this > 0 means mid-epoch
+        #: progress lived only on a dead replica (control-plane
+        #: process-loss recovery is epoch-granularity; the join warns)
+        self._jobs_since_sync = 0
+        #: latest in-program-reduce stats per client process (mid, pid)
+        #: mined from the piggybacked metric rows — persisted like
+        #: _chaos_reports so fleet_status() can still report the reduce
+        #: plane after a slave disconnects (the dashboard's proof the
+        #: math stayed on the chip)
+        self._reduce_reports = {}
+        #: frames carrying a data-plane ``update`` payload on a
+        #: control-plane wire, rejected (never applied) — see
+        #: :meth:`_apply_update`
+        self._payload_rejects = 0
         host, _, port = address.rpartition(":")
         # loopback by default: an exposed master means remote code
         # execution for anyone with the secret — opt in explicitly
@@ -318,6 +353,23 @@ class Server(Logger):
                     "error": "workflow checksum mismatch"}, self._secret)
                 self.warning("rejected slave with wrong workflow checksum")
                 return
+            # both sides must run the SAME wire plane: a data-plane
+            # slave joining a control-plane master would ship weight
+            # payloads the master rejects (and vice versa would starve
+            # the master of weights entirely) — fail the handshake with
+            # a message naming the knob instead of stalling later
+            peer_plane = hello.get("plane", "data")
+            if peer_plane != self.plane:
+                await write_frame(writer, {
+                    "type": "error",
+                    "error": "fleet plane mismatch (master=%s, slave="
+                             "%s): set root.common.fleet.plane / "
+                             "--fleet-plane identically on every host"
+                             % (self.plane, peer_plane)}, self._secret)
+                self.warning("rejected slave with mismatched fleet "
+                             "plane %r (ours: %r)", peer_plane,
+                             self.plane)
+                return
             self._next_id += 1
             sid = "slave-%d" % self._next_id
             slave = SlaveDescription(sid, hello)
@@ -345,6 +397,24 @@ class Server(Logger):
                               shm_threshold=slave.shm_threshold)
             self.info("slave %s connected (mid=%s power=%.1f)", sid,
                       slave.mid, slave.power)
+            if self.control_plane and self._jobs_since_sync > 0 \
+                    and (slave.mid, slave.pid) not in self._acked_ticks:
+                # a FRESH process (not a reconnect of a live replica)
+                # joined while settled mid-epoch work exists only on a
+                # dead replica: it starts from the last epoch fence —
+                # control-plane process-loss recovery is
+                # epoch-granularity by design (docs/compiler_fleet.md
+                # decision table); say so LOUDLY instead of silently
+                # dropping those applications from the trajectory
+                self.warning(
+                    "control-plane slave %s is a fresh process but %d "
+                    "accepted job(s) since the last epoch-fence sync "
+                    "lived on a departed replica — it resumes from "
+                    "the fence weights; that mid-epoch progress is "
+                    "lost to the weight trajectory (use the data "
+                    "plane if per-minibatch durability across process "
+                    "deaths matters — docs/compiler_fleet.md)",
+                    sid, self._jobs_since_sync)
             while not self._stopped.is_set():
                 msg = await read_frame(reader, self._secret)
                 mtype = msg.get("type")
@@ -352,6 +422,8 @@ class Server(Logger):
                     await self._serve_job(slave, writer)
                 elif mtype == "update":
                     await self._apply_update(slave, writer, msg)
+                elif mtype == "sync":
+                    await self._apply_sync(slave, writer, msg)
                 elif mtype == "power":
                     try:
                         slave.power = float(msg.get("power"))
@@ -397,6 +469,15 @@ class Server(Logger):
         job_id = self.ledger.issue(slave.id, timeout)
         frame = {"type": "job", "job": job, "job_id": job_id,
                  "epoch": self.epoch}
+        if self.control_plane:
+            # rollback protocol: the highest local tick we ACCEPTED
+            # from this process. A slave holding a higher local tick
+            # knows its last application was never accepted (lost
+            # update) and must roll it back before applying this job —
+            # that is what keeps re-issued work bit-identical without
+            # weights on the wire (docs/compiler_fleet.md)
+            frame["acked"] = self._acked_ticks.get(
+                (slave.mid, slave.pid), 0)
         # trace propagation (docs/observability.md): the issue event
         # roots the job's trace; its context rides the frame, the slave
         # parents its do_job span to it and echoes ITS context in the
@@ -422,6 +503,49 @@ class Server(Logger):
             # id; truncated at INGESTION so an oversized hostile list
             # is never retained past the frame
             slave.metrics_rows = msg["metrics"][:self.METRICS_MAX_ROWS]
+            entry = self._mine_reduce_rows(slave.metrics_rows)
+            if entry:
+                self._reduce_reports[(slave.mid, slave.pid)] = \
+                    (slave.id, entry)
+        if self.control_plane and "update" in msg:
+            # a data-plane weight payload on the control-plane wire is
+            # a protocol violation (zombie or misconfigured peer
+            # shipping stale weights a future refactor might apply) —
+            # REJECT it loudly BEFORE the fence consumes the lease: the
+            # job stays OUTSTANDING, so the hang timer requeues the
+            # work and liveness survives the violator
+            self._payload_rejects += 1
+            self.warning(
+                "rejected update from %s: frame carries a data-plane "
+                "'update' payload on a control-plane wire (job_id=%r) "
+                "— weights never ride updates in this mode", slave.id,
+                msg.get("job_id"))
+            get_flight_recorder().note("fleet.payload_reject",
+                                       slave=slave.id,
+                                       job_id=msg.get("job_id"))
+            await write_frame(writer, {"type": "update_ack",
+                                       "fenced": "payload-rejected"},
+                              self._secret)
+            slave.state = "WAIT"
+            await self._retry_pending()
+            return
+        results = msg.get("results" if self.control_plane else "update")
+        if results is None:
+            # a metrics-only keepalive: no completed-work bookkeeping
+            # (jobs_done/job timing/respawn budget) AND no lease
+            # consumption — settling it would mark work DONE whose
+            # results never arrived, silently dropping that minibatch
+            # from the run (the hang timer requeues the lease instead)
+            self.warning("update from %s carried no results (job_id="
+                         "%r) — acked, lease left outstanding, not "
+                         "counted as completed work", slave.id,
+                         msg.get("job_id"))
+            await write_frame(writer, {"type": "update_ack",
+                                       "fenced": "no-results"},
+                              self._secret)
+            slave.state = "WAIT"
+            await self._retry_pending()
+            return
         verdict = self._fence_update(slave, msg)
         if verdict is not None:
             self.warning("fenced update from %s: %s (job_id=%r)",
@@ -440,20 +564,73 @@ class Server(Logger):
         slave.jobs_done += 1
         if slave.jobs_done == 1 and self.respawn_manager is not None \
                 and slave.mid != "?":
-            # reset the respawn budget only once the slave proves it can
-            # WORK — resetting at handshake would let a crash-on-init
-            # loop respawn forever at base delay
+            # reset the respawn budget only once the slave proves it
+            # can WORK — resetting at handshake would let a
+            # crash-on-init loop respawn forever at base delay
             self.respawn_manager.notify_reconnected(slave.mid)
-        update = msg.get("update")
-        if update is not None:
-            with get_tracer().span(
-                    "fleet.apply",
-                    parent=parse_trace_field(msg.get("trace")),
-                    job_id=msg.get("job_id"), slave=slave.id):
-                await self._in_thread(self._locked_apply, update, slave)
+        with get_tracer().span(
+                "fleet.apply",
+                parent=parse_trace_field(msg.get("trace")),
+                job_id=msg.get("job_id"), slave=slave.id):
+            await self._in_thread(self._locked_apply, results, slave)
+        if self.control_plane:
+            key = (slave.mid, slave.pid)
+            tick = msg.get("tick")
+            if isinstance(tick, int) and not isinstance(tick, bool):
+                self._acked_ticks[key] = tick
+            if isinstance(msg.get("job_id"), int):
+                self._accepted_jobs[key] = msg["job_id"]
+            self._jobs_since_sync += 1
         await write_frame(writer, {"type": "update_ack"}, self._secret)
         slave.state = "WAIT"
         await self._retry_pending()
+
+    async def _apply_sync(self, slave, writer, msg):
+        """Epoch-fence weight sync (control plane): the only frames
+        that carry weights after the handshake. Fenced like updates —
+        a stale master epoch (zombie from a previous incarnation) or a
+        job the ledger never accepted from this process means the
+        weights are rejected, never applied. Re-application of the
+        SAME accepted fence (the client resends until acked) is an
+        idempotent overwrite."""
+        verdict = None
+        if not self.control_plane:
+            verdict = "not-control-plane"
+        elif msg.get("epoch") != self.epoch:
+            verdict = FENCE_STALE_EPOCH
+        elif msg.get("job_id") is None or msg.get("job_id") != \
+                self._accepted_jobs.get((slave.mid, slave.pid)):
+            # the sync must chase an update WE accepted from THIS
+            # process — a zombie's fence payload (its job was requeued
+            # and re-run elsewhere) never lands
+            verdict = "unsettled-job"
+        if verdict is not None:
+            self._sync_counters["fenced"] += 1
+            self.warning("fenced sync from %s: %s (job_id=%r)",
+                         slave.id, verdict, msg.get("job_id"))
+            get_flight_recorder().note("fleet.sync_fence",
+                                       verdict=verdict, slave=slave.id,
+                                       job_id=msg.get("job_id"))
+            await write_frame(writer, {"type": "sync_ack",
+                                       "fenced": verdict}, self._secret)
+            return
+        payload = msg.get("sync")
+        if payload is not None:
+            await self._in_thread(self._locked_apply_sync, payload,
+                                  slave)
+            self._sync_counters["applied"] += 1
+            self._jobs_since_sync = 0
+        await write_frame(writer, {"type": "sync_ack"}, self._secret)
+
+    def _locked_apply_sync(self, payload, slave):
+        with self._update_lock:
+            apply = getattr(self.workflow, "apply_sync_from_slave",
+                            None)
+            if apply is not None:
+                apply(payload, slave)
+            else:
+                self.warning("workflow has no apply_sync_from_slave — "
+                             "fence sync from %s dropped", slave.id)
 
     def _fence_update(self, slave, msg):
         """Judge an update before it can touch master state. Returns
@@ -658,12 +835,44 @@ class Server(Logger):
             for key, value in counters.items():
                 if isinstance(value, (int, float)):
                     chaos[key] = chaos.get(key, 0) + value
-        return {"slaves": [s.as_dict() for s in slaves],
-                # .copy() is a single C-level op (GIL-atomic), unlike
-                # sorted() iterating the live set under a concurrent
-                # hang-check blacklist.add
-                "blacklist": sorted(self.blacklist.copy()),
-                "queued_jobs": len(pending),
-                "epoch": self.epoch,
-                "ledger": self.ledger.snapshot(),
-                "chaos": chaos}
+        status = {"slaves": [s.as_dict() for s in slaves],
+                  # .copy() is a single C-level op (GIL-atomic), unlike
+                  # sorted() iterating the live set under a concurrent
+                  # hang-check blacklist.add
+                  "blacklist": sorted(self.blacklist.copy()),
+                  "queued_jobs": len(pending),
+                  "epoch": self.epoch,
+                  "plane": self.plane,
+                  "ledger": self.ledger.snapshot(),
+                  "chaos": chaos}
+        if self.control_plane:
+            status["sync"] = dict(self._sync_counters)
+            status["payload_rejects"] = self._payload_rejects
+        reduce_rows = {sid: dict(entry) for sid, entry
+                       in self._reduce_reports.copy().values()}
+        if reduce_rows:
+            status["reduce"] = reduce_rows
+        return status
+
+    @staticmethod
+    def _mine_reduce_rows(rows):
+        """In-program-reduce stats from one piggybacked snapshot
+        (``parallel/mapreduce.py`` publishes them into each slave's
+        registry) — the web-status fleet column's proof the math
+        stayed on the chip."""
+        entry = {}
+        for row in rows:
+            try:
+                name, _, _, value = row
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue
+            if name == "veles_fleet_reduce_steps_total":
+                entry["steps"] = entry.get("steps", 0) + value
+            elif name == "veles_fleet_reduce_bytes_total":
+                entry["bytes"] = entry.get("bytes", 0) + value
+            elif name == "veles_fleet_chip_idle_fraction":
+                entry["idle"] = value
+        return entry

@@ -149,6 +149,19 @@ class StandardWorkflow(Workflow):
             # loop-back and fire the EndPoint right after the backward chain
             # so the job callback ships the update (reference
             # workflow.py:554-569)
+            from veles_tpu.fleet import fleet_control_plane
+            if fleet_control_plane() and not use_fused:
+                # the control-plane wire carries no weights: the slave's
+                # params live in the fused tick's device-resident tree
+                # (with its one-slot rollback). A graph-mode slave
+                # mutates unit Arrays in place with no rollback — a
+                # re-issued job would silently double-apply
+                raise ValueError(
+                    "control-plane fleet mode (root.common.fleet.plane"
+                    "=control) requires the fused tick on the slave, "
+                    "but this topology/loader is not fusible (see "
+                    "parallel/fused.py supports()) — use the data "
+                    "plane for graph-mode slaves")
             self.repeater.unlink_from(self.gds[0])
             self.end_point.unlink_from(self.decision)
             self.end_point.link_from(self.gds[0])
@@ -159,6 +172,18 @@ class StandardWorkflow(Workflow):
         elif self.fused and self.is_standalone:
             self._enable_fused()
         return super().initialize(**kwargs)
+
+    def apply_initial_data_from_master(self, data):
+        """Handshake application + fused-tick residency reset: in
+        control-plane mode a (re)handshake that ships initial weights
+        (first join, or a master restart under a new epoch) must make
+        the next tick refresh its device-resident params from the unit
+        Arrays instead of continuing from the pre-handshake replica."""
+        super().apply_initial_data_from_master(data)
+        tick = self.fused_tick
+        if data and tick is not None \
+                and hasattr(tick, "reset_residency"):
+            tick.reset_residency()
 
     def _enable_fused_slave(self, mesh):
         """Fleet x pod composition (SURVEY §5's stated translation): the

@@ -251,12 +251,38 @@ class GradientDescent(JitUnit):
         return [n for n in self.OUTPUTS if n.startswith("_velocity")] \
             + list(self._second_slots_) + ["_step"]
 
-    def generate_data_for_master(self):
+    @staticmethod
+    def _control_plane():
+        from veles_tpu.fleet import fleet_control_plane
+        return fleet_control_plane()
+
+    @property
+    def negotiates_on_connect(self):
+        """Control-plane fleet (docs/compiler_fleet.md): initial
+        weights travel ONCE in the handshake instead of riding every
+        job, so the per-job wire can stay weight-free. Data plane keeps
+        the reference behavior (no handshake exchange — weights ride
+        the first job payload)."""
+        return self._control_plane()
+
+    def _state_payload(self):
+        """Full distributable state: params + (stateful-solver) moments
+        — the body shared by the data-plane update payload, the
+        control-plane handshake and the epoch-fence sync."""
         data = {attr: getattr(self, attr).mem
                 for attr in self._param_attrs()}
         for attr in self._solver_state_attrs():
-            data[attr] = getattr(self, attr).mem
+            if getattr(self, attr).data is not None:
+                data[attr] = getattr(self, attr).mem
         return data
+
+    def generate_data_for_master(self):
+        if self._control_plane():
+            # control plane: per-job updates carry NO weight payload —
+            # the gradient merge happened in-program on the slave's
+            # mesh; the scalar metrics ride the Decision's payload
+            return None
+        return self._state_payload()
 
     def apply_data_from_slave(self, data, slave=None):
         """Merge a slave's trained weights into master state.
@@ -294,14 +320,42 @@ class GradientDescent(JitUnit):
         # the rates ride every job so master-side annealing (plateau
         # lr_decay, set_learning_rate) reaches the slaves that execute
         # the actual GD ticks
-        data = {attr: getattr(self, attr).mem
-                for attr in self._param_attrs()}
-        for attr in self._solver_state_attrs():
-            if getattr(self, attr).data is not None:
-                data[attr] = getattr(self, attr).mem
+        if self._control_plane():
+            # control plane: jobs are batch assignments + hypers only;
+            # weights traveled once in the handshake and live on the
+            # slave's devices between epoch fences
+            return {"lr": self.learning_rate,
+                    "lr_bias": self.learning_rate_bias}
+        data = self._state_payload()
         data["lr"] = self.learning_rate
         data["lr_bias"] = self.learning_rate_bias
         return data
+
+    def generate_handshake_data(self, slave=None):
+        """Control-plane handshake: the FULL state (weights + solver
+        moments + rates), shipped once at connect so a joining slave
+        adopts the master's canonical params without per-job weight
+        frames. (Only reached in control-plane mode — see
+        ``negotiates_on_connect``.)"""
+        data = self._state_payload()
+        data["lr"] = self.learning_rate
+        data["lr_bias"] = self.learning_rate_bias
+        return data
+
+    def generate_sync_for_master(self):
+        """The epoch-fence bulk sync payload (control plane): current
+        weights + solver moments, read from the unit Arrays the fused
+        tick wrote at the fence."""
+        return self._state_payload()
+
+    def apply_sync_from_slave(self, data, slave=None):
+        """Fence sync application: OVERWRITE — between fences the
+        slave's in-program replica is the canonical state, so there is
+        nothing meaningful to merge (the data-plane merge modes apply
+        to per-job host aggregation only)."""
+        for attr in self._param_attrs() + self._solver_state_attrs():
+            if attr in data:
+                getattr(self, attr).data = jnp.asarray(data[attr])
 
     def apply_data_from_master(self, data):
         for attr in self._param_attrs() + self._solver_state_attrs():

@@ -38,12 +38,19 @@ SCHEMA_VERSION = 1
 #: numeric key suffixes where LOWER is better (times, overhead
 #: shares). NOT "_sec" alone: throughput keys end in "tokens_per_sec";
 #: "_sec_mean" covers the headline's epoch_sec_mean (seconds/epoch);
-#: "_bytes" covers the reshard keys (bytes on the wire per transition
-#: — a schedule that starts moving more data regressed)
+#: "_bytes" covers the reshard AND fleet-reduce keys (bytes on the
+#: wire per transition/reduce — a schedule or reduce tier that starts
+#: moving more data regressed; fleet_reduce[_bf16|_int8]_bytes,
+#: docs/compiler_fleet.md);
 #: "_hit_fraction" is the paged admission ratio (hit admit wall over
 #: cold prefill wall — a cache that stops saving work regressed) and
 #: "_flatness" the paged step-time max/min across the length sweep
-#: (docs/paged_kv.md; decode_paged in bench.py)
+#: (docs/paged_kv.md; decode_paged in bench.py).
+#: The fleet mapreduce section's directions (bench.py fleet_section):
+#: fleet_reduce*_ms / fleet_host_baseline_ms / fleet_step_ms regress
+#: UP via "_ms"; fleet_reduce*_bytes regress UP via "_bytes";
+#: fleet_step_mfu and fleet_inprogram_speedup use the higher-is-better
+#: default (and "_mfu"/"_speedup" carry spread siblings below)
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness")

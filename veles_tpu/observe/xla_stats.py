@@ -96,8 +96,19 @@ def abstractify(args, kwargs):
             # (sharded slot serving) must be costed from the SPMD
             # lowering it actually runs, and a lowering without input
             # shardings can't honor donation against pinned
-            # out_shardings (spurious donated-buffer warnings)
+            # out_shardings (spurious donated-buffer warnings).
+            # ...except SingleDeviceSharding: a replicated operand of
+            # a shard_map program (the fused fleet tick's params)
+            # carries one, and pinning THAT into the lower fails with
+            # "incompatible devices" against the mesh — dropping it
+            # lets the lowering re-infer placement. Scoped by TYPE,
+            # not device count: a NamedSharding over a 1-device serve
+            # mesh must keep costing from its real SPMD lowering
             sharding = getattr(x, "sharding", None)
+            single = getattr(jax.sharding, "SingleDeviceSharding",
+                             None)
+            if single is not None and isinstance(sharding, single):
+                sharding = None
             try:
                 return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                             sharding=sharding)
@@ -422,10 +433,15 @@ def publish_device_stats(registry):
 
 def publish_xla_stats(registry):
     """The full device-truth collector: compile/hit/storm counters, MFU
-    and memory gauges — registered once per registry by
-    :func:`ensure_registered`."""
+    and memory gauges, plus the in-program fleet-reduce plane
+    (``parallel/mapreduce.py``: reduce steps/bytes per precision tier
+    and the chip-idle-fraction gauge) — registered once per registry by
+    :func:`ensure_registered`, so every ``/metrics`` mount and every
+    fleet slave's piggybacked snapshot carries it."""
     get_compile_tracker().publish(registry)
     publish_device_stats(registry)
+    from veles_tpu.parallel.mapreduce import publish_reduce_stats
+    publish_reduce_stats(registry)
 
 
 def ensure_registered(registry=None):

@@ -23,9 +23,19 @@ computes, which is numerically identical to the hand-chained GD units
 
 Sharding: with a mesh (pod mode) the tick is ``shard_map``-ped over the
 ``data`` axis — each device gathers its own index shard from the
-replicated originals, gradients/metrics are ``psum``-merged over ICI —
-the synchronous SPMD answer to the reference's master/slave update merge.
-Tensor parallelism for dense chains stays in ``parallel.step``.
+replicated originals, gradients/metrics are merged over ICI by the
+mapreduce primitives (``parallel/mapreduce.py``: ``reduce_sum`` at the
+configured ``root.common.fleet.reduce`` tier, f32 == the plain psum) —
+the synchronous SPMD answer to the reference's master/slave update
+merge. Tensor parallelism for dense chains stays in ``parallel.step``.
+
+Control-plane fleet mode (``root.common.fleet.plane = "control"``,
+``docs/compiler_fleet.md``): a SLAVE's tick keeps its params
+device-resident across jobs (no per-job refresh from the unit Arrays —
+the wire no longer carries weights), stashes a one-slot rollback before
+every train tick so a re-issued job (lost update) replays from exactly
+the pre-job state, and writes the unit Arrays only at epoch fences
+(feeding the fence-sync payload the client ships to the master).
 """
 
 import jax
@@ -34,6 +44,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from veles_tpu.core.units import Unit
+from veles_tpu.parallel import mapreduce
 from veles_tpu.parallel.mesh import shard_map
 from veles_tpu.loader.base import TRAIN, VALID
 from veles_tpu.ops import activations as act_lib, losses
@@ -285,7 +296,7 @@ _TICK_CACHE = {}
 
 def build_tick(specs, norm_type="none", mesh=None,
                with_confusion=True, augment="none",
-               loss_kind="softmax"):
+               loss_kind="softmax", grad_reduce="f32"):
     """Compile the fused engine.
 
     Returns ``(train_step, eval_step, train_sweep, eval_sweep)``:
@@ -311,10 +322,18 @@ def build_tick(specs, norm_type="none", mesh=None,
       what makes the product path dispatch-bound-free: one XLA call per
       class per epoch instead of one per minibatch;
     - ``eval_sweep(...)`` likewise without updates.
+
+    ``grad_reduce`` selects the mesh gradient-merge wire tier
+    (``parallel/mapreduce.py``): ``"f32"`` (default, == the plain
+    psum), ``"bf16"``, or ``"int8"`` (quantized all-reduce with
+    per-leaf scales). Metric scalars always reduce exact. Callers
+    building for a mesh normally go through
+    ``mapreduce.fleet_train_step``, which also instruments the
+    programs for the /metrics plane.
     """
     from veles_tpu.core.config import root
     key = (_freeze(specs), norm_type, with_confusion, augment,
-           loss_kind, None if mesh is None else id(mesh),
+           loss_kind, grad_reduce, None if mesh is None else id(mesh),
            # EVERY engine knob the trace folds in: a changed level /
            # dtype / Pallas opt-in must not reuse a stale compiled tick
            root.common.engine.get("precision_level", 0),
@@ -383,9 +402,14 @@ def build_tick(specs, norm_type="none", mesh=None,
         (_, (loss_sum, n_err)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(wb)
         if data_ax > 1:
-            grads = lax.psum(grads, "data")
-            loss_sum = lax.psum(loss_sum, "data")
-            n_err = lax.psum(n_err, "data")
+            # the in-program fleet aggregation (parallel/mapreduce.py):
+            # gradients merge at the configured wire tier (f32 IS the
+            # plain psum, bit-identical to the pre-tier programs);
+            # metric scalars always reduce exact
+            grads = mapreduce.reduce_sum(grads, "data",
+                                         precision=grad_reduce)
+            loss_sum = mapreduce.reduce_sum(loss_sum, "data")
+            n_err = mapreduce.reduce_sum(n_err, "data")
         new = []
         for p, g, hyper, spec in zip(params, grads, hypers, specs):
             if not p:
@@ -428,9 +452,9 @@ def build_tick(specs, norm_type="none", mesh=None,
         cm = (losses.confusion_matrix(logits, lab, logits.shape[-1], mask)
               if with_confusion else jnp.zeros((1, 1), jnp.int32))
         if data_ax > 1:
-            loss_sum = lax.psum(loss_sum, "data")
-            n_err = lax.psum(n_err, "data")
-            cm = lax.psum(cm, "data")
+            loss_sum = mapreduce.reduce_sum(loss_sum, "data")
+            n_err = mapreduce.reduce_sum(n_err, "data")
+            cm = mapreduce.reduce_sum(cm, "data")
         return loss_sum, n_err, cm
 
     def local_train(params, hypers, norm, data, labels, indices, valid,
@@ -591,6 +615,9 @@ class FusedTick(Unit):
         self._steps_ = None
         self._norm_ = None
         self._specs_ = None
+        #: control-plane fleet: params snapshot taken before the last
+        #: TRAIN tick — a re-issued job (lost update) rolls back to it
+        self._rollback_ = None
         self._wrote_eval_params_ = False
         if not hasattr(self, "pipelined"):
             self.pipelined = False
@@ -641,22 +668,40 @@ class FusedTick(Unit):
         self._specs_ = extract_model_spec(wf)
         self._norm_ = {k: jnp.asarray(v) for k, v in
                        loader.normalizer.jit_state().items()}
-        self._steps_ = build_tick(
-            self._specs_, loader.normalization_type, self.mesh_,
-            with_confusion=getattr(wf.evaluator, "compute_confusion",
-                                   True),
-            augment=getattr(loader, "jit_transform", None) or "none",
-            loss_kind=self._loss_kind_)
+        if self.mesh_ is not None:
+            # meshed ticks build through the mapreduce layer: same
+            # compiled programs (build_tick underneath, f32 reduce ==
+            # the old psum) plus xla_stats instrumentation and the
+            # configured gradient-reduce wire tier
+            self._steps_ = mapreduce.fleet_train_step(
+                self.mesh_, self._specs_, loader.normalization_type,
+                with_confusion=getattr(wf.evaluator,
+                                       "compute_confusion", True),
+                augment=getattr(loader, "jit_transform", None)
+                or "none",
+                loss_kind=self._loss_kind_)
+        else:
+            self._steps_ = build_tick(
+                self._specs_, loader.normalization_type, self.mesh_,
+                with_confusion=getattr(wf.evaluator,
+                                       "compute_confusion", True),
+                augment=getattr(loader, "jit_transform", None)
+                or "none",
+                loss_kind=self._loss_kind_)
 
     def run(self):
         import numpy
         wf = self.workflow
         loader = wf.loader
-        if self._params_ is None or wf.is_slave:
+        control = wf.is_slave and self._control_plane()
+        if self._params_ is None or (wf.is_slave and not control):
             # copy: the unit Arrays keep their own buffers — ours get
-            # donated through the train step. A SLAVE refreshes every
-            # tick: the master overwrites the unit Arrays between jobs
-            # (apply_data_from_master)
+            # donated through the train step. A data-plane SLAVE
+            # refreshes every tick: the master overwrites the unit
+            # Arrays between jobs (apply_data_from_master). A
+            # CONTROL-plane slave keeps its params device-resident —
+            # the wire no longer carries weights, so the local replica
+            # is the authoritative mid-epoch state
             self._params_ = jax.tree.map(
                 jnp.copy, get_params(wf, self._specs_))
         train_step, eval_step, train_sweep, eval_sweep = self._steps_
@@ -670,6 +715,14 @@ class FusedTick(Unit):
         indices = loader.minibatch_indices.data
         valid = numpy.float32(max(loader.minibatch_valid_size, 1))
         training = loader.minibatch_class == TRAIN
+        if control:
+            # one-slot rollback stash: a job whose update frame is
+            # lost gets re-issued by the master; the replay must start
+            # from exactly the pre-job params (sync-mode pipelining
+            # bounds the unacknowledged depth to one). Eval ticks
+            # mutate nothing — no slot, rollback_job is then a no-op
+            self._rollback_ = (jax.tree.map(jnp.copy, self._params_)
+                               if training else None)
         if getattr(loader, "sweep_serving", False):
             sizes = loader.sweep_valid_sizes
             if training:
@@ -704,10 +757,17 @@ class FusedTick(Unit):
             evaluator.confusion_matrix.data = cm
         self.ticks += 1
         if wf.is_slave:
-            # one tick per job: write the trained weights straight back
-            # so generate_data_for_master ships them; epoch accounting
-            # lives on the master
-            if training:
+            if control:
+                # control plane: the unit Arrays are written only at
+                # EPOCH FENCES — they feed the bulk fence-sync payload
+                # the client ships (docs/compiler_fleet.md); per-job
+                # updates carry scalars only
+                if bool(loader.epoch_ended):
+                    set_params(wf, self._params_, self._specs_)
+            elif training:
+                # data plane (one tick per job): write the trained
+                # weights straight back so generate_data_for_master
+                # ships them; epoch accounting lives on the master
                 set_params(wf, self._params_, self._specs_)
             return
         if not training and loader.epoch_ended_for_class:
@@ -745,6 +805,31 @@ class FusedTick(Unit):
                 set_params(wf, self._params_, self._specs_)
             self._wrote_eval_params_ = False
             self._stashed_this_epoch_ = False
+
+    @staticmethod
+    def _control_plane():
+        from veles_tpu.fleet import fleet_control_plane
+        return fleet_control_plane()
+
+    def rollback_job(self):
+        """Control-plane fleet: undo the LAST job's local application.
+        Returns True when params were actually restored (the last job
+        was a train tick); False when there was nothing to undo (eval
+        tick — idempotent to re-run). Called by the fleet client when
+        the master re-issues work whose update never arrived."""
+        if self._rollback_ is None:
+            return False
+        self._params_ = self._rollback_
+        self._rollback_ = None
+        return True
+
+    def reset_residency(self):
+        """Drop the device-resident params so the next tick refreshes
+        from the unit Arrays — called after a master handshake applied
+        fresh initial weights (master restart / first join in
+        control-plane mode)."""
+        self._params_ = None
+        self._rollback_ = None
 
     def advance_eval_params(self):
         """Write the one-slot history's evaluated params into the unit

@@ -126,6 +126,10 @@ def format_fleet_health(fleet):
     if not isinstance(fleet, dict):
         return ""
     parts = []
+    if fleet.get("plane") == "control":
+        # the compiler-visible wire (docs/compiler_fleet.md): say so,
+        # since "jobs done" then means assignments, not weight merges
+        parts.append("control-plane")
     ledger = fleet.get("ledger")
     if isinstance(ledger, dict):
         parts.append("%s/%s jobs done" % (ledger.get("done", 0),
@@ -134,6 +138,27 @@ def format_fleet_health(fleet):
             parts.append("%s requeued" % ledger["requeued"])
         if ledger.get("fenced_total"):
             parts.append("%s fenced" % ledger["fenced_total"])
+    sync = fleet.get("sync")
+    if isinstance(sync, dict) and (sync.get("applied")
+                                   or sync.get("fenced")):
+        parts.append("%s syncs" % sync.get("applied", 0)
+                     + (" (%s fenced)" % sync["fenced"]
+                        if sync.get("fenced") else ""))
+    reduce_rows = fleet.get("reduce")
+    if isinstance(reduce_rows, dict) and reduce_rows:
+        steps = sum(e.get("steps", 0) for e in reduce_rows.values()
+                    if isinstance(e, dict))
+        bytes_total = sum(e.get("bytes", 0)
+                          for e in reduce_rows.values()
+                          if isinstance(e, dict))
+        idles = [e["idle"] for e in reduce_rows.values()
+                 if isinstance(e, dict) and e.get("idle") is not None]
+        cell = "in-program reduce: %d steps" % steps
+        if bytes_total:
+            cell += " · %.1f MB wire" % (bytes_total / 1e6)
+        if idles:
+            cell += " · idle %d%%" % round(100 * max(idles))
+        parts.append(cell)
     chaos = fleet.get("chaos")
     if isinstance(chaos, dict):
         fired = ", ".join("%s %s" % (v, k.replace("_", " "))
@@ -600,7 +625,8 @@ class StatusNotifier:
             # the dashboard's proof that requeue/fencing actually works
             status["fleet"] = {
                 key: fleet.get(key)
-                for key in ("epoch", "queued_jobs", "ledger", "chaos")}
+                for key in ("epoch", "queued_jobs", "ledger", "chaos",
+                            "plane", "sync", "reduce")}
         # serving-survival observability (docs/serving_robustness.md):
         # a serving API mirrors its breaker state and trip/rebuild/
         # shed/expired counters onto the dashboard. Two attachment
