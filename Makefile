@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr
+	regress mesh paged fleet-mr aot
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -87,6 +87,16 @@ regress:
 	JAX_PLATFORMS=cpu $(PYTHON) -m veles_tpu observe regress \
 		BENCH_r05.json BENCH_r05.json
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_regress.py -q
+
+# AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
+# build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device
+# CPU mesh, one fused train step), the compatibility-gate rejection
+# matrix (schema/jax/jaxlib/fingerprint/mesh each refused by name), the
+# zero-retrace serving warmup (veles_xla_compiles_total pinned flat),
+# deterministic package bytes, and the forge 422-on-tamper upload path.
+aot:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_aot.py \
+		-m aot -q
 
 entry:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import jax, __graft_entry__ as g; \

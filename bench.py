@@ -1416,6 +1416,157 @@ def reshard_bench():
     return keys
 
 
+#: one geometry for the cold-start twins (parent builds the bundle,
+#: both children rebuild the same params from the seed) — serving-bench
+#: scale, small enough that the live child's trace+compile finishes in
+#: CI time
+COLDSTART_CFG = dict(blocks=2, embed=256, heads=8, vocab=2048, slots=4,
+                     max_len=256, n_tokens=16, chunk=8, seed=0)
+
+
+def coldstart_child(kind, bundle=None, cfg=None):
+    """One cold-start step, run in a FRESH subprocess on the CPU
+    platform (a warm parent cannot honestly measure cold start, and
+    the children must share one device fingerprint with the bundle —
+    the CPU-child doctrine of ``reshard_bench``/``fleet_bench``, so
+    the keys stay CI-comparable wherever the bench runs):
+    ``kind="build"`` writes the bundle; ``kind="live"`` boots a
+    serving decoder by tracing + compiling, ``kind="aot"`` by loading
+    the bundle — time to the first generated chunk, then a warmup over
+    every prompt bucket, then the XLA compile tally the decode
+    programs booked (``observe/xla_stats``). Prints one JSON line;
+    the AOT child's ``compiles == 0`` is the device-truth zero-retrace
+    proof the regression sentinel pins."""
+    import time
+
+    cfg = dict(COLDSTART_CFG, **(cfg or {}))
+    import numpy
+
+    from veles_tpu.observe.xla_stats import get_compile_tracker
+    from veles_tpu.parallel.transformer_step import \
+        init_transformer_params
+    from veles_tpu.serving import ContinuousDecoder
+
+    tracker = get_compile_tracker()
+    tracker.enable()
+    rng = numpy.random.RandomState(cfg["seed"])
+    params = init_transformer_params(rng, cfg["blocks"], cfg["embed"],
+                                     cfg["heads"], cfg["vocab"])
+    table = jnp.asarray(rng.randn(cfg["vocab"], cfg["embed"])
+                        .astype(numpy.float32) * 0.3)
+    if kind == "build":
+        from veles_tpu.aot.artifact import build_serving_bundle
+        t0 = time.perf_counter()
+        build_serving_bundle(params, table, cfg["heads"], bundle,
+                             slots=cfg["slots"],
+                             max_len=cfg["max_len"],
+                             n_tokens=cfg["n_tokens"],
+                             chunk=cfg["chunk"])
+        out = {"build_ms": round(
+            (time.perf_counter() - t0) * 1000.0, 1),
+            "bytes": os.path.getsize(bundle)}
+        print(json.dumps(out))
+        return out
+    prompt = rng.randint(0, cfg["vocab"], 12)
+    t0 = time.perf_counter()
+    aot = None
+    if kind == "aot":
+        from veles_tpu.aot.loader import load_bundle
+        aot = load_bundle(bundle)
+    dec = ContinuousDecoder(params, table, cfg["heads"],
+                            slots=cfg["slots"], max_len=cfg["max_len"],
+                            n_tokens=cfg["n_tokens"], aot=aot)
+    rid = dec.submit(prompt)
+    while not dec.results.get(rid):
+        dec.step_many(cfg["chunk"])
+    first_token_ms = (time.perf_counter() - t0) * 1000.0
+    # warmup: one prompt per bucket the decoder serves, so every admit
+    # shape the replica will ever compile is exercised
+    bucket = 16
+    while bucket <= cfg["max_len"]:
+        n = max(1, min(bucket - 1,
+                       cfg["max_len"] - cfg["n_tokens"] - 1))
+        dec.submit(rng.randint(0, cfg["vocab"], n))
+        bucket *= 2
+    dec.run_until_drained(chunk=cfg["chunk"])
+    snap = tracker.snapshot()
+    compiles = sum(count for name, count in snap["compiles"].items()
+                   if name.startswith(("decode.", "paged.")))
+    out = {"first_token_ms": round(first_token_ms, 1),
+           "compiles": compiles,
+           "tokens": dec.tokens_out}
+    if aot is not None:
+        out["aot"] = aot.stats()
+    print(json.dumps(out))
+    return out
+
+
+def coldstart_section(repeats=2):
+    """Cold-start-to-first-token, live-compile vs AOT-load (ROADMAP
+    item 4 / docs/aot_artifacts.md): a fresh CPU subprocess builds the
+    serving bundle (`veles_tpu aot build`'s path — in a CHILD so the
+    bundle's device fingerprint matches the twins' platform even when
+    the bench parent runs on a TPU), then fresh subprocess twins boot
+    a decoder each way. Records the measured
+    ``coldstart_to_first_token_ms`` (AOT) against the live twin, and
+    ``coldstart_compiles`` — the AOT warmup's live-compile tally,
+    pinned 0 by the device-truth counter (lower-better in
+    ``observe/regress``)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    cfg = COLDSTART_CFG
+    tmp = tempfile.mkdtemp(prefix="veles_aot_bench_")
+    bundle = os.path.join(tmp, "coldstart.aot.tar")
+
+    env = _cpu8_env()
+    env["XLA_FLAGS"] = ""  # cold start is a single-replica fact
+
+    def child(kind, runs=repeats):
+        code = ("import bench\n"
+                "bench.coldstart_child(%r, bundle=%r)\n"
+                % (kind, bundle))
+        best = None
+        for _ in range(runs):
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  env=env, capture_output=True,
+                                  text=True, timeout=900)
+            if proc.returncode != 0:
+                print(proc.stderr[-2000:], file=sys.stderr)
+                return None
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            if best is None or row.get("first_token_ms", 0) \
+                    < best.get("first_token_ms", 0):
+                best = row
+        return best
+
+    built = child("build", runs=1)
+    if not built:
+        return {}
+    build_ms = built["build_ms"]
+    live = child("live")
+    aot = child("aot")
+    if not live or not aot:
+        return {}
+    out = {
+        "coldstart_live_to_first_token_ms": live["first_token_ms"],
+        "coldstart_to_first_token_ms": aot["first_token_ms"],
+        "coldstart_first_token_speedup": round(
+            live["first_token_ms"] / aot["first_token_ms"], 2),
+        "coldstart_live_compiles": live["compiles"],
+        "coldstart_compiles": aot["compiles"],
+        "coldstart_bundle_build_ms": round(build_ms, 1),
+        "coldstart_bundle_bytes": os.path.getsize(bundle),
+        "coldstart_aot_programs": (aot.get("aot") or {}).get(
+            "programs"),
+        "coldstart_config": "blocks%d_embed%d_slots%d_maxlen%d_cpu"
+                            % (cfg["blocks"], cfg["embed"],
+                               cfg["slots"], cfg["max_len"]),
+    }
+    return out
+
+
 def fleet_section(in_f=784, hidden=1024, classes=10, batch=1024,
                   repeats=12):
     """In-program fleet aggregation vs the measured host-aggregation
@@ -1705,6 +1856,7 @@ def main(artifact_path=None):
     _add(_guarded(decode_continuous, fallback={}))
     _add(_guarded(reshard_bench, fallback={}))
     _add(_guarded(fleet_bench, fallback={}))
+    _add(_guarded(coldstart_section, fallback={}))
     _add(_guarded(pod_overhead, fallback={}))
     _add(_guarded(pallas_epilogue_compare, fallback={}))
     gflops = device_keys.get("fused_step_gflops")
@@ -1807,6 +1959,12 @@ def serve_main(profile_dir=None, artifact_path=None):
             # time ride the serving bench too, so `make bench-serve`
             # alone guards the whole serving surface incl. the pod path
             section = _guarded(reshard_bench, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # AOT cold start (docs/aot_artifacts.md): live trace+compile
+            # vs bundle deserialize+execute, fresh-subprocess twins —
+            # coldstart_compiles pinned 0 is the zero-retrace proof
+            section = _guarded(coldstart_section, fallback={})
             out.update(section)
             artifact.update(section)
         out["decode_histograms"] = registry.histogram_summary(

@@ -190,6 +190,14 @@ class Main(Logger):
                            metavar="N", help="positions per KV page "
                            "(default SLOT_SPAN_TILE=128; must be a "
                            "multiple of the span tile on TPU)")
+        serve.add_argument("--serve-aot", default=None, metavar="PATH",
+                           help="boot GenerateAPI from an AOT "
+                           "compiled-program bundle (veles_tpu aot "
+                           "build): cold start becomes deserialize + "
+                           "execute, zero retracing; a stale bundle "
+                           "is refused by name and serving falls "
+                           "back to live compilation "
+                           "(docs/aot_artifacts.md)")
         serve.add_argument("--serve-pool-pages", type=int, default=None,
                            metavar="N", help="total pages in the KV "
                            "pool incl. the scratch page (default: the "
@@ -517,6 +525,7 @@ class Main(Logger):
                 ("serve_paged", root.common.serve, "paged"),
                 ("serve_page_size", root.common.serve, "page_size"),
                 ("serve_pool_pages", root.common.serve, "pool_pages"),
+                ("serve_aot", root.common.serve, "aot"),
                 ("chaos_serve_seed", root.common.serve.chaos, "seed"),
                 ("chaos_serve_step_fail", root.common.serve.chaos,
                  "step_fail"),
@@ -619,6 +628,9 @@ def main(argv=None):
     if argv and argv[0] == "observe":
         from veles_tpu.observe.trace_export import main as observe_main
         return observe_main(argv[1:])
+    if argv and argv[0] == "aot":
+        from veles_tpu.aot.cli import main as aot_main
+        return aot_main(argv[1:])
     return Main().run(argv)
 
 

@@ -1,0 +1,24 @@
+"""AOT compiled-program artifacts: the libVeles analogue.
+
+The reference VELES shipped trained workflows as self-contained packages
+executed by a Python-free C++ runtime (PAPER.md §libVeles,
+``WorkflowLoader::Load(package)``). This package is the TPU-era twin for
+the COMPILED programs themselves: ``artifact.py`` captures the stack's
+jitted serving and training programs through ``jax.export`` into
+StableHLO members of a versioned, sha-addressed bundle; ``loader.py``
+deserializes them back into callables that slot into the existing jit
+call surfaces with zero retracing — cold start becomes deserialize +
+execute (docs/aot_artifacts.md).
+"""
+
+from veles_tpu.aot.artifact import (SCHEMA_VERSION, BundleBuilder,
+                                    build_serving_bundle,
+                                    capture_tick_programs, read_bundle)
+from veles_tpu.aot.loader import (AotCompatError, AotPrograms,
+                                  check_compat, install_fused_tick,
+                                  load_bundle)
+
+__all__ = ["SCHEMA_VERSION", "BundleBuilder", "build_serving_bundle",
+           "capture_tick_programs", "read_bundle", "AotCompatError",
+           "AotPrograms", "check_compat", "install_fused_tick",
+           "load_bundle"]

@@ -1,0 +1,181 @@
+"""``veles_tpu aot build|inspect|verify`` — the artifact toolchain.
+
+- ``build``: capture a serving configuration's slot programs into a
+  bundle (the shapes are the artifact — weights travel separately via
+  the forge packages, exactly as libVeles split workflow bytes from
+  the runtime);
+- ``inspect``: print a bundle's manifest summary;
+- ``verify``: check the sidecar, the content-addressed members and the
+  compatibility gate against THIS machine — exit 0 loadable, 1 refused
+  (stale field named), 2 unreadable/tampered.
+"""
+
+import argparse
+import json
+
+
+def _build(args):
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.aot.artifact import build_serving_bundle
+    from veles_tpu.parallel.transformer_step import \
+        init_transformer_params
+    from veles_tpu.serving import build_serve_mesh
+
+    rng = numpy.random.RandomState(args.seed)
+    params = init_transformer_params(rng, args.blocks, args.embed,
+                                     args.heads, args.vocab)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.dtype == "bfloat16":
+        params = jax.tree.map(lambda a: a.astype(dtype), params)
+    table = jnp.asarray(
+        rng.randn(args.vocab, args.embed).astype("float32") * 0.3
+    ).astype(dtype)
+    mesh = build_serve_mesh(args.mesh) if args.mesh else None
+
+    def progress(name, key):
+        if args.verbose:
+            print("  exporting %s %s" % (name, list(key)))
+
+    path = build_serving_bundle(
+        params, table, args.heads, args.out, slots=args.slots,
+        max_len=args.max_len, n_tokens=args.n_tokens, chunk=args.chunk,
+        temperature=args.temperature, top_k=args.top_k,
+        quantize=args.quantize if args.quantize != "none" else None,
+        tile=args.tile, paged=args.paged, page_size=args.page_size,
+        pool_pages=args.pool_pages, mesh=mesh, progress=progress)
+    if args.forge_dir:
+        stage_into_package(path, args.forge_dir)
+    from veles_tpu.aot.artifact import inspect_bundle
+    print(json.dumps(inspect_bundle(path), indent=1))
+    return 0
+
+
+def stage_into_package(bundle_path, directory):
+    """Stage a bundle (+ its sidecar) into a forge package directory
+    and list it under the manifest's ``artifacts`` member — the
+    distribution flow: ``veles_tpu forge upload -d DIR`` then ships
+    programs and weights together, and the server verifies the
+    sidecar on receipt (422 on tamper)."""
+    import os
+    import shutil
+
+    from veles_tpu.forge.package import MANIFEST
+
+    name = os.path.basename(bundle_path)
+    shutil.copy(bundle_path, os.path.join(directory, name))
+    shutil.copy(bundle_path + ".sha256",
+                os.path.join(directory, name + ".sha256"))
+    manifest_path = os.path.join(directory, MANIFEST)
+    with open(manifest_path) as fin:
+        manifest = json.load(fin)
+    artifacts = manifest.setdefault("artifacts", [])
+    if name not in artifacts:
+        artifacts.append(name)
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as fout:
+        json.dump(manifest, fout, indent=1, sort_keys=True)
+    os.replace(tmp, manifest_path)
+    return name
+
+
+def _inspect(args):
+    from veles_tpu.aot.artifact import inspect_bundle
+    print(json.dumps(inspect_bundle(args.bundle), indent=1))
+    return 0
+
+
+def _verify(args):
+    from veles_tpu.aot.artifact import read_bundle
+    from veles_tpu.aot.loader import AotCompatError, check_compat
+    from veles_tpu.serving import build_serve_mesh
+
+    try:
+        manifest, _ = read_bundle(args.bundle)
+    except (OSError, ValueError) as exc:
+        print("UNREADABLE: %s" % exc)
+        return 2
+    mesh = None
+    if args.mesh:
+        # the operator's intended serving mesh ALWAYS participates in
+        # the verdict: verifying a single-chip bundle with --mesh must
+        # refuse exactly like --serve-aot + --serve-mesh would
+        try:
+            mesh = build_serve_mesh(args.mesh)
+        except ValueError as exc:
+            print("REFUSED: mesh: %s" % exc)
+            return 1
+    elif manifest.get("mesh") is not None:
+        # verify against the bundle's own axes so a matching machine
+        # answers "loadable" without the operator retyping the mesh
+        axes = manifest["mesh"].get("axes") or {}
+        try:
+            mesh = build_serve_mesh(dict(axes))
+        except ValueError as exc:
+            print("REFUSED: mesh: %s" % exc)
+            return 1
+    try:
+        check_compat(manifest, mesh=mesh)
+    except AotCompatError as exc:
+        print("REFUSED: %s: %s" % (exc.field, exc))
+        return 1
+    print("OK: %d programs, loadable on this machine"
+          % len(manifest.get("programs", ())))
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="veles_tpu aot")
+    sub = parser.add_subparsers(dest="action", required=True)
+
+    build = sub.add_parser("build", help="capture a serving "
+                           "configuration's programs into a bundle")
+    build.add_argument("--out", required=True, help="bundle path")
+    build.add_argument("--blocks", type=int, default=2)
+    build.add_argument("--embed", type=int, default=256)
+    build.add_argument("--heads", type=int, default=8)
+    build.add_argument("--vocab", type=int, default=2048)
+    build.add_argument("--dtype", choices=("float32", "bfloat16"),
+                       default="float32")
+    build.add_argument("--slots", type=int, default=4)
+    build.add_argument("--max-len", type=int, default=512)
+    build.add_argument("--n-tokens", type=int, default=32)
+    build.add_argument("--chunk", type=int, default=8)
+    build.add_argument("--temperature", type=float, default=0.0)
+    build.add_argument("--top-k", type=int, default=0)
+    build.add_argument("--quantize",
+                       choices=("none", "int8", "int8-kv"),
+                       default="none")
+    build.add_argument("--tile", type=int, default=None)
+    build.add_argument("--paged", action="store_true")
+    build.add_argument("--page-size", type=int, default=None)
+    build.add_argument("--pool-pages", type=int, default=None)
+    build.add_argument("--mesh", default=None,
+                       metavar="AXIS=N[,AXIS=N...]")
+    build.add_argument("--forge-dir", default=None, metavar="DIR",
+                       help="also stage the bundle + .sha256 sidecar "
+                       "into this forge package directory and list it "
+                       "in the manifest's 'artifacts'")
+    build.add_argument("--seed", type=int, default=0,
+                       help="params init seed (shapes only — serve "
+                       "real weights via the forge package)")
+    build.add_argument("-v", "--verbose", action="store_true")
+    build.set_defaults(func=_build)
+
+    inspect_p = sub.add_parser("inspect", help="print a bundle's "
+                               "manifest summary")
+    inspect_p.add_argument("bundle")
+    inspect_p.set_defaults(func=_inspect)
+
+    verify = sub.add_parser("verify", help="integrity + compatibility "
+                            "check against this machine")
+    verify.add_argument("bundle")
+    verify.add_argument("--mesh", default=None,
+                        metavar="AXIS=N[,AXIS=N...]")
+    verify.set_defaults(func=_verify)
+
+    args = parser.parse_args(argv)
+    return args.func(args)

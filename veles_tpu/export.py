@@ -22,12 +22,29 @@ point of a native inference runtime.
 
 import io
 import json
+import os
 import tarfile
 import time
 
 import numpy
 
 from veles_tpu.memory import Array
+
+
+def _export_stamp():
+    """Deterministic export timestamp: epoch 0 unless the operator
+    sets ``SOURCE_DATE_EPOCH`` (the reproducible-builds convention).
+    Two exports of identical state must produce byte-identical
+    packages — the sha-addressed artifact store (forge uploads, the
+    AOT bundle sidecars) dedupes by content, and a wall-clock stamp
+    made every repack hash differently. Tar member mtimes are already
+    fixed (``TarInfo`` defaults to 0); this pins the one remaining
+    wall-clock leak, the ``contents.json`` stamp."""
+    try:
+        epoch = int(os.environ.get("SOURCE_DATE_EPOCH", "0"))
+    except ValueError:
+        epoch = 0
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(epoch))
 
 
 def _npy_bytes(array, dtype=numpy.float32):
@@ -133,7 +150,7 @@ def package_export(workflow, path, precision=32):
     contents = {
         "workflow": workflow.name,
         "checksum": workflow.checksum,
-        "exported": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "exported": _export_stamp(),
         "precision": precision,
         "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
         "units": units,

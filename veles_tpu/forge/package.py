@@ -5,8 +5,24 @@ a model package is a gzipped tarball whose ``manifest.json`` declares
 ``name``, ``version``, ``workflow`` (the entry Python file), ``config``,
 ``short_description`` and a requirements-style ``requires`` list. Both
 named files must exist in the archive.
+
+Two additions for the AOT artifact tier (docs/aot_artifacts.md):
+
+- **deterministic bytes**: :func:`pack` stamps every tar member with a
+  fixed epoch-0 mtime / zero uid-gid and writes the gzip wrapper with
+  ``mtime=0`` — two packs of an identical directory are byte-identical,
+  so sha-addressed stores dedupe instead of treating every repack as a
+  new blob;
+- **artifact members**: the manifest's optional ``artifacts`` list
+  names AOT bundle members shipped inside the package, each with a
+  ``<name>.sha256`` sidecar member (the snapshotter's shasum format).
+  :func:`verify_artifact_members` re-hashes them — the forge server
+  runs it on every upload and rejects tampered packages with 422
+  instead of storing them.
 """
 
+import gzip
+import hashlib
 import io
 import json
 import os
@@ -15,6 +31,10 @@ import tarfile
 
 MANIFEST = "manifest.json"
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+class TamperedPackageError(ValueError):
+    """An artifact member's bytes do not match its sha256 sidecar."""
 
 
 def validate_manifest(manifest):
@@ -29,6 +49,10 @@ def validate_manifest(manifest):
     if not isinstance(requires, list) \
             or not all(isinstance(r, str) for r in requires):
         raise TypeError("'requires' must be a list of requirement strings")
+    artifacts = manifest.get("artifacts", [])
+    if not isinstance(artifacts, list) \
+            or not all(isinstance(a, str) and a for a in artifacts):
+        raise TypeError("'artifacts' must be a list of member names")
     seen = set()
     for item in requires:
         project = re.split(r"[<>=!~\[; ]", item, 1)[0].strip()
@@ -50,15 +74,41 @@ def pack(directory, out_path=None):
             raise FileNotFoundError(
                 "manifest names %s=%r but the file is absent"
                 % (field, name))
+    for name in manifest.get("artifacts", []):
+        for member in (name, name + ".sha256"):
+            if not os.path.isfile(os.path.join(directory, member)):
+                raise FileNotFoundError(
+                    "manifest lists artifact %r but %s is absent"
+                    % (name, member))
     if out_path is None:
         out_path = os.path.join(
             directory, "%s.tar.gz" % manifest["name"])
-    with tarfile.open(out_path, "w:gz") as tar:
+
+    def deterministic(info):
+        # fixed mtime / zero ownership / normalized modes: two packs
+        # of identical state must hash identically ACROSS machines
+        # (the sha-addressed dedup contract) — mode bits would
+        # otherwise carry the packing user's umask
+        info.mtime = 0
+        info.uid = info.gid = 0
+        info.uname = info.gname = ""
+        if info.isdir() or info.mode & 0o100:
+            info.mode = 0o755
+        else:
+            info.mode = 0o644
+        return info
+
+    # gzip via an explicit wrapper: tarfile's "w:gz" stamps the gzip
+    # header with time.time(), which alone made every repack a new sha
+    with open(out_path, "wb") as raw, \
+            gzip.GzipFile(filename="", mode="wb", fileobj=raw,
+                          mtime=0) as gz, \
+            tarfile.open(fileobj=gz, mode="w") as tar:
         for entry in sorted(os.listdir(directory)):
             full = os.path.join(directory, entry)
             if os.path.abspath(full) == os.path.abspath(out_path):
                 continue
-            tar.add(full, arcname=entry)
+            tar.add(full, arcname=entry, filter=deterministic)
     return out_path, manifest
 
 
@@ -76,12 +126,56 @@ def read_manifest(blob):
     return validate_manifest(manifest)
 
 
+def verify_artifact_members(blob, manifest=None, inventory=None):
+    """Check every AOT artifact member the manifest lists against its
+    ``.sha256`` sidecar member (the snapshotter's shasum format: any
+    listed digest vouches, comment lines ignored — the same convention
+    ``SnapshotterToFile._load_verified`` reads). Raises
+    :class:`TamperedPackageError` naming the bad member; the forge
+    server maps that to 422 on upload, so a bundle corrupted in
+    transit (or maliciously swapped) is never stored.
+
+    ``inventory`` (:func:`file_inventory`'s output) supplies the
+    members' already-computed digests so the (large) artifact bytes
+    are not decompressed and hashed a second time on the upload path —
+    only the tiny sidecar members are extracted here."""
+    if manifest is None:
+        manifest = read_manifest(blob)
+    artifacts = manifest.get("artifacts", [])
+    if not artifacts:
+        return manifest
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        for name in artifacts:
+            if inventory is not None and name in inventory:
+                got = inventory[name]["sha256"]
+            else:
+                try:
+                    got = hashlib.sha256(tar.extractfile(
+                        tar.getmember(name)).read()).hexdigest()
+                except KeyError:
+                    raise TamperedPackageError(
+                        "manifest lists artifact %r but the member is "
+                        "missing" % name)
+            try:
+                sidecar = tar.extractfile(
+                    tar.getmember(name + ".sha256")).read().decode()
+            except KeyError:
+                raise TamperedPackageError(
+                    "artifact %r has no .sha256 sidecar member" % name)
+            want = [line.split()[0] for line in sidecar.splitlines()
+                    if line.strip() and not line.startswith("#")]
+            if not want or got not in want:
+                raise TamperedPackageError(
+                    "artifact %r sha256 %s not among its sidecar "
+                    "digests %s — refusing the tampered package"
+                    % (name, got, want))
+    return manifest
+
+
 def file_inventory(blob):
     """Per-file metadata of a package: {name: {"size", "sha256"}} —
     the diffable content record the server stores with every version
     (the role of the reference's per-model git history)."""
-    import hashlib
-
     out = {}
     with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
         for member in tar.getmembers():

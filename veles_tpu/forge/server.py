@@ -193,6 +193,13 @@ class ForgeServer(Logger):
         version = self._safe_version(
             str(version or manifest.get("version", "1.0")))
         files = pkg.file_inventory(blob)
+        # AOT artifact members are verified against their sha256
+        # sidecars ON RECEIPT: a bundle corrupted in transit (or
+        # swapped for one that would execute different programs) is
+        # refused with 422 — never stored, never served to a replica.
+        # The inventory above already hashed every member, so this
+        # pass only reads the tiny sidecar texts.
+        pkg.verify_artifact_members(blob, manifest, inventory=files)
         with self._lock:
             model_dir = os.path.join(self.root_dir, name)
             os.makedirs(model_dir, exist_ok=True)
@@ -401,6 +408,10 @@ class ForgeServer(Logger):
                         pass  # 413 already sent
                     except PermissionError as exc:
                         reply(self, {"error": str(exc)}, code=403)
+                    except pkg.TamperedPackageError as exc:
+                        # 422: the request was well-formed but its
+                        # artifact bytes are not what they claim
+                        reply(self, {"error": str(exc)}, code=422)
                     except (ValueError, TypeError, OSError) as exc:
                         reply(self, {"error": str(exc)}, code=400)
                 elif path == "/delete":

@@ -52,6 +52,15 @@ class JitUnit(Unit):
         (or a single tensor when there is one OUTPUT)."""
         raise NotImplementedError
 
+    def install_program(self, fn):
+        """Adopt a caller-provided program as this unit's compute —
+        the AOT artifact loader's seam (``veles_tpu/aot/loader.py``):
+        a deserialized compiled program (wrapped with a live-jit
+        fallback dispatcher) slots in here and ``run()`` uses it
+        unchanged, so a unit's cold start skips tracing entirely."""
+        self._jitted_ = fn
+        return self
+
     @property
     def jitted(self):
         if self._jitted_ is None:

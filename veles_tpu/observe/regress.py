@@ -46,6 +46,11 @@ SCHEMA_VERSION = 1
 #: cold prefill wall — a cache that stops saving work regressed) and
 #: "_flatness" the paged step-time max/min across the length sweep
 #: (docs/paged_kv.md; decode_paged in bench.py).
+#: "_compiles" covers the AOT cold-start section (bench.py
+#: coldstart_section): coldstart_compiles counts live XLA compiles
+#: booked against decode programs during an AOT-booted warmup — its
+#: flat-zero value IS the zero-retrace proof, so any growth regressed;
+#: coldstart_*_ms keys ride the "_ms" rule (docs/aot_artifacts.md).
 #: The fleet mapreduce section's directions (bench.py fleet_section):
 #: fleet_reduce*_ms / fleet_host_baseline_ms / fleet_step_ms regress
 #: UP via "_ms"; fleet_reduce*_bytes regress UP via "_bytes";
@@ -53,7 +58,7 @@ SCHEMA_VERSION = 1
 #: default (and "_mfu"/"_speedup" carry spread siblings below)
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
-                 "_flatness")
+                 "_flatness", "_compiles")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

@@ -433,15 +433,20 @@ def publish_device_stats(registry):
 
 def publish_xla_stats(registry):
     """The full device-truth collector: compile/hit/storm counters, MFU
-    and memory gauges, plus the in-program fleet-reduce plane
+    and memory gauges, the in-program fleet-reduce plane
     (``parallel/mapreduce.py``: reduce steps/bytes per precision tier
-    and the chip-idle-fraction gauge) — registered once per registry by
+    and the chip-idle-fraction gauge), and the AOT artifact plane
+    (``veles_tpu/aot/loader.py``: loaded programs + hit/miss tallies —
+    the flat ``veles_xla_compiles_total`` twin that proves zero
+    retrace) — registered once per registry by
     :func:`ensure_registered`, so every ``/metrics`` mount and every
     fleet slave's piggybacked snapshot carries it."""
     get_compile_tracker().publish(registry)
     publish_device_stats(registry)
     from veles_tpu.parallel.mapreduce import publish_reduce_stats
     publish_reduce_stats(registry)
+    from veles_tpu.aot.loader import publish_aot_stats
+    publish_aot_stats(registry)
 
 
 def ensure_registered(registry=None):

@@ -995,6 +995,35 @@ _SHARDED_SLOT_FNS = {}
 _SHARDED_SLOT_LOCK = threading.Lock()
 
 
+# -- AOT wire format (veles_tpu/aot/) -----------------------------------------
+#
+# jax.export's flatbuffer schema cannot serialize extended PRNG-key
+# dtypes (key<fry>), so every program crossing the AOT artifact boundary
+# carries the slot state's ``req_key`` leaf — and the admit path's
+# ``req_keys`` operand — as raw uint32 key DATA. ``wrap_key_data``/
+# ``key_data`` are bit-level reinterpretations, so wire-format streams
+# stay bit-identical to the live programs' (tests/test_aot.py pins it).
+# One copy of the convention here, next to the state definition; the
+# paged state (parallel/kv_pool.py) shares the leaf name so the same
+# helpers serve both engines.
+
+def wire_slot_state(state):
+    """Slot/paged state with the ``req_key`` leaf as raw uint32 data —
+    the calling convention of every exported slot program."""
+    import jax
+
+    return dict(state, req_key=jax.random.key_data(state["req_key"]))
+
+
+def unwire_slot_state(state):
+    """Invert :func:`wire_slot_state`: re-wrap the raw key data into
+    the typed PRNG keys the live jit surface expects."""
+    import jax
+
+    return dict(state,
+                req_key=jax.random.wrap_key_data(state["req_key"]))
+
+
 def sharded_slot_fns(mesh, mesh_axis="model", quantized=False):
     """The sharded slot engine's jitted call surface: the SAME raw
     functions as the single-chip ``slot_admit_many``/``slot_step``/

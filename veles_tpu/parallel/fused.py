@@ -294,6 +294,37 @@ def _freeze(obj):
 _TICK_CACHE = {}
 
 
+def _tick_key(specs, norm_type, with_confusion, augment, loss_kind,
+              grad_reduce, mesh):
+    """The tick cache key: topology + every engine knob the trace
+    folds in. ONE copy — :func:`build_tick` and the AOT adoption seam
+    (:func:`install_tick_steps`) must agree on it exactly, or a loaded
+    artifact would silently shadow (or miss) the live programs."""
+    from veles_tpu.core.config import root
+    return (_freeze(specs), norm_type, with_confusion, augment,
+            loss_kind, grad_reduce, None if mesh is None else id(mesh),
+            root.common.engine.get("precision_level", 0),
+            str(root.common.engine.get("compute_dtype", "bfloat16")),
+            bool(root.common.engine.get("use_pallas", False)),
+            bool(root.common.engine.get("pallas_epilogue", False)))
+
+
+def install_tick_steps(steps, specs, norm_type="none", mesh=None,
+                       with_confusion=True, augment="none",
+                       loss_kind="softmax", grad_reduce="f32"):
+    """Seed the tick cache for this topology with caller-provided step
+    callables — the seam the AOT loader (``veles_tpu/aot/loader.py``)
+    slots loaded compiled programs into: a later :func:`build_tick`
+    with the same key returns THESE steps, so ``FusedTick`` (and the
+    fleet wrappers above it) run artifact programs unchanged. Returns
+    the previous cache entry (None when the tick was never built)."""
+    key = _tick_key(specs, norm_type, with_confusion, augment,
+                    loss_kind, grad_reduce, mesh)
+    previous = _TICK_CACHE.get(key)
+    _TICK_CACHE[key] = tuple(steps)
+    return previous
+
+
 def build_tick(specs, norm_type="none", mesh=None,
                with_confusion=True, augment="none",
                loss_kind="softmax", grad_reduce="f32"):
@@ -331,15 +362,8 @@ def build_tick(specs, norm_type="none", mesh=None,
     ``mapreduce.fleet_train_step``, which also instruments the
     programs for the /metrics plane.
     """
-    from veles_tpu.core.config import root
-    key = (_freeze(specs), norm_type, with_confusion, augment,
-           loss_kind, grad_reduce, None if mesh is None else id(mesh),
-           # EVERY engine knob the trace folds in: a changed level /
-           # dtype / Pallas opt-in must not reuse a stale compiled tick
-           root.common.engine.get("precision_level", 0),
-           str(root.common.engine.get("compute_dtype", "bfloat16")),
-           bool(root.common.engine.get("use_pallas", False)),
-           bool(root.common.engine.get("pallas_epilogue", False)))
+    key = _tick_key(specs, norm_type, with_confusion, augment,
+                    loss_kind, grad_reduce, mesh)
     cached = _TICK_CACHE.get(key)
     if cached is not None:
         return cached

@@ -644,7 +644,8 @@ class ContinuousDecoder:
                  max_len=512, n_tokens=32, eos=None,
                  temperature=0.0, top_k=0, key=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=False,
-                 page_size=None, pool_pages=None, prefix_cache=None):
+                 page_size=None, pool_pages=None, prefix_cache=None,
+                 aot=None):
         import collections
 
         import jax
@@ -781,6 +782,27 @@ class ContinuousDecoder:
             # single-chip: resolved per call from the module (late
             # binding — the chaos/fault-injection seam tests patch)
             self._sharded_fns = None
+        #: AOT compiled-program bundle (docs/aot_artifacts.md): a
+        #: loaded ``veles_tpu.aot.loader.AotPrograms`` whose bound
+        #: facade serves every covered (bucket, group, span) dispatch
+        #: from pre-compiled StableHLO — ZERO retracing, the live jit
+        #: caches never grow. A geometry mismatch refuses the bundle
+        #: with the stale field named and degrades to live compilation
+        #: (never a wrong-answer execute); uncovered shapes fall back
+        #: per dispatch and count in veles_aot_misses_total.
+        self.aot = None
+        self._aot = None
+        if aot is not None:
+            from veles_tpu.aot.loader import AotCompatError
+            try:
+                self._aot = aot.bind(self)
+                self.aot = aot
+            except AotCompatError as exc:
+                import logging
+                logging.getLogger("ContinuousDecoder").warning(
+                    "AOT bundle refused (stale field %r): %s — "
+                    "serving continues with live compilation",
+                    exc.field, exc)
         self._queue = collections.deque()
         self._free = list(range(slots))
         self._slot_req = {}      # slot -> request id
@@ -878,6 +900,11 @@ class ContinuousDecoder:
     def busy(self):
         return bool(self._queue or self._slot_req)
 
+    @property
+    def aot_active(self):
+        """True while dispatches resolve through a bound AOT bundle."""
+        return self._aot is not None
+
     def done(self, rid):
         """True once request ``rid``'s stream is complete (its tokens
         sit in ``results[rid]``)."""
@@ -939,8 +966,11 @@ class ContinuousDecoder:
 
         from veles_tpu.parallel.decode import slot_admit_many
 
-        admit = (self._sharded_fns[0] if self._sharded_fns
-                 else slot_admit_many)
+        if self._aot is not None:
+            admit = self._aot.admit
+        else:
+            admit = (self._sharded_fns[0] if self._sharded_fns
+                     else slot_admit_many)
         if not (self._queue and self._free):
             return
         groups = {}
@@ -1039,10 +1069,15 @@ class ContinuousDecoder:
 
         from veles_tpu.parallel import kv_pool
 
-        fns = self._paged_fns
-        admit = fns[0] if fns else kv_pool.paged_admit_many
-        admit_tail = fns[1] if fns else kv_pool.paged_admit_tail
-        admit_hit = fns[2] if fns else kv_pool.paged_admit_hit
+        if self._aot is not None:
+            admit = self._aot.paged_admit
+            admit_tail = self._aot.paged_admit_tail
+            admit_hit = self._aot.paged_admit_hit
+        else:
+            fns = self._paged_fns
+            admit = fns[0] if fns else kv_pool.paged_admit_many
+            admit_tail = fns[1] if fns else kv_pool.paged_admit_tail
+            admit_hit = fns[2] if fns else kv_pool.paged_admit_hit
         if not (self._queue and self._free):
             return
         ps = self.pool.page_size
@@ -1288,7 +1323,8 @@ class ContinuousDecoder:
         snapshot = dict(self._slot_req)
         if self.paged:
             from veles_tpu.parallel.kv_pool import paged_slot_step
-            step = (self._paged_fns[3] if self._paged_fns
+            step = (self._aot.paged_step if self._aot is not None
+                    else self._paged_fns[3] if self._paged_fns
                     else paged_slot_step)
             self.state, emitted = step(
                 self.params, self.embed_table, self.heads, self.state,
@@ -1296,7 +1332,8 @@ class ContinuousDecoder:
                 jnp.float32(self.temperature or 1.0),
                 sample=bool(self.temperature), top_k=self.top_k)
         else:
-            step = (self._sharded_fns[1] if self._sharded_fns
+            step = (self._aot.step if self._aot is not None
+                    else self._sharded_fns[1] if self._sharded_fns
                     else slot_step)
             self.state, emitted = step(
                 self.params, self.embed_table, self.heads, self.state,
@@ -1422,7 +1459,9 @@ class ContinuousDecoder:
             if self.paged:
                 from veles_tpu.parallel.kv_pool import \
                     paged_slot_step_many
-                step_many = (self._paged_fns[4] if self._paged_fns
+                step_many = (self._aot.paged_step_many
+                             if self._aot is not None
+                             else self._paged_fns[4] if self._paged_fns
                              else paged_slot_step_many)
                 self.state, emitted = step_many(
                     self.params, self.embed_table, self.heads,
@@ -1431,7 +1470,10 @@ class ContinuousDecoder:
                     jnp.float32(self.temperature or 1.0),
                     sample=bool(self.temperature), top_k=self.top_k)
             else:
-                step_many = (self._sharded_fns[2] if self._sharded_fns
+                step_many = (self._aot.step_many
+                             if self._aot is not None
+                             else self._sharded_fns[2]
+                             if self._sharded_fns
                              else slot_step_many)
                 self.state, emitted = step_many(
                     self.params, self.embed_table, self.heads,
@@ -1549,7 +1591,7 @@ class GenerateAPI:
                  max_queue=None, deadline=None, rebuild_backoff=None,
                  rebuild_backoff_max=None, chaos=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=None,
-                 page_size=None, pool_pages=None):
+                 page_size=None, pool_pages=None, aot=None):
         import queue
 
         from veles_tpu.core.config import root
@@ -1596,13 +1638,48 @@ class GenerateAPI:
             page_size = serve_cfg.get("page_size", None)
         if pool_pages is None:
             pool_pages = serve_cfg.get("pool_pages", None)
+        #: AOT compiled-program boot (--serve-aot PATH /
+        #: root.common.serve.aot — docs/aot_artifacts.md): load the
+        #: bundle ONCE here, so the decoder and every breaker-rebuild
+        #: decoder reuse the same compiled programs (a trip never pays
+        #: a second deserialize+compile). Strict gating: a stale bundle
+        #: (schema / jax / jaxlib / fingerprint / mesh) is refused with
+        #: the stale field named, and serving proceeds on live
+        #: compilation — never a wrong-answer execute.
+        if aot is None:
+            aot_path = serve_cfg.get("aot", None)
+            if aot_path:
+                from veles_tpu.aot.loader import (AotCompatError,
+                                                  load_bundle)
+                try:
+                    aot = load_bundle(aot_path, mesh=mesh)
+                except (AotCompatError, ValueError, OSError) as exc:
+                    import logging
+                    logging.getLogger("GenerateAPI").warning(
+                        "AOT bundle %s refused (%s): %s — serving "
+                        "boots with live compilation instead",
+                        aot_path,
+                        getattr(exc, "field", "unreadable"), exc)
+                    aot = None
+        if aot is not None and aot.chunk is not None \
+                and int(aot.chunk) != int(chunk):
+            # not a refusal — step programs still serve — but the
+            # dominant per-token dispatch program would miss on every
+            # span and live-compile silently, which defeats the boot
+            import logging
+            logging.getLogger("GenerateAPI").warning(
+                "AOT bundle was built for dispatch chunk %d but this "
+                "server drives chunk %d: every chunked dispatch will "
+                "fall back to live compilation (veles_aot_misses_"
+                "total) — rebuild with --chunk %d or pass chunk=%d",
+                aot.chunk, chunk, chunk, aot.chunk)
         self._decoder_kwargs = dict(
             params=params, embed_table=embed_table, heads=heads,
             slots=slots, max_len=max_len, n_tokens=n_tokens,
             temperature=temperature, top_k=top_k, eos=eos, key=key,
             quantize=quantize, tile=tile, mesh=mesh,
             mesh_axis=mesh_axis, paged=bool(paged),
-            page_size=page_size, pool_pages=pool_pages)
+            page_size=page_size, pool_pages=pool_pages, aot=aot)
         self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
         self.port = port

@@ -78,6 +78,11 @@ class _HashingWriter:
     def flush(self):
         self._file.flush()
 
+    def tell(self):
+        # tarfile tracks member offsets through the tee (the AOT
+        # bundle writer streams a whole archive through one hasher)
+        return self._file.tell()
+
     def hexdigest(self):
         return self._digest.hexdigest()
 
