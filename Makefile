@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr aot
+	regress mesh paged fleet-mr aot slo
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -87,6 +87,16 @@ regress:
 	JAX_PLATFORMS=cpu $(PYTHON) -m veles_tpu observe regress \
 		BENCH_r05.json BENCH_r05.json
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_regress.py -q
+
+# Request-truth ledger + SLO suite (docs/observability.md): the
+# bounded per-request ledger's stage-waterfall invariants, SLO
+# burn-rate window math + per-tenant labels, the /debug/requests +
+# fleet-piggyback round trip, AOT dispatch attribution, and the chaos
+# acceptance — a seeded slow-step run burns budget and its autopsy
+# names the stall stage.
+slo:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_reqledger.py \
+		-m slo -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

@@ -19,6 +19,7 @@ the reference's GTX-TITAN GEMM anchor (0.1642 s per 3001² matmul,
 """
 
 import json
+import math
 import os
 import time
 
@@ -1057,7 +1058,17 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
     run's wall clock spent OUTSIDE device-facing calls (dispatch,
     readback, admit) — pure host bookkeeping; near 0 means the device
     queue stays fed. ``quantize`` forwards to the decoder (the int8 /
-    int8-KV slot tiers)."""
+    int8-KV slot tiers).
+
+    Request-latency keys (the request-truth observability PR): a
+    RequestLedger rides the staggered run, so per-request
+    ``decode_continuous_ttft_p50/p95/p99_ms`` (submit -> first token,
+    from the ledger's stage stamps) and
+    ``decode_continuous_tpot_p95_ms`` (per-token chunk-collect
+    cadence) land in the artifact beside tokens/sec — all lower-better
+    under ``make regress``'s ``_ms`` rule."""
+    from veles_tpu.observe.reqledger import RequestLedger
+    from veles_tpu.observe.slo import row_latencies
     from veles_tpu.parallel.transformer_step import (
         init_transformer_params)
     from veles_tpu.serving import ContinuousDecoder
@@ -1072,31 +1083,67 @@ def decode_continuous(slots=8, prompt=512, budget=64, n_requests=16,
     def run():
         # +2 chunks of headroom: the lag-1 pipelined drain lets a
         # finished slot decode one extra chunk before it recycles
+        ledger = RequestLedger(capacity=2 * n_requests)
         dec = ContinuousDecoder(params, table, heads, slots=slots,
                                 max_len=prompt + budget + 2 * chunk,
-                                n_tokens=budget, quantize=quantize)
+                                n_tokens=budget, quantize=quantize,
+                                ledger=ledger)
+        rows = {}
+
+        def submit_one():
+            rid = dec.submit(pending.pop())
+            rows[rid] = ledger.stage(api="bench", prompt_len=prompt,
+                                     budget=budget)
+            dec.ledger_link(rid, rows[rid])
+
+        def progress():
+            # resolve completed rows within one pass of their last
+            # chunk (the tpot fallback spans first_token -> resolved),
+            # then keep the stagger fed
+            for rid in [r for r in rows if dec.done(r)]:
+                ledger.resolve(rows.pop(rid), "completed")
+            if pending:
+                submit_one()
+
         # stagger: half the requests up front, the rest trickle in as
         # chunks complete (joining mid-flight is the tier's point)
         pending = list(prompts)
         for _ in range(min(slots, len(pending))):
-            dec.submit(pending.pop())
+            submit_one()
         t0 = time.perf_counter()
-        dec.drain_pipelined(
-            chunk, admit=lambda: pending and dec.submit(pending.pop()))
+        dec.drain_pipelined(chunk, admit=progress)
         dt = time.perf_counter() - t0
+        for rid in list(rows):
+            ledger.resolve(rows.pop(rid), "completed")
+        latencies = [row_latencies(row)
+                     for row in ledger.slowest(2 * n_requests)]
         return (dec.tokens_out / dt, dt, dict(dec.timings),
-                dict(dec.dispatch_counts))
+                dict(dec.dispatch_counts), latencies)
+
+    def percentile_ms(values, q):
+        if not values:
+            return None
+        ordered = sorted(values)
+        index = min(len(ordered) - 1,
+                    int(math.ceil(q * (len(ordered) - 1))))
+        return round(ordered[index] * 1000.0, 3)
 
     run()  # compile (admit + chunk programs) + warm
     runs = [run() for _ in range(2)]
-    best_rate, wall, timings, dispatch_counts = max(
+    best_rate, wall, timings, dispatch_counts, latencies = max(
         runs, key=lambda r: r[0])
+    ttfts = [t for t, _ in latencies if t is not None]
+    tpots = [t for _, t in latencies if t is not None]
     device_s = sum(timings.values())
     prefix = ("decode_continuous" if not quantize
               else "decode_continuous_" + quantize.replace("-", ""))
     return {prefix + "_tokens_per_sec": round(best_rate, 1),
             prefix + "_spread": round(
                 (best_rate - min(r[0] for r in runs)) / best_rate, 4),
+            prefix + "_ttft_p50_ms": percentile_ms(ttfts, 0.5),
+            prefix + "_ttft_p95_ms": percentile_ms(ttfts, 0.95),
+            prefix + "_ttft_p99_ms": percentile_ms(ttfts, 0.99),
+            prefix + "_tpot_p95_ms": percentile_ms(tpots, 0.95),
             prefix + "_prefill_ms": round(
                 timings["admit_s"] * 1000, 3),
             prefix + "_host_overhead_fraction": round(

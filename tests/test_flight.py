@@ -102,6 +102,32 @@ class TestDump:
         assert ["veles_boxed_total", "counter", [], 3] \
             in doc["metrics"]
 
+    def test_dump_embeds_request_ledger_tail(self, flight_home,
+                                             monkeypatch):
+        """ISSUE 10 satellite: black-box dumps carry the request
+        ledger's tail — in-flight rows plus the slowest resolved — so
+        a post-mortem names requests, not just counters."""
+        import veles_tpu.observe.reqledger as reqledger_mod
+        from veles_tpu.observe.reqledger import RequestLedger
+
+        recorder, _ = flight_home
+        ledger = RequestLedger()
+        monkeypatch.setattr(reqledger_mod, "_ledger", ledger)
+        done = ledger.stage(api="generate-api", trace="aa11",
+                            prompt_len=7)
+        ledger.link(done, 0)
+        ledger.note_admit(done, "dense", group=2, bucket=16)
+        ledger.note_tokens(done, 3)
+        ledger.resolve(done, "completed")
+        live = ledger.stage(api="generate-api", prompt_len=9)
+        doc = load_dump(recorder.dump("with-requests"))
+        requests = doc["requests"]
+        assert [r["id"] for r in requests["inflight"]] == [live["id"]]
+        (slow,) = requests["slowest"]
+        assert slow["outcome"] == "completed" and slow["tokens"] == 3
+        assert [s[0] for s in slow["stages"]] == [
+            "staged", "admitted", "first_token", "resolved"]
+
     def test_dump_is_reentrant_from_the_same_thread(self, flight_home):
         """A repeated SIGTERM re-enters dump() on the main thread while
         a dump is in flight — the lock must be re-entrant or the
@@ -141,13 +167,16 @@ class TestTriggers:
         a loadable black-box dump containing the trip's spans and the
         dispatch tail that led to it."""
         import urllib.request
+        import veles_tpu.observe.reqledger as reqledger_mod
         import veles_tpu.parallel.decode as decode_mod
         from veles_tpu.core.logger import EventRecorder
         from veles_tpu.core import logger as logger_mod
+        from veles_tpu.observe.reqledger import RequestLedger
         from veles_tpu.observe.tracing import get_tracer
         from veles_tpu.serving import GenerateAPI
 
         recorder, _ = flight_home
+        monkeypatch.setattr(reqledger_mod, "_ledger", RequestLedger())
         monkeypatch.setattr(logger_mod, "_event_recorder",
                             EventRecorder())
         tracer = get_tracer()
@@ -189,6 +218,15 @@ class TestTriggers:
                       if e["kind"] == "span"}
         assert "serve.request" in span_names
         assert "serve.submit" in span_names
+        # the trip ships the requests it shed (ISSUE 10 satellite):
+        # the dump runs BEFORE _fail_all, so the victim is still an
+        # in-flight ledger row with its waterfall up to the admit
+        shed = doc["requests"]["inflight"]
+        assert len(shed) == 1, doc["requests"]
+        stages = [s[0] for s in shed[0]["stages"]]
+        assert stages[0] == "staged" and "admitted" in stages
+        assert shed[0]["outcome"] is None
+        assert shed[0]["admit"]["kind"] == "dense"
 
     def test_unhandled_unit_exception_dumps(self, flight_home):
         from veles_tpu.dummy import DummyWorkflow

@@ -294,6 +294,165 @@ class TestConcurrentScrape:
                   if line.startswith("veles_cw_total{"))
         assert got == total
 
+    def test_openmetrics_scrapes_stay_consistent_with_exemplars(self):
+        """ISSUE 10 satellite: the same hammer with exemplar-carrying
+        observations and openmetrics scrapers — every scrape must stay
+        parseable after stripping the exemplar suffixes, buckets
+        monotone, and every exemplar line well-formed."""
+        import re
+
+        exemplar_re = re.compile(
+            r' # \{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\} '
+            r'[-+0-9.eE]+ [-+0-9.eE]+$')
+        registry = MetricsRegistry(enabled=True)
+        stop = threading.Event()
+        failures = []
+
+        def writer(i):
+            n = 0
+            while not stop.is_set():
+                registry.observe(
+                    "veles_om_seconds", 0.002 * (i + 1),
+                    buckets=(0.005, 0.01),
+                    exemplar={"trace_id": "t%d-%d" % (i, n)})
+                n += 1
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    text = registry.expose(openmetrics=True)
+                    assert text.rstrip().endswith("# EOF")
+                    stripped = []
+                    for line in text.splitlines():
+                        if line == "# EOF":
+                            continue
+                        cut = line.find(" # {")
+                        if cut != -1:
+                            assert line.startswith(
+                                "veles_om_seconds_bucket"), line
+                            assert exemplar_re.search(line), line
+                            line = line[:cut]
+                        stripped.append(line)
+                    _assert_valid_exposition("\n".join(stripped) + "\n")
+                except AssertionError as exc:
+                    failures.append(exc)
+                    stop.set()
+                    return
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(3)]
+        threads += [threading.Thread(target=scraper) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.6)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not failures, failures[0]
+
+
+class TestExemplars:
+    """ISSUE 10 satellite: OpenMetrics exemplars on the latency
+    histograms — exemplars appear ONLY on histogram bucket lines, only
+    on openmetrics-negotiated expositions, with the label set bounded
+    per the spec; the plain-Prometheus fallback stays parseable."""
+
+    def _registry(self):
+        registry = MetricsRegistry(enabled=True)
+        registry.observe("veles_ex_seconds", 0.003,
+                         buckets=(0.005, 0.01),
+                         exemplar={"trace_id": "abc123"})
+        registry.observe("veles_ex_seconds", 99.0,
+                         buckets=(0.005, 0.01),
+                         exemplar={"trace_id": "def456"})
+        registry.incr("veles_ex_total", 2)
+        registry.set("veles_ex_gauge", 1.0)
+        return registry
+
+    def test_exemplars_only_on_histogram_buckets(self):
+        text = self._registry().expose(openmetrics=True)
+        exemplar_lines = [line for line in text.splitlines()
+                          if " # {" in line]
+        assert len(exemplar_lines) == 2  # one per bucket hit (incl +Inf)
+        for line in exemplar_lines:
+            assert line.startswith("veles_ex_seconds_bucket"), line
+        assert 'le="0.005"' in exemplar_lines[0] \
+            and 'trace_id="abc123"' in exemplar_lines[0]
+        assert 'le="+Inf"' in exemplar_lines[1] \
+            and 'trace_id="def456"' in exemplar_lines[1]
+        # counters/gauges never carry exemplars, and the exposition
+        # terminates with the OpenMetrics EOF marker
+        for line in text.splitlines():
+            if line.startswith(("veles_ex_total", "veles_ex_gauge")):
+                assert " # {" not in line
+        assert text.rstrip().endswith("# EOF")
+        # OpenMetrics counter FAMILIES drop the _total sample suffix
+        # (a modern Prometheus negotiates openmetrics by default and
+        # would refuse the 0.0.4 spelling); samples keep it
+        assert "# TYPE veles_ex counter" in text
+        assert "# TYPE veles_ex_total counter" not in text
+        assert "\nveles_ex_total 2" in text
+        # ...while the plain exposition keeps the 0.0.4 spelling
+        assert "# TYPE veles_ex_total counter" in \
+            self._registry().expose()
+
+    def test_plain_scrape_fallback_is_parseable(self):
+        text = self._registry().expose()
+        assert " # {" not in text and "# EOF" not in text
+        _assert_valid_exposition(text)
+
+    def test_exemplar_label_set_bounded_and_validated(self):
+        from veles_tpu.observe.metrics import EXEMPLAR_MAX_RUNES
+
+        registry = MetricsRegistry(enabled=True)
+        # oversized label set: the exemplar is DROPPED, the
+        # observation is kept
+        registry.observe("veles_big_seconds", 0.001,
+                         buckets=(0.01,),
+                         exemplar={"trace_id":
+                                   "x" * (EXEMPLAR_MAX_RUNES + 1)})
+        # invalid label name / the reserved "le": dropped too
+        registry.observe("veles_big_seconds", 0.002, buckets=(0.01,),
+                         exemplar={"bad name": "v"})
+        registry.observe("veles_big_seconds", 0.003, buckets=(0.01,),
+                         exemplar={"le": "0.01"})
+        text = registry.expose(openmetrics=True)
+        assert " # {" not in text
+        assert "veles_big_seconds_count 3" in text
+
+    def test_http_accept_negotiation(self, observability):
+        """A scraper advertising application/openmetrics-text gets
+        exemplars + # EOF; a plain scrape of the SAME surface stays
+        0.0.4 text."""
+        import urllib.request
+        from veles_tpu.core.httpd import serve_metrics  # noqa: F401
+        from veles_tpu.observe.metrics import get_metrics_registry
+        from veles_tpu.serving import RESTfulAPI
+        from veles_tpu.dummy import DummyWorkflow
+
+        registry = get_metrics_registry()
+        registry.observe("veles_neg_seconds", 0.002, buckets=(0.01,),
+                         exemplar={"trace_id": "feed01"})
+        api = RESTfulAPI(DummyWorkflow(name="neg-wf"), port=0)
+        api.feed = lambda *a: None
+        api.requests = []
+        api.initialize()
+        try:
+            url = "http://127.0.0.1:%d/metrics" % api.port
+            plain = get(url)
+            assert " # {" not in plain and "# EOF" not in plain
+            req = urllib.request.Request(
+                url, headers={"Accept": "application/openmetrics-text"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                om = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+            assert "application/openmetrics-text" in ctype
+            assert 'trace_id="feed01"' in om
+            assert om.rstrip().endswith("# EOF")
+        finally:
+            api.stop()
+
 
 class TestMetricNamingLint:
     """ISSUE 5 satellite: pin the veles_* token conventions at the
@@ -453,6 +612,53 @@ class TestOverheadGuard:
         # ...bounded, and with ZERO registry/tracer traffic
         assert len(dec.flight.entries()) <= 4
         assert dec.metrics._families == {}
+
+    def test_request_ledger_null_and_default_paths(self):
+        """ISSUE 10: with NO ledger attached (the default) a decoder
+        leaves the process ledger untouched — one attribute check per
+        dispatch; with one attached, a full request costs bounded ring
+        appends only, with ZERO registry/tracer traffic and no lock
+        attribute anywhere on the record path."""
+        from veles_tpu.observe.reqledger import (RequestLedger,
+                                                 get_request_ledger)
+        from veles_tpu.parallel.transformer_step import (
+            init_transformer_params)
+        from veles_tpu.serving import ContinuousDecoder
+        import jax.numpy as jnp
+
+        rng = numpy.random.RandomState(0)
+        params = init_transformer_params(rng, 1, 8, 2, 7)
+        table = jnp.asarray(rng.randn(7, 8).astype(numpy.float32))
+        before = (get_request_ledger().staged_total,
+                  get_request_ledger().resolved_total)
+        dec = ContinuousDecoder(params, table, 2, slots=1, max_len=32,
+                                n_tokens=2)
+        assert dec.ledger is None
+        dec.submit([1, 2])
+        dec.run_until_drained(max_steps=8)
+        assert (get_request_ledger().staged_total,
+                get_request_ledger().resolved_total) == before
+        # attached: rows record through GIL-atomic appends alone — the
+        # ledger holds no lock object at all (the structural guarantee
+        # behind "no locks on the record path")
+        ledger = RequestLedger(capacity=2)
+        assert not any("lock" in attr.lower()
+                       for attr in vars(ledger))
+        dec = ContinuousDecoder(params, table, 2, slots=1, max_len=32,
+                                n_tokens=2, ledger=ledger)
+        dec._tracer = Tracer(enabled=False)
+        dec.metrics = MetricsRegistry(enabled=False)
+        for i in range(4):
+            row = ledger.stage(api="guard", prompt_len=2)
+            dec.ledger_link(dec.submit([1, 2]), row)
+            dec.run_until_drained(max_steps=8)
+            ledger.resolve(row, "completed")
+        assert dec.metrics._families == {}
+        assert len(ledger.slowest(10)) == 2  # ring bounded
+        assert ledger.resolved_total == 4
+        (last,) = ledger.slowest(1)
+        assert [s[0] for s in last["stages"]] == [
+            "staged", "admitted", "first_token", "resolved"]
 
     def test_instrument_disabled_tracker_is_pure_delegation(self):
         from veles_tpu.observe.xla_stats import (CompileTracker,

@@ -186,6 +186,31 @@ class TestCompare:
                   "fleet_inprogram_speedup": 20.0}
         assert regressions(compare(old, better)) == []
 
+    def test_request_latency_and_burn_rate_directions(self):
+        """The request-truth observability keys (ISSUE 10):
+        per-request latency percentiles (decode_continuous_ttft_*/
+        tpot_*_ms) and SLO burn rates are LOWER-better — a slower p99
+        or a hotter error-budget burn regresses even while tokens/sec
+        holds."""
+        old = {"decode_continuous_ttft_p50_ms": 10.0,
+               "decode_continuous_ttft_p95_ms": 25.0,
+               "decode_continuous_ttft_p99_ms": 40.0,
+               "decode_continuous_tpot_p95_ms": 2.0,
+               "serve_slo_burn_rate": 0.5,
+               "decode_continuous_tokens_per_sec": 1000.0}
+        worse = {"decode_continuous_ttft_p50_ms": 20.0,
+                 "decode_continuous_ttft_p95_ms": 50.0,
+                 "decode_continuous_ttft_p99_ms": 80.0,
+                 "decode_continuous_tpot_p95_ms": 4.0,
+                 "serve_slo_burn_rate": 2.0,
+                 "decode_continuous_tokens_per_sec": 1000.0}
+        bad = {f["key"] for f in regressions(compare(old, worse))}
+        assert bad == set(old) - {"decode_continuous_tokens_per_sec"}
+        better = {key: value / 2 if key !=
+                  "decode_continuous_tokens_per_sec" else value
+                  for key, value in old.items()}
+        assert regressions(compare(old, better)) == []
+
     def test_type_change_is_a_regression(self):
         new = dict(self.OLD, decode_step_ms="fast")
         assert regressions(compare(self.OLD, new))[0]["verdict"] \

@@ -1,0 +1,495 @@
+"""Request-truth ledger + SLO engine tests (docs/observability.md,
+ISSUE 10): bounded-memory ring semantics, the stage-ordering invariant
+on a REAL GenerateAPI request, SLO window math and per-tenant labels,
+AOT dispatch attribution, the ``/debug/requests`` + fleet-piggyback
+round trip, and the chaos acceptance — a seeded slow-step run produces
+a nonzero burn rate and an autopsy naming the stall stage. ``make
+slo`` runs this module standalone."""
+
+import json
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.observe.metrics import MetricsRegistry
+from veles_tpu.observe.reqledger import (STAGES, RequestLedger,
+                                         autopsy, format_waterfall,
+                                         widest_gap)
+from veles_tpu.observe.slo import (SLOEngine, observe_request,
+                                   parse_objectives, row_latencies)
+
+pytestmark = pytest.mark.slo
+
+
+def get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def post(url, payload, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+@pytest.fixture(scope="module")
+def model():
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 16, 11
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    return params, table, heads, vocab
+
+
+@pytest.fixture
+def registry(monkeypatch):
+    """Fresh-enough process registry: reset before and after, so the
+    SLO bridge asserts see only this test's families."""
+    from veles_tpu.observe.metrics import get_metrics_registry
+
+    reg = get_metrics_registry()
+    was = reg.enabled
+    reg.reset()
+    reg.enable()
+    yield reg
+    reg.reset()
+    reg.enabled = was
+
+
+def serve_api(model, **kwargs):
+    from veles_tpu.serving import GenerateAPI
+
+    params, table, heads, _ = model
+    kwargs.setdefault("ledger", RequestLedger())
+    return GenerateAPI(params, table, heads, slots=2, max_len=32,
+                       n_tokens=4, chunk=2, port=0, **kwargs)
+
+
+class TestLedgerRing:
+    def test_resolved_ring_bounded_drop_oldest(self):
+        ledger = RequestLedger(capacity=4)
+        for i in range(10):
+            row = ledger.stage(api="t", prompt_len=i)
+            ledger.resolve(row, "completed")
+        slowest = ledger.slowest(100)
+        assert len(slowest) == 4
+        assert {r["prompt_len"] for r in slowest} == {6, 7, 8, 9}
+        assert ledger.resolved_total == 10
+        assert ledger.inflight() == []
+
+    def test_inflight_map_bounded_drop_oldest(self):
+        ledger = RequestLedger(inflight_cap=3)
+        rows = [ledger.stage(api="t", prompt_len=i) for i in range(5)]
+        live = ledger.inflight()
+        assert len(live) == 3
+        assert [r["prompt_len"] for r in live] == [2, 3, 4]
+        assert ledger.dropped_total == 2
+        ledger.link(rows[4], 42)
+        assert rows[4]["rid"] == 42
+
+    def test_chunk_cadence_bounded(self):
+        ledger = RequestLedger(chunk_cap=2)
+        row = ledger.stage(api="t")
+        ledger.link(row, 1)
+        for _ in range(5):
+            ledger.note_tokens(row, 2)
+        assert len(row["chunks"]) == 2
+        assert row["chunks_dropped"] == 3
+        assert row["tokens"] == 10  # counting never stops
+
+    def test_unlinked_row_hooks_are_noops(self):
+        """An unlinked rid resolves to row=None in the decoder's
+        map (direct submits, breaker probes) — every hook is a
+        no-op."""
+        ledger = RequestLedger()
+        ledger.note_admit(None, "dense")
+        ledger.note_tokens(None, 3)
+        assert ledger.inflight() == [] and ledger.slowest(4) == []
+
+    def test_resolve_is_exactly_once(self):
+        ledger = RequestLedger()
+        row = ledger.stage(api="t")
+        ledger.resolve(row, "completed")
+        ledger.resolve(row, "errors", error="late")
+        (resolved,) = ledger.slowest(4)
+        assert resolved["outcome"] == "completed"
+        assert resolved["error"] is None
+        assert ledger.resolved_total == 1
+
+    def test_disabled_ledger_stages_nothing(self):
+        ledger = RequestLedger(enabled=False)
+        assert ledger.stage(api="t") is None
+        ledger.mark(None, "pool_gated")  # None rows never branch
+        ledger.link(None, 1)
+        ledger.resolve(None, "completed")
+        assert ledger.staged_total == 0
+
+
+class TestStageOrdering:
+    def test_real_request_carries_complete_ordered_waterfall(
+            self, model):
+        """The acceptance shape: after a GenerateAPI warmup every
+        request row carries a COMPLETE waterfall — canonical stage
+        order, monotone stamps, a chunk cadence summing to the token
+        budget, dense live-dispatch attribution."""
+        ledger = RequestLedger()
+        api = serve_api(model, ledger=ledger)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            for _ in range(3):
+                body = post(url + "/generate",
+                            {"tokens": [1, 2, 3]},
+                            headers={"X-Veles-Tenant": "acme"})
+                assert len(body["tokens"]) == 4
+        finally:
+            api.stop()
+        rows = ledger.slowest(8)
+        assert len(rows) == 3
+        order = {stage: i for i, stage in enumerate(STAGES)}
+        for row in rows:
+            names = [s[0] for s in row["stages"]]
+            # complete: every canonical stage of the dense path
+            assert names == ["staged", "admitted", "first_token",
+                             "resolved"], names
+            stamps = [s[1] for s in row["stages"]]
+            assert stamps == sorted(stamps)
+            assert [order[n] for n in names] == sorted(
+                order[n] for n in names)
+            assert row["outcome"] == "completed"
+            assert row["tenant"] == "acme"
+            assert row["tokens"] == 4
+            assert sum(c[1] for c in row["chunks"]) == 4
+            assert row["admit"]["kind"] == "dense"
+            assert row["admit"]["program"] == "decode.admit"
+            assert row["quant"] == "bf16"
+            assert row["breaker_gen"] == 0
+            # live-compiled serving: zero aot dispatches, >= admit +
+            # one chunk live
+            assert row["dispatches"]["aot"] == 0
+            assert row["dispatches"]["live"] >= 2
+            ttft, tpot = row_latencies(row)
+            assert ttft is not None and ttft >= 0
+            assert tpot is not None and tpot >= 0
+
+
+class TestSLOWindows:
+    def test_window_math_ratio_budget_and_burn(self):
+        """80 good / 20 bad against a 0.9 availability target in one
+        window: ratio 0.8, burn 2.0 (erring at twice the sustainable
+        rate), budget remaining -1.0 (overdrawn)."""
+        engine = SLOEngine({"availability": 0.9}, windows=(60.0,),
+                           bucket_seconds=10.0)
+        for i in range(100):
+            engine.record(ok=i < 80, now=1000.0 + i * 0.1)
+        (row,) = engine.gauges(now=1010.0)
+        assert row["objective"] == "availability"
+        assert row["window"] == "60s" and row["count"] == 100
+        assert row["ratio"] == pytest.approx(0.8)
+        assert row["burn_rate"] == pytest.approx(2.0)
+        assert row["error_budget_remaining"] == pytest.approx(-1.0)
+
+    def test_rolling_windows_age_out(self):
+        """Bad traffic older than the window stops burning it; the
+        longer window still sees it — the multi-window split."""
+        engine = SLOEngine({"ttft_p95_ms": 100.0},
+                           windows=(60.0, 600.0), bucket_seconds=10.0)
+        for i in range(10):  # old, slow
+            engine.record(ttft_s=0.5, ok=True, now=1000.0 + i)
+        for i in range(10):  # recent, fast
+            engine.record(ttft_s=0.01, ok=True, now=1300.0 + i)
+        rows = {r["window"]: r for r in engine.gauges(now=1310.0)}
+        assert rows["60s"]["ratio"] == pytest.approx(1.0)
+        assert rows["60s"]["burn_rate"] == pytest.approx(0.0)
+        assert rows["600s"]["ratio"] == pytest.approx(0.5)
+        assert rows["600s"]["burn_rate"] == pytest.approx(10.0)
+
+    def test_latency_objective_counts_failures_as_bad(self):
+        """A FAILED request without a latency signal counts AGAINST
+        every latency objective (it never produced its tokens); a
+        COMPLETED request without a tpot signal (single-chunk stream)
+        is simply not counted against tpot."""
+        engine = SLOEngine({"ttft_p95_ms": 100.0, "tpot_p95_ms": 10.0},
+                           windows=(60.0,))
+        engine.record(ttft_s=None, tpot_s=None, ok=False, now=100.0)
+        engine.record(ttft_s=0.01, tpot_s=None, ok=True, now=101.0)
+        rows = {r["objective"]: r for r in engine.gauges(now=102.0)}
+        assert rows["ttft_p95_ms"]["count"] == 2
+        assert rows["ttft_p95_ms"]["ratio"] == pytest.approx(0.5)
+        # only the failure counted: the completed no-signal request
+        # did not, so the tpot ratio is 0/1
+        assert rows["tpot_p95_ms"]["count"] == 1
+        assert rows["tpot_p95_ms"]["ratio"] == pytest.approx(0.0)
+
+    def test_per_tenant_labels_and_cardinality_cap(self):
+        engine = SLOEngine({"availability": 0.99}, windows=(60.0,),
+                           tenant_cap=2)
+        engine.record(ok=True, tenant="a", now=100.0)
+        engine.record(ok=False, tenant="b", now=100.0)
+        engine.record(ok=True, tenant="hostile-1", now=100.0)
+        engine.record(ok=True, tenant="hostile-2", now=100.0)
+        rows = engine.gauges(now=101.0)
+        tenants = {r["tenant"] for r in rows}
+        assert tenants == {None, "a", "b", "other"}
+        aggregate = [r for r in rows if r["tenant"] is None]
+        assert aggregate[0]["count"] == 4
+        registry = MetricsRegistry(enabled=True)
+        engine.publish(registry, now=101.0)
+        text = registry.expose()
+        assert 'veles_slo_burn_rate{objective="availability"' \
+            ',tenant="b",window="60s"}' in text
+        assert 'veles_slo_objective_ratio{objective="availability"' \
+            ',window="60s"} 0.75' in text
+
+    def test_emptied_windows_stop_exporting_stale_gauges(self):
+        """Review finding: publish() REPLACES the sample sets, so a
+        burn rate from an incident two hours ago must not keep firing
+        the pager after traffic stops — the gauges retire with the
+        window, like /healthz's summary."""
+        engine = SLOEngine({"availability": 0.9}, windows=(60.0,))
+        engine.record(ok=False, tenant="acme", now=1000.0)
+        registry = MetricsRegistry(enabled=True)
+        engine.publish(registry, now=1005.0)
+        hot = registry.expose()
+        assert "veles_slo_burn_rate" in hot and 'tenant="acme"' in hot
+        assert engine.summary(now=1005.0)["burn_rate"] > 0
+        engine.publish(registry, now=1000.0 + 7200.0)
+        cold = registry.expose()
+        assert "veles_slo_" not in cold
+        assert engine.summary(now=1000.0 + 7200.0) is None
+
+    def test_objective_parsing_rejects_garbage_naming_the_flag(self):
+        assert parse_objectives(None) == []
+        parsed = parse_objectives("ttft_p95_ms=250, availability=0.999",
+                                  flag="--serve-slo")
+        assert [(o.name, o.target) for o in parsed] == [
+            ("availability", 0.999), ("ttft_p95_ms", 0.95)]
+        assert parsed[1].threshold_s == pytest.approx(0.25)
+        for bad in ("latency=5", "ttft_p95_ms=nope", "ttft_p0_ms=5",
+                    "availability=2", "oops"):
+            with pytest.raises(ValueError, match="--serve-slo"):
+                parse_objectives(bad, flag="--serve-slo")
+
+
+class TestAotAttribution:
+    def test_rows_book_aot_served_dispatches(self, model):
+        """The facade's last-dispatch record flows into the rows: a
+        decoder whose dispatches are served from an AOT bundle books
+        them under ``dispatches.aot`` (the acceptance pairs this with
+        veles_xla_compiles_total staying flat — pinned end to end in
+        tests/test_aot.py)."""
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads, _ = model
+        ledger = RequestLedger()
+        dec = ContinuousDecoder(params, table, heads, slots=1,
+                                max_len=32, n_tokens=4, ledger=ledger)
+
+        class FacadeStub:
+            """Delegates to the live fns, flagging aot-served."""
+
+            def __init__(self, decoder):
+                self._dec = decoder
+                self.last_dispatch = None
+
+            def admit(self, *args, **kwargs):
+                from veles_tpu.parallel.decode import slot_admit_many
+                self.last_dispatch = ("decode.admit", True)
+                return slot_admit_many(*args, **kwargs)
+
+            def step_many(self, *args, **kwargs):
+                from veles_tpu.parallel.decode import slot_step_many
+                self.last_dispatch = ("decode.dispatch", True)
+                return slot_step_many(*args, **kwargs)
+
+        dec._aot = FacadeStub(dec)
+        rid = dec.submit([1, 2, 3])
+        row = ledger.stage(api="aot-test", prompt_len=3)
+        dec.ledger_link(rid, row)
+        dec.run_until_drained(max_steps=8, chunk=2)
+        ledger.resolve(row, "completed")
+        assert row["admit"] == {"kind": "dense", "group": 1,
+                                "bucket": 16, "aot": True,
+                                "program": "decode.admit"}
+        assert row["dispatches"]["aot"] >= 2
+        assert row["dispatches"]["live"] == 0
+        assert row["tokens"] == 4
+
+
+class TestDebugSurfaceAndPiggyback:
+    def test_debug_requests_and_slo_piggyback_round_trip(
+            self, model, registry):
+        """The surface pair: ``GET /debug/requests`` returns the live
+        ledger view; the SLO gauges land in the process registry's
+        snapshot (the EXACT payload a fleet slave piggybacks on update
+        frames) and re-export slave-labeled on a master registry."""
+        from veles_tpu.observe.metrics import COUNTER
+
+        engine = SLOEngine({"ttft_p95_ms": 10000.0,
+                            "availability": 0.999})
+        api = serve_api(model, slo=engine)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            for _ in range(2):
+                post(url + "/generate", {"tokens": [1, 2]},
+                     headers={"X-Veles-Tenant": "acme"})
+            dbg = json.loads(get(url + "/debug/requests?n=1"))
+            assert dbg["resolved_total"] == 2
+            assert len(dbg["slowest"]) == 1  # ?n= honored
+            row = dbg["slowest"][0]
+            assert [s[0] for s in row["stages"]] == [
+                "staged", "admitted", "first_token", "resolved"]
+            assert row["tenant"] == "acme"
+            # the SLO gauges ride the piggyback payload...
+            snapshot = registry.snapshot()
+            slo_rows = [r for r in snapshot
+                        if str(r[0]).startswith("veles_slo_")]
+            names = {r[0] for r in slo_rows}
+            assert names == {"veles_slo_objective_ratio",
+                             "veles_slo_error_budget_remaining",
+                             "veles_slo_burn_rate"}
+            tenants = {dict(r[2]).get("tenant") for r in slo_rows}
+            assert "acme" in tenants
+        finally:
+            api.stop()
+        # ...and re-export slave-labeled on the master side (the
+        # publish_fleet ingestion rule, payload-level round trip)
+        master = MetricsRegistry(enabled=True)
+        for name, kind, labels, value in slo_rows:
+            merged = dict(labels)
+            merged["slave"] = "s1"
+            if kind == COUNTER:
+                master.counter_set(name, value, labels=merged)
+            else:
+                master.set(name, value, labels=merged)
+        text = master.expose()
+        assert 'veles_slo_burn_rate{objective="availability"' in text
+        assert 'slave="s1"' in text
+
+    def test_healthz_shows_tpot_and_burn(self, model, registry):
+        engine = SLOEngine({"availability": 0.5})
+        api = serve_api(model, slo=engine)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            post(url + "/generate", {"tokens": [1, 2, 3]})
+            health = json.loads(get(url + "/healthz"))
+            assert "tpot" in health["latency_ms"]
+            assert health["latency_ms"]["tpot"]["count"] >= 1
+            assert health["slo"]["objective"] == "availability"
+            assert health["slo"]["burn_rate"] == 0.0
+            # the request histograms carry the api label
+            metrics = get(url + "/metrics")
+            assert 'veles_request_ttft_seconds_count' \
+                '{api="generate-api"} 1' in metrics
+            assert 'veles_request_tpot_seconds_count' \
+                '{api="generate-api"} 1' in metrics
+        finally:
+            api.stop()
+
+
+class TestChaosAutopsy:
+    def test_slow_step_chaos_burns_budget_and_names_the_stall(
+            self, model, registry, tmp_path, capsys):
+        """The ISSUE acceptance: a seeded slow-step chaos run produces
+        a NONZERO veles_slo_burn_rate, and the slowest-request autopsy
+        waterfall names the injected stall stage (a decode-side gap —
+        never the staging bookkeeping)."""
+        from veles_tpu.observe.trace_export import main as observe_main
+        from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                             ServingChaosMonkey)
+
+        chaos = ServingChaosMonkey(ServingChaosConfig(
+            seed=3, slow_step=1.0, slow_step_ms=40.0))
+        engine = SLOEngine({"ttft_p95_ms": 1.0})  # unmeetable
+        ledger = RequestLedger()
+        api = serve_api(model, slo=engine, ledger=ledger, chaos=chaos)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d" % api.port
+            post(url + "/generate", {"tokens": [1, 2]})  # warm compile
+            for _ in range(2):
+                post(url + "/generate", {"tokens": [1, 2, 3]})
+            assert chaos.counters["steps_slowed"] > 0
+            metrics = get(url + "/metrics")
+            burn = [line for line in metrics.splitlines()
+                    if line.startswith("veles_slo_burn_rate")
+                    and 'objective="ttft_p95_ms"' in line
+                    and 'window="60s"' in line
+                    and "tenant" not in line]
+            assert burn, metrics
+            assert float(burn[0].rsplit(" ", 1)[1]) > 0
+            saved = tmp_path / "requests.json"
+            saved.write_text(get(url + "/debug/requests"))
+        finally:
+            api.stop()
+        # the post-warmup rows stall in the decode path, not staging
+        row = ledger.slowest(8)[-1]  # the fastest = a warmed request
+        label, ms = widest_gap(row)
+        stall_end = label.split("→")[1]
+        assert stall_end in ("admitted", "first_token", "resolved") \
+            or stall_end.startswith("decode["), (label, ms)
+        assert stall_end != "pool_gated"
+        assert ms >= 30.0, (label, ms)
+        text = format_waterfall(row)
+        assert "<-- stall" in text and stall_end in text
+        # the autopsy CLI reads the saved /debug/requests payload
+        assert observe_main(["slo", str(saved)]) == 0
+        out = capsys.readouterr().out
+        assert "slowest resolved" in out
+        assert "<-- stall" in out
+
+    def test_web_status_cell_renders_burn_and_tpot(self):
+        """The dashboard satellite: the serving cell shows the worst
+        short-window burn rate and the new tpot p95 beside the
+        survival counters."""
+        from veles_tpu.web_status import format_serving_health
+
+        cell = format_serving_health({
+            "ready": True,
+            "latency_ms": {"tpot": {"p50": 1.2, "p95": 3.4,
+                                    "count": 9}},
+            "slo": {"burn_rate": 2.3, "objective": "ttft_p95_ms",
+                    "window": "60s"}})
+        assert "tpot p95 3.4ms" in cell
+        assert "burn 2.3x (ttft_p95_ms/60s)" in cell
+        # no slo summary, no burn cell — never a "burn 0.0x" banner
+        assert "burn" not in format_serving_health({"ready": True})
+
+    def test_cli_reads_blackbox_dumps(self, tmp_path, capsys):
+        """``observe slo`` also autopsies flight-recorder dumps (the
+        breaker-trip artifact): rows + any veles_slo_* metric rows."""
+        import veles_tpu.observe.reqledger as reqledger_mod
+        from veles_tpu.observe.flight import FlightRecorder
+        from veles_tpu.observe.trace_export import main as observe_main
+
+        ledger = RequestLedger()
+        saved_ledger = reqledger_mod._ledger
+        reqledger_mod._ledger = ledger
+        try:
+            row = ledger.stage(api="generate-api", trace="fade01",
+                               prompt_len=4)
+            ledger.link(row, 0)
+            ledger.note_admit(row, "dense", group=1, bucket=16)
+            ledger.note_tokens(row, 2)
+            ledger.resolve(row, "shed", error="breaker open")
+            recorder = FlightRecorder()
+            path = recorder.dump(
+                "breaker_trip", path=str(tmp_path / "box.json"))
+        finally:
+            reqledger_mod._ledger = saved_ledger
+        assert observe_main(["slo", path]) == 0
+        out = capsys.readouterr().out
+        assert "outcome=shed" in out
+        assert "trace=fade01" in out
+        assert observe_main(["slo", str(tmp_path / "nope.json")]) == 1

@@ -205,6 +205,16 @@ class Main(Logger):
                            "ceil((max_len + 2*n_tokens)/page_size) + 1 "
                            "— sized for dispatch chunks up to "
                            "n_tokens)")
+        serve.add_argument("--serve-slo", default=None,
+                           metavar="OBJ=TARGET[,OBJ=TARGET...]",
+                           help="SLO objectives for the request "
+                           "ledger, e.g. --serve-slo ttft_p95_ms=250,"
+                           "tpot_p95_ms=50,availability=0.999 — "
+                           "evaluated over multi-window rolling "
+                           "buckets and exported as veles_slo_* "
+                           "burn-rate gauges "
+                           "(root.common.observe.slo; "
+                           "docs/observability.md)")
         serve.add_argument("--chaos-serve-seed", type=int, default=None,
                            metavar="N", help="serving chaos RNG seed")
         serve.add_argument("--chaos-serve-step-fail", type=float,
@@ -495,6 +505,15 @@ class Main(Logger):
                 parser.error(str(exc))
             for axis, size in overrides.items():
                 setattr(root.common.mesh.axes, axis, size)
+        if args.serve_slo:
+            # validate NOW (same early-failure contract as --mesh); the
+            # string lands in root.common.observe.slo below and the
+            # SLO engine re-parses it at GenerateAPI construction
+            from veles_tpu.observe.slo import parse_objectives
+            try:
+                parse_objectives(args.serve_slo, flag="--serve-slo")
+            except ValueError as exc:
+                parser.error(str(exc))
         if args.serve_mesh:
             # validate NOW (same early-failure contract as --mesh); the
             # string itself lands in config below and GenerateAPI
@@ -526,6 +545,7 @@ class Main(Logger):
                 ("serve_page_size", root.common.serve, "page_size"),
                 ("serve_pool_pages", root.common.serve, "pool_pages"),
                 ("serve_aot", root.common.serve, "aot"),
+                ("serve_slo", root.common.observe, "slo"),
                 ("chaos_serve_seed", root.common.serve.chaos, "seed"),
                 ("chaos_serve_step_fail", root.common.serve.chaos,
                  "step_fail"),

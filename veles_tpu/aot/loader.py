@@ -373,6 +373,11 @@ class _BoundAot:
     def __init__(self, programs, decoder):
         self._programs = programs
         self._decoder = weakref.ref(decoder)
+        #: (program_name, served_from_aot) of the most recent dispatch
+        #: through this facade — the request ledger's per-dispatch
+        #: aot/live attribution seam (read by the decoder right after
+        #: the call returns, single driver thread)
+        self.last_dispatch = None
 
     # live fallback resolvers (the decoder's own late-binding rules)
     def _live_dense(self, index, module_name):
@@ -399,8 +404,10 @@ class _BoundAot:
         compiled = self._programs.program(name, key)
         if compiled is None:
             self._programs._book_miss(name)
+            self.last_dispatch = (name, False)
             return fallback()
         self._programs._book_hit(name)
+        self.last_dispatch = (name, True)
         out = compiled(*wire_args)
         if state_only:
             return unwire_slot_state(out)
@@ -472,6 +479,7 @@ class _BoundAot:
         tail bucket) is unbounded at build time — always the live
         path, counted as a miss so the fallback is observable."""
         self._programs._book_miss("paged.admit_tail")
+        self.last_dispatch = ("paged.admit_tail", False)
         return self._live_paged(1, "paged_admit_tail")(
             params, embed_table, heads, state, slots, prefix_pages,
             tail_pages, tail_x, req_keys, lengths)

@@ -115,7 +115,14 @@ def serve_metrics(handler, registry=None):
     ``registry`` (default: the process-global one). Returns True when
     the path was handled. The first mount enables the registry — until
     some surface can actually be scraped, every ``incr``/``observe``
-    in the hot paths stays a structural no-op."""
+    in the hot paths stays a structural no-op.
+
+    Content negotiation: a scraper advertising
+    ``application/openmetrics-text`` in ``Accept`` gets the OpenMetrics
+    rendering — histogram bucket EXEMPLARS (trace-id links on the
+    request-latency families, docs/observability.md) and the ``# EOF``
+    terminator; everyone else gets the plain 0.0.4 text exposition, so
+    exemplars can never break a legacy scraper."""
     path = handler.path.split("?")[0]
     if path != "/metrics":
         return False
@@ -127,8 +134,36 @@ def serve_metrics(handler, registry=None):
     # turns on and the XLA/memory/MFU collector attaches (idempotent)
     from veles_tpu.observe.xla_stats import ensure_registered
     ensure_registered(registry)
-    reply(handler, registry.expose(),
-          content_type="text/plain; version=0.0.4; charset=utf-8")
+    accept = str(getattr(handler, "headers", {}).get("Accept") or "")
+    if "application/openmetrics-text" in accept:
+        reply(handler, registry.expose(openmetrics=True),
+              content_type="application/openmetrics-text; "
+                           "version=1.0.0; charset=utf-8")
+    else:
+        reply(handler, registry.expose(),
+              content_type="text/plain; version=0.0.4; charset=utf-8")
+    return True
+
+
+def serve_debug_requests(handler, ledger=None):
+    """Route ``GET /debug/requests``: the request-truth ledger's live
+    view — in-flight rows plus the N slowest resolved (``?n=``, default
+    8, capped 64) as JSON (``observe/reqledger.py``). Mounted on every
+    serving surface beside ``/healthz``; returns True when handled."""
+    path, _, query = handler.path.partition("?")
+    if path != "/debug/requests":
+        return False
+    if ledger is None:
+        from veles_tpu.observe.reqledger import get_request_ledger
+        ledger = get_request_ledger()
+    n = 8
+    for part in query.split("&"):
+        if part.startswith("n="):
+            try:
+                n = max(1, min(64, int(part[2:])))
+            except ValueError:
+                pass
+    reply(handler, ledger.debug_snapshot(slowest=n))
     return True
 
 

@@ -371,11 +371,17 @@ class Client(Logger):
                     # coordinates (process index + active mesh shape)
                     # so a master scrape distinguishes the SHARDS of a
                     # pod-mode slave, not just the slaves.
+                    from veles_tpu.observe.slo import (
+                        ensure_slo_registered)
                     from veles_tpu.observe.xla_stats import (
                         ensure_registered)
                     from veles_tpu.parallel.mesh import (
                         mesh_coordinate_labels)
                     ensure_registered(registry)
+                    # a serving slave's SLO gauges ride the same
+                    # snapshot: the master re-exports its burn rates
+                    # slave-labeled, like the mesh/device rows
+                    ensure_slo_registered(registry)
                     coords = sorted(mesh_coordinate_labels().items())
                     frame["metrics"] = [
                         [name, kind,

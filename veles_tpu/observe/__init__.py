@@ -17,6 +17,14 @@ Three coordinated parts (docs/observability.md):
 - :mod:`veles_tpu.observe.xla_stats` — device truth: XLA compile/cache
   counters with recompilation-storm detection, per-device memory
   gauges, online MFU from ``cost_analysis`` FLOPs;
+- :mod:`veles_tpu.observe.reqledger` — request truth: the bounded
+  lock-free per-request ledger (stage waterfalls + dispatch/KV/compile
+  attribution) behind ``GET /debug/requests``, the ``veles_tpu observe
+  slo`` autopsy CLI and the black-box request tails;
+- :mod:`veles_tpu.observe.slo` — the SLO engine: configurable
+  objectives over multi-window rolling buckets exported as
+  ``veles_slo_*`` burn-rate gauges (per-tenant slices, fleet
+  piggyback), plus the exemplar-linked request latency histograms;
 - :mod:`veles_tpu.observe.flight` — the always-on bounded flight
   recorder that dumps a black-box JSON on breaker trips, epoch fences,
   unit exceptions and SIGTERM (``veles_tpu observe blackbox``);
@@ -38,6 +46,10 @@ from veles_tpu.observe.metrics import (  # noqa: F401
     DEFAULT_BUCKETS, MetricsRegistry, bridge, get_metrics_registry,
     publish_decoder, publish_fleet, publish_loader,
     publish_serving_health)
+from veles_tpu.observe.reqledger import (  # noqa: F401
+    RequestLedger, get_request_ledger)
+from veles_tpu.observe.slo import (  # noqa: F401
+    SLOEngine, get_slo_engine, observe_request, parse_objectives)
 from veles_tpu.observe.tracing import (  # noqa: F401
     NULL_SPAN, TRACE_HEADER, Tracer, current_context,
     format_trace_header, get_tracer, parse_trace_field,

@@ -120,6 +120,18 @@ class FlightRecorder:
                 doc["xla"] = tracker.snapshot()
         except Exception:
             pass
+        try:
+            # the request-truth tail (observe/reqledger.py): a breaker
+            # trip dumps BEFORE shedding, so the in-flight rows here
+            # are exactly the requests the trip is about to shed —
+            # the autopsy names them instead of a bare counter
+            from veles_tpu.observe.reqledger import get_request_ledger
+            ledger = get_request_ledger()
+            if ledger.enabled and (ledger.staged_total
+                                   or ledger.resolved_total):
+                doc["requests"] = ledger.debug_snapshot(slowest=16)
+        except Exception:
+            pass
         with self._dump_lock:
             try:
                 if path is None:

@@ -32,6 +32,7 @@ import logging
 import math
 import re
 import threading
+import time
 import weakref
 
 #: valid exposition tokens (the Prometheus data model): metric names
@@ -45,6 +46,12 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0)
 
 COUNTER, GAUGE, HISTOGRAM = "counter", "gauge", "histogram"
+
+#: OpenMetrics bound on an exemplar's label set: the total character
+#: count of all label names + values must not exceed this (the spec's
+#: 128-rune rule); oversized exemplars are DROPPED, never truncated
+#: (a truncated trace id links to nothing)
+EXEMPLAR_MAX_RUNES = 128
 
 
 def _escape_help(text):
@@ -176,11 +183,55 @@ class MetricsRegistry:
             if family is not None:
                 family.samples[self._key(labels)] = value
 
-    def observe(self, name, value, labels=None, buckets=None, help=None):
+    def set_gauge_family(self, name, rows, help=None):
+        """Atomically REPLACE a gauge family's whole sample set with
+        ``rows`` (``[(labels_dict, value)]``) — the publisher mode for
+        windowed sources (the SLO engine): a series the source no
+        longer reports must STOP being exported, not freeze at its
+        last value forever. An empty ``rows`` retires the family."""
+        if not self.enabled:
+            return
+        with self._lock:
+            family = self._family(name, GAUGE, help)
+            if family is None:
+                return
+            family.samples = {self._key(labels): value
+                              for labels, value in rows}
+            if not family.samples:
+                del self._families[name]
+
+    @staticmethod
+    def _valid_exemplar(exemplar):
+        """Validate an exemplar label dict (OpenMetrics rules): valid
+        label names, never ``le``, total runes bounded. Returns the
+        sorted label tuple or None (drop — an invalid exemplar must
+        never drop the OBSERVATION it rides)."""
+        if not isinstance(exemplar, dict) or not exemplar:
+            return None
+        runes = 0
+        pairs = []
+        for key in sorted(exemplar):
+            value = str(exemplar[key])
+            if not isinstance(key, str) or not LABEL_NAME_RE.match(key) \
+                    or key == "le":
+                return None
+            runes += len(key) + len(value)
+            pairs.append((key, value))
+        if runes > EXEMPLAR_MAX_RUNES:
+            return None
+        return tuple(pairs)
+
+    def observe(self, name, value, labels=None, buckets=None, help=None,
+                exemplar=None):
         """Record one observation into a fixed-bucket histogram.
         ``buckets`` binds on first use of the family and is immutable
         after (Prometheus semantics: bucket layout is part of the
-        family identity)."""
+        family identity). ``exemplar`` optionally attaches an
+        OpenMetrics exemplar label dict (e.g. ``{"trace_id": ...}``) to
+        the bucket this observation lands in — kept latest-wins per
+        bucket, exposed ONLY on openmetrics-negotiated scrapes
+        (:meth:`expose` with ``openmetrics=True``) so plain Prometheus
+        text scrapes stay parseable."""
         if not self.enabled:
             return
         with self._lock:
@@ -190,12 +241,19 @@ class MetricsRegistry:
             if family is None:
                 return
             slot = family.hist_slot(self._key(labels), family.buckets)
+            index = len(family.buckets)  # the +Inf bucket
             for i, bound in enumerate(family.buckets):
                 if value <= bound:
                     slot["buckets"][i] += 1
+                    index = i
                     break
             slot["sum"] += value
             slot["count"] += 1
+            if exemplar is not None:
+                pairs = self._valid_exemplar(exemplar)
+                if pairs is not None:
+                    slot.setdefault("exemplars", {})[index] = (
+                        pairs, float(value), time.time())
 
     # -- collectors -------------------------------------------------------
     def add_collector(self, fn):
@@ -298,29 +356,58 @@ class MetricsRegistry:
         return out
 
     # -- exposition -------------------------------------------------------
-    def expose(self):
-        """The Prometheus text exposition (format version 0.0.4)."""
+    @staticmethod
+    def _exemplar_str(slot, index):
+        """The OpenMetrics exemplar suffix for bucket ``index`` (or ""):
+        `` # {label="value"} observed_value timestamp``."""
+        entry = (slot.get("exemplars") or {}).get(index)
+        if entry is None:
+            return ""
+        pairs, value, stamp = entry
+        return " # {%s} %s %s" % (
+            ",".join('%s="%s"' % (k, _escape_label(v))
+                     for k, v in pairs),
+            _format_value(value), _format_value(round(stamp, 3)))
+
+    def expose(self, openmetrics=False):
+        """The Prometheus text exposition (format version 0.0.4).
+        ``openmetrics=True`` (Accept-header negotiated by
+        ``core/httpd.serve_metrics``) additionally renders histogram
+        bucket exemplars and the ``# EOF`` terminator — the gate that
+        keeps plain-Prometheus scrapes parseable."""
         self._run_collectors()
         lines = []
         with self._lock:
             for name, family in sorted(self._families.items()):
+                # OpenMetrics names counter FAMILIES without the
+                # _total sample suffix — a negotiated scrape with the
+                # 0.0.4 spelling would fail to parse on a modern
+                # Prometheus (which advertises openmetrics by default)
+                family_name = (name[:-len("_total")]
+                               if openmetrics and family.kind == COUNTER
+                               and name.endswith("_total") else name)
                 if family.help:
                     lines.append("# HELP %s %s"
-                                 % (name, _escape_help(family.help)))
-                lines.append("# TYPE %s %s" % (name, family.kind))
+                                 % (family_name,
+                                    _escape_help(family.help)))
+                lines.append("# TYPE %s %s" % (family_name, family.kind))
                 if family.kind == HISTOGRAM:
                     for key, slot in sorted(family.samples.items()):
                         cum = 0
-                        for bound, n in zip(family.buckets,
-                                            slot["buckets"]):
+                        for i, (bound, n) in enumerate(
+                                zip(family.buckets, slot["buckets"])):
                             cum += n
                             labels = list(key) + [
                                 ("le", _format_value(float(bound)))]
-                            lines.append("%s_bucket%s %d" % (
-                                name, _label_str(labels), cum))
+                            lines.append("%s_bucket%s %d%s" % (
+                                name, _label_str(labels), cum,
+                                self._exemplar_str(slot, i)
+                                if openmetrics else ""))
                         labels = list(key) + [("le", "+Inf")]
-                        lines.append("%s_bucket%s %d" % (
-                            name, _label_str(labels), slot["count"]))
+                        lines.append("%s_bucket%s %d%s" % (
+                            name, _label_str(labels), slot["count"],
+                            self._exemplar_str(slot, len(family.buckets))
+                            if openmetrics else ""))
                         lines.append("%s_sum%s %s" % (
                             name, _label_str(list(key)),
                             _format_value(slot["sum"])))
@@ -331,6 +418,8 @@ class MetricsRegistry:
                         lines.append("%s%s %s" % (
                             name, _label_str(list(key)),
                             _format_value(value)))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

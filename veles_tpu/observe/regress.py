@@ -51,6 +51,12 @@ SCHEMA_VERSION = 1
 #: booked against decode programs during an AOT-booted warmup — its
 #: flat-zero value IS the zero-retrace proof, so any growth regressed;
 #: coldstart_*_ms keys ride the "_ms" rule (docs/aot_artifacts.md).
+#: The request-truth observability keys (observe/reqledger.py +
+#: observe/slo.py): bench's per-request decode_continuous_ttft_p50/
+#: p95/p99_ms and decode_continuous_tpot_p95_ms ride the "_ms" rule
+#: (latency percentiles regress UP); "burn_rate" covers any exported
+#: SLO burn-rate key (veles_slo_burn_rate snapshots in artifacts) —
+#: burning MORE error budget is always a regression.
 #: The fleet mapreduce section's directions (bench.py fleet_section):
 #: fleet_reduce*_ms / fleet_host_baseline_ms / fleet_step_ms regress
 #: UP via "_ms"; fleet_reduce*_bytes regress UP via "_bytes";
@@ -58,7 +64,7 @@ SCHEMA_VERSION = 1
 #: default (and "_mfu"/"_speedup" carry spread siblings below)
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
-                 "_flatness", "_compiles")
+                 "_flatness", "_compiles", "burn_rate")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

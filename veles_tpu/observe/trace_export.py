@@ -145,6 +145,20 @@ def main(argv=None):
     blackbox.add_argument("--tail", type=int, default=20,
                           help="ring entries to show from the newest "
                                "dump (default 20)")
+    slo = sub.add_parser(
+        "slo",
+        help="waterfall autopsy of the slowest requests from a "
+             "black-box dump / saved /debug/requests JSON, or live "
+             "from a serving URL (observe/slo.py, observe/"
+             "reqledger.py)")
+    slo.add_argument("artifact", nargs="?", default=None,
+                     help="black-box dump or /debug/requests JSON")
+    slo.add_argument("--live", default=None, metavar="URL",
+                     help="fetch <URL>/debug/requests (+ the SLO "
+                          "gauges off <URL>/metrics) instead of a "
+                          "file")
+    slo.add_argument("--slowest", type=int, default=8,
+                     help="resolved requests to autopsy (default 8)")
     regress = sub.add_parser(
         "regress",
         help="compare two BENCH artifacts with spread-aware per-key "
@@ -160,6 +174,12 @@ def main(argv=None):
     if args.command == "blackbox":
         from veles_tpu.observe.flight import blackbox_main
         return blackbox_main(args.path, tail=args.tail)
+    if args.command == "slo":
+        if not args.artifact and not args.live:
+            parser.error("observe slo needs an ARTIFACT or --live URL")
+        from veles_tpu.observe.slo import slo_main
+        return slo_main(args.artifact, live=args.live,
+                        slowest=args.slowest)
     if args.command == "regress":
         from veles_tpu.observe.regress import compare_main
         return compare_main(args.old, args.new,

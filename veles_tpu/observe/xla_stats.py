@@ -163,6 +163,10 @@ class CompileTracker:
         self._flops = {}            # name -> latest program FLOPs
         self._step_ema = {}         # name -> EMA of step seconds
         self._step_count = {}       # name -> observations
+        #: recent compile windows (name, start_mono, end_mono) — the
+        #: request ledger intersects these with a request's lifetime
+        #: to attribute a latency spike to the compile that caused it
+        self._windows = deque(maxlen=256)
 
     def enable(self):
         self.enabled = True
@@ -196,6 +200,7 @@ class CompileTracker:
                 stamps = self._stamps[name] = deque(
                     maxlen=self.STORM_THRESHOLD)
             now = time.monotonic()
+            self._windows.append((name, now - float(seconds), now))
             stamps.append(now)
             if len(stamps) == self.STORM_THRESHOLD \
                     and now - stamps[0] <= self.STORM_WINDOW:
@@ -227,6 +232,20 @@ class CompileTracker:
             self._step_ema[name] = seconds if ema is None else (
                 (1 - self.STEP_EMA) * ema + self.STEP_EMA * seconds)
             self._step_count[name] = self._step_count.get(name, 0) + 1
+
+    def compiles_overlapping(self, t0, t1):
+        """Compile windows intersecting the monotonic interval
+        ``[t0, t1]`` as ``[(program, overlap_seconds)]`` — how the
+        request ledger names the compile stall that stretched a
+        request (``observe/reqledger.py``)."""
+        with self._lock:
+            windows = list(self._windows)
+        out = []
+        for name, start, end in windows:
+            overlap = min(end, t1) - max(start, t0)
+            if overlap > 0:
+                out.append((name, overlap))
+        return out
 
     def set_program_flops(self, name, flops):
         """Pin a program's FLOPs explicitly (callers with analytic

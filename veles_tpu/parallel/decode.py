@@ -673,6 +673,16 @@ slot_step = instrument("decode.step", slot_step)
 slot_step_many = instrument("decode.dispatch", slot_step_many)
 
 
+def dispatch_program(fn, default):
+    """The instrumented program name of a dispatch callable — the
+    per-dispatch attribution key the request ledger records
+    (``observe/reqledger.py``). ``instrument()`` stamps
+    ``program_name`` on every wrapped slot program (live, sharded and
+    paged alike); raw callables (a chaos monkeypatch, a bare jit) fall
+    back to the call-family ``default`` so attribution never raises."""
+    return getattr(fn, "program_name", default)
+
+
 # -- tensor-parallel decode (Megatron-style weight sharding) ------------------
 
 def _repack_block(blk, heads):

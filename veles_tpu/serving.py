@@ -46,7 +46,10 @@ from veles_tpu.observe.flight import get_flight_recorder
 from veles_tpu.observe.metrics import (bridge, get_metrics_registry,
                                        publish_decoder,
                                        publish_serving_health)
+from veles_tpu.observe.reqledger import get_request_ledger
+from veles_tpu.observe.slo import get_slo_engine, observe_request
 from veles_tpu.observe.tracing import (NULL_SPAN, TRACE_HEADER,
+                                       current_context,
                                        format_trace_header, get_tracer,
                                        parse_trace_header)
 from veles_tpu.observe.xla_stats import get_compile_tracker
@@ -242,15 +245,17 @@ class ServingHealth:
 
     Latency accounting: :meth:`record_latency` feeds per-kind rolling
     windows (``ttft`` — staged to first generated token on the host;
-    ``queue_wait`` — staged to admitted into a decoder slot), and the
-    snapshot exposes their p50/p95 in milliseconds, so the
-    prefill/admission path's cost is observable on ``/healthz`` and
+    ``tpot`` — time per output token, fed from the chunk collect
+    cadence via the request ledger; ``queue_wait`` — staged to
+    admitted into a decoder slot), and the snapshot exposes their
+    p50/p95 in milliseconds, so the prefill/admission path's cost AND
+    the steady-state token cadence are observable on ``/healthz`` and
     the web-status serving column, not just in bench runs."""
 
     COUNTERS = ("admitted", "completed", "rejected", "expired", "shed",
                 "trips", "rebuilds", "errors")
     #: rolling-window latency kinds exposed as p50/p95 on /healthz
-    LATENCY_KINDS = ("ttft", "queue_wait")
+    LATENCY_KINDS = ("ttft", "tpot", "queue_wait")
     #: rolling-window size per latency kind
     LATENCY_WINDOW = 512
 
@@ -264,6 +269,7 @@ class ServingHealth:
         self._inflight = 0
         self._counters = {key: 0 for key in self.COUNTERS}
         self._pool_ref = None
+        self._slo_ref = None
         self._latencies = {
             kind: collections.deque(maxlen=self.LATENCY_WINDOW)
             for kind in self.LATENCY_KINDS}
@@ -288,6 +294,23 @@ class ServingHealth:
     def incr(self, key, n=1):
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + n
+
+    def counter(self, key):
+        """One counter's current value (the request ledger stamps
+        ``rebuilds`` as the row's breaker generation)."""
+        with self._lock:
+            return self._counters.get(key, 0)
+
+    def attach_slo(self, engine):
+        """Mirror an SLO engine's worst short-window burn rate into the
+        health snapshot (weakly referenced, like the pool) so the
+        web-status serving cell shows budget burn beside the survival
+        counters."""
+        import weakref
+
+        with self._lock:
+            self._slo_ref = weakref.ref(engine) if engine is not None \
+                else None
 
     def attach_pool(self, pool):
         """Mirror a paged KV pool's occupancy/prefix-cache state into
@@ -390,8 +413,14 @@ class ServingHealth:
                         for kind, window in self._latencies.items()}}
             pool = self._pool_ref() if self._pool_ref is not None \
                 else None
+            slo = self._slo_ref() if self._slo_ref is not None \
+                else None
         if pool is not None:
             snap["pool"] = pool.snapshot()
+        if slo is not None:
+            summary = slo.summary()
+            if summary is not None:
+                snap["slo"] = summary
         return snap
 
 
@@ -439,6 +468,7 @@ class RESTfulAPI(Unit):
         from veles_tpu.core.httpd import (MAX_BODY, BodyTooLarge,
                                           QuietHandlerMixin,
                                           enable_metrics, read_body,
+                                          serve_debug_requests,
                                           serve_health, serve_metrics,
                                           start_server)
 
@@ -463,6 +493,8 @@ class RESTfulAPI(Unit):
 
             def do_GET(self):
                 if serve_metrics(self):
+                    return
+                if serve_debug_requests(self):
                     return
                 if not serve_health(self, api.health):
                     self.send_error(404)
@@ -523,10 +555,20 @@ class RESTfulAPI(Unit):
         if data is None:
             return
         from veles_tpu.core.httpd import reply
+        # the request-truth row (observe/reqledger.py): this surface
+        # has no slot-engine waterfall, but its requests still land in
+        # /debug/requests and the black box with staged -> resolved
+        # stamps and an outcome
+        ctx = current_context()
+        ledger = get_request_ledger()
+        row = ledger.stage(api="restful-api",
+                           trace=ctx[0] if ctx else None,
+                           prompt_len=int(getattr(data, "size", 0)))
         # the same atomic admit/release pair as GenerateAPI, so the
         # /healthz inflight gauge and counters stay balanced here too
         # (the queue bound itself is the minibatch: feed overflows)
         if self.health.try_admit(None) is not None:
+            ledger.resolve(row, "rejected", error="not ready")
             reply(handler, {"error": "not ready"}, code=503,
                   headers={"Retry-After": "1"})
             return
@@ -538,22 +580,26 @@ class RESTfulAPI(Unit):
             # with a retry hint instead of queueing unboundedly (the
             # batch flushes within max_response_time, so "1" is honest)
             self.health.reject_admitted()
+            ledger.resolve(row, "rejected", error="saturated")
             reply(handler, {"error": "server saturated: retry"},
                   code=429, headers={"Retry-After": "1"})
             return
         except Exception as exc:
             self.health.release("errors")
+            ledger.resolve(row, "errors", error=str(exc))
             self._fail(handler, "invalid input: %s" % exc)
             return
         if not responder["event"].wait(self.RESPONSE_TIMEOUT):
             # a server-side stall is retryable — 503, matching the
             # GenerateAPI surface, never a client-blaming 400
             self.health.release("expired")
+            ledger.resolve(row, "expired", error="inference timed out")
             self.warning("inference timed out")
             reply(handler, {"error": "inference timed out"}, code=503,
                   headers={"Retry-After": "1"})
             return
         self.health.release("completed")
+        ledger.resolve(row, "completed")
         reply(handler, {"result": responder["result"]})
 
     # -- response side (workflow thread, after the forward tick) --------------
@@ -645,7 +691,7 @@ class ContinuousDecoder:
                  temperature=0.0, top_k=0, key=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=False,
                  page_size=None, pool_pages=None, prefix_cache=None,
-                 aot=None):
+                 aot=None, ledger=None):
         import collections
 
         import jax
@@ -842,6 +888,18 @@ class ContinuousDecoder:
         #: bounded ring so a breaker trip can dump the tail that led
         #: to it (flight.py — one flag check + append per dispatch)
         self.flight = get_flight_recorder()
+        #: request-truth plane (observe/reqledger.py): when a ledger is
+        #: attached (GenerateAPI wires the process ledger; rebuilds
+        #: re-attach via _decoder_kwargs), every dispatch books its
+        #: stage mark + aot/live attribution onto the rows of the
+        #: requests it served. None (the default) keeps the hot path
+        #: at one attribute check per dispatch — the NULL-path guard
+        self.ledger = ledger
+        #: rid -> ledger row, scoped to THIS decoder (two engines with
+        #: independent rid counters can share one process ledger);
+        #: entries pop at retirement/cancel so it is bounded by live
+        #: requests plus the admission queue
+        self._ledger_rows = {}
         #: device-truth plane: chunk cadence feeds the online MFU
         #: gauge once /metrics is mounted (observe/xla_stats.py)
         self._xla = get_compile_tracker()
@@ -867,6 +925,28 @@ class ContinuousDecoder:
                            if r in self._done_trace), None)
         return self._tracer.span(name, parent=parent,
                                  rids=list(rids), **attrs)
+
+    def _dispatch_attribution(self, fn, default):
+        """(program_name, aot_served) of the dispatch that just ran —
+        the request ledger's per-dispatch attribution. AOT-bound
+        decoders read the facade's last-dispatch record (the program it
+        actually served or live-fell-back on); live decoders read the
+        instrumented callable's program name."""
+        if self._aot is not None:
+            last = getattr(self._aot, "last_dispatch", None)
+            if last is not None:
+                return last
+        from veles_tpu.parallel.decode import dispatch_program
+        return dispatch_program(fn, default), False
+
+    def ledger_link(self, rid, row):
+        """Bind a staged ledger row to request ``rid`` for the
+        dispatch-time hooks (GenerateAPI calls this right after
+        ``submit``; direct drivers may too)."""
+        if self.ledger is None or row is None:
+            return
+        self.ledger.link(row, rid)
+        self._ledger_rows[rid] = row
 
     def _retire_trace(self, rid):
         trace = self._trace.pop(rid, None)
@@ -934,6 +1014,7 @@ class ContinuousDecoder:
         del self._budget[rid]
         self.results.pop(rid, None)
         self.admitted_at.pop(rid, None)
+        self._ledger_rows.pop(rid, None)
         self._retire_trace(rid)
         self.cancelled += 1
         return True
@@ -949,6 +1030,13 @@ class ContinuousDecoder:
         while bucket < n:
             bucket *= 2
         return bucket
+
+    def bucket_for(self, n):
+        """The admission bucket an ``n``-token prompt (or tail)
+        actually prefills under: the power-of-two bucket clamped to
+        ``max_len`` — ONE definition for the admit paths, the
+        page-reservation bound and the request ledger's attribution."""
+        return min(self._bucket(n), self.max_len)
 
     def _admit_pending(self):
         if self.paged:
@@ -978,7 +1066,7 @@ class ContinuousDecoder:
         while self._queue and self._free:
             rid, prompt, _ = self._queue.popleft()
             slot = self._free.pop()
-            bucket = min(self._bucket(len(prompt)), self.max_len)
+            bucket = self.bucket_for(len(prompt))
             if bucket not in groups:
                 groups[bucket] = []
                 order.append(bucket)
@@ -1018,6 +1106,14 @@ class ContinuousDecoder:
                              ms=round(elapsed * 1000, 3))
             if self.dispatch_log is not None:
                 self.dispatch_log.append(("admit", bucket, len(group)))
+            if self.ledger is not None:
+                program, aot_served = self._dispatch_attribution(
+                    admit, "decode.admit")
+                for rid, _, _ in group:
+                    self.ledger.note_admit(
+                        self._ledger_rows.get(rid), "dense",
+                        group=len(group), bucket=bucket,
+                        aot=aot_served, program=program)
             for rid, prompt, slot in group:
                 self._slot_req[slot] = rid
                 self._slot_len[slot] = len(prompt)
@@ -1095,8 +1191,7 @@ class ContinuousDecoder:
                 hits.append((rid, prompt, slot, entry))
                 continue
             if entry is not None:
-                tail_bucket = min(self._bucket(len(prompt) - shared),
-                                  self.max_len)
+                tail_bucket = self.bucket_for(len(prompt) - shared)
                 pages = self.pool.alloc(
                     kv_pool.pages_for(tail_bucket, ps))
                 if pages is None:
@@ -1112,7 +1207,7 @@ class ContinuousDecoder:
                 tails[key].append((rid, prompt, slot, entry, shared,
                                    pages))
                 continue
-            bucket = min(self._bucket(len(prompt)), self.max_len)
+            bucket = self.bucket_for(len(prompt))
             pages = self.pool.alloc(kv_pool.pages_for(bucket, ps))
             if pages is None:
                 break
@@ -1149,11 +1244,20 @@ class ContinuousDecoder:
                     jnp.asarray([len(r[1]) for r in rows], jnp.int32))
                 elapsed = time.perf_counter() - t0
             self._book_admit("cold", elapsed, group, bucket)
+            if self.ledger is not None:
+                program, aot_served = self._dispatch_attribution(
+                    admit, "paged.admit")
             for rid, prompt, slot, pages in group:
                 self._slot_req[slot] = rid
                 self._slot_len[slot] = len(prompt)
                 self._slot_pages[slot] = list(pages)
                 self.admitted_at[rid] = now
+                if self.ledger is not None:
+                    self.ledger.note_admit(
+                        self._ledger_rows.get(rid), "cold",
+                        group=len(group), bucket=bucket,
+                        aot=aot_served, program=program,
+                        pages=len(self._slot_pages[slot]))
                 # publish the prompt's whole pages (and, when the
                 # prompt is page-aligned, its last-position logits)
                 # so the NEXT admission of this prefix is a hit
@@ -1184,12 +1288,21 @@ class ContinuousDecoder:
                     jnp.asarray([len(r[1]) for r in rows], jnp.int32))
                 elapsed = time.perf_counter() - t0
             self._book_admit("tail", elapsed, group, tail_bucket)
+            if self.ledger is not None:
+                program, aot_served = self._dispatch_attribution(
+                    admit_tail, "paged.admit_tail")
             for rid, prompt, slot, entry, shared, pages in group:
                 self._slot_req[slot] = rid
                 self._slot_len[slot] = len(prompt)
                 self._slot_pages[slot] = list(entry["pages"]) \
                     + list(pages)
                 self.admitted_at[rid] = now
+                if self.ledger is not None:
+                    self.ledger.note_admit(
+                        self._ledger_rows.get(rid), "tail",
+                        group=len(group), bucket=tail_bucket,
+                        aot=aot_served, program=program,
+                        pages=len(self._slot_pages[slot]))
                 # publish the EXTENDED prompt too (prefix pages + the
                 # tail's whole pages hold exactly a cold prefill's
                 # bytes — the tail ran the same math behind the
@@ -1213,11 +1326,20 @@ class ContinuousDecoder:
                     fold_keys(rows))
                 elapsed = time.perf_counter() - t0
             self._book_admit("hit", elapsed, group, 0)
+            if self.ledger is not None:
+                program, aot_served = self._dispatch_attribution(
+                    admit_hit, "paged.admit_hit")
             for rid, prompt, slot, entry in group:
                 self._slot_req[slot] = rid
                 self._slot_len[slot] = len(prompt)
                 self._slot_pages[slot] = list(entry["pages"])
                 self.admitted_at[rid] = now
+                if self.ledger is not None:
+                    self.ledger.note_admit(
+                        self._ledger_rows.get(rid), "hit",
+                        group=len(group), bucket=0,
+                        aot=aot_served, program=program,
+                        pages=len(self._slot_pages[slot]))
 
     def _release_slot_pages(self, slot):
         """Return a retired/cancelled slot's pages to the pool (shared
@@ -1288,11 +1410,10 @@ class ContinuousDecoder:
         from veles_tpu.parallel.kv_pool import pages_for
 
         ps = self.page_size
-        bucket = min(self._bucket(prompt_len), self.max_len)
+        bucket = self.bucket_for(prompt_len)
         worst = pages_for(bucket + budget + 2 * chunk, ps)
         for shared in range(ps, prompt_len, ps):
-            tail_bucket = min(self._bucket(prompt_len - shared),
-                              self.max_len)
+            tail_bucket = self.bucket_for(prompt_len - shared)
             worst = max(worst,
                         shared // ps + pages_for(tail_bucket, ps))
         return worst
@@ -1345,12 +1466,19 @@ class ContinuousDecoder:
             self._slot_len[slot] += 1
         self.dispatch_counts["step"] += 1
         self.flight.note("step", rids=list(snapshot.values()))
+        ledger_aot = None
+        if self.ledger is not None:
+            ledger_aot = self._dispatch_attribution(
+                step, "paged.step" if self.paged else "decode.step")[1]
         emitted = numpy.asarray(emitted)
         out = {}
         for slot, rid in snapshot.items():
             token = int(emitted[slot])
             self.results[rid].append(token)
             out[rid] = token
+            if ledger_aot is not None:
+                self.ledger.note_tokens(self._ledger_rows.get(rid),
+                                        1, aot=ledger_aot)
             self.tokens_out += 1
             self._budget[rid] -= 1
             done = self._budget[rid] <= 0 or (
@@ -1359,6 +1487,7 @@ class ContinuousDecoder:
                 del self._slot_req[slot]
                 del self._budget[rid]
                 self.admitted_at.pop(rid, None)
+                self._ledger_rows.pop(rid, None)
                 self._retire_trace(rid)
                 self._free.append(slot)
                 self._release_slot_pages(slot)
@@ -1383,7 +1512,9 @@ class ContinuousDecoder:
         cancelled while the chunk was in flight (pipelined mode keeps
         their slot active one extra chunk) are skipped; tail tokens
         past a budget or eos are discarded."""
-        emitted, snapshot = dispatched
+        emitted, snapshot, dispatch_info = (
+            dispatched if len(dispatched) == 3
+            else (dispatched[0], dispatched[1], None))
         # span writes stay outside the timed window (see decode.admit)
         with self._span("decode.collect", list(snapshot.values())):
             t0 = time.perf_counter()
@@ -1422,6 +1553,13 @@ class ContinuousDecoder:
                 tokens = tokens[:tokens.index(self.eos) + 1]
             self.results[rid].extend(tokens)
             out[rid] = tokens
+            if self.ledger is not None and tokens:
+                # the request-truth cadence: one stamp per collected
+                # chunk per request, with the DISPATCHING program's
+                # aot/live attribution captured at dispatch time
+                self.ledger.note_tokens(
+                    self._ledger_rows.get(rid), len(tokens),
+                    aot=bool(dispatch_info and dispatch_info.get("aot")))
             self.tokens_out += len(tokens)
             self._budget[rid] -= len(tokens)
             done = self._budget[rid] <= 0 or (
@@ -1430,6 +1568,7 @@ class ContinuousDecoder:
             if done:
                 del self._budget[rid]
                 self.admitted_at.pop(rid, None)
+                self._ledger_rows.pop(rid, None)
                 self._retire_trace(rid)
                 if self._slot_req.get(slot) == rid:
                     del self._slot_req[slot]
@@ -1499,7 +1638,14 @@ class ContinuousDecoder:
         if self.dispatch_log is not None:
             self.dispatch_log.append(("dispatch", chunk))
         self.steps += chunk
-        return emitted, snapshot
+        dispatch_info = None
+        if self.ledger is not None:
+            program, aot_served = self._dispatch_attribution(
+                step_many,
+                "paged.dispatch" if self.paged else "decode.dispatch")
+            dispatch_info = {"program": program, "aot": aot_served,
+                             "chunk": chunk}
+        return emitted, snapshot, dispatch_info
 
     def drain_pipelined(self, chunk, max_steps=100000, admit=None):
         """Throughput drain: chunk N's tokens are read back while chunk
@@ -1591,7 +1737,8 @@ class GenerateAPI:
                  max_queue=None, deadline=None, rebuild_backoff=None,
                  rebuild_backoff_max=None, chaos=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=None,
-                 page_size=None, pool_pages=None, aot=None):
+                 page_size=None, pool_pages=None, aot=None, slo=None,
+                 ledger=None):
         import queue
 
         from veles_tpu.core.config import root
@@ -1673,13 +1820,26 @@ class GenerateAPI:
                 "fall back to live compilation (veles_aot_misses_"
                 "total) — rebuild with --chunk %d or pass chunk=%d",
                 aot.chunk, chunk, chunk, aot.chunk)
+        #: request-truth plane (observe/reqledger.py): every request
+        #: this API serves gets a ledger row with its full stage
+        #: waterfall; the PROCESS ledger by default so /debug/requests,
+        #: the autopsy CLI and flight-recorder dumps see one view.
+        #: Threaded into the decoder (and every breaker-rebuild
+        #: decoder, via _decoder_kwargs) for the dispatch-time hooks.
+        self.ledger = ledger if ledger is not None \
+            else get_request_ledger()
+        #: SLO engine (observe/slo.py): root.common.observe.slo /
+        #: --serve-slo objectives over multi-window rolling buckets;
+        #: None without config — the ledger path stays lock-free
+        self.slo = slo if slo is not None else get_slo_engine()
         self._decoder_kwargs = dict(
             params=params, embed_table=embed_table, heads=heads,
             slots=slots, max_len=max_len, n_tokens=n_tokens,
             temperature=temperature, top_k=top_k, eos=eos, key=key,
             quantize=quantize, tile=tile, mesh=mesh,
             mesh_axis=mesh_axis, paged=bool(paged),
-            page_size=page_size, pool_pages=pool_pages, aot=aot)
+            page_size=page_size, pool_pages=pool_pages, aot=aot,
+            ledger=self.ledger)
         self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
         self.port = port
@@ -1704,6 +1864,8 @@ class GenerateAPI:
         self.health = ServingHealth(name="generate-api")
         if self.decoder.pool is not None:
             self.health.attach_pool(self.decoder.pool)
+        if self.slo is not None:
+            self.health.attach_slo(self.slo)
         self._staged = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -1732,6 +1894,17 @@ class GenerateAPI:
             if pool is not None:
                 pool.unreserve(reserved)
         self.health.release(outcome)
+        row = holder.get("ledger_row")
+        if row is not None:
+            # close the request-truth row and feed the aggregate
+            # planes from it: the SLO engine, the tpot health window,
+            # and the exemplar-linked request histograms — once per
+            # request, never on the token path
+            self.ledger.resolve(row, outcome,
+                                error=holder.get("error"))
+            observe_request(row, engine=self.slo,
+                            registry=get_metrics_registry(),
+                            health=self.health)
         holder["event"].set()
 
     def _drain_staged(self):
@@ -1787,6 +1960,7 @@ class GenerateAPI:
                 self._resolve(holder, "errors", error=str(exc),
                               code=400)
                 continue
+            self.decoder.ledger_link(rid, holder.get("ledger_row"))
             get_tracer().event("serve.submit",
                                parent=holder.get("trace"), rid=rid)
             waiting[rid] = holder
@@ -1991,8 +2165,9 @@ class GenerateAPI:
         from http.server import BaseHTTPRequestHandler
         from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
-                                          reply, serve_health,
-                                          serve_metrics, start_server)
+                                          reply, serve_debug_requests,
+                                          serve_health, serve_metrics,
+                                          start_server)
 
         api = self
         # the telemetry plane (docs/observability.md): /metrics on this
@@ -2004,10 +2179,17 @@ class GenerateAPI:
         bridge(registry, self.health, publish_serving_health)
         bridge(registry, self,
                lambda reg, live: publish_decoder(reg, live.decoder))
+        if self.slo is not None:
+            # the SLO gauges ride every scrape of this surface AND the
+            # fleet piggyback (registry.snapshot runs collectors)
+            bridge(registry, self.slo,
+                   lambda reg, live: live.publish(reg))
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_GET(self):
                 if serve_metrics(self):
+                    return
+                if serve_debug_requests(self, api.ledger):
                     return
                 if not serve_health(self, api.health):
                     self.send_error(404)
@@ -2068,13 +2250,20 @@ class GenerateAPI:
                 # parent to it across threads
                 parent = parse_trace_header(
                     self.headers.get(TRACE_HEADER))
+                # multi-tenant attribution (the ROADMAP item-5
+                # foundation): an optional client-supplied tenant id,
+                # bounded, rides the ledger row and slices the SLO
+                # gauges per tenant
+                tenant = str(self.headers.get("X-Veles-Tenant")
+                             or "").strip()[:64]
                 with get_tracer().span("serve.request",
                                        parent=parent) as req_span:
                     self._serve_admitted(prompt, budget, deadline_s,
-                                         req_span)
+                                         req_span, tenant,
+                                         parent[0] if parent else None)
 
             def _serve_admitted(self, prompt, budget, deadline_s,
-                                req_span):
+                                req_span, tenant="", trace_hint=None):
                 # admission: atomic ready + queue-bound check; rejected
                 # requests never stage, so the decoder queue is bounded.
                 # The paged tier extends the decision to KV pages: the
@@ -2084,6 +2273,23 @@ class GenerateAPI:
                 # it was promised — a full pool 429s here instead, with
                 # Retry-After priced from the observed page-release
                 # rate (docs/paged_kv.md).
+                # the request-truth row opens at staging (before the
+                # admission verdict, so rejected requests leave a row
+                # too); the driver/decoder hooks fill in the
+                # waterfall. Trace identity: the server span's trace
+                # when tracing is on, else the CLIENT's propagated id
+                # — exemplars and autopsies link either way
+                ctx = req_span.context()
+                row = api.ledger.stage(
+                    api="generate-api",
+                    trace=ctx[0] if ctx else trace_hint,
+                    tenant=tenant,
+                    prompt_len=len(prompt),
+                    budget=(budget if budget is not None
+                            else api.decoder.n_tokens),
+                    bucket=api.decoder.bucket_for(len(prompt)),
+                    quant=api.decoder.quantize,
+                    breaker_gen=api.health.counter("rebuilds"))
                 booked = {}
                 pool_gate = None
                 if api.decoder.pool is not None:
@@ -2100,6 +2306,8 @@ class GenerateAPI:
                         pool = booked["pool"] = decoder.pool
                         need = booked["need"] = decoder.worst_case_pages(
                             len(prompt), limit, api.chunk)
+                        api.ledger.mark(row, "pool_gated",
+                                        pages_reserved=need)
                         if pool.try_reserve(need):
                             booked["reserved"] = True
                             return None
@@ -2108,11 +2316,15 @@ class GenerateAPI:
                                                pool_gate=pool_gate)
                 if verdict == "unready":
                     req_span.annotate(outcome="unready")
+                    api.ledger.resolve(row, "rejected",
+                                       error="unready")
                     reply(self, {"error": api._tripped or "not ready"},
                           code=503, headers={"Retry-After": "1"})
                     return
                 if verdict == "full":
                     req_span.annotate(outcome="rejected")
+                    api.ledger.resolve(row, "rejected",
+                                       error="queue full")
                     reply(self,
                           {"error": "saturated: %d requests in flight"
                            % api.max_queue},
@@ -2120,6 +2332,8 @@ class GenerateAPI:
                     return
                 if isinstance(verdict, tuple) and verdict[0] == "pool":
                     req_span.annotate(outcome="pool_full")
+                    api.ledger.resolve(row, "rejected",
+                                       error="kv page pool full")
                     reply(self,
                           {"error": "kv page pool exhausted: need %d "
                            "pages, %d free"
@@ -2133,7 +2347,8 @@ class GenerateAPI:
                 holder = {"event": threading.Event(),
                           "staged_at": staged_at,
                           "deadline": staged_at + deadline_s,
-                          "trace": req_span.context()}
+                          "trace": req_span.context(),
+                          "ledger_row": row}
                 if booked.get("reserved"):
                     holder["pool"] = booked["pool"]
                     holder["pool_reserved"] = booked["need"]

@@ -198,10 +198,19 @@ def format_serving_health(serving):
         # the serving-performance observability pair (docs/
         # serving_performance.md): staged->first-token and
         # staged->slot-admitted p95s over the rolling window
-        for kind, label in (("ttft", "ttft"), ("queue_wait", "queue")):
+        for kind, label in (("ttft", "ttft"), ("tpot", "tpot"),
+                            ("queue_wait", "queue")):
             entry = latency.get(kind)
             if isinstance(entry, dict) and entry.get("count"):
                 parts.append("%s p95 %sms" % (label, entry["p95"]))
+    slo = serving.get("slo")
+    if isinstance(slo, dict) and slo.get("burn_rate") is not None:
+        # the SLO cell (observe/slo.py): the worst short-window burn
+        # rate — >1.0 means the error budget is burning faster than
+        # sustainable, the number an on-call scans for first
+        parts.append("burn %.1fx (%s/%s)"
+                     % (slo["burn_rate"], slo.get("objective"),
+                        slo.get("window")))
     pool = serving.get("pool")
     if isinstance(pool, dict):
         # the paged-KV pair (docs/paged_kv.md): page occupancy and the
