@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr aot slo
+	regress mesh paged fleet-mr aot slo governor
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -97,6 +97,19 @@ regress:
 slo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_reqledger.py \
 		-m slo -q
+
+# Closed-loop serving governor suite (docs/serving_robustness.md):
+# hysteresis-band/cooldown state-machine determinism (at most one tier
+# transition per cooldown window), the priced Retry-After helper on
+# every 429/503 surface, per-tenant SLO gauge retirement, and the
+# chaos acceptance — under each seeded burn-inducing profile (latency
+# ramp, pool-exhaustion flood, compile storm) the governor converges
+# to a stable degraded tier with a PINNED transition count, every
+# demoted request's ledger row names its tier, and full fidelity
+# restores with burn < 1.0 after the fault clears.
+governor:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_governor.py \
+		-m governor -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

@@ -1961,6 +1961,126 @@ def main(artifact_path=None):
     print(json.dumps(out))
 
 
+def governor_section():
+    """Closed-loop governor bench (docs/serving_robustness.md): drive
+    a toy GenerateAPI through one seeded latency-ramp fault and
+    measure the CONTROL LOOP, not throughput —
+
+    - ``governor_demote_latency_ms``: fault-inject (first ramp stall)
+      -> demote actuation;
+    - ``governor_demote_to_recover_ms``: fault-inject -> tier demotion
+      -> fault-clear -> full-fidelity restore (decoder back at the
+      base tier), the whole closed loop's wall time;
+    - ``governor_transitions``: demote+promote count for the seeded
+      profile (2 = converged; more = oscillation — lower-better via
+      the ``_transitions`` regress rule);
+    - ``governor_tier_attainment_bf16`` / ``_int8``: per-tier SLO
+      attainment (fraction of completed requests meeting the ttft
+      objective), from the ledger rows' tier/quant attribution.
+    """
+    import urllib.request
+
+    from veles_tpu.observe.governor import (GovernorConfig,
+                                            ServingGovernor)
+    from veles_tpu.observe.reqledger import RequestLedger
+    from veles_tpu.observe.slo import SLOEngine, row_latencies
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import GenerateAPI
+    from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                         ServingChaosMonkey)
+
+    threshold_s = 0.150
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 32, 64
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.1)
+    engine = SLOEngine({"ttft_p95_ms": threshold_s * 1000.0},
+                       windows=(2.0, 8.0), bucket_seconds=0.25)
+    governor = ServingGovernor(GovernorConfig(
+        demote_burn=2.0, recover_burn=1.0, cooldown_s=3.0,
+        interval_s=0.05, ladder=("int8",), prewarm=False,
+        breaker_guard=False))
+    monkey = ServingChaosMonkey(ServingChaosConfig(
+        seed=1, latency_ramp_ms=300.0, latency_ramp_steps=8,
+        latency_ramp_hold=1 << 30))
+    ledger = RequestLedger()
+    api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                      n_tokens=5, chunk=2, port=0,
+                      rebuild_backoff=0.02, slo=engine,
+                      governor=governor, chaos=monkey, ledger=ledger)
+    api.start()
+    url = "http://127.0.0.1:%d/generate" % api.port
+    prompt = [1, 2, 3]
+
+    def post_one():
+        req = urllib.request.Request(
+            url, data=json.dumps({"tokens": prompt}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+        except Exception:
+            pass
+
+    def wait(predicate, timeout, tick=0.05, trickle=False):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if trickle:
+                post_one()
+            if predicate():
+                return True
+            time.sleep(tick)
+        return False
+
+    out = {}
+    try:
+        # fault-inject: the held ramp burns the ttft objective until
+        # the governor demotes and the graceful swap lands
+        demoted = wait(lambda: governor.demoted, 60, trickle=True)
+        swapped = demoted and wait(
+            lambda: api.decoder.quantize == "int8", 60, trickle=True)
+        # fault-clear: a trickle of now-fast traffic shows the burn
+        # decaying; the governor promotes and restores full fidelity
+        monkey.clear_ramp()
+        recovered = swapped and wait(
+            lambda: not governor.demoted
+            and (api.decoder.quantize or "bf16") == "bf16", 90,
+            tick=0.1, trickle=True)
+        recovered_at = time.monotonic()
+        start = monkey.stamps.get("ramp_start")
+        moves = [t for t in governor.transitions
+                 if t["action"] in ("demote", "promote")]
+        if recovered and start is not None and moves:
+            out["governor_demote_latency_ms"] = round(
+                (moves[0]["mono"] - start) * 1000.0, 1)
+            out["governor_demote_to_recover_ms"] = round(
+                (recovered_at - start) * 1000.0, 1)
+            out["governor_transitions"] = len(moves)
+        by_tier = {}
+        for row in ledger.slowest(512):
+            if row.get("outcome") != "completed":
+                continue
+            tier = row.get("tier") or row.get("quant") or "bf16"
+            ttft, _ = row_latencies(row)
+            if ttft is None:
+                continue
+            good, total = by_tier.setdefault(tier, [0, 0])
+            by_tier[tier] = [good + (ttft <= threshold_s), total + 1]
+        for tier, (good, total) in sorted(by_tier.items()):
+            if total:
+                out["governor_tier_attainment_"
+                    + tier.replace("-", "")] = round(good / total, 4)
+        out["governor_config"] = ("demote_burn=2,recover_burn=1,"
+                                  "cooldown_s=3,ladder=int8,"
+                                  "ramp=300ms×8+hold")
+    finally:
+        monkey.clear_ramp()
+        api.stop()
+    return out
+
+
 def serve_main(profile_dir=None, artifact_path=None):
     """``make bench-serve``: the continuous-batching serving bench
     standalone (one JSON line) — fast iteration on the slot-engine hot
@@ -2012,6 +2132,12 @@ def serve_main(profile_dir=None, artifact_path=None):
             # vs bundle deserialize+execute, fresh-subprocess twins —
             # coldstart_compiles pinned 0 is the zero-retrace proof
             section = _guarded(coldstart_section, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # the closed-loop governor (docs/serving_robustness.md):
+            # fault->demote->recover wall time, transition count and
+            # per-tier SLO attainment under a seeded latency ramp
+            section = _guarded(governor_section, fallback={})
             out.update(section)
             artifact.update(section)
         out["decode_histograms"] = registry.histogram_summary(

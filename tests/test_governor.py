@@ -1,0 +1,766 @@
+"""Closed-loop serving governor (docs/serving_robustness.md, ISSUE 11):
+hysteresis-banded tier transitions pinned to at most one per cooldown
+window, the priced Retry-After helper replacing the hardcoded ``"1"``s,
+admission resize under pool pressure, the prewarm and breaker-guard
+actuators, ledger/flight/metrics visibility for every actuation — and
+the chaos acceptance: under each seeded burn-inducing profile (latency
+ramp, pool-exhaustion flood, compile storm) the governor converges to a
+stable degraded tier with a PINNED transition count, every demoted
+request's ledger row names its tier, and the system restores full
+fidelity with burn < 1.0 after the fault clears, bit-identical greedy
+tokens on the non-demoted path. ``make governor`` runs this module
+standalone; the ramp/flood/storm acceptance rides the ``slow`` marker
+so tier-1 stays inside its timeout margin."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.observe.governor import (GovernorConfig, ServingGovernor,
+                                        format_governor_transitions,
+                                        parse_governor_spec,
+                                        publish_governor)
+from veles_tpu.observe.metrics import MetricsRegistry
+from veles_tpu.observe.reqledger import RequestLedger
+from veles_tpu.observe.slo import SLOEngine
+from veles_tpu.serving import GenerateAPI, ServingHealth
+from veles_tpu.serving_chaos import ServingChaosConfig, ServingChaosMonkey
+
+CHAOS_SEED = int(os.environ.get("VELES_TPU_CHAOS_SEED", "1"))
+
+pytestmark = pytest.mark.governor
+
+
+def post(url, payload, timeout=60):
+    data = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as err:
+        body = err.read().decode()
+        try:
+            body = json.loads(body)
+        except ValueError:
+            body = {"raw": body}
+        return err.code, body, dict(err.headers)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 16, 11
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    return params, table, heads, vocab
+
+
+def make_api(model, **kw):
+    params, table, heads, _ = model
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("n_tokens", 5)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("port", 0)
+    kw.setdefault("rebuild_backoff", 0.02)
+    kw.setdefault("ledger", RequestLedger())
+    return GenerateAPI(params, table, heads, **kw)
+
+
+def wait_until(predicate, timeout=60.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- stubs for the pure control-law tests (no HTTP, injected clock) ---------
+
+class StubSLO:
+    def __init__(self, burns):
+        self.burns = list(burns)
+
+    def summary(self):
+        burn = self.burns.pop(0) if self.burns else 0.0
+        if burn is None:
+            return None
+        return {"burn_rate": burn, "objective": "ttft_p95_ms",
+                "window": "2s"}
+
+
+class StubDecoder:
+    def __init__(self, pool=None, quantize=None):
+        self.pool = pool
+        self.quantize = quantize
+        self.aot = None
+
+
+class StubApi:
+    def __init__(self, burns, pool=None, max_queue=64):
+        self.slo = StubSLO(burns)
+        self.decoder = StubDecoder(pool=pool)
+        self.max_queue = max_queue
+        self._base_tier = "bf16"
+        self.tier_requests = []
+        self.trip_requests = []
+
+    def request_tier(self, tier):
+        self.tier_requests.append(tier)
+        # mimic the driver's swap so reconciliation settles
+        self.decoder.quantize = None if tier == "bf16" else tier
+
+    def request_trip(self, reason):
+        self.trip_requests.append(reason)
+
+
+class TestGovernorConfig:
+    def test_spec_parsing_and_validation_name_the_flag(self):
+        config = parse_governor_spec(
+            "demote_burn=3,recover_burn=0.5,cooldown_s=5,"
+            "ladder=int8+int8-kv,min_admit=4,prewarm=0",
+            flag="--serve-governor")
+        assert config.demote_burn == 3.0
+        assert config.ladder == ("int8", "int8-kv")
+        assert config.min_admit == 4
+        assert config.prewarm is False
+        assert parse_governor_spec(None) is None
+        assert parse_governor_spec("") is None
+        assert parse_governor_spec("enabled=0,demote_burn=3") is None
+        for bad in ("demote_burn", "nope=1", "demote_burn=x",
+                    "recover_burn=5,demote_burn=2", "cooldown_s=0",
+                    "ladder=bf16", "ladder=int8-kv+int8",
+                    "admit_factor=1.5", "min_admit=0", "prewarm=maybe"):
+            with pytest.raises(ValueError, match="--serve-governor"):
+                parse_governor_spec(bad, flag="--serve-governor")
+
+    def test_from_config_default_off_and_on(self):
+        from veles_tpu.core.config import root
+
+        assert ServingGovernor.from_config() is None  # unset -> no loop
+        try:
+            root.common.serve.governor = "demote_burn=4,cooldown_s=2"
+            governor = ServingGovernor.from_config()
+            assert governor is not None
+            assert governor.config.demote_burn == 4.0
+            root.common.serve.governor = "enabled=0"
+            assert ServingGovernor.from_config() is None
+        finally:
+            root.common.serve.governor = None
+
+    def test_base_tier_drops_unreachable_rungs(self):
+        governor = ServingGovernor(GovernorConfig(
+            ladder=("int8", "int8-kv")))
+        governor.set_base_tier("int8")
+        assert governor._ladder == ("int8-kv",)
+        assert governor.tier_name() == "int8"
+        governor.level = 1
+        assert governor.tier_name() == "int8-kv"
+
+
+class TestHysteresis:
+    """Satellite: a burn rate oscillating across the demote threshold
+    must produce at most ONE tier transition per cooldown window."""
+
+    def run_governor(self, burns, cooldown=10.0, ladder=("int8",)):
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=cooldown,
+            interval_s=1.0, ladder=ladder, prewarm=False,
+            breaker_guard=False), clock=lambda: 0.0)
+        api = StubApi(burns)
+        for second, _ in enumerate(list(burns)):
+            governor.tick(api, now=float(second))
+        return governor, api
+
+    def test_at_most_one_transition_per_cooldown_window(self):
+        # burn flaps across the demote threshold every second; the
+        # cooldown must hold the ladder to one move per window
+        burns = [5.0, 0.2, 5.0, 0.2, 5.0, 0.2, 5.0, 0.2, 5.0, 0.2,
+                 5.0, 0.2, 5.0, 0.2, 5.0, 0.2, 5.0, 0.2, 5.0, 0.2,
+                 5.0, 0.2]
+        governor, _ = self.run_governor(burns, cooldown=10.0)
+        moves = [t for t in governor.transitions
+                 if t["action"] in ("demote", "promote")]
+        for a in moves:
+            same_window = [b for b in moves
+                           if a is not b
+                           and abs(b["mono"] - a["mono"]) < 10.0]
+            assert not same_window, (a, same_window)
+        total = governor.counters["demotions"] \
+            + governor.counters["promotions"]
+        # 22 seconds of flapping, 10 s cooldown: at most 3 moves
+        assert 1 <= total <= 3
+
+    def test_band_holds_between_thresholds(self):
+        # burn inside the (recover, demote) band must HOLD the tier
+        governor, api = self.run_governor([5.0] + [1.5] * 20,
+                                          cooldown=2.0)
+        assert governor.counters["demotions"] == 1
+        assert governor.counters["promotions"] == 0
+        assert governor.demoted
+        assert api.decoder.quantize == "int8"
+
+    def test_demote_stops_at_ladder_bottom_then_recovers(self):
+        burns = [9.0] * 12 + [0.0] * 12
+        governor, api = self.run_governor(
+            burns, cooldown=2.0, ladder=("int8", "int8-kv"))
+        assert governor.counters["demotions"] == 2  # int8, int8-kv
+        assert governor.counters["promotions"] == 2  # back up both
+        assert not governor.demoted
+        assert (api.decoder.quantize or "bf16") == "bf16"
+        tiers = [t["tier"] for t in governor.transitions
+                 if t["action"] in ("demote", "promote")]
+        assert tiers == ["int8", "int8-kv", "int8", "bf16"]
+
+    def test_no_slo_engine_means_no_transitions(self):
+        governor = ServingGovernor(GovernorConfig(prewarm=False,
+                                                  breaker_guard=False),
+                                   clock=lambda: 0.0)
+        api = StubApi([])
+        api.slo = None
+        for second in range(5):
+            governor.tick(api, now=float(second))
+        assert governor.counters["demotions"] == 0
+        assert governor.last_burn is None
+
+
+class TestRetryAfterPricing:
+    """Satellite: the five hardcoded ``Retry-After: "1"`` headers are
+    one priced helper, clamped [1, 60] like the pool gate."""
+
+    def test_helper_clamps_and_degrades(self):
+        from veles_tpu.core.httpd import retry_after_headers
+
+        class Priced:
+            def __init__(self, seconds):
+                self.seconds = seconds
+
+            def retry_after_s(self, need=1):
+                return self.seconds
+
+        assert retry_after_headers(None) == {"Retry-After": "1"}
+        assert retry_after_headers(Priced(7.4)) == {"Retry-After": "7"}
+        assert retry_after_headers(Priced(900)) == {"Retry-After": "60"}
+        assert retry_after_headers(Priced(0.01)) == {"Retry-After": "1"}
+
+        class Broken:
+            def retry_after_s(self, need=1):
+                raise RuntimeError("boom")
+
+        assert retry_after_headers(Broken()) == {"Retry-After": "1"}
+
+    def test_health_consults_governor_then_pool(self):
+        health = ServingHealth()
+        assert health.retry_after_s() == 1.0
+
+        class PoolStub:
+            def retry_after(self, need, fallback=1.0):
+                return 42.0
+
+        pool = PoolStub()
+        health.attach_pool(pool)
+        assert health.retry_after_s() == 42.0
+        governor = ServingGovernor(GovernorConfig())
+        governor.retry_price = 9.0
+        health.attach_governor(governor)
+        assert health.retry_after_s() == 9.0
+
+    def test_readyz_and_429_carry_priced_headers(self, model):
+        api = make_api(model, max_queue=1, deadline=60.0)
+        api.start()
+        gate = threading.Event()
+        real = api.decoder.dispatch_chunk
+        api.decoder.dispatch_chunk = lambda n: (gate.wait(20),
+                                                real(n))[1]
+        try:
+            base = "http://127.0.0.1:%d" % api.port
+            results = {}
+            thread = threading.Thread(target=lambda: results.update(
+                first=post(base + "/generate", {"tokens": [1, 2]})))
+            thread.start()
+            assert wait_until(lambda: api.health.inflight == 1, 10)
+            code, _, headers = post(base + "/generate",
+                                    {"tokens": [1, 2]})
+            assert code == 429
+            assert 1 <= int(headers["Retry-After"]) <= 60
+            gate.set()
+            thread.join(timeout=60)
+            api.health.set_ready(False)
+            with urllib.request.urlopen(base + "/readyz",
+                                        timeout=10) as resp:  # pragma: no cover
+                raise AssertionError("readyz should be 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            assert 1 <= int(err.headers["Retry-After"]) <= 60
+        finally:
+            gate.set()
+            api.stop()
+
+    def test_pool_overhang_pricing(self):
+        """The governor prices the time for the observed release rate
+        to clear the pressure OVERHANG above the pool_high gate — the
+        need it hands the pool's release-rate pricer is the pages over
+        the gate, not a constant 1."""
+        class PoolStub:
+            def __init__(self):
+                self.needs = []
+
+            @staticmethod
+            def snapshot():
+                return {"pages_total": 100, "pages_used": 90,
+                        "reserved_pages": 20}
+
+            def retry_after(self, need, fallback=1.0):
+                self.needs.append(need)
+                return 37.0
+
+        pool = PoolStub()
+        governor = ServingGovernor(GovernorConfig(
+            pool_high=0.5, prewarm=False, breaker_guard=False),
+            clock=lambda: 0.0)
+        api = StubApi([0.0], pool=pool, max_queue=8)
+        governor.tick(api, now=0.0)
+        assert pool.needs == [40]  # 90 used - 50 (the 0.5 gate)
+        assert governor.retry_price == 37.0
+        health = ServingHealth()
+        health.attach_governor(governor)
+        assert health.retry_after_s() == governor.retry_price
+
+
+class TestAdmissionResize:
+    def test_demotion_and_pool_pressure_shrink_the_limit(self):
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=1.0,
+            interval_s=0.5, min_admit=2, admit_factor=0.5,
+            pool_high=0.85, prewarm=False, breaker_guard=False),
+            clock=lambda: 0.0)
+        api = StubApi([5.0, 5.0, 0.0, 0.0], max_queue=64)
+        governor.tick(api, now=0.0)   # demote -> limit 32
+        assert governor.effective_limit == 32
+        assert governor.admit_limit == 32
+
+        class PressuredPool:
+            @staticmethod
+            def snapshot():
+                return {"pages_total": 100, "pages_used": 10,
+                        "reserved_pages": 95}
+
+            @staticmethod
+            def retry_after(need, fallback=1.0):
+                return 30.0
+
+        api.decoder.pool = PressuredPool()
+        governor.tick(api, now=1.0)   # still demoted + pool pressure
+        assert governor.effective_limit == 16
+        api.decoder.pool = None
+        governor.tick(api, now=2.0)   # promote (burn 0) -> restore
+        governor.tick(api, now=3.0)
+        assert governor.effective_limit == 64
+        assert governor.admit_limit is None
+        assert governor.counters["admit_resizes"] >= 2
+
+    def test_disabled_bound_stays_disabled(self):
+        governor = ServingGovernor(GovernorConfig(prewarm=False,
+                                                  breaker_guard=False),
+                                   clock=lambda: 0.0)
+        api = StubApi([9.0], max_queue=0)
+        governor.tick(api, now=0.0)
+        assert governor.admit_limit is None
+        assert governor.effective_limit is None
+
+    def test_generate_api_effective_limit_reads_override(self, model):
+        api = make_api(model, max_queue=64,
+                       governor=ServingGovernor(GovernorConfig()))
+        assert api.effective_max_queue == 64
+        api.governor.admit_limit = 3
+        assert api.effective_max_queue == 3
+
+
+class TestActuationVisibility:
+    def test_metrics_families_and_snapshot(self):
+        governor = ServingGovernor(GovernorConfig(
+            prewarm=False, breaker_guard=False), clock=lambda: 0.0)
+        api = StubApi([5.0])
+        governor.tick(api, now=0.0)
+        registry = MetricsRegistry(enabled=True)
+        publish_governor(registry, governor)
+        text = registry.expose()
+        assert "veles_governor_tier_level 1" in text
+        assert "veles_governor_demoted 1" in text
+        assert 'veles_governor_actuations_total{action="demotions"} 1' \
+            in text
+        assert "veles_governor_retry_after" in text
+        snap = governor.snapshot()
+        assert snap["tier"] == "int8" and snap["demoted"]
+        assert snap["transitions"][-1]["action"] in ("demote",
+                                                     "admit_resize")
+        health = ServingHealth()
+        health.attach_governor(governor)
+        assert health.snapshot()["governor"]["tier"] == "int8"
+
+    def test_dashboard_cell_names_the_governed_tier(self):
+        from veles_tpu.web_status import format_serving_health
+
+        cell = format_serving_health({
+            "ready": True, "breaker": "closed",
+            "counters": {"completed": 3},
+            "governor": {"demoted": True, "tier": "int8",
+                         "counters": {"demotions": 1, "promotions": 0,
+                                      "guard_trips": 2}}})
+        assert "tier int8 (governed)" in cell
+        assert "1 tier moves" in cell
+        assert "2 guard trips" in cell
+
+    def test_autopsy_cli_replays_governor_actuations(self, tmp_path,
+                                                     capsys):
+        """Black-box dumps carry the governor's flight entries; the
+        ``veles_tpu observe slo`` autopsy prints the actuation tail."""
+        from veles_tpu.observe.flight import FlightRecorder
+        from veles_tpu.observe.slo import slo_main
+
+        recorder = FlightRecorder()
+        recorder.note("governor", action="demote", tier="int8",
+                      burn=12.0, reason="burn 12 >= 2")
+        recorder.note("governor", action="promote", tier="bf16",
+                      burn=0.4, reason="burn 0.4 <= 1")
+        path = str(tmp_path / "box.json")
+        recorder.dump("test", path=path)
+        with open(path) as fin:
+            doc = json.load(fin)
+        doc["requests"] = {"slowest": [], "inflight": []}
+        with open(path, "w") as fout:
+            json.dump(doc, fout)
+        slo_main(path)
+        out = capsys.readouterr().out
+        assert "governor actuations:" in out
+        assert "demote" in out and "tier=int8" in out
+        assert "promote" in out and "tier=bf16" in out
+        assert "burn=12" in out
+
+    def test_format_transitions(self):
+        lines = format_governor_transitions([
+            {"action": "guard_trip", "tier": "bf16", "burn": None,
+             "reason": "recompile storm (2 total, was 1)"}])
+        assert "guard_trip" in lines and "recompile storm" in lines
+
+
+class TestPrewarm:
+    def test_hot_bucket_prewarms_once(self):
+        governor = ServingGovernor(GovernorConfig(
+            prewarm=True, prewarm_hot=3, breaker_guard=False),
+            clock=lambda: 0.0)
+        warmed = []
+
+        class ProgramsStub:
+            def prewarm_bucket(self, bucket):
+                warmed.append(bucket)
+                return 1
+
+        api = StubApi([0.0, 0.0, 0.0])
+        api.decoder.aot = ProgramsStub()
+        governor.observe_bucket(16)
+        governor.tick(api, now=0.0)
+        assert warmed == []  # 1 admission: not trending yet
+        governor.observe_bucket(16)
+        governor.observe_bucket(16)
+        governor.tick(api, now=1.0)
+        governor.drain_prewarm()
+        assert warmed == [16]
+        governor.observe_bucket(16)
+        governor.tick(api, now=2.0)  # already warmed: no repeat
+        governor.drain_prewarm()
+        assert warmed == [16]
+        assert governor.counters["prewarms"] == 1
+
+    def test_aot_programs_prewarm_bucket_compiles_admit_family(self):
+        from veles_tpu.aot.loader import AotPrograms
+
+        class EntryStub:
+            def __init__(self):
+                self.compiled = None
+
+            def get(self):
+                self.compiled = object()
+                return self.compiled
+
+        entries = {("decode.admit", ("admit", 16, 1)): EntryStub(),
+                   ("decode.admit", ("admit", 32, 1)): EntryStub(),
+                   ("decode.dispatch", ("chunk", 2, 16)): EntryStub()}
+        programs = AotPrograms({"geometry": None}, entries)
+        assert programs.prewarm_bucket(16) == 1
+        assert entries[("decode.admit", ("admit", 16, 1))].compiled \
+            is not None
+        assert entries[("decode.admit", ("admit", 32, 1))].compiled \
+            is None
+        # the step program is NOT an admit-family prewarm target
+        assert entries[("decode.dispatch", ("chunk", 2, 16))].compiled \
+            is None
+        assert programs.prewarm_bucket(16) == 0  # idempotent
+
+
+class TestChaosProfiles:
+    def test_profile_validation_and_enable(self):
+        with pytest.raises(ValueError, match=">= 0"):
+            ServingChaosConfig(latency_ramp_ms=-1)
+        with pytest.raises(ValueError, match="compile_storm_at"):
+            ServingChaosConfig(compile_storm_at=-2)
+        assert not ServingChaosConfig().any_profile
+        assert ServingChaosConfig(latency_ramp_ms=5,
+                                  latency_ramp_steps=2).any_profile
+        assert ServingChaosConfig(pool_flood_pages=4).any_profile
+        assert ServingChaosConfig(compile_storm_at=0).any_profile
+
+    def test_latency_ramp_is_deterministic_and_clears(self):
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, latency_ramp_ms=1.0, latency_ramp_steps=3))
+        for _ in range(5):
+            monkey.before_step()
+        assert monkey.counters["ramp_stalls"] == 3
+        assert "ramp_start" in monkey.stamps
+        assert "ramp_clear" in monkey.stamps
+        assert monkey.stamps["ramp_clear"] >= monkey.stamps["ramp_start"]
+
+    def test_pool_flood_reserves_and_releases(self):
+        from veles_tpu.parallel.kv_pool import PagePool
+
+        pool = PagePool(pages=17, page_size=4)
+        decoder = StubDecoder(pool=pool)
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, pool_flood_pages=12, pool_flood_at=1,
+            pool_flood_steps=2))
+        monkey.before_step(decoder)          # step 0: nothing
+        assert pool.snapshot()["reserved_pages"] == 0
+        monkey.before_step(decoder)          # step 1: flood
+        assert pool.snapshot()["reserved_pages"] == 12
+        assert monkey.counters["pool_floods"] == 1
+        monkey.before_step(decoder)          # step 2: held
+        assert pool.snapshot()["reserved_pages"] == 12
+        monkey.before_step(decoder)          # step 3: cleared
+        assert pool.snapshot()["reserved_pages"] == 0
+        assert "flood_clear" in monkey.stamps
+
+    def test_compile_storm_fires_the_detector(self):
+        from veles_tpu.observe.xla_stats import get_compile_tracker
+
+        tracker = get_compile_tracker()
+        was = tracker.enabled
+        tracker.enable()
+        before = tracker.storm_total()
+        try:
+            monkey = ServingChaosMonkey(ServingChaosConfig(
+                seed=CHAOS_SEED, compile_storm_at=0))
+            monkey.before_step()
+            assert monkey.counters["compile_storms"] == 1
+            assert tracker.storm_total() == before + 1
+        finally:
+            if not was:
+                tracker.disable()
+
+
+class TestChaosAcceptance:
+    """THE acceptance: seeded burn-inducing profiles, convergence to a
+    stable degraded tier (pinned transition counts), ledger-named
+    demotions, recovery to full fidelity, bit-identical greedy tokens
+    on the non-demoted path. Slow-marked: these wait out real SLO
+    windows (``make governor`` runs them; tier-1 skips)."""
+
+    pytestmark = [pytest.mark.governor, pytest.mark.slow]
+
+    def test_latency_ramp_demotes_recovers_bit_identical(self, model):
+        prompt = [1, 2, 3]
+        clean_api = make_api(model)
+        clean_api.start()
+        try:
+            code, body, _ = post(
+                "http://127.0.0.1:%d/generate" % clean_api.port,
+                {"tokens": prompt})
+            assert code == 200
+            want = body["tokens"]
+        finally:
+            clean_api.stop()
+
+        engine = SLOEngine({"ttft_p95_ms": 150.0}, windows=(2.0, 8.0),
+                           bucket_seconds=0.25)
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=3.0,
+            interval_s=0.05, ladder=("int8",), breaker_guard=False,
+            prewarm=False))
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, latency_ramp_ms=400.0,
+            latency_ramp_steps=10, latency_ramp_hold=1 << 30))
+        ledger = RequestLedger()
+        api = make_api(model, slo=engine, governor=governor,
+                       chaos=monkey, ledger=ledger)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            # the ramp stalls every driver step: requests burn the ttft
+            # objective until the governor demotes
+            pre_demote = []
+            deadline = time.time() + 60
+            while not governor.demoted and time.time() < deadline:
+                code, body, _ = post(url, {"tokens": prompt})
+                if code == 200 and not governor.demoted \
+                        and (api.decoder.quantize or "bf16") == "bf16":
+                    pre_demote.append(body["tokens"])
+                time.sleep(0.02)
+            assert governor.demoted, governor.snapshot()
+            # the fault HOLDS, so the governor stays demoted and the
+            # graceful swap lands once the in-flight bf16 work drains
+            # (nobody shed); keep a trickle of traffic flowing
+            assert wait_until(
+                lambda: (post(url, {"tokens": prompt}), )
+                and api.decoder.quantize == "int8", 90), \
+                api.decoder.quantize
+            # a demoted request's ledger row names its tier
+            code, body, _ = post(url, {"tokens": prompt})
+            assert code == 200
+            assert any(row.get("tier") == "int8"
+                       and row.get("quant") == "int8"
+                       for row in ledger.slowest(512)), \
+                [(r.get("quant"), r.get("tier"))
+                 for r in ledger.slowest(16)]
+            # stable degraded tier under the held fault: no further
+            # ladder moves while the burn persists
+            assert governor.counters["demotions"] == 1
+            # fault clears; a trickle of now-fast traffic shows the
+            # burn decaying (the governor promotes only on OBSERVED
+            # low burn — an empty window holds the tier) and full
+            # fidelity restores on its own
+            monkey.clear_ramp()
+            assert wait_until(
+                lambda: (post(url, {"tokens": prompt}), )
+                and not governor.demoted
+                and (api.decoder.quantize or "bf16") == "bf16", 90,
+                interval=0.1), governor.snapshot()
+            # pinned transition count: exactly one demote + one promote
+            # — zero oscillation under the seeded ramp
+            moves = [t["action"] for t in governor.transitions
+                     if t["action"] in ("demote", "promote")]
+            assert moves == ["demote", "promote"], moves
+            # full fidelity restored: burn < 1.0 and the post-recovery
+            # stream is bit-identical to the fault-free run, as is
+            # every pre-demote bf16 stream
+            code, body, _ = post(url, {"tokens": prompt})
+            assert code == 200 and body["tokens"] == want
+            for tokens in pre_demote:
+                assert tokens == want
+            summary = engine.summary()
+            assert summary is None or summary["burn_rate"] < 1.0
+            snap = api.health.snapshot()["governor"]
+            assert snap["counters"]["demotions"] == 1
+            assert snap["counters"]["promotions"] == 1
+        finally:
+            api.stop()
+
+    def test_pool_flood_resizes_admission_and_prices_retry(self, model):
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=1e9, cooldown_s=0.5, interval_s=0.02,
+            pool_high=0.5, min_admit=2, breaker_guard=False,
+            prewarm=False))
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, pool_flood_pages=48, pool_flood_at=4,
+            pool_flood_steps=1 << 30))
+        api = make_api(model, paged=True, pool_pages=64, max_queue=16,
+                       governor=governor, chaos=monkey)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            # traffic past the flood step: completed requests feed the
+            # release-rate window, the flood reserves most of the pool
+            for _ in range(4):
+                code, _, _ = post(url, {"tokens": [1, 2, 3]})
+                assert code == 200
+            assert wait_until(lambda: "flood_start" in monkey.stamps,
+                              30)
+            post(url, {"tokens": [1, 2, 3]})  # tick the governor
+            assert wait_until(
+                lambda: api.effective_max_queue < api.max_queue, 30), \
+                governor.snapshot()
+            # the pool gate rejects with a PRICED Retry-After (the
+            # worst-case demand cannot be reserved past the flood)
+            code, body, headers = post(url, {"tokens": [1, 2, 3] * 3})
+            if code == 429:
+                assert 1 <= int(headers["Retry-After"]) <= 60
+            assert api.health.retry_after_s() == governor.retry_price
+            assert governor.counters["admit_resizes"] >= 1
+            # fault clears: the reservation flood drops, the limit
+            # restores to the configured bound
+            monkey.release_flood()
+            post(url, {"tokens": [1, 2, 3]})
+            assert wait_until(
+                lambda: (post(url, {"tokens": [1, 2]}),)
+                and api.effective_max_queue == api.max_queue, 30), \
+                governor.snapshot()
+            code, body, _ = post(url, {"tokens": [1, 2, 3]})
+            assert code == 200 and len(body["tokens"]) == 5
+        finally:
+            monkey.release_flood()
+            api.stop()
+
+    def test_compile_storm_trips_breaker_proactively(self, model):
+        from veles_tpu.observe.xla_stats import get_compile_tracker
+
+        tracker = get_compile_tracker()
+        was = tracker.enabled
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=1e9, cooldown_s=5.0, interval_s=0.02,
+            breaker_guard=True, prewarm=False))
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=CHAOS_SEED, compile_storm_at=6))
+        api = make_api(model, governor=governor, chaos=monkey)
+        api.start()  # mounts metrics -> enables the compile tracker
+        try:
+            prompt = [1, 2, 3]
+            url = "http://127.0.0.1:%d/generate" % api.port
+            code, body, _ = post(url, {"tokens": prompt})
+            assert code == 200
+            want = body["tokens"]
+            # drive steps until the injected storm fires and the guard
+            # trips the breaker proactively
+            deadline = time.time() + 60
+            while monkey.counters["compile_storms"] == 0 \
+                    and time.time() < deadline:
+                post(url, {"tokens": prompt})
+                time.sleep(0.02)
+            assert monkey.counters["compile_storms"] == 1
+            assert wait_until(
+                lambda: governor.counters["guard_trips"] >= 1, 30), \
+                governor.snapshot()
+            # ONE guard trip per storm (cooldown-limited), the breaker
+            # healed behind the probe, and the retried stream is
+            # bit-identical. The trip executes at the top of the next
+            # drive pass: wait for the counter BEFORE the heal.
+            assert wait_until(
+                lambda: api.health.counter("trips") >= 1, 30), \
+                api.health.snapshot()
+            assert wait_until(lambda: api.health.ready, 30), \
+                api.health.snapshot()
+            snap = api.health.snapshot()
+            assert snap["counters"]["trips"] >= 1
+            assert snap["counters"]["rebuilds"] >= 1
+            assert governor.counters["guard_trips"] == 1
+            assert any(t["action"] == "guard_trip"
+                       and "storm" in t["reason"]
+                       for t in governor.transitions)
+            code, body, _ = post(url, {"tokens": prompt})
+            assert code == 200 and body["tokens"] == want
+        finally:
+            api.stop()
+            if not was:
+                tracker.disable()

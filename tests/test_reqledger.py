@@ -267,6 +267,34 @@ class TestSLOWindows:
         assert "veles_slo_" not in cold
         assert engine.summary(now=1000.0 + 7200.0) is None
 
+    def test_tenant_slice_retires_with_its_windows(self):
+        """Governor-PR satellite, beside the frozen-burn-rate guard
+        above: a tenant whose windows ALL emptied retires in the same
+        pruning pass as the global buckets — its gauges stop exporting
+        AND its cardinality-cap slot frees. Previously only the global
+        path was pinned: a long-dead tenant pinned the cap forever and
+        every new tenant folded into "other"."""
+        engine = SLOEngine({"availability": 0.9}, windows=(60.0,),
+                           tenant_cap=1)
+        engine.record(ok=False, tenant="acme", now=1000.0)
+        registry = MetricsRegistry(enabled=True)
+        engine.publish(registry, now=1005.0)
+        assert 'tenant="acme"' in registry.expose()
+        # global traffic continues two hours later; acme's windows all
+        # emptied — the same record() pruning pass retires the slice
+        engine.record(ok=True, now=1000.0 + 7200.0)
+        engine.publish(registry, now=1000.0 + 7200.0)
+        text = registry.expose()
+        assert "veles_slo_burn_rate" in text  # global still exports
+        assert "tenant=" not in text          # the slice retired
+        # the freed cap slot serves the NEXT tenant, not "other"
+        engine.record(ok=True, tenant="fresh", now=1000.0 + 7201.0)
+        tenants = {row["tenant"]
+                   for row in engine.gauges(now=1000.0 + 7202.0)}
+        assert "fresh" in tenants
+        assert "other" not in tenants
+        assert "acme" not in tenants
+
     def test_objective_parsing_rejects_garbage_naming_the_flag(self):
         assert parse_objectives(None) == []
         parsed = parse_objectives("ttft_p95_ms=250, availability=0.999",

@@ -215,6 +215,20 @@ class Main(Logger):
                            "burn-rate gauges "
                            "(root.common.observe.slo; "
                            "docs/observability.md)")
+        serve.add_argument("--serve-governor", default=None,
+                           metavar="KEY=VALUE[,KEY=VALUE...]",
+                           help="enable the closed-loop serving "
+                           "governor: SLO-burn-driven graceful "
+                           "degradation down the bf16->int8->int8-kv "
+                           "ladder with hysteresis bands, admission "
+                           "resize + Retry-After priced from the KV "
+                           "pool release rate, AOT hot-bucket prewarm "
+                           "and a proactive breaker guard — e.g. "
+                           "--serve-governor demote_burn=2,"
+                           "recover_burn=1,cooldown_s=10,"
+                           "ladder=int8+int8-kv "
+                           "(root.common.serve.governor; "
+                           "docs/serving_robustness.md)")
         serve.add_argument("--chaos-serve-seed", type=int, default=None,
                            metavar="N", help="serving chaos RNG seed")
         serve.add_argument("--chaos-serve-step-fail", type=float,
@@ -514,6 +528,16 @@ class Main(Logger):
                 parse_objectives(args.serve_slo, flag="--serve-slo")
             except ValueError as exc:
                 parser.error(str(exc))
+        if args.serve_governor:
+            # validate NOW (same early-failure contract as --serve-slo);
+            # the string lands in root.common.serve.governor below and
+            # GenerateAPI re-parses it at construction
+            from veles_tpu.observe.governor import parse_governor_spec
+            try:
+                parse_governor_spec(args.serve_governor,
+                                    flag="--serve-governor")
+            except ValueError as exc:
+                parser.error(str(exc))
         if args.serve_mesh:
             # validate NOW (same early-failure contract as --mesh); the
             # string itself lands in config below and GenerateAPI
@@ -546,6 +570,7 @@ class Main(Logger):
                 ("serve_pool_pages", root.common.serve, "pool_pages"),
                 ("serve_aot", root.common.serve, "aot"),
                 ("serve_slo", root.common.observe, "slo"),
+                ("serve_governor", root.common.serve, "governor"),
                 ("chaos_serve_seed", root.common.serve.chaos, "seed"),
                 ("chaos_serve_step_fail", root.common.serve.chaos,
                  "step_fail"),

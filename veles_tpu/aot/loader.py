@@ -307,6 +307,27 @@ class AotPrograms:
     def keys(self):
         return sorted(self._entries)
 
+    def prewarm_bucket(self, bucket):
+        """Compile every not-yet-compiled admit-family program whose
+        shape key names ``bucket`` (the serving governor's hot-bucket
+        actuator — docs/serving_robustness.md). Blocking; callers run
+        it on a background thread so the first cold admission of a
+        trending bucket finds its program already executable. Returns
+        the number of programs compiled."""
+        warmed = 0
+        for (name, key), entry in sorted(self._entries.items()):
+            # admit shape keys put the prompt bucket at key[1] (dense
+            # ("admit", bucket, group), paged ("paged_admit", bucket,
+            # group, pb)) — positional match, NOT membership, so a
+            # bucket equal to another entry's group-size element never
+            # prewarms unrelated programs
+            if "admit" not in name or len(key) < 2 or key[1] != bucket:
+                continue
+            if entry.compiled is None:
+                entry.get()
+                warmed += 1
+        return warmed
+
     # -- bookkeeping ------------------------------------------------------
     def _book_hit(self, name):
         from veles_tpu.observe.xla_stats import get_compile_tracker

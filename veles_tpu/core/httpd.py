@@ -87,6 +87,27 @@ def read_body(handler, limit=MAX_BODY):
     return handler.rfile.read(length)
 
 
+def retry_after_headers(source=None, need=1, fallback=1.0):
+    """THE priced ``Retry-After`` header — one helper for every
+    429/503 the serving surfaces emit (historically five independent
+    hardcoded ``"1"``s). ``source`` is anything with a
+    ``retry_after_s(need)`` (``ServingHealth`` consults its attached
+    governor, then its pool's observed page-release rate); without one
+    the fallback applies. Clamped to [1, 60] seconds like the
+    pool-gate pricing (``kv_pool.PagePool.retry_after``); a broken
+    source must degrade to the fallback, never break the reply."""
+    seconds = None
+    price = getattr(source, "retry_after_s", None)
+    if price is not None:
+        try:
+            seconds = price(need)
+        except Exception:
+            seconds = None
+    if seconds is None:
+        seconds = fallback
+    return {"Retry-After": "%d" % int(min(60, max(1, round(seconds))))}
+
+
 def serve_health(handler, health):
     """Route ``GET /healthz`` and ``GET /readyz`` against ``health``
     (any object with ``snapshot()`` -> dict and a ``ready`` bool).
@@ -105,7 +126,7 @@ def serve_health(handler, health):
             reply(handler, {"ready": True})
         else:
             reply(handler, {"ready": False, "state": health.snapshot()},
-                  code=503, headers={"Retry-After": "1"})
+                  code=503, headers=retry_after_headers(health))
         return True
     return False
 

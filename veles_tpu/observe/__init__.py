@@ -25,6 +25,13 @@ Three coordinated parts (docs/observability.md):
   objectives over multi-window rolling buckets exported as
   ``veles_slo_*`` burn-rate gauges (per-tenant slices, fleet
   piggyback), plus the exemplar-linked request latency histograms;
+- :mod:`veles_tpu.observe.governor` — the closed loop over all of the
+  above: the serving governor reads burn rates, pool release windows
+  and compile windows and ACTS — graceful tier degradation with
+  hysteresis, admission resize + priced Retry-After, AOT prewarm,
+  proactive breaker trips — every actuation ledger-visible
+  (``veles_governor_*`` gauges, flight-ring entries, demotion marks on
+  request rows);
 - :mod:`veles_tpu.observe.flight` — the always-on bounded flight
   recorder that dumps a black-box JSON on breaker trips, epoch fences,
   unit exceptions and SIGTERM (``veles_tpu observe blackbox``);

@@ -61,10 +61,17 @@ SCHEMA_VERSION = 1
 #: fleet_reduce*_ms / fleet_host_baseline_ms / fleet_step_ms regress
 #: UP via "_ms"; fleet_reduce*_bytes regress UP via "_bytes";
 #: fleet_step_mfu and fleet_inprogram_speedup use the higher-is-better
-#: default (and "_mfu"/"_speedup" carry spread siblings below)
+#: default (and "_mfu"/"_speedup" carry spread siblings below).
+#: The serving-governor keys (observe/governor.py, bench governor
+#: section): governor_demote_to_recover_ms rides the "_ms" rule (a
+#: slower fault->demote->recover loop regressed); "_transitions"
+#: regresses UP (more ladder moves for the same seeded fault profile
+#: is oscillation — the hysteresis got worse); the per-tier
+#: governor_*_attainment keys use the higher-is-better default (SLO
+#: attainment dropping at a tier is a regression).
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
-                 "_flatness", "_compiles", "burn_rate")
+                 "_flatness", "_compiles", "burn_rate", "_transitions")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

@@ -200,8 +200,20 @@ class SLOEngine:
             if not self._buckets or self._buckets[-1][0] < start:
                 self._buckets.append((start, {}))
                 horizon = now - self.windows[-1] - self.bucket_seconds
+                pruned = False
                 while self._buckets and self._buckets[0][0] < horizon:
                     self._buckets.pop(0)
+                    pruned = True
+                if pruned and self._tenants:
+                    # a tenant whose windows all emptied retires in
+                    # the SAME pruning pass as the global buckets: its
+                    # gauges stop exporting (publish REPLACES the
+                    # sample sets) AND its cardinality-cap slot frees,
+                    # so a long-dead tenant cannot pin the cap and
+                    # fold every new tenant into "other" forever
+                    live = {tenant for _, cells in self._buckets
+                            for (_, tenant) in cells if tenant}
+                    self._tenants &= live
             cells = self._buckets[-1][1]
             tenant_key = self._tenant_key(tenant)
             for objective in self.objectives:
@@ -260,20 +272,41 @@ class SLOEngine:
         return rows
 
     def summary(self, now=None):
-        """The dashboard cell: the worst aggregate burn rate over the
-        SHORTEST window (the page signal), or None without traffic."""
+        """The dashboard cell AND the governor's per-tick sensor: the
+        worst aggregate burn rate over the SHORTEST window (the page
+        signal), or None without traffic. Deliberately cheap — it sums
+        only the shortest window's aggregate cells under the lock
+        (never the full multi-window/tenant copy ``gauges`` makes),
+        because the serving governor reads it at ~4 Hz on the decode
+        driver thread."""
+        if now is None:
+            now = time.monotonic()
+        window = self.windows[0]
+        horizon = now - window
+        sums = {}
+        with self._lock:
+            for start, cells in self._buckets:
+                if start + self.bucket_seconds <= horizon:
+                    continue
+                for (objective, tenant), (good, total) in cells.items():
+                    if tenant is not None:
+                        continue
+                    cell = sums.setdefault(objective, [0, 0])
+                    cell[0] += good
+                    cell[1] += total
         worst = None
-        short = "%ds" % int(self.windows[0])
-        for row in self.gauges(now=now):
-            if row["tenant"] is not None or row["window"] != short:
+        for objective in self.objectives:
+            good, total = sums.get(objective.name, (0, 0))
+            if not total:
                 continue
-            if worst is None or row["burn_rate"] > worst["burn_rate"]:
-                worst = row
-        if worst is None:
-            return None
-        return {"burn_rate": worst["burn_rate"],
-                "objective": worst["objective"],
-                "window": worst["window"]}
+            budget = 1.0 - objective.target
+            burn = (1.0 - good / total) / budget if budget > 0 else 0.0
+            burn = round(burn, 6)
+            if worst is None or burn > worst["burn_rate"]:
+                worst = {"burn_rate": burn,
+                         "objective": objective.name,
+                         "window": "%ds" % int(window)}
+        return worst
 
     def publish(self, registry, now=None):
         """Scrape-time re-publication (the bridge contract). The
@@ -418,16 +451,19 @@ def observe_request(row, engine=None, registry=None, health=None):
 # -- the `veles_tpu observe slo` CLI ----------------------------------------
 
 def _rows_from_doc(doc):
-    """Ledger rows + SLO gauge lines out of a JSON artifact: a
-    flight-recorder black box (``requests`` section + ``metrics``
-    snapshot) or a saved ``/debug/requests`` payload."""
+    """Ledger rows + SLO gauge lines + governor actuations out of a
+    JSON artifact: a flight-recorder black box (``requests`` section +
+    ``metrics`` snapshot + governor flight entries) or a saved
+    ``/debug/requests`` payload."""
     if "entries" in doc or "requests" in doc:  # black-box dump
         requests = doc.get("requests") or {}
         slo_rows = [row for row in doc.get("metrics") or []
                     if str(row[0]).startswith("veles_slo_")]
-        return requests, slo_rows
+        governor = [entry for entry in doc.get("entries") or []
+                    if entry.get("kind") == "governor"]
+        return requests, slo_rows, governor
     if "slowest" in doc or "inflight" in doc:  # /debug/requests
-        return doc, []
+        return doc, [], []
     raise ValueError("not a black-box dump or /debug/requests payload")
 
 
@@ -441,6 +477,7 @@ def slo_main(target=None, live=None, slowest=8):
     from veles_tpu.observe.reqledger import autopsy
 
     slo_lines = []
+    governor_entries = []
     if live:
         base = live.rstrip("/")
         with urllib.request.urlopen(
@@ -459,7 +496,7 @@ def slo_main(target=None, live=None, slowest=8):
         try:
             with open(target, "r") as fin:
                 doc = json.load(fin)
-            requests, slo_rows = _rows_from_doc(doc)
+            requests, slo_rows, governor_entries = _rows_from_doc(doc)
         except (OSError, ValueError) as exc:
             print("cannot load %s: %s" % (target, exc))
             return 1
@@ -471,11 +508,21 @@ def slo_main(target=None, live=None, slowest=8):
         for line in slo_lines:
             print("  " + line)
         print()
+    if governor_entries:
+        # the actuation replay: what the governor DID during the
+        # window the black box covers, in order
+        from veles_tpu.observe.governor import \
+            format_governor_transitions
+        print("governor actuations:")
+        print(format_governor_transitions(governor_entries))
+        print()
     rows = list(requests.get("slowest") or [])
     inflight = list(requests.get("inflight") or [])
     if not rows and not inflight:
         print("no request rows (ledger empty?)")
-        return 1
+        # gauges or governor actuations alone are still a successful
+        # autopsy — 1 is reserved for a dump with nothing to show
+        return 0 if (slo_lines or governor_entries) else 1
     if inflight:
         print("%d in flight:" % len(inflight))
         print(autopsy(inflight, slowest=slowest))

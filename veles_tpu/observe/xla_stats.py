@@ -255,6 +255,13 @@ class CompileTracker:
                 self._flops[name] = float(flops)
 
     # -- views ------------------------------------------------------------
+    def storm_total(self):
+        """Total recompilation storms across programs — the serving
+        governor's stall predictor (one lock, no device/peak lookups:
+        cheap enough for a per-tick control-loop read)."""
+        with self._lock:
+            return sum(self._storms.values())
+
     def snapshot(self):
         """Plain-dict view for the web-status dashboard and black-box
         dumps."""

@@ -270,6 +270,7 @@ class ServingHealth:
         self._counters = {key: 0 for key in self.COUNTERS}
         self._pool_ref = None
         self._slo_ref = None
+        self._governor_ref = None
         self._latencies = {
             kind: collections.deque(maxlen=self.LATENCY_WINDOW)
             for kind in self.LATENCY_KINDS}
@@ -311,6 +312,33 @@ class ServingHealth:
         with self._lock:
             self._slo_ref = weakref.ref(engine) if engine is not None \
                 else None
+
+    def attach_governor(self, governor):
+        """Mirror the serving governor's tier/actuation state into the
+        health snapshot and let it price this surface's Retry-After
+        (weakly referenced, like the pool and the SLO engine)."""
+        import weakref
+
+        with self._lock:
+            self._governor_ref = weakref.ref(governor) \
+                if governor is not None else None
+
+    def retry_after_s(self, need=1):
+        """The honest Retry-After price for this surface's 429/503s,
+        in seconds clamped [1, 60]: the attached governor's price
+        first (it watches the pool release rate AND the degradation
+        state), else the pool's release-rate pricing, else 1 — the
+        ``core/httpd.py:retry_after_headers`` source contract."""
+        with self._lock:
+            governor = self._governor_ref() \
+                if self._governor_ref is not None else None
+            pool = self._pool_ref() if self._pool_ref is not None \
+                else None
+        if governor is not None:
+            return governor.retry_after_s(need)
+        if pool is not None:
+            return pool.retry_after(need)
+        return 1.0
 
     def attach_pool(self, pool):
         """Mirror a paged KV pool's occupancy/prefix-cache state into
@@ -415,12 +443,16 @@ class ServingHealth:
                 else None
             slo = self._slo_ref() if self._slo_ref is not None \
                 else None
+            governor = self._governor_ref() \
+                if self._governor_ref is not None else None
         if pool is not None:
             snap["pool"] = pool.snapshot()
         if slo is not None:
             summary = slo.summary()
             if summary is not None:
                 snap["slo"] = summary
+        if governor is not None:
+            snap["governor"] = governor.snapshot()
         return snap
 
 
@@ -567,10 +599,11 @@ class RESTfulAPI(Unit):
         # the same atomic admit/release pair as GenerateAPI, so the
         # /healthz inflight gauge and counters stay balanced here too
         # (the queue bound itself is the minibatch: feed overflows)
+        from veles_tpu.core.httpd import retry_after_headers
         if self.health.try_admit(None) is not None:
             ledger.resolve(row, "rejected", error="not ready")
             reply(handler, {"error": "not ready"}, code=503,
-                  headers={"Retry-After": "1"})
+                  headers=retry_after_headers(self.health))
             return
         responder = {"event": threading.Event(), "result": None}
         try:
@@ -578,11 +611,12 @@ class RESTfulAPI(Unit):
         except OverflowError:
             # admission control: the serving minibatch is full — shed
             # with a retry hint instead of queueing unboundedly (the
-            # batch flushes within max_response_time, so "1" is honest)
+            # batch flushes within max_response_time, so the priced
+            # helper's 1 s floor stays honest here)
             self.health.reject_admitted()
             ledger.resolve(row, "rejected", error="saturated")
             reply(handler, {"error": "server saturated: retry"},
-                  code=429, headers={"Retry-After": "1"})
+                  code=429, headers=retry_after_headers(self.health))
             return
         except Exception as exc:
             self.health.release("errors")
@@ -596,7 +630,7 @@ class RESTfulAPI(Unit):
             ledger.resolve(row, "expired", error="inference timed out")
             self.warning("inference timed out")
             reply(handler, {"error": "inference timed out"}, code=503,
-                  headers={"Retry-After": "1"})
+                  headers=retry_after_headers(self.health))
             return
         self.health.release("completed")
         ledger.resolve(row, "completed")
@@ -1724,7 +1758,20 @@ class GenerateAPI:
     ``/readyz`` expose the breaker state and the trip/rebuild/shed/
     expired counters. ``chaos`` accepts a
     :class:`veles_tpu.serving_chaos.ServingChaosMonkey` (default: built
-    from ``root.common.serve.chaos``)."""
+    from ``root.common.serve.chaos``).
+
+    Closed loop (observe/governor.py, docs/serving_robustness.md):
+    ``governor`` accepts a :class:`ServingGovernor` (default: built
+    from ``root.common.serve.governor`` / ``--serve-governor``; None
+    without config). The governor ticks on THIS driver thread and acts
+    through four seams — :meth:`request_tier` (graceful demote/promote
+    down the bf16→int8→int8-kv ladder on SLO burn),
+    :attr:`effective_max_queue` + ``ServingHealth.retry_after_s``
+    (admission resize and Retry-After priced from the pool release
+    rate), AOT bucket prewarm, and :meth:`request_trip` (proactive
+    breaker guard on recompile storms / memory pressure). Every
+    actuation lands in the flight ring, the ``veles_governor_*``
+    metrics and — for demotions — on the request ledger rows."""
 
     #: extra handler-side wait beyond the request deadline before the
     #: handler gives up on the driver (wedged-driver backstop)
@@ -1738,7 +1785,7 @@ class GenerateAPI:
                  rebuild_backoff_max=None, chaos=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=None,
                  page_size=None, pool_pages=None, aot=None, slo=None,
-                 ledger=None):
+                 ledger=None, governor=None):
         import queue
 
         from veles_tpu.core.config import root
@@ -1866,6 +1913,26 @@ class GenerateAPI:
             self.health.attach_pool(self.decoder.pool)
         if self.slo is not None:
             self.health.attach_slo(self.slo)
+        #: closed-loop governor (observe/governor.py,
+        #: root.common.serve.governor / --serve-governor): the control
+        #: loop over the sensors above. None without config — the
+        #: driver pays one attribute check per pass and every knob
+        #: stays the static flag it was.
+        self._base_tier = self.decoder.quantize or "bf16"
+        if governor is None:
+            from veles_tpu.observe.governor import ServingGovernor
+            governor = ServingGovernor.from_config()
+        self.governor = governor
+        if governor is not None:
+            governor.set_base_tier(self._base_tier)
+            self.health.attach_governor(governor)
+        #: the governor's graceful tier-swap request (driver-thread
+        #: owned) and the backoff stamp a failed swap arms so a sick
+        #: device cannot wedge the driver in swap-probe loops
+        self._tier_request = None
+        self._tier_block_until = 0.0
+        #: the governor's proactive-trip request (actuator d)
+        self._trip_request = None
         self._staged = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -1960,7 +2027,23 @@ class GenerateAPI:
                 self._resolve(holder, "errors", error=str(exc),
                               code=400)
                 continue
-            self.decoder.ledger_link(rid, holder.get("ledger_row"))
+            row = holder.get("ledger_row")
+            if row is not None:
+                # tier attribution is authoritative at SUBMIT time, on
+                # the decoder that will actually serve the request: a
+                # request staged while a tier swap was pending carries
+                # the handler's pre-swap snapshot — re-stamp it here so
+                # every demoted request's row truthfully names its tier
+                # (and a promote-raced row drops back to the base tier)
+                served_tier = self.decoder.quantize or "bf16"
+                row["quant"] = served_tier
+                if served_tier != self._base_tier:
+                    if row.get("tier") != served_tier:
+                        self.ledger.mark(row, "demoted",
+                                         tier=served_tier)
+                elif row.get("tier"):
+                    row["tier"] = served_tier
+            self.decoder.ledger_link(rid, row)
             get_tracer().event("serve.submit",
                                parent=holder.get("trace"), rid=rid)
             waiting[rid] = holder
@@ -2011,20 +2094,61 @@ class GenerateAPI:
         self.health.incr("trips")
         self.health.set_breaker("open")
         self.health.set_ready(False)
+        # a pending graceful swap is moot: the rebuild below lands on
+        # the governed tier directly (_governed_kwargs)
+        self._tier_request = None
         self._tripped = "decode driver failed: %s; rebuilding" % exc
         self._fail_all(waiting, self._tripped, outcome="shed", code=503)
 
+    def _governed_kwargs(self):
+        """The decoder construction kwargs at the tier the governor
+        currently wants (the configured tier without one): a rebuild
+        or tier swap lands directly on the governed rung instead of
+        flapping through the base tier first."""
+        kwargs = dict(self._decoder_kwargs)
+        tier = (self.governor.tier_name() if self.governor is not None
+                else self._base_tier)
+        kwargs["quantize"] = None if tier == "bf16" else tier
+        return kwargs, tier
+
+    def _build_probed_decoder(self, kwargs):
+        """THE build-and-probe discipline shared by the breaker
+        rebuild and the governor's tier swap: construct the decoder,
+        carry the request-id counter over (per-request sampling keys
+        ``fold_in(base, rid)`` must never repeat), then prove the
+        device path end to end with a probe decode through the
+        decoder's own :meth:`ContinuousDecoder.run_until_drained` —
+        bounded step budget, the DRIVER's chunk size (what live
+        traffic runs is what closes the gate), the chaos hook in the
+        loop. Raises on any failure, including a hung probe."""
+        decoder = ContinuousDecoder(**kwargs)
+        decoder._next_id = self.decoder._next_id
+        probe = decoder.submit([0], 1)
+        before = (self.chaos.before_step if self.chaos is not None
+                  else None)
+        decoder.run_until_drained(max_steps=8, chunk=self.chunk,
+                                  before_step=before)
+        if not decoder.done(probe):
+            raise RuntimeError("probe decode did not finish")
+        decoder.results.pop(probe, None)
+        return decoder
+
+    def _install_decoder(self, decoder):
+        """Swap the probed decoder in and re-point the health
+        surface's pool mirror at its fresh pool."""
+        self.decoder = decoder
+        if decoder.pool is not None:
+            self.health.attach_pool(decoder.pool)
+
     def _rebuild(self):
         """Build a fresh decoder from the held params/embed_table and
-        prove the device path end to end with a probe decode; only a
-        probed decoder takes traffic again. The probe runs through the
-        decoder's own :meth:`ContinuousDecoder.run_until_drained` with
-        a bounded step budget — it exercises whatever step semantics
-        the driver will actually use and RAISES on a hung probe instead
-        of looping silently. Returns True on success."""
+        prove the device path end to end with a probe decode
+        (:meth:`_build_probed_decoder`); only a probed decoder takes
+        traffic again. Returns True on success."""
         try:
-            kwargs = dict(self._decoder_kwargs)
-            if self.decoder.pool is not None:
+            kwargs, tier = self._governed_kwargs()
+            same_tier = tier == (self.decoder.quantize or "bf16")
+            if self.decoder.pool is not None and same_tier:
                 # the prefix cache OUTLIVES the decoder: its entries
                 # (tokens, logits, per-page payload shadows) restore
                 # into the fresh pool by page copy, so a breaker trip
@@ -2043,29 +2167,72 @@ class GenerateAPI:
                     import traceback
                     traceback.print_exc()
                 kwargs["prefix_cache"] = self.decoder.pool.cache
-            decoder = ContinuousDecoder(**kwargs)
-            # request ids stay monotonic across rebuilds so per-request
-            # sampling keys (fold_in(base, rid)) never repeat
-            decoder._next_id = self.decoder._next_id
-            probe = decoder.submit([0], 1)
-            before = (self.chaos.before_step if self.chaos is not None
-                      else None)
-            # probe with the DRIVER's chunk size so the chunked
-            # slot_step_many program — what live traffic runs — is
-            # what closes the breaker
-            decoder.run_until_drained(max_steps=8, chunk=self.chunk,
-                                      before_step=before)
-            if not decoder.done(probe):
-                raise RuntimeError("probe decode did not finish")
-            decoder.results.pop(probe, None)
+            decoder = self._build_probed_decoder(kwargs)
         except Exception:
             import traceback
             traceback.print_exc()
             return False
-        self.decoder = decoder
-        if decoder.pool is not None:
-            # /healthz + the pool gauges must mirror the FRESH pool
-            self.health.attach_pool(decoder.pool)
+        self._install_decoder(decoder)
+        return True
+
+    # -- governor actuation seams (driver thread) -------------------------
+    @property
+    def effective_max_queue(self):
+        """The admission bound actually enforced: the governor's
+        resized limit while one is in effect, else ``max_queue``."""
+        governor = self.governor
+        if governor is not None:
+            # single read: the driver-thread tick rebinds admit_limit
+            # concurrently, and a check-then-read pair could return a
+            # None the None-check just ruled out (try_admit treats
+            # None as UNBOUNDED — an admission-control bypass)
+            override = governor.admit_limit
+            if override is not None:
+                return override
+        return self.max_queue
+
+    def request_tier(self, tier):
+        """Governor actuator (a): ask the driver for a GRACEFUL swap
+        to ``tier`` — stop admitting, drain the in-flight requests at
+        their admitted tier (bit-identical tokens), then rebuild the
+        decoder at the new tier behind a probe. Ignored while a failed
+        swap's backoff is armed, and idempotent at the live tier."""
+        if time.monotonic() < self._tier_block_until:
+            return
+        if tier == (self.decoder.quantize or "bf16"):
+            self._tier_request = None
+            return
+        self._tier_request = tier
+
+    def request_trip(self, reason):
+        """Governor actuator (d): trip the breaker proactively at the
+        top of the next drive pass (shed retryably + rebuild behind
+        the probe) — a predicted stall is handled like a real one."""
+        self._trip_request = reason
+
+    def _apply_tier(self, tier):
+        """The graceful tier swap: the decoder is idle (the driver
+        drained in-flight work first and held the staged queue), so
+        nobody is shed — build the new-tier decoder, probe it, swap.
+        The prefix cache does NOT carry across tiers (cached pages
+        hold tier-specific KV bytes). A failed swap arms a backoff and
+        leaves the live decoder serving. Returns True on success."""
+        kwargs = dict(self._decoder_kwargs)
+        kwargs["quantize"] = None if tier == "bf16" else tier
+        try:
+            decoder = self._build_probed_decoder(kwargs)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            self._tier_block_until = time.monotonic() \
+                + 4 * self.rebuild_backoff
+            get_flight_recorder().note("governor.tier_failed",
+                                       tier=tier)
+            return False
+        self._install_decoder(decoder)
+        self.health.incr("tier_swaps")
+        get_flight_recorder().note("governor.tier", tier=tier,
+                                   base=self._base_tier)
         return True
 
     def _note_progress(self, waiting):
@@ -2132,9 +2299,38 @@ class GenerateAPI:
                         backoff = min(backoff * 2,
                                       self.rebuild_backoff_max)
                     continue
-                waiting.update(self._drain_staged())
+                if self.governor is not None:
+                    # the closed loop rides the driver thread — one
+                    # rate-limited pass, and a broken governor must
+                    # never take the driver down with it
+                    try:
+                        self.governor.tick(self)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc()
+                if self._trip_request is not None:
+                    # proactive breaker guard: treat the predicted
+                    # stall exactly like a real one — shed retryably,
+                    # rebuild behind the probe
+                    reason = self._trip_request
+                    self._trip_request = None
+                    self._pending = None
+                    self._trip(RuntimeError(reason), waiting)
+                    continue
+                if self._tier_request is None:
+                    waiting.update(self._drain_staged())
+                # while a tier swap is pending the staged queue HOLDS:
+                # in-flight requests drain at their admitted tier (the
+                # bit-identity contract), then the idle branch swaps
+                # and the next pass admits into the new-tier decoder
                 self._expire_deadlines(waiting)
                 if not self.decoder.busy and self._pending is None:
+                    if self._tier_request is not None:
+                        tier = self._tier_request
+                        self._tier_request = None
+                        if tier != (self.decoder.quantize or "bf16"):
+                            self._apply_tier(tier)
+                        continue
                     # idle: the MFU cadence baseline must not span the
                     # gap, or the first chunk of the next burst feeds
                     # the whole idle wall time into the step-time EMA
@@ -2145,7 +2341,7 @@ class GenerateAPI:
                     continue
                 try:
                     if self.chaos is not None:
-                        self.chaos.before_step()
+                        self.chaos.before_step(self.decoder)
                     current = self.decoder.dispatch_chunk(self.chunk)
                     if self._pending is not None:
                         self.decoder.collect_chunk(self._pending)
@@ -2165,7 +2361,8 @@ class GenerateAPI:
         from http.server import BaseHTTPRequestHandler
         from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
-                                          reply, serve_debug_requests,
+                                          reply, retry_after_headers,
+                                          serve_debug_requests,
                                           serve_health, serve_metrics,
                                           start_server)
 
@@ -2184,6 +2381,12 @@ class GenerateAPI:
             # fleet piggyback (registry.snapshot runs collectors)
             bridge(registry, self.slo,
                    lambda reg, live: live.publish(reg))
+        if self.governor is not None:
+            # governor actuations are ledger-visible on /metrics too:
+            # tier level, effective limit, priced Retry-After and the
+            # per-action actuation counters (observe/governor.py)
+            from veles_tpu.observe.governor import publish_governor
+            bridge(registry, self.governor, publish_governor)
 
         class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
             def do_GET(self):
@@ -2280,16 +2483,26 @@ class GenerateAPI:
                 # when tracing is on, else the CLIENT's propagated id
                 # — exemplars and autopsies link either way
                 ctx = req_span.context()
+                decoder = api.decoder
                 row = api.ledger.stage(
                     api="generate-api",
                     trace=ctx[0] if ctx else trace_hint,
                     tenant=tenant,
                     prompt_len=len(prompt),
                     budget=(budget if budget is not None
-                            else api.decoder.n_tokens),
-                    bucket=api.decoder.bucket_for(len(prompt)),
-                    quant=api.decoder.quantize,
+                            else decoder.n_tokens),
+                    bucket=decoder.bucket_for(len(prompt)),
+                    quant=decoder.quantize,
                     breaker_gen=api.health.counter("rebuilds"))
+                serving_tier = decoder.quantize or "bf16"
+                if serving_tier != api._base_tier:
+                    # the governed tier in effect: the demoted
+                    # request's row names its tier (the acceptance's
+                    # ledger-visibility contract) beside the quant
+                    # field that says what actually served it; the
+                    # driver re-stamps both at submit time if a tier
+                    # swap lands in between (_drain_staged)
+                    api.ledger.mark(row, "demoted", tier=serving_tier)
                 booked = {}
                 pool_gate = None
                 if api.decoder.pool is not None:
@@ -2312,14 +2525,16 @@ class GenerateAPI:
                             booked["reserved"] = True
                             return None
                         return pool.retry_after(need)
-                verdict = api.health.try_admit(api.max_queue,
+                admit_limit = api.effective_max_queue
+                verdict = api.health.try_admit(admit_limit,
                                                pool_gate=pool_gate)
                 if verdict == "unready":
                     req_span.annotate(outcome="unready")
                     api.ledger.resolve(row, "rejected",
                                        error="unready")
                     reply(self, {"error": api._tripped or "not ready"},
-                          code=503, headers={"Retry-After": "1"})
+                          code=503,
+                          headers=retry_after_headers(api.health))
                     return
                 if verdict == "full":
                     req_span.annotate(outcome="rejected")
@@ -2327,8 +2542,9 @@ class GenerateAPI:
                                        error="queue full")
                     reply(self,
                           {"error": "saturated: %d requests in flight"
-                           % api.max_queue},
-                          code=429, headers={"Retry-After": "1"})
+                           % admit_limit},
+                          code=429,
+                          headers=retry_after_headers(api.health))
                     return
                 if isinstance(verdict, tuple) and verdict[0] == "pool":
                     req_span.annotate(outcome="pool_full")
@@ -2343,6 +2559,12 @@ class GenerateAPI:
                           headers={"Retry-After":
                                    "%d" % max(1, round(verdict[1]))})
                     return
+                if api.governor is not None:
+                    # prewarm trend sensor (actuator c): ADMITTED
+                    # requests only — rejections must not heat a
+                    # bucket the server never actually serves
+                    api.governor.observe_bucket(
+                        decoder.bucket_for(len(prompt)))
                 staged_at = time.monotonic()
                 holder = {"event": threading.Event(),
                           "staged_at": staged_at,
@@ -2377,7 +2599,7 @@ class GenerateAPI:
                     req_span.annotate(outcome="error", code=code)
                     headers = dict(trace_headers)
                     if code in (429, 503):
-                        headers["Retry-After"] = "1"
+                        headers.update(retry_after_headers(api.health))
                     reply(self, {"error": holder["error"]}, code=code,
                           headers=headers)
                     return
@@ -2404,6 +2626,12 @@ class GenerateAPI:
             # ("server stopped") so no handler blocks out its deadline
             self._driver.join(timeout=10)
             self._driver = None
+        if self.governor is not None:
+            # outstanding prewarm compiles are non-daemon threads (an
+            # XLA compile must never be killed mid-flight); join them
+            # AFTER the driver so its final pass cannot spawn a
+            # straggler this join would miss
+            self.governor.drain_prewarm()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None

@@ -16,6 +16,30 @@ loop through :meth:`ServingChaosMonkey.before_step`:
   pre-empted TPU slice); exercises deadline expiry and queue backpressure
   without killing anything.
 
+**Burn-inducing profiles** (deterministic, step-indexed — no RNG), the
+serving governor's proving ground (``observe/governor.py``,
+docs/serving_robustness.md): each drives one sensor plane past its
+threshold for a bounded window and then CLEARS, so the chaos suite can
+pin that the governor converges to a stable degraded tier and restores
+full fidelity afterwards:
+
+- **latency ramp** — ``latency_ramp_ms``/``latency_ramp_steps``
+  (+ ``latency_ramp_hold``): every driver step inside the window
+  stalls for a linearly growing slice of the peak, then holds the
+  peak for ``latency_ramp_hold`` more steps (or until
+  :meth:`ServingChaosMonkey.clear_ramp`), burning the ttft objective;
+- **pool-exhaustion flood** — ``pool_flood_pages`` at step
+  ``pool_flood_at`` for ``pool_flood_steps``: the monkey allocates
+  (and later releases) pages straight from the decoder's KV pool,
+  driving occupancy/release-rate pressure;
+- **compile-storm trigger** — ``compile_storm_at``: injects a
+  threshold-worth of same-name compiles into the process
+  CompileTracker, firing its storm detector (the governor's proactive
+  breaker guard).
+
+The fault-inject and fault-clear instants land in ``stamps`` (mono
+clocks) so the bench can measure demote→recover wall time.
+
 **Client-side faults**, rolled by the test harness's chaos client via
 :meth:`roll_client_fault` (the server cannot inject these on itself):
 
@@ -63,7 +87,11 @@ class ServingChaosConfig(ChaosConfigBase):
 
     def __init__(self, seed=1, step_fail=0.0, step_fail_max=None,
                  slow_step=0.0, slow_step_ms=20.0, disconnect=0.0,
-                 garbage_body=0.0, oversize_body=0.0):
+                 garbage_body=0.0, oversize_body=0.0,
+                 latency_ramp_ms=0.0, latency_ramp_steps=0,
+                 latency_ramp_hold=0,
+                 pool_flood_pages=0, pool_flood_at=0,
+                 pool_flood_steps=0, compile_storm_at=None):
         self._set_probabilities(
             step_fail=step_fail, slow_step=slow_step,
             disconnect=disconnect, garbage_body=garbage_body,
@@ -75,6 +103,31 @@ class ServingChaosConfig(ChaosConfigBase):
         self.step_fail_max = step_fail_max
         self.seed = int(seed)
         self.slow_step_ms = float(slow_step_ms)
+        # burn-inducing profiles (deterministic, step-indexed)
+        self.latency_ramp_ms = float(latency_ramp_ms)
+        self.latency_ramp_steps = int(latency_ramp_steps)
+        self.latency_ramp_hold = int(latency_ramp_hold)
+        if self.latency_ramp_ms < 0 or self.latency_ramp_steps < 0 \
+                or self.latency_ramp_hold < 0:
+            raise ValueError("latency ramp knobs must be >= 0")
+        self.pool_flood_pages = int(pool_flood_pages)
+        self.pool_flood_at = int(pool_flood_at)
+        self.pool_flood_steps = int(pool_flood_steps)
+        if self.pool_flood_pages < 0 or self.pool_flood_at < 0 \
+                or self.pool_flood_steps < 0:
+            raise ValueError("pool flood knobs must be >= 0")
+        if compile_storm_at is not None:
+            compile_storm_at = int(compile_storm_at)
+            if compile_storm_at < 0:
+                raise ValueError("compile_storm_at must be >= 0")
+        self.compile_storm_at = compile_storm_at
+
+    @property
+    def any_profile(self):
+        """True when a burn-inducing profile is configured."""
+        return bool((self.latency_ramp_ms and self.latency_ramp_steps)
+                    or self.pool_flood_pages
+                    or self.compile_storm_at is not None)
 
 
 class ServingChaosMonkey(Logger):
@@ -90,7 +143,21 @@ class ServingChaosMonkey(Logger):
         self._rng_client = random.Random("client-%d" % config.seed)
         self.counters = {"steps_failed": 0, "steps_slowed": 0,
                          "disconnects": 0, "garbage_bodies": 0,
-                         "oversize_bodies": 0}
+                         "oversize_bodies": 0, "ramp_stalls": 0,
+                         "pool_floods": 0, "compile_storms": 0}
+        #: driver-step index: the burn profiles are step-indexed, so a
+        #: (config, workload) pair replays the same fault schedule
+        self._step = 0
+        #: harness-forced end of the latency ramp (clear_ramp)
+        self._ramp_cleared = False
+        #: pages the pool-flood profile currently holds hostage; done
+        #: latches after the release so the flood fires exactly once
+        self._flood_pages = None
+        self._flood_pool = None
+        self._flood_done = False
+        #: fault-inject / fault-clear instants (monotonic): the bench's
+        #: governor_demote_to_recover_ms measures from these
+        self.stamps = {}
 
     @classmethod
     def from_config(cls):
@@ -106,8 +173,16 @@ class ServingChaosMonkey(Logger):
             slow_step_ms=cfg.get("slow_step_ms", 20.0),
             disconnect=cfg.get("disconnect", 0.0),
             garbage_body=cfg.get("garbage_body", 0.0),
-            oversize_body=cfg.get("oversize_body", 0.0))
-        if not cfg.get("enabled", config.any_enabled):
+            oversize_body=cfg.get("oversize_body", 0.0),
+            latency_ramp_ms=cfg.get("latency_ramp_ms", 0.0),
+            latency_ramp_steps=cfg.get("latency_ramp_steps", 0),
+            latency_ramp_hold=cfg.get("latency_ramp_hold", 0),
+            pool_flood_pages=cfg.get("pool_flood_pages", 0),
+            pool_flood_at=cfg.get("pool_flood_at", 0),
+            pool_flood_steps=cfg.get("pool_flood_steps", 0),
+            compile_storm_at=cfg.get("compile_storm_at", None))
+        if not cfg.get("enabled",
+                       config.any_enabled or config.any_profile):
             return None
         monkey = cls(config)
         monkey.info(
@@ -118,12 +193,16 @@ class ServingChaosMonkey(Logger):
         return monkey
 
     # -- server-side (driver) faults ------------------------------------------
-    def before_step(self):
+    def before_step(self, decoder=None):
         """Called by the GenerateAPI driver before each decoder dispatch
         (including rebuild-probe decodes): maybe stretch the step, maybe
         raise the injected device failure. Each stream advances in a
         fixed call order on its own thread -> deterministic fault
-        schedule for a deterministic workload."""
+        schedule for a deterministic workload. ``decoder`` (the live
+        driver passes it; probe decodes don't) is the burn-profile
+        seam — the pool-flood profile allocates its hostage pages from
+        the decoder's own KV pool."""
+        self._run_profiles(decoder)
         if roll(self._rng, self.config.slow_step):
             self.counters["steps_slowed"] += 1
             time.sleep(self.config.slow_step_ms / 1000.0)
@@ -136,6 +215,86 @@ class ServingChaosMonkey(Logger):
             self.warning("chaos: injecting decoder-step failure (#%d)",
                          self.counters["steps_failed"])
             raise ChaosStepError("chaos: injected decoder-step failure")
+
+    # -- burn-inducing profiles (deterministic, step-indexed) -----------------
+    def _run_profiles(self, decoder):
+        """Advance the step index and fire whichever burn profiles the
+        current step falls inside (see module docstring)."""
+        cfg = self.config
+        step = self._step
+        self._step += 1
+        if cfg.latency_ramp_ms and cfg.latency_ramp_steps \
+                and not self._ramp_cleared:
+            window = cfg.latency_ramp_steps + cfg.latency_ramp_hold
+            if step < window:
+                if step == 0:
+                    self.stamps["ramp_start"] = time.monotonic()
+                # linear ramp toward the peak stall (burn builds up
+                # instead of arriving as one cliff), then hold the
+                # peak for latency_ramp_hold steps — a PERSISTENT
+                # fault the governor must stay demoted under
+                stall = cfg.latency_ramp_ms \
+                    * min(1.0, (step + 1) / cfg.latency_ramp_steps)
+                self.counters["ramp_stalls"] += 1
+                time.sleep(stall / 1000.0)
+            elif step == window:
+                self.stamps["ramp_clear"] = time.monotonic()
+        if cfg.pool_flood_pages and decoder is not None \
+                and decoder.pool is not None and not self._flood_done:
+            # >=, not ==: the scheduled step can land on a probe
+            # decode's before_step() (no decoder) or on a try_reserve
+            # race — retry until the flood actually engages
+            if step >= cfg.pool_flood_at and self._flood_pages is None:
+                # flood the RESERVATION plane (what the admission gate
+                # sums), not the raw free list: admitted requests keep
+                # their no-deadlock page promise while new arrivals
+                # see a pool promised to capacity — exactly the
+                # exhaustion signature the governor resizes against
+                if decoder.pool.try_reserve(cfg.pool_flood_pages):
+                    self._flood_pages = cfg.pool_flood_pages
+                    self._flood_pool = decoder.pool
+                    self.counters["pool_floods"] += 1
+                    self.stamps["flood_start"] = time.monotonic()
+                    self.warning("chaos: flooding KV pool (%d pages "
+                                 "reserved)", cfg.pool_flood_pages)
+            elif self._flood_pages is not None \
+                    and step >= cfg.pool_flood_at + cfg.pool_flood_steps:
+                self.release_flood()
+        if cfg.compile_storm_at is not None \
+                and step == cfg.compile_storm_at:
+            from veles_tpu.observe.xla_stats import get_compile_tracker
+            tracker = get_compile_tracker()
+            if tracker.enabled:
+                # a threshold-worth of same-name compiles inside the
+                # window fires the storm detector — the governor's
+                # proactive breaker guard sees exactly what a real
+                # shape-churning storm would produce
+                for _ in range(tracker.STORM_THRESHOLD):
+                    tracker.record_compile("chaos.compile_storm", 0.001)
+                self.counters["compile_storms"] += 1
+                self.stamps["storm_at"] = time.monotonic()
+                self.warning("chaos: injected recompile storm")
+
+    def clear_ramp(self):
+        """End the latency ramp NOW (the harness clears a held fault;
+        idempotent)."""
+        if not self._ramp_cleared:
+            self._ramp_cleared = True
+            self.stamps.setdefault("ramp_clear", time.monotonic())
+
+    def release_flood(self):
+        """Drop the flood's reservation (the fault clears; also safe
+        to call from the harness at teardown)."""
+        self._flood_done = True
+        if self._flood_pages is None:
+            return
+        pool, reserved = self._flood_pool, self._flood_pages
+        self._flood_pages = None
+        self._flood_pool = None
+        try:
+            pool.unreserve(reserved)
+        finally:
+            self.stamps["flood_clear"] = time.monotonic()
 
     # -- client-side faults (rolled by the harness's chaos client) ------------
     def roll_client_fault(self):

@@ -203,6 +203,23 @@ def format_serving_health(serving):
             entry = latency.get(kind)
             if isinstance(entry, dict) and entry.get("count"):
                 parts.append("%s p95 %sms" % (label, entry["p95"]))
+    governor = serving.get("governor")
+    if isinstance(governor, dict):
+        # the closed-loop cell (observe/governor.py): the governed
+        # tier while degraded, plus how many times the ladder moved —
+        # a dashboard scan shows "tier int8 (governed)" the moment
+        # graceful degradation engages
+        if governor.get("demoted"):
+            parts.append("tier %s (governed)" % governor.get("tier"))
+        gov_counters = governor.get("counters")
+        if isinstance(gov_counters, dict):
+            moves = (gov_counters.get("demotions", 0)
+                     + gov_counters.get("promotions", 0))
+            if moves:
+                parts.append("%d tier moves" % moves)
+            if gov_counters.get("guard_trips"):
+                parts.append("%d guard trips"
+                             % gov_counters["guard_trips"])
     slo = serving.get("slo")
     if isinstance(slo, dict) and slo.get("burn_rate") is not None:
         # the SLO cell (observe/slo.py): the worst short-window burn
