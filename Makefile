@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr aot slo governor
+	regress mesh paged fleet-mr aot slo governor history
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -110,6 +110,19 @@ slo:
 governor:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_governor.py \
 		-m governor -q
+
+# Metric flight recorder suite (docs/observability.md): ring/series-cap
+# bounds and counter-rate math, the threshold/slope/drop anomaly
+# predicates on synthetic series, incident-artifact schema + atomic
+# write discipline + leading-indicator math, the /debug/history round
+# trip, fleet slave-labeled history piggyback, sparkline cells, the
+# `observe incident` CLI on saved and live payloads, and the
+# governor-reads-history acceptance (control and autopsy trends share
+# one store). The chaos-driven end-to-end cases ride the `slow` marker
+# so tier-1 keeps its timeout margin.
+history:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_history.py \
+		-m history -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

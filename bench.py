@@ -2081,6 +2081,147 @@ def governor_section():
     return out
 
 
+def history_section():
+    """Metric flight recorder bench (docs/observability.md): the cost
+    of always-on trend memory, and how fast it notices a fault —
+
+    - ``history_sample_off_ns`` / ``history_sample_on_ns``:
+      steady-state nanoseconds per registry sample without/with the
+      history store (rings + seed rules) attached — the embedded
+      recorder's whole tax, lower-better via the ``_ns`` regress rule;
+    - ``incident_mttd_ms``: seeded latency-ramp fault injection ->
+      first anomaly firing (the detector's mean time to detect);
+    - ``history_anomaly_rate``: rule firings per sample over the chaos
+      window (a noisier detector regressed — the ``_anomaly_rate``
+      rule);
+    - ``incident_leading_series``: which series the incident artifact
+      named as the leading indicator (string, not compared).
+    """
+    import tempfile
+    import urllib.request
+
+    from veles_tpu.observe.history import (AnomalyRule,
+                                           IncidentRecorder,
+                                           MetricHistory,
+                                           default_rules,
+                                           get_metric_history,
+                                           set_metric_history)
+    from veles_tpu.observe.metrics import (MetricsRegistry,
+                                           get_metrics_registry)
+    from veles_tpu.observe.reqledger import RequestLedger
+    from veles_tpu.observe.slo import SLOEngine
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import GenerateAPI
+    from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                         ServingChaosMonkey)
+
+    out = {}
+    # -- sampler overhead: a synthetic registry with a representative
+    # series population, sampled bare vs through the history store
+    bench_reg = MetricsRegistry(enabled=True)
+    for i in range(64):
+        bench_reg.set("veles_bench_gauge", float(i),
+                      labels={"lane": str(i)})
+        bench_reg.counter_set("veles_bench_total", 100 + i,
+                              labels={"lane": str(i)})
+        bench_reg.observe("veles_bench_seconds", 0.001 * i,
+                          labels={"lane": str(i % 8)})
+    reps = 200
+    start = time.perf_counter()
+    for _ in range(reps):
+        bench_reg.sample()
+    out["history_sample_off_ns"] = round(
+        (time.perf_counter() - start) / reps * 1e9, 1)
+    bench_hist = MetricHistory(
+        registry=bench_reg, interval_s=0.0, capacity=256,
+        rules=default_rules(),
+        incidents=IncidentRecorder(cooldown_s=3600.0,
+                                   directory=tempfile.mkdtemp()))
+    for _ in range(8):  # warm the rings to steady state
+        bench_hist.sample()
+    start = time.perf_counter()
+    for _ in range(reps):
+        bench_hist.sample()
+    out["history_sample_on_ns"] = round(
+        (time.perf_counter() - start) / reps * 1e9, 1)
+
+    # -- chaos-driven MTTD: a seeded latency ramp burns the ttft
+    # objective; measure fault-inject -> first anomaly firing
+    threshold_s = 0.150
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 32, 64
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.1)
+    engine = SLOEngine({"ttft_p95_ms": threshold_s * 1000.0},
+                       windows=(2.0, 8.0), bucket_seconds=0.25)
+    # incident cooldown 0 so the LAST artifact (the slo_burn-triggered
+    # one) carries both breaching rules; each rule fires once. The
+    # latency rule exists so the leading indicator is a measurement —
+    # the gauge updates at first token, before the burn can resolve
+    hist = MetricHistory(
+        registry=get_metrics_registry(), interval_s=0.1,
+        incidents=IncidentRecorder(cooldown_s=0.0,
+                                   directory=tempfile.mkdtemp()))
+    hist.add_rule(AnomalyRule(
+        "ttft_p95_high", "veles_serving_latency_ms",
+        match={"kind": "ttft", "quantile": "p95"}, kind="threshold",
+        op=">=", threshold=threshold_s * 500.0, for_samples=1,
+        cooldown_s=3600.0))
+    hist.add_rule(AnomalyRule(
+        "slo_burn", "veles_slo_burn_rate", kind="threshold", op=">=",
+        threshold=2.0, for_samples=1, cooldown_s=3600.0))
+    previous = get_metric_history()
+    set_metric_history(hist)
+    monkey = ServingChaosMonkey(ServingChaosConfig(
+        seed=1, latency_ramp_ms=300.0, latency_ramp_steps=8,
+        latency_ramp_hold=1 << 30))
+    api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                      n_tokens=5, chunk=2, port=0,
+                      rebuild_backoff=0.02, slo=engine, chaos=monkey,
+                      ledger=RequestLedger())
+    api.start()
+    url = "http://127.0.0.1:%d/generate" % api.port
+    samples_before = hist.samples_total
+    burn_rule = hist.rules[-1]
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline \
+                and not burn_rule.fired_total:
+            req = urllib.request.Request(
+                url, data=json.dumps({"tokens": [1, 2, 3]}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+            except Exception:
+                pass
+            hist.maybe_sample()
+        doc = hist.incidents.last_doc
+        ramp_start = monkey.stamps.get("ramp_start")
+        first_fire = min((r.last_fired for r in hist.rules
+                          if r.last_fired is not None),
+                         default=None)
+        if doc is not None and ramp_start is not None \
+                and first_fire is not None:
+            out["incident_mttd_ms"] = round(
+                (first_fire - ramp_start) * 1000.0, 1)
+            out["incident_leading_series"] = \
+                doc["leading_indicator"]["series"]
+        window_samples = hist.samples_total - samples_before
+        if window_samples:
+            out["history_anomaly_rate"] = round(
+                hist.anomalies_total / window_samples, 4)
+        out["history_config"] = ("interval_s=0.1,rules=ttft_p95_high"
+                                 "+slo_burn,ramp=300msx8+hold")
+    finally:
+        monkey.clear_ramp()
+        api.stop()
+        set_metric_history(previous)
+    return out
+
+
 def serve_main(profile_dir=None, artifact_path=None):
     """``make bench-serve``: the continuous-batching serving bench
     standalone (one JSON line) — fast iteration on the slot-engine hot
@@ -2138,6 +2279,12 @@ def serve_main(profile_dir=None, artifact_path=None):
             # fault->demote->recover wall time, transition count and
             # per-tier SLO attainment under a seeded latency ramp
             section = _guarded(governor_section, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # the metric flight recorder (docs/observability.md):
+            # sampler overhead with history on vs off, and the
+            # chaos-driven incident MTTD + anomaly rate
+            section = _guarded(history_section, fallback={})
             out.update(section)
             artifact.update(section)
         out["decode_histograms"] = registry.histogram_summary(

@@ -28,3 +28,11 @@ os.environ["VELES_TPU_HOME"] = _tmp
 from veles_tpu.core.config import root  # noqa: E402
 
 root.common.disable.plotting = True
+# the metric flight recorder (observe/history.py) is default-on at a
+# 1 s cadence wherever /metrics mounts; each sample runs EVERY
+# registry collector, including the per-device live-buffer memory
+# walk, for the remainder of the session — at test scale that bleeds
+# tier-1's timeout margin. Keep the default-on wiring exercised but
+# sample lazily; tests that need a fast cadence build their own
+# MetricHistory (tests/test_history.py does).
+root.common.observe.history = "interval_s=30"

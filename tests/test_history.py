@@ -1,0 +1,878 @@
+"""Metric flight recorder (docs/observability.md, ISSUE 12): bounded
+time-series rings with counter-rate math and a series-count cap, the
+threshold/slope/drop anomaly predicates on synthetic series, incident
+artifacts (schema, atomic counter-suffixed writes, leading-indicator
+math), the ``/debug/history`` round trip, fleet slave-labeled history
+piggyback, sparkline cells, the ``observe incident`` CLI on saved and
+live payloads, the governor-reads-history seam (control and autopsy
+trends share ONE store) — and the chaos acceptance: under each seeded
+burn profile (latency ramp, pool flood, compile storm) an incident is
+produced whose leading indicator names the injected fault's series.
+``make history`` runs this module standalone; the chaos end-to-end
+cases ride the ``slow`` marker so tier-1 keeps its timeout margin."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.observe.history import (AnomalyRule, FLEET_MAX_SERIES,
+                                       HistoryConfig, IncidentRecorder,
+                                       MetricHistory, default_rules,
+                                       get_metric_history,
+                                       incident_main, load_incident,
+                                       parse_history_spec,
+                                       render_incident,
+                                       set_metric_history, sparkline)
+from veles_tpu.observe.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.history
+
+
+def make_history(tmp_path, registry=None, capacity=64, series_cap=64,
+                 cooldown=3600.0, rules=()):
+    return MetricHistory(
+        registry=registry or MetricsRegistry(enabled=True),
+        interval_s=0.01, capacity=capacity, series_cap=series_cap,
+        rules=list(rules),
+        incidents=IncidentRecorder(cooldown_s=cooldown,
+                                   directory=str(tmp_path)))
+
+
+def gauge_rows(**values):
+    return [(name, "gauge", (), value)
+            for name, value in values.items()]
+
+
+class TestConfig:
+    def test_spec_parsing_defaults_and_off(self):
+        config = parse_history_spec(None)
+        assert isinstance(config, HistoryConfig)  # unset = default ON
+        assert config.interval_s == 1.0
+        config = parse_history_spec("interval_s=0.5,capacity=600,"
+                                    "series_cap=32,seed_rules=0")
+        assert config.interval_s == 0.5
+        assert config.capacity == 600
+        assert config.series_cap == 32
+        assert config.seed_rules is False
+        assert parse_history_spec("off") is None
+        assert parse_history_spec("enabled=0") is None
+        assert parse_history_spec({"enabled": False}) is None
+        for bad in ("nope=1", "interval_s=x", "interval_s=0",
+                    "capacity=1", "series_cap=0", "seed_rules=maybe",
+                    "interval_s"):
+            with pytest.raises(ValueError, match="--serve-history"):
+                parse_history_spec(bad, flag="--serve-history")
+
+    def test_default_rules_cover_the_seed_set(self):
+        names = {rule.name for rule in default_rules()}
+        assert {"slo_burn", "tpot_p95_slope", "mfu_collapse",
+                "pool_exhaustion", "compile_storm"} <= names
+
+
+class TestStore:
+    def test_ring_drops_oldest_at_capacity(self, tmp_path):
+        hist = make_history(tmp_path, capacity=4)
+        for i in range(10):
+            hist.sample(now=100.0 + i, rows=gauge_rows(veles_g=float(i)))
+        series = hist.get("veles_g")
+        assert list(series.values) == [6.0, 7.0, 8.0, 9.0]
+        assert list(series.stamps) == [106.0, 107.0, 108.0, 109.0]
+
+    def test_series_cap_books_overflow_tally(self, tmp_path):
+        """A hostile label set cannot balloon memory: past the cap,
+        new series are counted and dropped."""
+        hist = make_history(tmp_path, series_cap=2)
+        rows = [("veles_g", "gauge", (("evil", str(i)),), 1.0)
+                for i in range(8)]
+        hist.sample(now=100.0, rows=rows)
+        assert len(hist.series_list()) == 2
+        assert hist.series_dropped == 6
+        # existing series keep sampling fine past the cap
+        hist.sample(now=101.0, rows=rows)
+        assert hist.series_dropped == 12
+        assert len(hist.get("veles_g",
+                            labels={"evil": "0"}).values) == 2
+
+    def test_counter_rate_math(self, tmp_path):
+        hist = make_history(tmp_path)
+        for now, total in ((100.0, 50), (101.0, 60), (103.0, 80),
+                           (104.0, 5), (105.0, 25)):
+            hist.sample(now=now,
+                        rows=[("veles_c_total", "counter", (), total)])
+        series = hist.get("veles_c_total")
+        # first sample = baseline (no point); the reset (80 -> 5)
+        # re-baselines without a point; rates are per second
+        assert list(series.values) == [10.0, 10.0, 20.0]
+        assert list(series.stamps) == [101.0, 103.0, 105.0]
+
+    def test_counter_first_seen_midflight_anchors_at_zero(self,
+                                                          tmp_path):
+        """A counter appearing AFTER the first pass (the first
+        recompile storm) rates against an implicit 0 at the previous
+        pass — the spike that must not vanish into a baseline."""
+        hist = make_history(tmp_path)
+        hist.sample(now=100.0, rows=gauge_rows(veles_g=1.0))
+        hist.sample(now=101.0,
+                    rows=[("veles_storms_total", "counter", (), 2)])
+        series = hist.get("veles_storms_total")
+        assert list(series.values) == [2.0]
+        # but the very FIRST pass books baselines only: attaching to a
+        # long-lived process must not spike every counter
+        fresh = make_history(tmp_path)
+        fresh.sample(now=100.0,
+                     rows=[("veles_old_total", "counter", (), 12345)])
+        assert list(fresh.get("veles_old_total").values) == []
+
+    def test_registry_sample_accessor_runs_collectors(self):
+        """The satellite: MetricsRegistry.sample() materializes
+        collector-backed series without rendering exposition text;
+        disabled, it returns nothing and never runs a collector."""
+        registry = MetricsRegistry(enabled=True)
+        registry.add_collector(
+            lambda: registry.set("veles_collected", 7.0))
+        registry.incr("veles_n_total", 3)
+        registry.observe("veles_h_seconds", 0.2, buckets=(0.1, 1.0))
+        rows = {(name, labels): (kind, value)
+                for name, kind, labels, value in registry.sample()}
+        assert rows[("veles_collected", ())] == ("gauge", 7.0)
+        assert rows[("veles_n_total", ())] == ("counter", 3)
+        # histograms surface as synthesized _count/_sum counters
+        assert rows[("veles_h_seconds_count", ())][1] == 1
+        assert rows[("veles_h_seconds_sum", ())][1] == 0.2
+        disabled = MetricsRegistry(enabled=False)
+        ran = []
+        disabled.add_collector(lambda: ran.append(1))
+        assert disabled.sample() == ()
+        assert ran == []
+
+
+class TestRules:
+    def test_threshold_for_n_samples(self, tmp_path):
+        rule = AnomalyRule("burn", "veles_b", kind="threshold",
+                           op=">=", threshold=2.0, for_samples=3,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        for i, value in enumerate((1.0, 5.0, 5.0)):
+            hist.sample(now=100.0 + i, rows=gauge_rows(veles_b=value))
+        assert rule.fired_total == 0  # streak 2 < for_samples 3
+        assert rule.breach_since == 101.0
+        hist.sample(now=103.0, rows=gauge_rows(veles_b=5.0))
+        assert rule.fired_total == 1
+        assert hist.anomalies_total == 1
+        # recovery resets the streak and the breach instant
+        hist.sample(now=104.0, rows=gauge_rows(veles_b=0.1))
+        assert rule.streak == 0 and rule.breach_since is None
+
+    def test_slope_predicate(self, tmp_path):
+        rule = AnomalyRule("ramp", "veles_lat", kind="slope", op=">=",
+                           threshold=5.0, window_s=4.0,
+                           for_samples=1, cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        for i in range(5):  # +1/s: under the 5/s threshold
+            hist.sample(now=100.0 + i,
+                        rows=gauge_rows(veles_lat=10.0 + i))
+        assert rule.fired_total == 0
+        for i in range(3):  # +8/s: breaches
+            hist.sample(now=105.0 + i,
+                        rows=gauge_rows(veles_lat=14.0 + 8.0 * (i + 1)))
+        assert rule.fired_total >= 1
+
+    def test_drop_vs_baseline_predicate(self, tmp_path):
+        rule = AnomalyRule("mfu", "veles_mfu", kind="drop",
+                           drop_frac=0.5, window_s=4.0,
+                           baseline_s=10.0, for_samples=1,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        for i in range(10):  # healthy baseline ~1.0
+            hist.sample(now=100.0 + i,
+                        rows=gauge_rows(veles_mfu=1.0))
+        assert rule.fired_total == 0
+        for i in range(4):  # collapse to 0.3 (< 50% of baseline)
+            hist.sample(now=110.0 + i,
+                        rows=gauge_rows(veles_mfu=0.3))
+        assert rule.fired_total >= 1
+
+    def test_tenant_and_slave_slices_are_excluded(self, tmp_path):
+        rule = AnomalyRule("burn", "veles_b", kind="threshold",
+                           op=">=", threshold=2.0, for_samples=1,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        rows = [("veles_b", "gauge", (("tenant", "evil"),), 99.0),
+                ("veles_b", "gauge", (("slave", "s1"),), 99.0),
+                ("veles_b", "gauge", (), 0.5)]
+        hist.sample(now=100.0, rows=rows)
+        assert rule.fired_total == 0  # only the aggregate counts
+
+    def test_retired_series_stops_driving_the_rule(self, tmp_path):
+        """A gauge family the source retired (set_gauge_family with no
+        rows) vanishes from later passes — the rule must not keep
+        breaching on the frozen ring tail."""
+        rule = AnomalyRule("burn", "veles_b", kind="threshold",
+                           op=">=", threshold=2.0, for_samples=2,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        hist.sample(now=100.0, rows=gauge_rows(veles_b=9.0))
+        assert rule.streak == 1
+        hist.sample(now=101.0, rows=gauge_rows(veles_other=1.0))
+        assert rule.streak == 0 and rule.fired_total == 0
+
+    def test_firings_book_counters_and_flight_entries(self, tmp_path):
+        from veles_tpu.observe.flight import get_flight_recorder
+
+        registry = MetricsRegistry(enabled=True)
+        rule = AnomalyRule("burn", "veles_b", kind="threshold",
+                           op=">=", threshold=2.0, for_samples=1,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, registry=registry, rules=[rule])
+        recorder = get_flight_recorder()
+        before = len([e for e in recorder.entries()
+                      if e.get("kind") == "anomaly"])
+        registry.set("veles_b", 9.0)
+        hist.sample(now=100.0)
+        fired = {(name, labels): value
+                 for name, kind, labels, value in registry.sample()
+                 if name == "veles_anomaly_fired_total"}
+        assert fired[("veles_anomaly_fired_total",
+                      (("rule", "burn"),))] == 1
+        marks = [e for e in recorder.entries()
+                 if e.get("kind") == "anomaly"]
+        assert len(marks) == before + 1
+        assert marks[-1]["rule"] == "burn"
+
+    def test_blackbox_summary_counts_entries_by_kind(self, tmp_path,
+                                                     capsys):
+        """The satellite: `observe blackbox` counts ring entries by
+        kind — the PR-11 governor entries and the new anomaly kind
+        included."""
+        from veles_tpu.observe.flight import (FlightRecorder,
+                                              blackbox_main)
+
+        recorder = FlightRecorder()
+        recorder.note("governor", action="demote", tier="int8")
+        recorder.note("anomaly", rule="slo_burn", value=3.0)
+        recorder.note("anomaly", rule="pool_exhaustion", value=40.0)
+        recorder.note("dispatch", kind_detail="x")
+        path = str(tmp_path / "blackbox-test.json")
+        recorder.dump("test", path=path)
+        assert blackbox_main(path, tail=0) == 0
+        out = capsys.readouterr().out
+        assert "kinds:" in out
+        assert "anomaly=2" in out
+        assert "governor=1" in out
+
+
+class TestIncidents:
+    def trigger_two_rules(self, tmp_path, cooldown=0.0):
+        lead = AnomalyRule("pool_exhaustion", "veles_pool",
+                           kind="threshold", op=">=", threshold=5.0,
+                           for_samples=1, cooldown_s=0.0)
+        burn = AnomalyRule("slo_burn", "veles_slo_burn_rate",
+                           kind="threshold", op=">=", threshold=2.0,
+                           for_samples=1, cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[lead, burn],
+                            cooldown=cooldown)
+        # t=100: only the pool series breaches; t=102: burn follows
+        hist.sample(now=100.0,
+                    rows=gauge_rows(veles_pool=9.0,
+                                    veles_slo_burn_rate=0.5))
+        hist.sample(now=102.0,
+                    rows=gauge_rows(veles_pool=9.0,
+                                    veles_slo_burn_rate=4.0))
+        return hist
+
+    def test_artifact_schema_and_leading_indicator_math(self,
+                                                        tmp_path):
+        hist = self.trigger_two_rules(tmp_path)
+        doc = hist.incidents.last_doc
+        assert doc["schema"] == 1 and doc["kind"] == "incident"
+        lead = doc["leading_indicator"]
+        assert lead["rule"] == "pool_exhaustion"
+        assert lead["series"] == "veles_pool"
+        assert lead["reference"] == "slo_burn"
+        assert lead["lead_ms"] == 2000.0
+        names = {state["name"] for state in doc["breaching"]}
+        assert names == {"pool_exhaustion", "slo_burn"}
+        series = {row["name"] for row in doc["history"]["series"]}
+        assert {"veles_pool", "veles_slo_burn_rate"} <= series
+        # round-trips through the loader; a non-incident is refused
+        saved = load_incident(hist.incidents.last_path)
+        assert saved["leading_indicator"]["rule"] == "pool_exhaustion"
+        bogus = tmp_path / "not_incident.json"
+        bogus.write_text(json.dumps({"entries": []}))
+        with pytest.raises(ValueError, match="not an incident"):
+            load_incident(str(bogus))
+
+    def test_atomic_counter_suffixed_writes(self, tmp_path):
+        hist = self.trigger_two_rules(tmp_path)
+        paths = sorted(p for p in os.listdir(str(tmp_path))
+                       if p.startswith("incident-"))
+        # cooldown 0: every firing pass writes; names never collide
+        # even inside one second (the dumps-counter suffix)
+        assert len(paths) == hist.incidents.count >= 2
+        assert len(set(paths)) == len(paths)
+        assert not [p for p in os.listdir(str(tmp_path))
+                    if p.endswith(".tmp")]
+
+    def test_cooldown_bounds_artifact_count(self, tmp_path):
+        hist = self.trigger_two_rules(tmp_path, cooldown=3600.0)
+        for i in range(5):
+            hist.sample(now=103.0 + i,
+                        rows=gauge_rows(veles_pool=9.0,
+                                        veles_slo_burn_rate=4.0))
+        assert hist.incidents.count == 1
+
+    def test_failed_write_does_not_consume_the_cooldown(self,
+                                                        tmp_path):
+        """A transiently unwritable run dir must not burn the incident
+        cooldown: the next firing retries the write."""
+        lead = AnomalyRule("burn", "veles_b", kind="threshold",
+                           op=">=", threshold=2.0, for_samples=1,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[lead], cooldown=3600.0)
+        # a regular FILE where the dump dir should be -> OSError
+        (tmp_path / "blocked").write_text("x")
+        hist.incidents.directory = str(tmp_path / "blocked" / "sub")
+        hist.sample(now=100.0, rows=gauge_rows(veles_b=9.0))
+        assert hist.incidents.count == 0
+        hist.incidents.directory = str(tmp_path)
+        hist.sample(now=101.0, rows=gauge_rows(veles_b=9.0))
+        assert hist.incidents.count == 1
+
+    def test_check_rules_false_ingests_data_only(self, tmp_path):
+        """The governor's driver-thread fallback path: data lands in
+        the rings, but no rule evaluation (and so no incident write)
+        ever runs there."""
+        rule = AnomalyRule("burn", "veles_b", kind="threshold",
+                           op=">=", threshold=2.0, for_samples=1,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        hist.sample(now=100.0, rows=gauge_rows(veles_b=9.0),
+                    check_rules=False)
+        assert hist.get("veles_b").values[-1] == 9.0
+        assert rule.streak == 0 and hist.incidents.count == 0
+        hist.sample(now=101.0, rows=gauge_rows(veles_b=9.0))
+        assert rule.fired_total == 1
+
+    def test_breach_severity_is_direction_aware(self, tmp_path):
+        """A drop-kind rule's worst breach is the LOWEST ratio — the
+        incident must name the most-collapsed series, and last_value
+        must never show a healthy sibling's number."""
+        rule = AnomalyRule("mfu", "veles_mfu", kind="drop",
+                           drop_frac=0.5, window_s=2.0,
+                           baseline_s=10.0, for_samples=1,
+                           cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        rows = lambda a, b: [  # noqa: E731
+            ("veles_mfu", "gauge", (("program", "a"),), a),
+            ("veles_mfu", "gauge", (("program", "b"),), b)]
+        for i in range(10):
+            hist.sample(now=100.0 + i, rows=rows(1.0, 1.0))
+        for i in range(4):
+            hist.sample(now=110.0 + i, rows=rows(0.45, 0.10))
+        assert rule.fired_total >= 1
+        # once both programs breach (window ratios ~0.45 and ~0.10),
+        # severity must pick the LOWER ratio — program b's 90%
+        # collapse, not a's milder one
+        assert dict(rule.breach_labels)["program"] == "b"
+        assert rule.breach_value < 0.3
+
+    def test_incident_cli_renders_saved_artifact(self, tmp_path,
+                                                 capsys):
+        hist = self.trigger_two_rules(tmp_path)
+        assert incident_main(hist.incidents.last_path) == 0
+        out = capsys.readouterr().out
+        assert "leading indicator: pool_exhaustion" in out
+        assert "veles_pool" in out
+        assert "led slo_burn by 2000ms" in out
+        # a directory lists and renders the newest
+        assert incident_main(str(tmp_path)) == 0
+        assert "leading indicator" in capsys.readouterr().out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert incident_main(str(empty)) == 1
+
+    def test_render_includes_sparkline_timeline(self, tmp_path):
+        hist = self.trigger_two_rules(tmp_path)
+        text = render_incident(hist.incidents.last_doc)
+        assert "timeline" in text
+        assert any(block in text for block in "▁▂▃▄▅▆▇█")
+
+
+class TestSparklines:
+    def test_sparkline_shape(self):
+        assert sparkline([]) == ""
+        assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"
+        ramp = sparkline(list(range(8)))
+        assert ramp[0] == "▁" and ramp[-1] == "█"
+        assert len(sparkline(list(range(100)), width=16)) == 16
+
+    def test_web_status_trends_cell(self, tmp_path):
+        from veles_tpu.web_status import format_trends_cell
+
+        hist = make_history(tmp_path)
+        for i in range(6):
+            hist.sample(now=100.0 + i, rows=[
+                ("veles_slo_burn_rate", "gauge",
+                 (("objective", "ttft"), ("window", "60s")), 0.5 * i),
+                ("veles_kv_pages_free", "gauge", (), 30.0 - i)])
+        cells = hist.dashboard_cells()
+        assert cells, "summary-prefix series must produce cells"
+        text = format_trends_cell(cells)
+        assert "slo_burn_rate" in text
+        assert any(block in text for block in "▁▂▃▄▅▆▇█")
+        assert format_trends_cell(None) == ""
+        assert format_trends_cell([{"label": "x", "spark": [1, 2],
+                                    "last": 2}]).startswith("x ")
+
+
+class TestFleetPiggyback:
+    def test_summary_round_trips_slave_labeled(self, tmp_path):
+        slave = make_history(tmp_path)
+        for i in range(40):
+            slave.sample(now=100.0 + i, rows=[
+                ("veles_slo_burn_rate", "gauge",
+                 (("window", "60s"),), 0.1 * i),
+                ("veles_private_gauge", "gauge", (), 1.0)])
+        rows = slave.fleet_summary(now=140.0)
+        # only the summary prefixes ride the frame, points bounded
+        assert {row[0] for row in rows} == {"veles_slo_burn_rate"}
+        assert len(rows[0][2]) <= 32
+        master = make_history(tmp_path)
+        assert master.ingest_summary("s1", rows, now=500.0) == 1
+        series = master.get("veles_slo_burn_rate",
+                            labels={"window": "60s", "slave": "s1"})
+        assert series is not None
+        assert list(series.values)[-1] == pytest.approx(3.9)
+        # ages rebased onto the master's clock, order preserved
+        assert list(series.stamps)[-1] <= 500.0
+        assert list(series.stamps) == sorted(series.stamps)
+        # a re-sent frame REPLACES the ring (no duplicated overlap)
+        master.ingest_summary("s1", rows, now=501.0)
+        assert len(series.values) == len(rows[0][3])
+
+    def test_hostile_rows_are_rejected_and_bounded(self, tmp_path):
+        master = make_history(tmp_path, series_cap=4)
+        bad = [
+            ["not a metric!", [], [0.0], [1.0]],        # invalid name
+            ["veles_ok", [], [0.0, 1.0], [1.0]],        # len mismatch
+            "garbage",                                   # not a row
+            ["veles_spoof", [["slave", "other"]], [0.0], [1.0]],
+        ]
+        assert master.ingest_summary("s1", bad, now=100.0) == 1
+        series = master.get("veles_spoof")
+        # the spoofed slave label was dropped; ours was stamped
+        assert series.label_dict() == {"slave": "s1"}
+        flood = [["veles_f%d" % i, [], [0.0], [1.0]]
+                 for i in range(FLEET_MAX_SERIES + 50)]
+        master.ingest_summary("s2", flood, now=101.0)
+        assert len(master.series_list()) <= 4
+        assert master.series_dropped > 0
+
+
+def _history_httpd(history):
+    from http.server import BaseHTTPRequestHandler
+    from veles_tpu.core.httpd import (QuietHandlerMixin,
+                                      serve_debug_history,
+                                      start_server)
+
+    class Handler(QuietHandlerMixin, BaseHTTPRequestHandler):
+        def do_GET(self):
+            if not serve_debug_history(self, history):
+                self.send_error(404)
+
+    return start_server(Handler, port=0, name="test-history")
+
+
+class TestDebugHistoryEndpoint:
+    def test_round_trip_with_series_and_window_filters(self, tmp_path):
+        rule = AnomalyRule("burn", "veles_slo_burn_rate",
+                           kind="threshold", op=">=", threshold=2.0,
+                           for_samples=1, cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        # stamps land in the recent PAST so a live-clock ?window=
+        # filter (serve_debug_history defaults now to monotonic) keeps
+        # a strict subset
+        base = time.monotonic() - 20.0
+        for i in range(20):
+            hist.sample(now=base + i, rows=gauge_rows(
+                veles_slo_burn_rate=3.0, veles_kv_pages_free=9.0))
+        httpd, port = _history_httpd(hist)
+        try:
+            url = "http://127.0.0.1:%d/debug/history" % port
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                payload = json.loads(resp.read().decode())
+            names = {row["name"] for row in payload["series"]}
+            assert names == {"veles_slo_burn_rate",
+                             "veles_kv_pages_free"}
+            assert payload["samples_total"] == 20
+            assert payload["rules"][0]["name"] == "burn"
+            assert payload["rules"][0]["fired_total"] >= 1
+            with urllib.request.urlopen(
+                    url + "?series=slo_burn&window=5", timeout=10) \
+                    as resp:
+                filtered = json.loads(resp.read().decode())
+            assert [row["name"] for row in filtered["series"]] \
+                == ["veles_slo_burn_rate"]
+            assert 0 < len(filtered["series"][0]["values"]) < 20
+            # ages are relative seconds, newest last (smallest age)
+            ages = filtered["series"][0]["ages"]
+            assert ages == sorted(ages, reverse=True)
+        finally:
+            httpd.shutdown()
+
+    def test_disabled_history_answers_404(self, tmp_path):
+        previous = get_metric_history()
+        set_metric_history(None)
+        try:
+            httpd, port = _history_httpd(None)
+            try:
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(
+                        "http://127.0.0.1:%d/debug/history" % port,
+                        timeout=10)
+                assert err.value.code == 404
+            finally:
+                httpd.shutdown()
+        finally:
+            set_metric_history(previous)
+
+    def test_incident_cli_live(self, tmp_path, capsys):
+        rule = AnomalyRule("burn", "veles_slo_burn_rate",
+                           kind="threshold", op=">=", threshold=2.0,
+                           for_samples=1, cooldown_s=0.0)
+        hist = make_history(tmp_path, rules=[rule])
+        base = time.monotonic()
+        for i in range(5):
+            hist.sample(now=base + i,
+                        rows=gauge_rows(veles_slo_burn_rate=4.0))
+        httpd, port = _history_httpd(hist)
+        try:
+            assert incident_main(
+                live="http://127.0.0.1:%d" % port) == 0
+            out = capsys.readouterr().out
+            assert "leading indicator: burn" in out
+            assert "veles_slo_burn_rate" in out
+        finally:
+            httpd.shutdown()
+        assert incident_main(live="http://127.0.0.1:1/") == 1
+
+
+class TestGovernorReadsHistory:
+    """The no-second-bookkeeping-path seam: with a history attached,
+    the governor's burn readings ARE history samples
+    (veles_ctrl_burn_rate), so the incident autopsy replays exactly
+    what the control loop acted on."""
+
+    class StubSLO:
+        def __init__(self, burns):
+            self.burns = list(burns)
+
+        def summary(self):
+            burn = self.burns.pop(0) if self.burns else 0.0
+            if burn is None:
+                return None
+            return {"burn_rate": burn, "objective": "ttft_p95_ms",
+                    "window": "60s"}
+
+    class StubDecoder:
+        def __init__(self):
+            self.pool = None
+            self.quantize = None
+            self.aot = None
+
+    class StubApi:
+        def __init__(self, burns):
+            self.slo = TestGovernorReadsHistory.StubSLO(burns)
+            self.decoder = TestGovernorReadsHistory.StubDecoder()
+            self.max_queue = 64
+            self._base_tier = "bf16"
+
+        def request_tier(self, tier):
+            self.decoder.quantize = None if tier == "bf16" else tier
+
+        def request_trip(self, reason):
+            pass
+
+    def test_demote_reads_the_recorded_ctrl_series(self, tmp_path):
+        from veles_tpu.observe.governor import (GovernorConfig,
+                                                ServingGovernor)
+
+        rule = AnomalyRule("ctrl_burn", "veles_ctrl_burn_rate",
+                           kind="threshold", op=">=", threshold=2.0,
+                           for_samples=1, cooldown_s=0.0,
+                           exclude_labels=())
+        hist = make_history(tmp_path, rules=[rule])
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=0.01,
+            interval_s=0.001, ladder=("int8",), prewarm=False,
+            breaker_guard=False))
+        governor.attach_history(hist)
+        burns = [3.5, 3.0, 0.4, 0.4]
+        api = self.StubApi(list(burns))
+        for _ in burns:
+            time.sleep(0.015)
+            governor.tick(api)
+        assert governor.counters["demotions"] == 1
+        assert governor.counters["promotions"] == 1
+        series = hist.get("veles_ctrl_burn_rate")
+        # every burn the governor acted on is in the ring, verbatim
+        assert list(series.values) == burns
+        assert governor.last_burn == burns[-1]
+        # an incident built NOW reports the same ctrl series
+        hist.sample(rows=[])
+        event = rule.evaluate(hist, time.monotonic())
+        doc = hist.incidents.build(hist, rule, event
+                                   or {"rule": "ctrl_burn"})
+        names = {row["name"] for row in doc["history"]["series"]}
+        assert "veles_ctrl_burn_rate" in names
+
+    def test_empty_window_holds_the_tier(self, tmp_path):
+        from veles_tpu.observe.governor import (GovernorConfig,
+                                                ServingGovernor)
+
+        hist = make_history(tmp_path)
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=0.01,
+            interval_s=0.001, ladder=("int8",), prewarm=False,
+            breaker_guard=False))
+        governor.attach_history(hist)
+        api = self.StubApi([3.0, None, None])
+        for _ in range(3):
+            time.sleep(0.015)
+            governor.tick(api)
+        # the None summaries (no traffic) must HOLD, not promote
+        assert governor.level == 1
+        assert governor.last_burn is None
+        series = hist.get("veles_ctrl_burn_rate")
+        assert list(series.values) == [3.0]  # silence records nothing
+
+
+# -- chaos acceptance: fault injection -> incident naming the fault ---------
+
+@pytest.fixture(scope="module")
+def model():
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 16, 11
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    return params, table, heads
+
+
+def _post(url, tokens, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps({"tokens": tokens}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+    except Exception:
+        pass
+
+
+def _drive_until(api, hist, predicate, timeout=90.0):
+    url = "http://127.0.0.1:%d/generate" % api.port
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not predicate():
+        _post(url, [1, 2, 3])
+        hist.maybe_sample()
+    return predicate()
+
+
+def _chaos_setup(tmp_path, rules, registry=None):
+    from veles_tpu.observe.metrics import get_metrics_registry
+
+    # incident cooldown 0: artifact count is bounded by the RULES'
+    # own cooldowns (each fires once), and the LAST artifact is the
+    # one triggered by the latest-breaching rule
+    hist = MetricHistory(
+        registry=registry or get_metrics_registry(),
+        interval_s=0.05, capacity=512,
+        rules=list(rules),
+        incidents=IncidentRecorder(cooldown_s=0.0,
+                                   directory=str(tmp_path)))
+    previous = get_metric_history()
+    set_metric_history(hist)
+    return hist, previous
+
+
+@pytest.mark.slow
+class TestChaosIncidents:
+    def test_pool_flood_incident_names_the_pool_series(self, model,
+                                                       tmp_path,
+                                                       capsys):
+        from veles_tpu.observe.metrics import get_metrics_registry
+        from veles_tpu.observe.reqledger import get_request_ledger
+        from veles_tpu.serving import GenerateAPI
+        from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                             ServingChaosMonkey)
+
+        params, table, heads = model
+        get_metrics_registry().reset()
+        # serial posts reserve at most 1 page at a time; only the
+        # flood's hostage reservation reaches 2+ — a deterministic
+        # threshold for the seeded profile
+        rules = [
+            AnomalyRule("pool_exhaustion", "veles_kv_pages_reserved",
+                        kind="threshold", op=">=", threshold=2.0,
+                        for_samples=1, cooldown_s=3600.0),
+            AnomalyRule("slo_burn", "veles_slo_burn_rate",
+                        kind="threshold", op=">=", threshold=2.0,
+                        for_samples=2, cooldown_s=3600.0),
+        ]
+        hist, previous = _chaos_setup(tmp_path, rules)
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=1, pool_flood_pages=2, pool_flood_at=1,
+            pool_flood_steps=1 << 30))
+        expected = monkey.config.expected_leading_series()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=4, chunk=2, port=0, paged=True,
+                          rebuild_backoff=0.02, chaos=monkey)
+        api.start()
+        try:
+            assert _drive_until(
+                api, hist, lambda: hist.incidents.count >= 1), \
+                "flood never produced an incident"
+            doc = hist.incidents.last_doc
+            assert doc["leading_indicator"]["series"] \
+                == expected["pool_flood"]
+            assert doc["leading_indicator"]["rule"] \
+                == "pool_exhaustion"
+            # the CLI renders it from the saved artifact AND live
+            assert incident_main(hist.incidents.last_path) == 0
+            saved_out = capsys.readouterr().out
+            assert expected["pool_flood"] in saved_out
+            assert incident_main(
+                live="http://127.0.0.1:%d" % api.port) == 0
+            assert expected["pool_flood"] in capsys.readouterr().out
+            # request truth rode along: the bundle carries ledger rows
+            if get_request_ledger().enabled:
+                assert "requests" in doc
+        finally:
+            monkey.release_flood()
+            api.stop()
+            set_metric_history(previous)
+
+    def test_compile_storm_incident_names_the_storm_counter(
+            self, model, tmp_path):
+        from veles_tpu.observe.metrics import get_metrics_registry
+        from veles_tpu.serving import GenerateAPI
+        from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                             ServingChaosMonkey)
+
+        params, table, heads = model
+        get_metrics_registry().reset()
+        rules = [
+            AnomalyRule("compile_storm",
+                        "veles_xla_recompile_storms_total",
+                        kind="threshold", op=">=", threshold=0.01,
+                        for_samples=1, cooldown_s=0.0),
+        ]
+        hist, previous = _chaos_setup(tmp_path, rules)
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=1, compile_storm_at=1))
+        expected = monkey.config.expected_leading_series()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=4, chunk=2, port=0,
+                          rebuild_backoff=0.02, chaos=monkey)
+        api.start()
+        try:
+            assert _drive_until(
+                api, hist, lambda: hist.incidents.count >= 1), \
+                "storm never produced an incident"
+            doc = hist.incidents.last_doc
+            assert doc["leading_indicator"]["series"] \
+                == expected["compile_storm"]
+        finally:
+            api.stop()
+            set_metric_history(previous)
+
+    def test_latency_ramp_incident_and_governor_share_trends(
+            self, model, tmp_path):
+        """The full acceptance: a held latency ramp burns the SLO; the
+        latency series breaches BEFORE the burn (the leading
+        indicator), the governed demote decisions are the recorded
+        veles_ctrl_burn_rate samples, and the incident artifact
+        reports that same series."""
+        from veles_tpu.observe.governor import (GovernorConfig,
+                                                ServingGovernor)
+        from veles_tpu.observe.metrics import get_metrics_registry
+        from veles_tpu.observe.reqledger import RequestLedger
+        from veles_tpu.observe.slo import SLOEngine
+        from veles_tpu.serving import GenerateAPI
+        from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                             ServingChaosMonkey)
+
+        params, table, heads = model
+        get_metrics_registry().reset()
+        # the latency gauge updates at FIRST TOKEN while the burn
+        # gauges need the request to RESOLVE — the injected fault's
+        # series deterministically breaches first. Each rule fires
+        # once (own cooldown); the incident recorder (cooldown 0)
+        # rewrites on the later slo_burn firing, so last_doc carries
+        # both breaching rules and the latency lead.
+        rules = [
+            AnomalyRule("ttft_p95_high", "veles_serving_latency_ms",
+                        match={"kind": "ttft", "quantile": "p95"},
+                        kind="threshold", op=">=", threshold=60.0,
+                        for_samples=1, cooldown_s=3600.0),
+            AnomalyRule("slo_burn", "veles_slo_burn_rate",
+                        kind="threshold", op=">=", threshold=2.0,
+                        for_samples=2, cooldown_s=3600.0),
+        ]
+        hist, previous = _chaos_setup(tmp_path, rules)
+        engine = SLOEngine({"ttft_p95_ms": 120.0}, windows=(2.0, 8.0),
+                           bucket_seconds=0.25)
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=3.0,
+            interval_s=0.05, ladder=("int8",), prewarm=False,
+            breaker_guard=False))
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=1, latency_ramp_ms=250.0, latency_ramp_steps=6,
+            latency_ramp_hold=1 << 30))
+        expected = monkey.config.expected_leading_series()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=4, chunk=2, port=0,
+                          rebuild_backoff=0.02, slo=engine,
+                          governor=governor, chaos=monkey,
+                          ledger=RequestLedger())
+        assert governor.history is hist  # attached at construction
+        api.start()
+        try:
+            assert _drive_until(
+                api, hist,
+                lambda: governor.demoted
+                and any(r.name == "slo_burn" and r.fired_total
+                        for r in hist.rules)), \
+                "ramp never demoted + burned"
+            # deterministic leading indicator: the injected fault's
+            # series breached before the user-visible SLO breach
+            doc = hist.incidents.last_doc
+            assert doc is not None
+            assert doc["leading_indicator"]["series"] \
+                == expected["latency_ramp"]
+            assert doc["leading_indicator"]["lead_ms"] >= 0.0
+            assert doc["leading_indicator"]["reference"] == "slo_burn"
+            # no second bookkeeping path: the burn the governor
+            # demoted on is a recorded history sample, and the
+            # artifact reports that exact series
+            ctrl = hist.get("veles_ctrl_burn_rate")
+            assert ctrl is not None
+            assert max(ctrl.values) >= governor.config.demote_burn
+            assert governor.last_burn in list(ctrl.values)
+            artifact_series = {row["name"]
+                               for row in doc["history"]["series"]}
+            assert "veles_ctrl_burn_rate" in artifact_series
+        finally:
+            monkey.clear_ramp()
+            api.stop()
+            set_metric_history(previous)

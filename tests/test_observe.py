@@ -613,6 +613,34 @@ class TestOverheadGuard:
         assert len(dec.flight.entries()) <= 4
         assert dec.metrics._families == {}
 
+    def test_history_disabled_path_stays_structurally_noop(self):
+        """ISSUE 12: the metric flight recorder obeys the same guard.
+        A disabled registry's sample() returns before running any
+        collector (the no-scrape fast path stays allocation-free), a
+        history over it books nothing — not a pass, not a rule
+        evaluation — and the store carries no lock attribute anywhere
+        (the flight-ring record discipline)."""
+        from veles_tpu.observe.history import (AnomalyRule,
+                                               IncidentRecorder,
+                                               MetricHistory)
+
+        registry = MetricsRegistry(enabled=False)
+        ran = []
+        registry.add_collector(lambda: ran.append(1))
+        assert registry.sample() == ()
+        assert ran == []
+        rule = AnomalyRule("burn", "veles_b", threshold=0.0,
+                           for_samples=1)
+        history = MetricHistory(
+            registry=registry, rules=[rule],
+            incidents=IncidentRecorder(cooldown_s=3600.0))
+        assert history.sample() is False
+        assert history.samples_total == 0
+        assert history.series_list() == []
+        assert rule.streak == 0 and history.anomalies_total == 0
+        assert not any("lock" in attr.lower()
+                       for attr in vars(history))
+
     def test_request_ledger_null_and_default_paths(self):
         """ISSUE 10: with NO ledger attached (the default) a decoder
         leaves the process ledger untouched — one attribute check per

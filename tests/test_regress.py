@@ -211,6 +211,25 @@ class TestCompare:
                   for key, value in old.items()}
         assert regressions(compare(old, better)) == []
 
+    def test_history_key_directions(self):
+        """The metric-history keys (ISSUE 12, bench history_section):
+        incident_mttd_ms rides the _ms rule (a slower detector
+        regressed), the sampler-overhead _ns keys and the
+        _anomaly_rate key are LOWER-better too (a pricier or noisier
+        embedded recorder regresses even while throughput holds)."""
+        old = {"incident_mttd_ms": 400.0,
+               "history_sample_on_ns": 50000.0,
+               "history_sample_off_ns": 20000.0,
+               "history_anomaly_rate": 0.01}
+        worse = {"incident_mttd_ms": 900.0,
+                 "history_sample_on_ns": 150000.0,
+                 "history_sample_off_ns": 60000.0,
+                 "history_anomaly_rate": 0.2}
+        bad = {f["key"] for f in regressions(compare(old, worse))}
+        assert bad == set(old)
+        better = {key: value / 2 for key, value in old.items()}
+        assert regressions(compare(old, better)) == []
+
     def test_type_change_is_a_regression(self):
         new = dict(self.OLD, decode_step_ms="fast")
         assert regressions(compare(self.OLD, new))[0]["verdict"] \

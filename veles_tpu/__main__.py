@@ -229,6 +229,18 @@ class Main(Logger):
                            "ladder=int8+int8-kv "
                            "(root.common.serve.governor; "
                            "docs/serving_robustness.md)")
+        serve.add_argument("--serve-history", default=None,
+                           metavar="KEY=VALUE[,KEY=VALUE...]",
+                           help="tune (or disable) the metric flight "
+                           "recorder: a bounded in-process time-series "
+                           "history sampled off the registry wherever "
+                           "/metrics is mounted, with anomaly rules "
+                           "and incident autopsies — e.g. "
+                           "--serve-history interval_s=0.5,"
+                           "capacity=600 or --serve-history off "
+                           "(default: on, 1s cadence; "
+                           "root.common.observe.history; "
+                           "docs/observability.md)")
         serve.add_argument("--chaos-serve-seed", type=int, default=None,
                            metavar="N", help="serving chaos RNG seed")
         serve.add_argument("--chaos-serve-step-fail", type=float,
@@ -538,6 +550,17 @@ class Main(Logger):
                                     flag="--serve-governor")
             except ValueError as exc:
                 parser.error(str(exc))
+        if args.serve_history:
+            # validate NOW (same early-failure contract as
+            # --serve-slo); the string lands in
+            # root.common.observe.history below and the history store
+            # re-parses it when /metrics first mounts
+            from veles_tpu.observe.history import parse_history_spec
+            try:
+                parse_history_spec(args.serve_history,
+                                   flag="--serve-history")
+            except ValueError as exc:
+                parser.error(str(exc))
         if args.serve_mesh:
             # validate NOW (same early-failure contract as --mesh); the
             # string itself lands in config below and GenerateAPI
@@ -571,6 +594,7 @@ class Main(Logger):
                 ("serve_aot", root.common.serve, "aot"),
                 ("serve_slo", root.common.observe, "slo"),
                 ("serve_governor", root.common.serve, "governor"),
+                ("serve_history", root.common.observe, "history"),
                 ("chaos_serve_seed", root.common.serve.chaos, "seed"),
                 ("chaos_serve_step_fail", root.common.serve.chaos,
                  "step_fail"),

@@ -155,6 +155,11 @@ def serve_metrics(handler, registry=None):
     # turns on and the XLA/memory/MFU collector attaches (idempotent)
     from veles_tpu.observe.xla_stats import ensure_registered
     ensure_registered(registry)
+    # the metric flight recorder rides too (observe/history.py):
+    # history is default-on wherever /metrics is mounted, so trends
+    # and incident autopsies exist for anything scrapeable (idempotent)
+    from veles_tpu.observe.history import start_history_sampler
+    start_history_sampler()
     accept = str(getattr(handler, "headers", {}).get("Accept") or "")
     if "application/openmetrics-text" in accept:
         reply(handler, registry.expose(openmetrics=True),
@@ -188,15 +193,51 @@ def serve_debug_requests(handler, ledger=None):
     return True
 
 
+def serve_debug_history(handler, history=None):
+    """Route ``GET /debug/history``: the metric flight recorder's
+    windowed series tails + anomaly-rule states as JSON
+    (``observe/history.py``). Query params: ``series=`` (name
+    substring filter) and ``window=`` (trailing seconds). Mounted on
+    the serving surfaces beside ``/debug/requests``; returns True when
+    handled (404 when history is disabled)."""
+    path, _, query = handler.path.partition("?")
+    if path != "/debug/history":
+        return False
+    if history is None:
+        from veles_tpu.observe.history import get_metric_history
+        history = get_metric_history()
+    if history is None:
+        reply(handler, {"error": "metric history disabled "
+                                 "(root.common.observe.history)"},
+              code=404)
+        return True
+    series, window = None, None
+    for part in query.split("&"):
+        if part.startswith("series="):
+            series = part[len("series="):] or None
+        elif part.startswith("window="):
+            try:
+                window = max(0.0, float(part[len("window="):]))
+            except ValueError:
+                pass
+    reply(handler, history.debug_snapshot(series=series, window=window))
+    return True
+
+
 def enable_metrics():
     """Turn the process-global registry on (idempotent); every HTTP
     surface calls this at start so its counters accumulate from the
     first request, not the first scrape. Also enables the device-truth
     plane (compile tracking, memory/MFU gauges — observe/xla_stats.py)
-    so a scrape of any surface sees what the chip is doing."""
+    and starts the metric-history sampler (observe/history.py) so a
+    scrape of any surface sees what the chip is doing AND how it has
+    been trending."""
+    from veles_tpu.observe.history import start_history_sampler
     from veles_tpu.observe.metrics import get_metrics_registry
     from veles_tpu.observe.xla_stats import ensure_registered
-    return ensure_registered(get_metrics_registry().enable())
+    registry = ensure_registered(get_metrics_registry().enable())
+    start_history_sampler()
+    return registry
 
 
 def start_server(handler_cls, host="127.0.0.1", port=0, name="httpd"):

@@ -391,6 +391,18 @@ class Client(Logger):
                          value]
                         for name, kind, labels, value
                         in registry.snapshot()]
+                    # the metric-history summary rides along
+                    # (observe/history.py): the master ingests it
+                    # slave-labeled into ITS history, so a master-side
+                    # incident autopsy spans the fleet's trends, not
+                    # just its own
+                    from veles_tpu.observe.history import (
+                        get_metric_history)
+                    history = get_metric_history()
+                    if history is not None and history.samples_total:
+                        rows = history.fleet_summary()
+                        if rows:
+                            frame["history"] = rows
                 await self._write(writer, frame, shm_threshold=shm_thr)
                 if self.control_plane:
                     # epoch fence? the workflow hands over the bulk

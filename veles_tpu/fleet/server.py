@@ -62,6 +62,11 @@ class SlaveDescription:
         #: update frames (observe/metrics.py snapshot() rows); the
         #: master's /metrics re-exports them with a slave label
         self.metrics_rows = None
+        #: latest metric-history summary piggybacked the same way
+        #: (observe/history.py fleet_summary() rows) — ingested
+        #: slave-labeled into the master's history so its incident
+        #: autopsies span the fleet
+        self.history_rows = None
 
     def record_job_time(self, duration):
         self.job_times.append(duration)
@@ -507,6 +512,19 @@ class Server(Logger):
             if entry:
                 self._reduce_reports[(slave.mid, slave.pid)] = \
                     (slave.id, entry)
+        if isinstance(msg.get("history"), list):
+            # the slave's trend summary (observe/history.py): bounded
+            # at ingestion like the metrics rows, then landed
+            # slave-labeled in the master's own history — a
+            # master-side incident artifact reports the whole fleet's
+            # breaching windows (ingest_summary validates the rows)
+            from veles_tpu.observe.history import (FLEET_MAX_SERIES,
+                                                   get_metric_history)
+            slave.history_rows = msg["history"][:FLEET_MAX_SERIES]
+            master_history = get_metric_history()
+            if master_history is not None:
+                master_history.ingest_summary(slave.id,
+                                              slave.history_rows)
         if self.control_plane and "update" in msg:
             # a data-plane weight payload on the control-plane wire is
             # a protocol violation (zombie or misconfigured peer

@@ -35,6 +35,14 @@ Three coordinated parts (docs/observability.md):
 - :mod:`veles_tpu.observe.flight` — the always-on bounded flight
   recorder that dumps a black-box JSON on breaker trips, epoch fences,
   unit exceptions and SIGTERM (``veles_tpu observe blackbox``);
+- :mod:`veles_tpu.observe.history` — the metric flight recorder: a
+  bounded lock-free time-series store sampling the full registry
+  (counters as rates), a declarative anomaly rule engine
+  (threshold/slope/drop-vs-baseline with seed rules), atomic incident
+  artifacts naming the LEADING INDICATOR of a breach, the
+  ``/debug/history`` surface, web-status sparklines, fleet piggyback
+  and the ``veles_tpu observe incident`` CLI — the governor's
+  burn/pressure sensing reads the same store the autopsies report;
 - :mod:`veles_tpu.observe.regress` — the artifact-proof bench sentinel:
   incremental atomic BENCH writes with SHA-256 sidecars, and the
   ``veles_tpu observe regress`` comparison gate (``make regress``).
@@ -49,6 +57,11 @@ lock-free append (the overhead guard covers it too).
 
 from veles_tpu.observe.flight import (  # noqa: F401
     FlightRecorder, get_flight_recorder)
+from veles_tpu.observe.history import (  # noqa: F401
+    AnomalyRule, IncidentRecorder, MetricHistory, default_rules,
+    ensure_metric_history, get_metric_history, parse_history_spec,
+    set_metric_history, sparkline, start_history_sampler,
+    stop_history_sampler)
 from veles_tpu.observe.metrics import (  # noqa: F401
     DEFAULT_BUCKETS, MetricsRegistry, bridge, get_metrics_registry,
     publish_decoder, publish_fleet, publish_loader,

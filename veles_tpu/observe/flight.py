@@ -214,6 +214,16 @@ def _summarize(doc, path, tail=0):
              "  reason: %s  pid: %s  entries: %d  schema: %s" % (
                  doc.get("reason"), doc.get("pid"),
                  len(doc.get("entries", [])), doc.get("schema"))]
+    # entry census by kind: the PR-11 `governor` actuations and the
+    # metric-history `anomaly`/`incident` marks count like the rest,
+    # so one summary line says what the ring actually recorded
+    kinds = collections.Counter(
+        str(entry.get("kind", "?"))
+        for entry in doc.get("entries", [])
+        if isinstance(entry, dict))
+    if kinds:
+        lines.append("  kinds: " + ", ".join(
+            "%s=%d" % kv for kv in sorted(kinds.items())))
     when = doc.get("time")
     if when:
         lines.append("  time: %s" % time.strftime(

@@ -234,6 +234,12 @@ class ServingGovernor(Logger):
                     "which returns None instead")
         self.config = config
         self._clock = clock
+        #: metric flight recorder (observe/history.py): when attached,
+        #: every burn/pressure reading the loop acts on is recorded as
+        #: a ``veles_ctrl_*`` history series — the incident autopsy
+        #: replays exactly what the governor saw (no second
+        #: bookkeeping path)
+        self.history = None
         #: 0 = full fidelity; k = self._ladder[k - 1] is serving
         self.level = 0
         self.base_tier = "bf16"
@@ -264,6 +270,14 @@ class ServingGovernor(Logger):
         self._prewarm_threads = []
 
     # -- wiring ------------------------------------------------------------
+    def attach_history(self, history):
+        """Wire the metric flight recorder: burn/pressure sensing runs
+        through it (``MetricHistory.control_burn``/``record_control``)
+        so the control plane and the incident autopsy read ONE trend
+        store. None detaches (the summary() fallback)."""
+        self.history = history
+        return history
+
     def set_base_tier(self, base):
         """Pin the configured (full-fidelity) tier; ladder rungs at or
         above it are unreachable and drop out."""
@@ -322,19 +336,45 @@ class ServingGovernor(Logger):
         self.counters["ticks"] += 1
         burn = None
         if api.slo is not None:
-            summary = api.slo.summary()
             # an EMPTY window is no signal, not a healthy one: burn
             # stays None and the tier HOLDS. Decisions come from
             # device-truth numbers only — promoting on silence during
             # a resolution gap (e.g. while a swap drains) would flap
             # the ladder against a fault that never cleared.
-            burn = summary["burn_rate"] if summary else None
+            if self.history is not None:
+                # the history-backed path: the reading is RECORDED as
+                # the veles_ctrl_burn_rate series in the same motion —
+                # demote decisions and incident autopsies share one
+                # trend store by construction
+                burn = self.history.control_burn(api.slo)
+            else:
+                summary = api.slo.summary()
+                burn = summary["burn_rate"] if summary else None
         self.last_burn = burn
         #: the tick's decision instant — _note stamps transitions with
         #: it so the hysteresis window math holds under injected clocks
         self._now = now
         pool = api.decoder.pool
         pool_snap = pool.snapshot() if pool is not None else None
+        if self.history is not None:
+            if pool_snap is not None:
+                # the pressure reading _resize_admission acts on,
+                # recorded under the same ctrl namespace as the burn
+                self.history.record_control(
+                    "veles_ctrl_pool_pressure",
+                    max(pool_snap["pages_used"],
+                        pool_snap["reserved_pages"])
+                    / max(1, pool_snap["pages_total"]))
+            # FALLBACK sampling only: while the process sampler
+            # thread is alive (every served /metrics mount starts
+            # one), the driver never samples. Without a sampler
+            # (library embedders), the rate-limited tick keeps the
+            # trends alive DATA-ONLY — rule evaluation, and with it
+            # any incident-artifact disk write, never runs on the
+            # decode driver thread.
+            from veles_tpu.observe.history import history_sampler_alive
+            if not history_sampler_alive():
+                self.history.maybe_sample(check_rules=False)
         # transition FIRST so the resize/reprice below act on the new
         # rung in the same pass, not one interval late
         self._maybe_transition(api, burn, now)

@@ -336,6 +336,34 @@ class MetricsRegistry:
                 out[name] = rows
         return out
 
+    def sample(self):
+        """Collector-run snapshot WITHOUT rendering exposition text:
+        ``[(name, kind, labels_tuple, value)]`` for every counter and
+        gauge, plus each histogram's ``_count``/``_sum`` synthesized as
+        counter rows (so a sampler can track observation rates).
+        Collector-backed series (``veles_xla_*`` and friends), which
+        otherwise materialize only inside a scrape, are refreshed first
+        — this is the metric-history sampler's feed
+        (``observe/history.py``). Disabled: returns an empty tuple
+        before touching the lock or the collectors, so the no-scrape
+        fast path stays allocation-free."""
+        if not self.enabled:
+            return ()
+        self._run_collectors()
+        out = []
+        with self._lock:
+            for name, family in self._families.items():
+                if family.kind == HISTOGRAM:
+                    for key, slot in family.samples.items():
+                        out.append((name + "_count", COUNTER, key,
+                                    slot["count"]))
+                        out.append((name + "_sum", COUNTER, key,
+                                    slot["sum"]))
+                else:
+                    for key, value in family.samples.items():
+                        out.append((name, family.kind, key, value))
+        return out
+
     def snapshot(self):
         """Flat counter/gauge snapshot ``[(name, kind, labels, value)]``
         — the piggyback payload a fleet slave rides on its update

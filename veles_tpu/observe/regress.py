@@ -69,9 +69,20 @@ SCHEMA_VERSION = 1
 #: is oscillation — the hysteresis got worse); the per-tier
 #: governor_*_attainment keys use the higher-is-better default (SLO
 #: attainment dropping at a tier is a regression).
+#: The metric-history keys (observe/history.py, bench history
+#: section): incident_mttd_ms (fault injection -> anomaly firing, the
+#: mean-time-to-detect of the seeded chaos profile) rides the "_ms"
+#: rule — a slower detector regressed; "_ns" covers the sampler
+#: overhead keys (history_sample_on_ns / history_sample_off_ns:
+#: steady-state nanoseconds per registry sample with the history
+#: store on vs off — the embedded recorder growing its tax is a
+#: regression); "_anomaly_rate" regresses UP (more rule firings for
+#: the same seeded fault profile means the rules got noisier, the
+#: detector equivalent of governor oscillation).
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
-                 "_flatness", "_compiles", "burn_rate", "_transitions")
+                 "_flatness", "_compiles", "burn_rate", "_transitions",
+                 "_ns", "_anomaly_rate")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

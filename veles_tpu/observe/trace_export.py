@@ -159,6 +159,21 @@ def main(argv=None):
                           "file")
     slo.add_argument("--slowest", type=int, default=8,
                      help="resolved requests to autopsy (default 8)")
+    incident = sub.add_parser(
+        "incident",
+        help="render a metric-history incident artifact's merged "
+             "timeline and its leading indicator (observe/history.py),"
+             " or inspect a live server via --live URL "
+             "(<URL>/debug/history)")
+    incident.add_argument("artifact", nargs="?", default=None,
+                          help="incident JSON, or a directory to list "
+                               "(default: the run dir)")
+    incident.add_argument("--live", default=None, metavar="URL",
+                          help="fetch <URL>/debug/history instead of "
+                               "a saved artifact")
+    incident.add_argument("--slowest", type=int, default=4,
+                          help="request waterfalls to include "
+                               "(default 4)")
     regress = sub.add_parser(
         "regress",
         help="compare two BENCH artifacts with spread-aware per-key "
@@ -180,6 +195,10 @@ def main(argv=None):
         from veles_tpu.observe.slo import slo_main
         return slo_main(args.artifact, live=args.live,
                         slowest=args.slowest)
+    if args.command == "incident":
+        from veles_tpu.observe.history import incident_main
+        return incident_main(args.artifact, live=args.live,
+                             slowest=args.slowest)
     if args.command == "regress":
         from veles_tpu.observe.regress import compare_main
         return compare_main(args.old, args.new,

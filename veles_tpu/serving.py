@@ -500,6 +500,7 @@ class RESTfulAPI(Unit):
         from veles_tpu.core.httpd import (MAX_BODY, BodyTooLarge,
                                           QuietHandlerMixin,
                                           enable_metrics, read_body,
+                                          serve_debug_history,
                                           serve_debug_requests,
                                           serve_health, serve_metrics,
                                           start_server)
@@ -527,6 +528,8 @@ class RESTfulAPI(Unit):
                 if serve_metrics(self):
                     return
                 if serve_debug_requests(self):
+                    return
+                if serve_debug_history(self):
                     return
                 if not serve_health(self, api.health):
                     self.send_error(404)
@@ -1926,6 +1929,12 @@ class GenerateAPI:
         if governor is not None:
             governor.set_base_tier(self._base_tier)
             self.health.attach_governor(governor)
+            # the metric flight recorder (observe/history.py): the
+            # governor's burn/pressure sensing runs THROUGH it, so the
+            # incident autopsy replays exactly the trend windows the
+            # demote decisions read (no second bookkeeping path)
+            from veles_tpu.observe.history import ensure_metric_history
+            governor.attach_history(ensure_metric_history())
         #: the governor's graceful tier-swap request (driver-thread
         #: owned) and the backoff stamp a failed swap arms so a sick
         #: device cannot wedge the driver in swap-probe loops
@@ -2362,6 +2371,7 @@ class GenerateAPI:
         from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
                                           reply, retry_after_headers,
+                                          serve_debug_history,
                                           serve_debug_requests,
                                           serve_health, serve_metrics,
                                           start_server)
@@ -2393,6 +2403,8 @@ class GenerateAPI:
                 if serve_metrics(self):
                     return
                 if serve_debug_requests(self, api.ledger):
+                    return
+                if serve_debug_history(self):
                     return
                 if not serve_health(self, api.health):
                     self.send_error(404)

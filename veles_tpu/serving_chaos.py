@@ -129,6 +129,24 @@ class ServingChaosConfig(ChaosConfigBase):
                     or self.pool_flood_pages
                     or self.compile_storm_at is not None)
 
+    def expected_leading_series(self):
+        """The metric series each configured burn profile is expected
+        to breach FIRST (observe/history.py's leading-indicator
+        acceptance): ``{profile: series_name}``. A latency ramp shows
+        up in the serving latency windows before the burn rate
+        crosses its threshold; a pool flood surges the reservation
+        gauge; a compile storm books the storm counter. Tests and the
+        bench assert the incident artifact's leading indicator against
+        exactly this map — the injected fault must name itself."""
+        out = {}
+        if self.latency_ramp_ms and self.latency_ramp_steps:
+            out["latency_ramp"] = "veles_serving_latency_ms"
+        if self.pool_flood_pages:
+            out["pool_flood"] = "veles_kv_pages_reserved"
+        if self.compile_storm_at is not None:
+            out["compile_storm"] = "veles_xla_recompile_storms_total"
+        return out
+
 
 class ServingChaosMonkey(Logger):
     """The serving-path fault injector (see module docstring)."""

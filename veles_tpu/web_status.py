@@ -46,7 +46,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Workflows</h2>
 <table id="wf"><tr><th>name</th><th>mode</th><th>slaves</th>
 <th>runtime (s)</th><th>fleet health</th><th>serving</th>
-<th>device</th><th>updated</th></tr>%(rows)s</table>
+<th>device</th><th>trends</th><th>updated</th></tr>%(rows)s</table>
 <h2>Workflow graphs</h2><div id="graphs">%(graphs)s</div>
 <h2>Plots</h2><div id="plots">%(plots)s</div>
 <script>
@@ -64,13 +64,15 @@ src.onmessage = function(ev) {
   var state = JSON.parse(ev.data);
   var rows = ['<tr><th>name</th><th>mode</th><th>slaves</th>' +
               '<th>runtime (s)</th><th>fleet health</th>' +
-              '<th>serving</th><th>device</th><th>updated</th></tr>'];
+              '<th>serving</th><th>device</th><th>trends</th>' +
+              '<th>updated</th></tr>'];
   (state.workflows || []).forEach(function(w) {
     rows.push('<tr><td>' + esc(w.name) + '</td><td>' + esc(w.mode) +
               '</td><td>' + (0 | w.slaves) + '</td><td>' +
               Math.round(w.runtime) + '</td><td>' + esc(w.fleet || '') +
               '</td><td>' + esc(w.serving || '') +
               '</td><td>' + esc(w.device || '') +
+              '</td><td>' + esc(w.trends || '') +
               '</td><td>' + esc(w.updated) + '</td></tr>');
   });
   document.getElementById('wf').innerHTML = rows.join('');
@@ -243,6 +245,27 @@ def format_serving_health(serving):
     return " · ".join(parts)
 
 
+def format_trends_cell(trends):
+    """Metric-history sparkline cells (observe/history.py) as one
+    table cell: the notifier ships ``[{"label", "spark", "last"}]``
+    rows and this renders ``label ▁▂▅█ last`` per series — formatted
+    server-side so the static page and the /stream JS cannot drift.
+    Empty for masters without a history (old notifiers, disabled)."""
+    if not isinstance(trends, list):
+        return ""
+    from veles_tpu.observe.history import sparkline
+    parts = []
+    for cell in trends[:8]:
+        if not isinstance(cell, dict):
+            continue
+        spark = cell.get("spark")
+        if isinstance(spark, list):
+            spark = sparkline(spark, width=16)
+        parts.append("%s %s %s" % (cell.get("label", "?"),
+                                   spark or "", cell.get("last", "")))
+    return " · ".join(parts)
+
+
 #: view-group fill colors for the live graph (the reference's viz.js
 #: page colored by the same VIEW_GROUP taxonomy)
 _GROUP_FILL = {"LOADER": "#c8e6c9", "WORKER": "#bbdefb",
@@ -369,8 +392,8 @@ class WebStatusServer(Logger):
         from http.server import BaseHTTPRequestHandler
         from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
                                           QuietHandlerMixin, read_body,
-                                          reply, serve_metrics,
-                                          start_server)
+                                          reply, serve_debug_history,
+                                          serve_metrics, start_server)
 
         enable_metrics()
         server = self
@@ -396,6 +419,8 @@ class WebStatusServer(Logger):
 
             def do_GET(self):
                 if serve_metrics(self):
+                    pass
+                elif serve_debug_history(self):
                     pass
                 elif self.path.startswith("/service"):
                     reply(self, server.statuses())
@@ -534,6 +559,7 @@ class WebStatusServer(Logger):
                 "fleet": format_fleet_health(s.get("fleet")),
                 "serving": format_serving_health(s.get("serving")),
                 "device": format_device_stats(s.get("device")),
+                "trends": format_trends_cell(s.get("trends")),
                 "updated": time.strftime(
                     "%X", time.localtime(s.get("updated", 0)))})
             if isinstance(s.get("graph"), dict):
@@ -573,7 +599,8 @@ class WebStatusServer(Logger):
             slaves = s.get("slaves", [])
             rows.append(
                 "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.0f</td>"
-                "<td>%s</td><td>%s</td><td>%s</td><td>%s</td></tr>" % (
+                "<td>%s</td><td>%s</td><td>%s</td><td>%s</td>"
+                "<td>%s</td></tr>" % (
                     escape(str(s.get("name", key))),
                     escape(str(s.get("mode", "?"))),
                     len(slaves) if isinstance(slaves, (list, tuple))
@@ -582,6 +609,7 @@ class WebStatusServer(Logger):
                     escape(format_fleet_health(s.get("fleet"))),
                     escape(format_serving_health(s.get("serving"))),
                     escape(format_device_stats(s.get("device"))),
+                    escape(format_trends_cell(s.get("trends"))),
                     time.strftime("%X",
                                   time.localtime(s.get("updated", 0)))))
         graphs = []
@@ -612,7 +640,7 @@ class WebStatusServer(Logger):
                 plots.append('<img src="/plots/%s?t=%d" alt="%s"/>'
                              % (name, stamp, name))
         return _PAGE % {"rows": "".join(rows) or
-                        "<tr><td colspan=8>none</td></tr>",
+                        "<tr><td colspan=9>none</td></tr>",
                         "graphs": "".join(graphs) or "<p>none</p>",
                         "plots": "".join(plots) or "<p>none</p>"}
 
@@ -676,6 +704,17 @@ class StatusNotifier:
         if serving_health is not None \
                 and hasattr(serving_health, "snapshot"):
             status["serving"] = serving_health.snapshot()
+        # the trends column (observe/history.py): sparkline tails of
+        # the key series — burn rate, latency, pool pressure — so the
+        # dashboard shows where each master is HEADING, not just where
+        # it is; empty until something mounted /metrics
+        try:
+            from veles_tpu.observe.history import get_metric_history
+            history = get_metric_history()
+            if history is not None and history.samples_total:
+                status["trends"] = history.dashboard_cells()
+        except Exception:
+            pass
         # device-truth column (observe/xla_stats.py): memory, compile
         # totals, storms, live MFU — only once the tracker is on (a
         # /metrics mount), so idle masters don't pay the device poll
