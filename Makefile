@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr aot slo governor history
+	regress mesh paged fleet-mr aot slo governor history analyze
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -123,6 +123,20 @@ governor:
 history:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_history.py \
 		-m history -q
+
+# Invariant gate (docs/static_analysis.md): the AST rule engine over
+# the package — flight-recorder lock discipline, retrace hazards,
+# donation safety, the thread-shared-state census and the Prometheus
+# metric grammar — gating on NEW findings only (the committed baseline
+# suppresses triaged ones; exit 1 = new violation, 2 = unreadable
+# file), then the analyzer's own suite: every rule proven live on a
+# seeded-violation fixture + the clean negative control + the baseline
+# round trip + the CLI exit-code matrix.
+analyze:
+	JAX_PLATFORMS=cpu $(PYTHON) -m veles_tpu analyze veles_tpu/ \
+		--baseline analyze_baseline.json
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_analyze.py \
+		-m analyze -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

@@ -1,0 +1,47 @@
+"""The negative control: clean under EVERY rule, even though the test
+registry declares ``CleanLedger.record`` record-path and
+``CleanShared`` thread-shared — each construct below is the sanctioned
+shape of a pattern the sibling fixtures violate."""
+
+import threading
+
+import jax
+
+_FN_CACHE = {}
+
+
+def _step(x):
+    return x
+
+
+def cached_dispatch(key, x):
+    # the sanctioned miss-branch shape: one jit object per key
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(_step)
+        _FN_CACHE[key] = fn
+    return fn(x)
+
+
+class CleanLedger:
+    def __init__(self):
+        self.rows = []
+        self.dropped = 0
+
+    def record(self, stamp):
+        # GIL-atomic container append: the flight-recorder discipline
+        self.rows.append(stamp)
+
+
+class CleanShared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def book(self):
+        with self._lock:
+            self.count += 1
+
+
+def publish(registry):
+    registry.incr("veles_clean_total", help="clean fixture counter")

@@ -1,0 +1,17 @@
+"""Seeded violation for ``donation.read-after-dispatch`` — ``state``
+is donated to the jitted step, then read again: XLA may already have
+reused its buffer (PR 9's donated-buffer doctrine)."""
+
+import jax
+
+
+def _train(state, batch):
+    return state
+
+
+step = jax.jit(_train, donate_argnums=(0,))
+
+
+def tick(state, batch):
+    out = step(state, batch)
+    return state, out  # analyze-expect: donation.read-after-dispatch

@@ -1,0 +1,17 @@
+"""Seeded violation for ``retrace.jit-in-loop`` — constructing the
+jit inside the loop body builds a fresh traced callable per iteration
+(nothing cached across iterations)."""
+
+import jax
+
+
+def _step(x):
+    return x + 1
+
+
+def sweep(batches):
+    outs = []
+    for batch in batches:
+        fn = jax.jit(_step)  # analyze-expect: retrace.jit-in-loop
+        outs.append(fn(batch))
+    return outs

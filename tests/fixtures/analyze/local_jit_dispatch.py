@@ -1,0 +1,19 @@
+"""Seeded violation for ``retrace.local-jit-dispatch`` — jitting a
+fresh shard_map wrapper and dispatching it in the same scope: the jit
+cache keys on the wrapper's identity, so every ``run_once`` call
+re-traces."""
+
+import jax
+
+
+def shard_map(fn, mesh=None):
+    return fn
+
+
+def run_once(xs, mesh):
+    fn = jax.jit(shard_map(_double, mesh=mesh))
+    return fn(xs)  # analyze-expect: retrace.local-jit-dispatch
+
+
+def _double(x):
+    return x * 2

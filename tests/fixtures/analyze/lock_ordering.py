@@ -1,0 +1,22 @@
+"""Seeded violation for ``lock.ordering`` — ``forward`` nests
+alpha->beta, ``backward`` nests beta->alpha: the classic two-thread
+deadlock, reported where the second ordering completes."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+        self.balance = 0
+
+    def forward(self):
+        with self._alpha_lock:
+            with self._beta_lock:
+                self.balance = 1
+
+    def backward(self):
+        with self._beta_lock:
+            with self._alpha_lock:  # analyze-expect: lock.ordering
+                self.balance = 2

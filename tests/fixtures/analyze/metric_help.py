@@ -1,0 +1,7 @@
+"""Seeded violation for ``metric.help`` — a validly named gauge whose
+family never passes ``help=`` at any call site (a bare ``# HELP`` line
+dashboards cannot explain)."""
+
+
+def publish(registry):
+    registry.set("veles_fixture_depth", 3)  # analyze-expect: metric.help

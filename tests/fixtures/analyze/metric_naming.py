@@ -1,0 +1,7 @@
+"""Seeded violation for ``metric.naming`` — a counter without the
+``_total`` suffix (PR 5's Prometheus grammar). ``help=`` is present so
+only the naming rule fires on this file."""
+
+
+def publish(registry):
+    registry.incr("veles_fixture_requests", help="seeded bad counter")  # analyze-expect: metric.naming

@@ -1,0 +1,14 @@
+"""Seeded violation for ``lock.record-path`` — the test registry
+declares ``ToyLedger.record`` a record-path function; the sleep is the
+one violation (the append is the sanctioned GIL-atomic op)."""
+
+import time
+
+
+class ToyLedger:
+    def __init__(self):
+        self.marks = []
+
+    def record(self, stamp):
+        time.sleep(0.001)  # analyze-expect: lock.record-path
+        self.marks.append(stamp)

@@ -1,0 +1,9 @@
+"""Seeded violation for ``retrace.shape-key`` — a program cache keyed
+on a list: shape keys must be canonical hashable tuples (one compiled
+program per canonical key is the dispatch-economy invariant)."""
+
+_PROGRAM_CACHE = {}
+
+
+def remember(bucket, group, fn):
+    _PROGRAM_CACHE[[bucket, group]] = fn  # analyze-expect: retrace.shape-key

@@ -1,0 +1,18 @@
+"""Seeded violation for ``shared.rmw`` — the test registry declares
+``SharedCounters`` reachable from handler AND driver threads; the
+unlocked ``+= 1`` interleaves load/op/store across threads and drops
+updates (the locked dict update below is the sanctioned shape)."""
+
+import threading
+
+
+class SharedCounters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.served = 0
+        self.by_kind = {}
+
+    def book(self, kind):
+        self.served += 1  # analyze-expect: shared.rmw
+        with self._lock:
+            self.by_kind[kind] = self.by_kind.get(kind, 0) + 1

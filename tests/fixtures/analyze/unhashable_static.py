@@ -1,0 +1,16 @@
+"""Seeded violation for ``retrace.unhashable-static`` — passing a
+list for a declared static argname: statics key the jit cache, so an
+unhashable one raises (and a call-varying one re-traces per call)."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def program(x, cfg):
+    return x
+
+
+def run(x):
+    return program(x, cfg=["a", "b"])  # analyze-expect: retrace.unhashable-static

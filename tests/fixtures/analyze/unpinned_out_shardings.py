@@ -1,0 +1,11 @@
+"""Seeded violation for ``retrace.unpinned-out-shardings`` — a mesh
+jit that pins in_shardings but lets the output layout float (the PR 6
+retrace-storm signature)."""
+
+import jax
+
+SPECS = object()
+
+
+def build_step(fn):
+    return jax.jit(fn, in_shardings=SPECS)  # analyze-expect: retrace.unpinned-out-shardings

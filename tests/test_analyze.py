@@ -1,0 +1,514 @@
+"""ISSUE 13: the invariant-checking static-analysis pass
+(``veles_tpu analyze``, docs/static_analysis.md).
+
+- every shipped rule is proven LIVE: it fires at the exact
+  ``file:line`` of its seeded fixture violation (and nowhere else in
+  that fixture), and the clean negative-control file yields zero
+  findings under the full rule set even when declared record-path and
+  thread-shared;
+- the baseline round-trips: findings -> ``--update-baseline`` ->
+  exit 0, and a NEW violation still surfaces through a populated
+  baseline (with triage justifications preserved across updates);
+- the CLI exit-code matrix holds: 0 clean / 1 findings /
+  2 unreadable;
+- the acceptance gate: ``veles_tpu analyze veles_tpu/`` exits 0
+  against the committed baseline.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from veles_tpu.analyze import AnalysisRegistry, run_analysis
+from veles_tpu.analyze.cli import main as analyze_main
+from veles_tpu.analyze.rules import default_rules
+
+pytestmark = pytest.mark.analyze
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "analyze")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (rule id, fixture file) — one seeded violation per shipped rule
+RULE_FIXTURES = [
+    ("lock.record-path", "record_path.py"),
+    ("lock.ordering", "lock_ordering.py"),
+    ("retrace.unpinned-out-shardings", "unpinned_out_shardings.py"),
+    ("retrace.local-jit-dispatch", "local_jit_dispatch.py"),
+    ("retrace.unhashable-static", "unhashable_static.py"),
+    ("retrace.jit-in-loop", "jit_in_loop.py"),
+    ("retrace.shape-key", "shape_key.py"),
+    ("donation.read-after-dispatch", "donation.py"),
+    ("shared.rmw", "shared_rmw.py"),
+    ("metric.naming", "metric_naming.py"),
+    ("metric.help", "metric_help.py"),
+]
+
+
+def fixture_registry():
+    """Fixture-scoped declarations (the real tree's live in
+    veles_tpu/analyze/registry.py)."""
+    return AnalysisRegistry(
+        record_path={"analyze/record_path.py": {"ToyLedger.record"},
+                     "analyze/clean.py": {"CleanLedger.record"}},
+        shared_classes={"analyze/shared_rmw.py": {"SharedCounters": ()},
+                        "analyze/clean.py": {"CleanShared": ()}})
+
+
+def expected_markers(path):
+    """``(rule id, line)`` rows from the ``# analyze-expect:`` markers
+    the fixtures carry on their violation lines."""
+    out = []
+    with open(path) as fin:
+        for lineno, line in enumerate(fin, 1):
+            if "# analyze-expect:" in line:
+                rule = line.split("# analyze-expect:")[1].strip()
+                out.append((rule, lineno))
+    return out
+
+
+class TestRuleCorpus:
+    @pytest.mark.parametrize("rule_id,filename", RULE_FIXTURES,
+                             ids=[r for r, _ in RULE_FIXTURES])
+    def test_rule_fires_at_exact_line(self, rule_id, filename):
+        """The seeded violation is found at its exact file:line — and
+        is the ONLY finding the full rule set raises on the fixture
+        (no cross-rule contamination)."""
+        path = os.path.join(FIXTURES, filename)
+        findings, errors = run_analysis([path],
+                                        registry=fixture_registry())
+        assert not errors
+        assert [(f.rule, f.line) for f in findings] \
+            == expected_markers(path)
+        assert all(f.path == path for f in findings)
+        assert any(f.rule == rule_id for f in findings)
+
+    def test_every_shipped_rule_has_a_fixture(self):
+        """A rule without a seeded-violation fixture is not proven
+        live — adding a rule forces adding its fixture."""
+        assert {rule for rule, _ in RULE_FIXTURES} \
+            == {rule.id for rule in default_rules()}
+
+    def test_clean_file_zero_findings(self):
+        """The negative control: clean under the FULL rule set even
+        while declared record-path and thread-shared."""
+        path = os.path.join(FIXTURES, "clean.py")
+        findings, errors = run_analysis([path],
+                                        registry=fixture_registry())
+        assert not errors
+        assert findings == []
+
+    def test_whole_corpus_matches_markers(self):
+        """Directory run: the union of every fixture's markers, each
+        at its own path — cross-file rules (metric.help) included."""
+        findings, errors = run_analysis([FIXTURES],
+                                        registry=fixture_registry())
+        assert not errors
+        got = {(os.path.basename(f.path), f.rule, f.line)
+               for f in findings}
+        want = set()
+        for _, filename in RULE_FIXTURES:
+            path = os.path.join(FIXTURES, filename)
+            want |= {(filename, rule, line)
+                     for rule, line in expected_markers(path)}
+        assert got == want
+
+    def test_record_path_nested_def_reported_once(self, tmp_path):
+        """A violation inside a nested def yields ONE finding — under
+        a whole-module declaration it is attributed to the nested
+        qualname; under an explicit declaration of the outer function
+        the closure inherits the discipline."""
+        mod = tmp_path / "probe.py"
+        mod.write_text(
+            "import time\n"
+            "def outer():\n"
+            "    def inner():\n"
+            "        time.sleep(1)\n"
+            "    return inner\n")
+        whole = AnalysisRegistry(record_path={"probe.py": None},
+                                 shared_classes={})
+        findings, _ = run_analysis([str(mod)], registry=whole)
+        assert [(f.rule, f.line) for f in findings] \
+            == [("lock.record-path", 4)]
+        assert "outer.inner" in findings[0].message
+        explicit = AnalysisRegistry(record_path={"probe.py": {"outer"}},
+                                    shared_classes={})
+        findings, _ = run_analysis([str(mod)], registry=explicit)
+        assert [(f.rule, f.line) for f in findings] \
+            == [("lock.record-path", 4)]
+
+    def test_donation_rebind_shape_is_sanctioned(self, tmp_path):
+        """`state = step(state, b)` (single call and the canonical
+        training loop) rebinds the name to the RETURNED value — no
+        finding; a read of a buffer donated to an earlier statement
+        still fires."""
+        mod = tmp_path / "ticks.py"
+        mod.write_text(
+            "import jax\n"
+            "def _t(state, b):\n"
+            "    return state\n"
+            "step = jax.jit(_t, donate_argnums=(0,))\n"
+            "def tick(state, b):\n"
+            "    state = step(state, b)\n"
+            "    return state\n"
+            "def loop(state, batches):\n"
+            "    for b in batches:\n"
+            "        state = step(state, b)\n"
+            "    return state\n"
+            "def double(state, b):\n"
+            "    out = step(state, b)\n"
+            "    again = step(state, b)\n"
+            "    return out, again\n")
+        findings, errors = run_analysis(
+            [str(mod)], rule_filter="donation",
+            registry=AnalysisRegistry(record_path={},
+                                      shared_classes={}))
+        assert not errors
+        assert [(f.rule, f.line) for f in findings] \
+            == [("donation.read-after-dispatch", 14)]
+
+    def test_donation_same_statement_read_fires(self, tmp_path):
+        """A read of the donated buffer in the SAME statement as the
+        donating call (`return step(state, b) + state`) is the bug
+        class the rule gates — it must fire."""
+        mod = tmp_path / "same.py"
+        mod.write_text(
+            "import jax\n"
+            "def _t(state, b):\n"
+            "    return state\n"
+            "step = jax.jit(_t, donate_argnums=(0,))\n"
+            "def tick(state, b):\n"
+            "    return step(state, b) + state\n")
+        findings, _ = run_analysis(
+            [str(mod)], rule_filter="donation",
+            registry=AnalysisRegistry(record_path={},
+                                      shared_classes={}))
+        assert [(f.rule, f.line) for f in findings] \
+            == [("donation.read-after-dispatch", 6)]
+
+    def test_jit_in_loop_cache_exemption_is_scope_local(self,
+                                                        tmp_path):
+        """An unrelated function's `cache[k] = fn` must not silence a
+        same-named uncached jit-in-loop elsewhere in the file."""
+        mod = tmp_path / "twofn.py"
+        mod.write_text(
+            "import jax\n"
+            "_C = {}\n"
+            "def _step(x):\n"
+            "    return x\n"
+            "def hot(batches):\n"
+            "    for b in batches:\n"
+            "        fn = jax.jit(_step)\n"
+            "        fn(b)\n"
+            "def other(fn):\n"
+            "    _C['k'] = fn\n")
+        findings, _ = run_analysis(
+            [str(mod)], rule_filter="retrace.jit-in-loop",
+            registry=AnalysisRegistry(record_path={},
+                                      shared_classes={}))
+        assert [(f.rule, f.line) for f in findings] \
+            == [("retrace.jit-in-loop", 7)]
+
+    def test_unguarded_nonlocal_jit_still_fires(self, tmp_path):
+        """A nonlocal slot rebuilt UNCONDITIONALLY per call re-traces
+        every call — only the `if slot is None:` memo-guard shape is
+        sanctioned."""
+        mod = tmp_path / "slots.py"
+        mod.write_text(
+            "import jax\n"
+            "def shard_map(fn, mesh=None):\n"
+            "    return fn\n"
+            "def _run(x):\n"
+            "    return x\n"
+            "def make(mesh):\n"
+            "    slot = None\n"
+            "    def bad(x):\n"
+            "        nonlocal slot\n"
+            "        slot = jax.jit(shard_map(_run, mesh=mesh))\n"
+            "        return slot(x)\n"
+            "    def good(x):\n"
+            "        nonlocal slot\n"
+            "        if slot is None:\n"
+            "            slot = jax.jit(shard_map(_run, mesh=mesh))\n"
+            "        return slot(x)\n"
+            "    return bad, good\n")
+        findings, _ = run_analysis(
+            [str(mod)], rule_filter="retrace.local-jit-dispatch",
+            registry=AnalysisRegistry(record_path={},
+                                      shared_classes={}))
+        assert [(f.rule, f.line) for f in findings] \
+            == [("retrace.local-jit-dispatch", 11)]
+
+    def test_jit_in_loop_miss_branch_is_sanctioned(self, tmp_path):
+        """The keyed-cache miss-branch inside a loop (clean.py's
+        sanctioned shape: `fn = jax.jit(...)` then `cache[key] = fn`)
+        must not fire."""
+        mod = tmp_path / "warm.py"
+        mod.write_text(
+            "import jax\n"
+            "_FN_CACHE = {}\n"
+            "def _step(x):\n"
+            "    return x\n"
+            "def warm(keys):\n"
+            "    for key in keys:\n"
+            "        fn = _FN_CACHE.get(key)\n"
+            "        if fn is None:\n"
+            "            fn = jax.jit(_step)\n"
+            "            _FN_CACHE[key] = fn\n"
+            "        fn(key)\n")
+        findings, errors = run_analysis(
+            [str(mod)], rule_filter="retrace.jit-in-loop",
+            registry=AnalysisRegistry(record_path={},
+                                      shared_classes={}))
+        assert not errors
+        assert findings == []
+
+    def test_registry_suffix_matches_at_segment_boundary(self):
+        """`serving.py` declarations must not leak onto a file that
+        merely ENDS with the same characters (llm_serving.py)."""
+        registry = AnalysisRegistry()
+        assert registry.shared_classes_for("veles_tpu/serving.py")
+        assert not registry.shared_classes_for(
+            "samples/llm_serving.py")
+        assert registry.record_path_functions(
+            "veles_tpu/observe/reqledger.py") is None
+        assert registry.record_path_functions(
+            "other/my_reqledger.py") == ()
+
+    def test_lockish_names_are_boundary_anchored(self, tmp_path):
+        """`with blocker:` must NOT count as holding a lock — a false
+        lock would silently satisfy shared.rmw (masking the exact race
+        the rule exists to catch) and mis-fire the lock rules."""
+        mod = tmp_path / "notlocks.py"
+        mod.write_text(
+            "class Gauges:\n"
+            "    def book(self, blocker):\n"
+            "        with blocker:\n"
+            "            self.served += 1\n"
+            "        with self.clock:\n"
+            "            self.ticks += 1\n")
+        registry = AnalysisRegistry(
+            record_path={},
+            shared_classes={"notlocks.py": {"Gauges": ()}})
+        findings, errors = run_analysis([str(mod)], registry=registry)
+        assert not errors
+        assert [(f.rule, f.line) for f in findings] \
+            == [("shared.rmw", 4), ("shared.rmw", 6)]
+
+    def test_rule_filter_selects_family_and_id(self):
+        path = os.path.join(FIXTURES, "metric_naming.py")
+        findings, _ = run_analysis([path], rule_filter="metric.naming",
+                                   registry=fixture_registry())
+        assert [f.rule for f in findings] == ["metric.naming"]
+        findings, _ = run_analysis([path], rule_filter="lock",
+                                   registry=fixture_registry())
+        assert findings == []
+        with pytest.raises(ValueError, match="unknown rule"):
+            run_analysis([path], rule_filter="nonsense",
+                         registry=fixture_registry())
+
+
+class TestCliExitCodes:
+    def test_exit_0_on_clean(self, capsys):
+        assert analyze_main([os.path.join(FIXTURES, "clean.py")]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_exit_1_on_findings(self, capsys):
+        path = os.path.join(FIXTURES, "metric_naming.py")
+        assert analyze_main([path]) == 1
+        out = capsys.readouterr().out
+        assert "[metric.naming]" in out
+        assert "metric_naming.py" in out
+
+    def test_exit_2_on_unreadable(self, tmp_path, capsys):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def broken(:\n")
+        assert analyze_main([str(bad)]) == 2
+        assert "UNREADABLE" in capsys.readouterr().err
+
+    def test_exit_2_on_corrupt_baseline(self, tmp_path, capsys):
+        """A merge-mangled baseline is an unreadable INPUT (exit 2),
+        never 'new findings' (exit 1)."""
+        corrupt = tmp_path / "baseline.json"
+        corrupt.write_text("{bad json")
+        clean = os.path.join(FIXTURES, "clean.py")
+        assert analyze_main([clean, "--baseline",
+                             str(corrupt)]) == 2
+        assert "UNREADABLE" in capsys.readouterr().err
+        corrupt.write_text('{"wrong": "shape"}')
+        assert analyze_main([clean, "--baseline",
+                             str(corrupt)]) == 2
+        # valid JSON, entry missing its fingerprint (bad merge
+        # resolution): still exit 2, never a KeyError traceback
+        corrupt.write_text(
+            '{"version": 1, "findings": [{"rule": "x"}]}')
+        assert analyze_main([clean, "--baseline",
+                             str(corrupt)]) == 2
+        # and --update-baseline rebuilds it from scratch as promised
+        assert analyze_main([clean, "--baseline", str(corrupt),
+                             "--update-baseline"]) == 0
+        assert analyze_main([clean, "--baseline",
+                             str(corrupt)]) == 0
+
+    def test_rule_flag(self, capsys):
+        path = os.path.join(FIXTURES, "metric_help.py")
+        assert analyze_main([path, "--rule", "lock"]) == 0
+        assert analyze_main([path, "--rule", "metric"]) == 1
+
+    def test_unknown_rule_is_an_error(self, capsys):
+        with pytest.raises(SystemExit):
+            analyze_main([os.path.join(FIXTURES, "clean.py"),
+                          "--rule", "nonsense"])
+
+    def test_list_rules(self, capsys):
+        assert analyze_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in default_rules():
+            assert rule.id in out
+
+    def test_record_path_and_shared_class_flags(self, tmp_path,
+                                                capsys):
+        """The one-off registry extension seam: the same fixture that
+        is silent without declarations fires with them."""
+        record = os.path.join(FIXTURES, "record_path.py")
+        shared = os.path.join(FIXTURES, "shared_rmw.py")
+        assert analyze_main([record, shared]) == 0
+        capsys.readouterr()
+        assert analyze_main(
+            [record, shared,
+             "--record-path", "analyze/record_path.py:ToyLedger.record",
+             "--shared-class", "analyze/shared_rmw.py:SharedCounters"]
+        ) == 1
+        out = capsys.readouterr().out
+        assert "[lock.record-path]" in out
+        assert "[shared.rmw]" in out
+
+
+class TestBaseline:
+    def _seed(self, tmp_path):
+        target = tmp_path / "shape_key.py"
+        shutil.copy(os.path.join(FIXTURES, "shape_key.py"), target)
+        return str(target), str(tmp_path / "baseline.json")
+
+    def test_round_trip_then_new_violation_surfaces(self, tmp_path,
+                                                    capsys):
+        target, baseline = self._seed(tmp_path)
+        assert analyze_main([target, "--baseline", baseline]) == 1
+        capsys.readouterr()
+        # adopt: record the pre-existing finding, gate goes green
+        assert analyze_main([target, "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        assert analyze_main([target, "--baseline", baseline]) == 0
+        assert "baselined" in capsys.readouterr().out
+        # a NEW violation still surfaces through the populated baseline
+        with open(target, "a") as fout:
+            fout.write("\n\ndef more(fn):\n"
+                       "    _PROGRAM_CACHE[[1, 2]] = fn\n")
+        assert analyze_main([target, "--baseline", baseline]) == 1
+        out = capsys.readouterr().out
+        assert out.count("[retrace.shape-key]") == 1  # only the new one
+
+    def test_update_preserves_justifications(self, tmp_path):
+        target, baseline = self._seed(tmp_path)
+        assert analyze_main([target, "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        with open(baseline) as fin:
+            data = json.load(fin)
+        assert len(data["findings"]) == 1
+        data["findings"][0]["justification"] = "fixture: deliberate"
+        with open(baseline, "w") as fout:
+            json.dump(data, fout)
+        assert analyze_main([target, "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        with open(baseline) as fin:
+            kept = json.load(fin)["findings"][0]
+        assert kept["justification"] == "fixture: deliberate"
+        assert kept["rule"] == "retrace.shape-key"
+
+    def test_update_refuses_rule_filter(self, tmp_path):
+        """A rule-filtered rewrite would silently drop every other
+        rule's triaged entries — the CLI refuses the combination."""
+        target, baseline = self._seed(tmp_path)
+        with pytest.raises(SystemExit):
+            analyze_main([target, "--baseline", baseline,
+                          "--rule", "metric", "--update-baseline"])
+
+    def test_subtree_update_preserves_other_subtrees(self, tmp_path):
+        """--update-baseline scoped to one subtree must carry over the
+        other subtree's baselined entries untouched."""
+        sub_a = tmp_path / "a"
+        sub_b = tmp_path / "b"
+        sub_a.mkdir()
+        sub_b.mkdir()
+        for sub in (sub_a, sub_b):
+            shutil.copy(os.path.join(FIXTURES, "shape_key.py"),
+                        sub / "shape_key.py")
+        baseline = str(tmp_path / "baseline.json")
+        assert analyze_main([str(tmp_path), "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        with open(baseline) as fin:
+            assert len(json.load(fin)["findings"]) == 2
+        # re-update from subtree a only: b's entry must survive
+        assert analyze_main([str(sub_a), "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        with open(baseline) as fin:
+            paths = {e["path"] for e in json.load(fin)["findings"]}
+        assert paths == {"a/shape_key.py", "b/shape_key.py"}
+        assert analyze_main([str(tmp_path), "--baseline", baseline]) \
+            == 0
+
+    def test_update_prunes_entries_of_deleted_files(self, tmp_path):
+        """Carried-over baseline entries must still point at code that
+        exists — a deleted file's entries are pruned on the next
+        update instead of rotting forever."""
+        sub = tmp_path / "a"
+        sub.mkdir()
+        doomed = sub / "doomed.py"
+        shutil.copy(os.path.join(FIXTURES, "shape_key.py"), doomed)
+        keeper = tmp_path / "shape_key.py"
+        shutil.copy(os.path.join(FIXTURES, "shape_key.py"), keeper)
+        baseline = str(tmp_path / "baseline.json")
+        assert analyze_main([str(tmp_path), "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        doomed.unlink()
+        # update scoped AWAY from the deleted file's subtree: the
+        # dead entry is pruned, the live out-of-scope one survives
+        assert analyze_main([str(keeper), "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        with open(baseline) as fin:
+            paths = {e["path"] for e in json.load(fin)["findings"]}
+        assert paths == {"shape_key.py"}
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        """An unrelated edit ABOVE a baselined finding must not
+        resurrect it (fingerprints are line-number independent)."""
+        target, baseline = self._seed(tmp_path)
+        assert analyze_main([target, "--baseline", baseline,
+                             "--update-baseline"]) == 0
+        with open(target) as fin:
+            source = fin.read()
+        with open(target, "w") as fout:
+            fout.write("# an unrelated comment pushing lines down\n"
+                       "\n" + source)
+        assert analyze_main([target, "--baseline", baseline]) == 0
+
+
+class TestTreeGate:
+    def test_package_clean_against_committed_baseline(self, capsys):
+        """The acceptance criterion: the analyzer, default registry
+        and committed baseline agree the package is clean."""
+        package = os.path.join(REPO_ROOT, "veles_tpu")
+        baseline = os.path.join(REPO_ROOT, "analyze_baseline.json")
+        assert analyze_main([package, "--baseline", baseline]) == 0
+
+    def test_default_paths_cover_the_package(self):
+        """CLI with no paths analyzes the installed package tree."""
+        from veles_tpu.analyze.engine import iter_python_files
+        package = os.path.dirname(
+            os.path.dirname(os.path.abspath(analyze_main.__code__
+                                            .co_filename)))
+        files = iter_python_files([package])
+        names = {os.path.basename(p) for p in files}
+        assert "serving.py" in names and "reqledger.py" in names

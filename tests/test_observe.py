@@ -4,6 +4,7 @@ EventRecorder buffering, dashboard event tailing, Chrome trace export,
 and end-to-end trace propagation through a real GenerateAPI request and
 a real fleet round trip. ``make metrics`` runs this module standalone."""
 
+import glob
 import json
 import os
 import threading
@@ -455,93 +456,49 @@ class TestExemplars:
 
 
 class TestMetricNamingLint:
-    """ISSUE 5 satellite: pin the veles_* token conventions at the
-    SOURCE level so a new gauge cannot silently break Prometheus
-    scrapers — every literal metric name in the package must be a
-    valid exposition token, counters must end _total, histograms must
-    end _seconds, and literal label keys must be valid (and never the
-    reserved ``le``)."""
+    """ISSUE 5 satellite, deduped by ISSUE 13: the AST walk that lived
+    here moved into the shared analyzer rule (veles_tpu/analyze/
+    rules.py, ``metric.naming``/``metric.help`` — `veles_tpu analyze`
+    gates it in CI). This wrapper pins that (1) the shared rule still
+    FIRES on a seeded violation fixture, and (2) the tree is clean —
+    plus the vacuous-scan guard: the instrumented families must
+    actually be in the scan."""
 
-    COUNTER_METHODS = {"incr", "counter_set"}
-    HISTOGRAM_METHODS = {"observe"}
-    GAUGE_METHODS = {"set"}
+    def test_rule_fires_on_seeded_violation(self):
+        from veles_tpu.analyze import run_analysis
 
-    @staticmethod
-    def _metric_calls():
+        fixture = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "fixtures", "analyze", "metric_naming.py")
+        findings, errors = run_analysis([fixture],
+                                        rule_filter="metric.naming")
+        assert not errors
+        assert len(findings) == 1
+        assert findings[0].rule == "metric.naming"
+        assert "_total" in findings[0].message
+
+    def test_conventions_hold_everywhere(self):
+        from veles_tpu.analyze import run_analysis
+        from veles_tpu.analyze.rules import iter_metric_calls
         import ast
-        import glob
 
         package = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "veles_tpu")
-        calls = []
-        for path in glob.glob(os.path.join(package, "**", "*.py"),
-                              recursive=True):
-            tree = ast.parse(open(path).read(), filename=path)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) \
-                        or not isinstance(node.func, ast.Attribute):
-                    continue
-                method = node.func.attr
-                if method not in {"incr", "counter_set", "set",
-                                  "observe"}:
-                    continue
-                if not node.args \
-                        or not isinstance(node.args[0], ast.Constant) \
-                        or not isinstance(node.args[0].value, str):
-                    continue
-                name = node.args[0].value
-                if not name.startswith("veles_"):
-                    continue
-                labels = []
-                for keyword in node.keywords:
-                    if keyword.arg == "labels" \
-                            and isinstance(keyword.value, ast.Dict):
-                        for key in keyword.value.keys:
-                            if isinstance(key, ast.Constant):
-                                labels.append(key.value)
-                calls.append((path, node.lineno, method, name, labels))
-        return calls
-
-    def test_conventions_hold_everywhere(self):
-        from veles_tpu.observe.metrics import (LABEL_NAME_RE,
-                                               METRIC_NAME_RE)
-        import re
-
-        calls = self._metric_calls()
+        findings, errors = run_analysis([package], rule_filter="metric")
+        assert not errors
+        assert findings == [], "\n".join(
+            f.format(relative_to=package) for f in findings)
         # the instrumented families must actually be in the scan —
         # an empty scan would "pass" vacuously
-        names = {name for _, _, _, name, _ in calls}
+        names = set()
+        for path in glob.glob(os.path.join(package, "**", "*.py"),
+                              recursive=True):
+            for _, _, name, _, _ in iter_metric_calls(
+                    ast.parse(open(path).read())):
+                names.add(name)
         assert "veles_serving_requests_total" in names
         assert "veles_xla_compiles_total" in names
         assert "veles_device_memory_bytes" in names
-        token = re.compile(r"^veles_[a-z][a-z0-9_]*$")
-        problems = []
-        for path, line, method, name, labels in calls:
-            where = "%s:%d" % (os.path.basename(path), line)
-            if not METRIC_NAME_RE.match(name) or not token.match(name):
-                problems.append("%s: %r is not a valid lowercase "
-                                "metric token" % (where, name))
-            if method in self.COUNTER_METHODS \
-                    and not name.endswith("_total"):
-                problems.append("%s: counter %r must end _total"
-                                % (where, name))
-            if method in self.HISTOGRAM_METHODS \
-                    and not name.endswith("_seconds"):
-                problems.append("%s: histogram %r must end _seconds"
-                                % (where, name))
-            if method in self.GAUGE_METHODS \
-                    and name.endswith(("_total", "_seconds")):
-                problems.append("%s: gauge %r carries a counter/"
-                                "histogram suffix" % (where, name))
-            for label in labels:
-                if not isinstance(label, str) \
-                        or not LABEL_NAME_RE.match(label) \
-                        or label == "le" \
-                        or label.startswith("__"):
-                    problems.append("%s: bad label key %r on %r"
-                                    % (where, label, name))
-        assert not problems, "\n".join(problems)
 
 
 class TestOverheadGuard:

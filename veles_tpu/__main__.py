@@ -700,6 +700,9 @@ def main(argv=None):
     if argv and argv[0] == "aot":
         from veles_tpu.aot.cli import main as aot_main
         return aot_main(argv[1:])
+    if argv and argv[0] == "analyze":
+        from veles_tpu.analyze.cli import main as analyze_main
+        return analyze_main(argv[1:])
     return Main().run(argv)
 
 

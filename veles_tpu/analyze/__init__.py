@@ -1,0 +1,16 @@
+"""Invariant-checking static analysis (``veles_tpu analyze``).
+
+Encodes the codebase's hard-won invariants — the flight-recorder lock
+discipline, retrace-hazard hygiene, donation safety, the thread-shared-
+state census and the Prometheus metric grammar — as executable AST
+rules gating CI on NEW violations only (docs/static_analysis.md).
+"""
+
+from veles_tpu.analyze.engine import (Finding, ParseError, Rule,
+                                      run_analysis)
+from veles_tpu.analyze.registry import (AnalysisRegistry,
+                                        DEFAULT_REGISTRY)
+from veles_tpu.analyze.rules import default_rules
+
+__all__ = ["Finding", "ParseError", "Rule", "run_analysis",
+           "AnalysisRegistry", "DEFAULT_REGISTRY", "default_rules"]

@@ -1,0 +1,147 @@
+"""Committed baseline: CI gates on NEW violations only.
+
+A static-analysis gate that fires on day-one findings gets disabled
+within a week. The baseline file records every finding the team has
+triaged as pre-existing (with an optional ``justification`` naming WHY
+it is acceptable or deferred); ``veles_tpu analyze`` subtracts it, so
+the exit code reflects only violations this change introduced.
+
+Fingerprints are LINE-NUMBER-INDEPENDENT: ``sha1(rule, relative path,
+stripped source line, occurrence index among identical lines)`` — an
+unrelated edit above a baselined finding must not resurrect it, while
+moving the offending line to a new file (or duplicating it) does
+surface it again. Paths are stored relative to the baseline file's own
+directory so the file is position-independent across checkouts.
+
+``--update-baseline`` rewrites the file from the current findings,
+preserving justifications of entries whose fingerprint survives.
+"""
+
+import hashlib
+import json
+import os
+
+
+def fingerprint(rule, rel_path, line_text, occurrence):
+    payload = "\0".join((rule, rel_path, line_text.strip(),
+                         str(occurrence)))
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()
+
+
+def _finding_rows(findings, base_dir):
+    """``(finding, fingerprint, rel_path, line_text)`` rows with
+    per-(rule, path, line-text) occurrence counting."""
+    counts = {}
+    rows = []
+    sources = {}
+    for finding in findings:
+        path = os.path.abspath(finding.path)
+        if path not in sources:
+            try:
+                with open(path, "rb") as fin:
+                    sources[path] = fin.read().decode(
+                        "utf-8", "replace").splitlines()
+            except OSError:
+                sources[path] = []
+        lines = sources[path]
+        text = lines[finding.line - 1] \
+            if 0 < finding.line <= len(lines) else ""
+        rel = os.path.relpath(path, base_dir).replace(os.sep, "/")
+        key = (finding.rule, rel, text.strip())
+        occurrence = counts.get(key, 0)
+        counts[key] = occurrence + 1
+        rows.append((finding, fingerprint(finding.rule, rel, text,
+                                          occurrence), rel, text))
+    return rows
+
+
+def load_baseline(path):
+    """``{fingerprint: entry dict}`` from a baseline file (empty when
+    the file does not exist — a missing baseline suppresses nothing)."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as fin:
+        data = json.load(fin)
+    if not isinstance(data, dict) or "findings" not in data \
+            or not isinstance(data["findings"], list):
+        raise ValueError("baseline %s is not a "
+                         '{"version": 1, "findings": [...]} document'
+                         % path)
+    out = {}
+    for entry in data["findings"]:
+        # a merge-mangled entry must surface as ValueError (CLI exit 2
+        # / write_baseline rebuild), never as a KeyError traceback
+        if not isinstance(entry, dict) or not entry.get("fingerprint"):
+            raise ValueError(
+                "baseline %s has an entry without a fingerprint "
+                "(merge-mangled?): %r" % (path, entry))
+        out[entry["fingerprint"]] = entry
+    return out
+
+
+def apply_baseline(findings, baseline_path):
+    """Split findings into ``(new, suppressed)`` against the baseline
+    at ``baseline_path``."""
+    base_dir = os.path.dirname(os.path.abspath(baseline_path)) \
+        if baseline_path else os.getcwd()
+    entries = load_baseline(baseline_path)
+    new, suppressed = [], []
+    for finding, print_, _, _ in _finding_rows(findings, base_dir):
+        (suppressed if print_ in entries else new).append(finding)
+    return new, suppressed
+
+
+def write_baseline(findings, baseline_path, analyzed_paths=None):
+    """Rewrite the baseline from the current findings, preserving the
+    ``justification`` of every surviving fingerprint; returns the
+    entry count.
+
+    ``analyzed_paths`` (absolute file paths this run actually looked
+    at) scopes the rewrite: previous entries for files OUTSIDE the
+    analyzed set are carried over untouched — updating the baseline
+    from a subtree must not silently drop another subtree's triaged
+    findings. ``None`` means a full rewrite."""
+    base_dir = os.path.dirname(os.path.abspath(baseline_path)) \
+        or os.getcwd()
+    previous = {}
+    try:
+        previous = load_baseline(baseline_path)
+    except ValueError:  # json.JSONDecodeError subclasses ValueError
+        pass  # a corrupt baseline is rebuilt from scratch
+    entries = []
+    seen = set()
+    for finding, print_, rel, text in _finding_rows(findings, base_dir):
+        if print_ in seen:
+            continue
+        seen.add(print_)
+        entry = {"rule": finding.rule, "path": rel,
+                 "line": finding.line, "source": text.strip(),
+                 "message": finding.message, "fingerprint": print_}
+        justification = previous.get(print_, {}).get("justification")
+        if justification:
+            entry["justification"] = justification
+        entries.append(entry)
+    if analyzed_paths is not None:
+        analyzed_rel = {
+            os.path.relpath(os.path.abspath(p),
+                            base_dir).replace(os.sep, "/")
+            for p in analyzed_paths}
+        for entry in previous.values():
+            if entry.get("path") in analyzed_rel \
+                    or entry["fingerprint"] in seen:
+                continue
+            # prune entries for deleted/renamed files — carried-over
+            # fingerprints must still point at code that exists
+            if not os.path.exists(os.path.join(base_dir,
+                                               entry.get("path", ""))):
+                continue
+            seen.add(entry["fingerprint"])
+            entries.append(entry)
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    tmp = baseline_path + ".tmp"
+    with open(tmp, "w") as fout:
+        json.dump({"version": 1, "findings": entries}, fout, indent=1,
+                  sort_keys=True)
+        fout.write("\n")
+    os.replace(tmp, baseline_path)
+    return len(entries)

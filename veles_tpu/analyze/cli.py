@@ -1,0 +1,145 @@
+"""``veles_tpu analyze [PATHS] [--rule ID] [--baseline PATH]
+[--update-baseline]`` — the invariant gate.
+
+Exit codes (the ``aot verify`` convention):
+
+- **0** — clean: no findings beyond the baseline;
+- **1** — findings: NEW violations printed one per line as
+  ``path:line: [rule] message``;
+- **2** — unreadable: a file failed to parse (syntax error, bad
+  encoding) — the gate refuses to vouch for code it could not read.
+
+``--update-baseline`` re-records every current finding (preserving
+justifications of surviving fingerprints) and exits 0 — the workflow
+for adopting the gate on a tree with triaged pre-existing findings.
+"""
+
+import argparse
+import os
+import sys
+
+#: picked up from the working directory when --baseline is omitted —
+#: `veles_tpu analyze veles_tpu/` run at the repo root gates against
+#: the committed baseline with no extra flags
+DEFAULT_BASELINE = "analyze_baseline.json"
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu analyze",
+        description="invariant-checking static analysis "
+                    "(docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to analyze "
+                             "(default: the veles_tpu package)")
+    parser.add_argument("--rule", default=None, metavar="ID",
+                        help="run one rule id (e.g. metric.naming) or "
+                             "family (e.g. retrace)")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="baseline file of triaged findings "
+                             "(default: ./%s when present)"
+                             % DEFAULT_BASELINE)
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the current "
+                             "findings and exit 0")
+    parser.add_argument("--record-path", action="append", default=[],
+                        metavar="SUFFIX[:FUNC,...]",
+                        help="declare an extra record-path module for "
+                             "this run (see analyze/registry.py for "
+                             "the committed declarations)")
+    parser.add_argument("--shared-class", action="append", default=[],
+                        metavar="SUFFIX:CLASS",
+                        help="declare an extra thread-shared class "
+                             "for this run")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    return parser
+
+
+def main(argv=None):
+    from veles_tpu.analyze.baseline import (apply_baseline,
+                                            write_baseline)
+    from veles_tpu.analyze.engine import run_analysis
+    from veles_tpu.analyze.registry import AnalysisRegistry
+    from veles_tpu.analyze.rules import default_rules
+
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print("%-32s %s" % (rule.id, rule.doc))
+        return 0
+
+    if args.update_baseline and args.rule:
+        parser.error("--update-baseline cannot be combined with "
+                     "--rule: a rule-filtered rewrite would silently "
+                     "drop every other rule's baselined entries")
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]
+    baseline = args.baseline
+    if baseline is None and os.path.exists(DEFAULT_BASELINE):
+        baseline = DEFAULT_BASELINE
+
+    registry = AnalysisRegistry()
+    for spec in args.record_path:
+        registry.add_record_path(spec)
+    for spec in args.shared_class:
+        try:
+            registry.add_shared_class(spec)
+        except ValueError as exc:
+            parser.error(str(exc))
+
+    try:
+        findings, errors = run_analysis(paths, rule_filter=args.rule,
+                                        registry=registry)
+    except ValueError as exc:   # unknown --rule selector
+        parser.error(str(exc))
+
+    cwd = os.getcwd()
+    if errors:
+        for error in errors:
+            print(error.format(relative_to=cwd), file=sys.stderr)
+        print("%d unreadable file(s) — refusing to vouch for code "
+              "the analyzer could not parse" % len(errors),
+              file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        from veles_tpu.analyze.engine import iter_python_files
+        target = baseline or DEFAULT_BASELINE
+        # scope the rewrite to the files this run analyzed: entries
+        # for other subtrees carry over untouched
+        count = write_baseline(findings, target,
+                               analyzed_paths=iter_python_files(paths))
+        print("baseline %s: %d finding(s) recorded" % (target, count))
+        return 0
+
+    try:
+        new, suppressed = apply_baseline(findings, baseline)
+    except (OSError, ValueError) as exc:
+        # a merge-mangled baseline is an "unreadable input", not "new
+        # findings" — misreporting it as exit 1 sends the triager
+        # hunting for violations that do not exist
+        print("baseline %s: UNREADABLE: %s" % (baseline, exc),
+              file=sys.stderr)
+        return 2
+    for finding in new:
+        print(finding.format(relative_to=cwd))
+    if new:
+        print("%d new finding(s)%s — fix them or triage into the "
+              "baseline with --update-baseline"
+              % (len(new),
+                 " (%d baselined)" % len(suppressed)
+                 if suppressed else ""))
+        return 1
+    print("clean: 0 new findings%s"
+          % (" (%d baselined)" % len(suppressed) if suppressed else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""The invariant-checking rule engine: parse once, run every rule.
+
+Twelve PRs of serving/fleet/observability work accumulated hard-won
+invariants that existed only as prose in CHANGES.md or as one-off test
+assertions — record paths must be lock-free (the flight-recorder
+discipline, PRs 10/12), mesh-jitted builders must pin ``out_shardings``
+or retrace-storm (PR 6), donated buffers must never be read after
+dispatch (PR 9), metric names must follow the Prometheus grammar
+(PR 5's naming lint). This package encodes them ONCE, as executable
+AST rules, so every future change is checked for free
+(docs/static_analysis.md is the catalog).
+
+Design contract:
+
+- a :class:`Finding` carries ``rule id + file:line + message`` — enough
+  for a human to act and for the baseline to fingerprint;
+- rules are pure AST visitors over one parsed module at a time
+  (``check_file``), with an optional cross-file ``finalize`` hook for
+  whole-package invariants (HELP-string presence needs every call site
+  of a metric family before it can rule);
+- unreadable files (syntax errors, undecodable bytes) are collected as
+  :class:`ParseError` — the CLI exits 2 on them, never silently skips;
+- no third-party imports: the analyzer must run in the leanest CI
+  container that can run the test suite.
+"""
+
+import ast
+import os
+
+from veles_tpu.analyze.registry import DEFAULT_REGISTRY
+
+
+class Finding:
+    """One rule violation at one source location."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule, path, line, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+
+    def format(self, relative_to=None):
+        path = self.path
+        if relative_to:
+            try:
+                path = os.path.relpath(path, relative_to)
+            except ValueError:
+                pass
+        return "%s:%d: [%s] %s" % (path, self.line, self.rule,
+                                   self.message)
+
+    def __repr__(self):
+        return "Finding(%r, %r, %d, %r)" % (self.rule, self.path,
+                                            self.line, self.message)
+
+
+class ParseError:
+    """A file the analyzer could not read or parse (CLI exit 2)."""
+
+    __slots__ = ("path", "message")
+
+    def __init__(self, path, message):
+        self.path = path
+        self.message = message
+
+    def format(self, relative_to=None):
+        path = self.path
+        if relative_to:
+            try:
+                path = os.path.relpath(path, relative_to)
+            except ValueError:
+                pass
+        return "%s: UNREADABLE: %s" % (path, self.message)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``family``/``doc`` and
+    implement :meth:`check_file`; cross-file rules accumulate there and
+    emit from :meth:`finalize`."""
+
+    id = None
+    family = None
+    doc = ""
+
+    def configure(self, registry):
+        """Called once per run with the :class:`AnalysisRegistry` in
+        effect (the seam the fixture tests use to declare record-path
+        modules and shared classes outside the real tree)."""
+        self.registry = registry
+
+    def check_file(self, path, tree, lines):
+        """Yield :class:`Finding` for one parsed module."""
+        return ()
+
+    def finalize(self):
+        """Yield findings that need the whole file set (default none)."""
+        return ()
+
+
+def iter_python_files(paths):
+    """Expand files/directories into a sorted, deduplicated list of
+    ``.py`` files (``__pycache__`` skipped)."""
+    out = []
+    seen = set()
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        if full not in seen:
+                            seen.add(full)
+                            out.append(full)
+        elif path not in seen:
+            seen.add(path)
+            out.append(path)
+    return out
+
+
+def match_rules(rules, selector):
+    """Filter rule instances by exact id or family prefix (the CLI's
+    ``--rule``); unknown selectors raise so a typo cannot silently
+    analyze nothing."""
+    if not selector:
+        return list(rules)
+    picked = [r for r in rules
+              if r.id == selector or r.family == selector
+              or r.id.startswith(selector + ".")]
+    if not picked:
+        raise ValueError(
+            "unknown rule %r (known: %s)"
+            % (selector, ", ".join(sorted(r.id for r in rules))))
+    return picked
+
+
+def run_analysis(paths, rules=None, rule_filter=None, registry=None):
+    """Run ``rules`` over every python file under ``paths``.
+
+    Returns ``(findings, errors)`` — findings sorted by
+    ``(path, line, rule)``, errors as :class:`ParseError` rows.
+    """
+    from veles_tpu.analyze.rules import default_rules
+
+    registry = registry if registry is not None else DEFAULT_REGISTRY
+    rules = list(rules) if rules is not None else default_rules()
+    rules = match_rules(rules, rule_filter)
+    for rule in rules:
+        rule.configure(registry)
+    findings, errors = [], []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "rb") as fin:
+                source = fin.read().decode("utf-8")
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError, UnicodeDecodeError) as exc:
+            errors.append(ParseError(path, str(exc)))
+            continue
+        lines = source.splitlines()
+        for rule in rules:
+            findings.extend(rule.check_file(path, tree, lines))
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors

@@ -1,0 +1,134 @@
+"""Declarative inputs to the analyzer: WHICH code owes WHICH invariant.
+
+Two of the rule families cannot be inferred from syntax alone — they
+encode deployment facts about this codebase's threading model:
+
+- **record-path modules/functions** (``lock.record-path``): code on the
+  flight-recorder discipline (PRs 10/12) — called from the serving hot
+  path, possibly from several threads, and REQUIRED to stay lock-free,
+  I/O-free and device-sync-free. Declared here as a mapping from a
+  module path *suffix* to the set of function qualnames owing the
+  discipline (``None`` = every function in the module).
+- **shared classes** (``shared.rmw``): classes whose instances are
+  reachable from BOTH the HTTP handler threads and the serving driver
+  thread (or the fleet event loop), so attribute mutations must be
+  GIL-atomic single ops or run under the class's lock. Declared as a
+  mapping from module path suffix to ``{class name: exempt methods}``
+  (``__init__`` is always exempt: no concurrency before publication).
+
+To put a NEW module on the record path or declare a NEW shared class,
+extend the literals below (or pass ``--record-path`` / ``--shared-class``
+to the CLI for a one-off run) — docs/static_analysis.md walks through
+both.
+
+Deliberately NOT declared here:
+
+- ``RequestLedger``/``FlightRecorder``/``MetricHistory`` as shared
+  classes: they ARE mutated from several threads, but the flight-
+  recorder discipline forbids them the lock that would satisfy
+  ``shared.rmw`` — their counters are documented best-effort tallies
+  (drift under contention is accepted; the bounded containers stay
+  consistent because every container op is a single GIL-atomic call).
+  Declaring them would make the two rule families contradict each
+  other by construction.
+"""
+
+import os
+
+#: module-path suffix -> set of "Class.method"/"function" qualnames on
+#: the flight-recorder discipline, or None for the whole module
+RECORD_PATH_FUNCTIONS = {
+    "observe/reqledger.py": None,
+    # note/note_span are the per-span record hooks; dump() runs on the
+    # (rare) trip path and legitimately takes _dump_lock + writes
+    "observe/flight.py": {"FlightRecorder.note",
+                          "FlightRecorder.note_span"},
+    # the sampler tick runs on the default-on background thread and on
+    # deadline-sensitive governor fallbacks; incident writes happen in
+    # _check_rules (anomaly firings only), which is NOT declared
+    "observe/history.py": {"MetricHistory.maybe_sample",
+                           "MetricHistory.sample",
+                           "MetricHistory.record_control",
+                           "MetricHistory._ingest",
+                           "_Series.push"},
+}
+
+#: module-path suffix -> {class name: (exempt method names,)}; every
+#: non-exempt method's read-modify-write attribute mutations must sit
+#: under a ``with self.<lock>`` (attribute matching LOCK_ATTR_RE)
+SHARED_CLASSES = {
+    # handler threads admit/record, the driver resolves
+    "serving.py": {"ServingHealth": ()},
+    # the HTTP pool gate and the driver share the page pool + cache
+    "parallel/kv_pool.py": {"PagePool": (), "PrefixCache": ()},
+    # scrape threads read windows the driver/handlers feed
+    "observe/slo.py": {"SLOEngine": ()},
+    # every thread with a metric to book mutates the registry
+    "observe/metrics.py": {"MetricsRegistry": ()},
+    # jit wrappers on driver + prefetch threads book compile windows
+    "observe/xla_stats.py": {"CompileTracker": ()},
+}
+
+#: attribute names treated as locks by lock-nesting/census checks —
+#: anchored to underscore/name boundaries so ``blocker``/``clock``
+#: are NOT classified as locks (a false lock would silently satisfy
+#: shared.rmw and mis-fire the lock rules)
+LOCK_ATTR_PATTERN = r"(?:^|_)(?:lock|mutex)(?:_|$)"
+
+
+class AnalysisRegistry:
+    """One run's declarations (the default instance mirrors the
+    literals above; tests build their own around fixture files)."""
+
+    def __init__(self, record_path=None, shared_classes=None):
+        self.record_path = dict(RECORD_PATH_FUNCTIONS
+                                if record_path is None else record_path)
+        self.shared_classes = dict(SHARED_CLASSES if shared_classes
+                                   is None else shared_classes)
+
+    def add_record_path(self, spec):
+        """``PATH_SUFFIX[:func,Class.method,...]`` (CLI seam)."""
+        path, _, funcs = spec.partition(":")
+        names = {f.strip() for f in funcs.split(",") if f.strip()}
+        self.record_path[path] = names or None
+
+    def add_shared_class(self, spec):
+        """``PATH_SUFFIX:ClassName`` (CLI seam)."""
+        path, sep, cls = spec.partition(":")
+        if not sep or not cls:
+            raise ValueError(
+                "shared-class spec %r is not PATH_SUFFIX:ClassName"
+                % spec)
+        self.shared_classes.setdefault(path, {})[cls] = ()
+
+    @staticmethod
+    def _norm(path):
+        return path.replace(os.sep, "/") if os.sep != "/" else path
+
+    @classmethod
+    def _matches(cls, path, suffix):
+        """Suffix match at a path-SEGMENT boundary: ``serving.py``
+        matches ``veles_tpu/serving.py`` but never
+        ``samples/llm_serving.py`` (a bare endswith would apply one
+        module's declarations to any similarly-named file)."""
+        norm = cls._norm(path)
+        return norm == suffix or norm.endswith("/" + suffix)
+
+    def record_path_functions(self, path):
+        """The declared qualnames for ``path`` (``None`` = whole
+        module, ``()`` = not a record-path module)."""
+        for suffix, funcs in self.record_path.items():
+            if self._matches(path, suffix):
+                return funcs
+        return ()
+
+    def shared_classes_for(self, path):
+        """``{class name: exempt methods}`` declared for ``path``."""
+        out = {}
+        for suffix, classes in self.shared_classes.items():
+            if self._matches(path, suffix):
+                out.update(classes)
+        return out
+
+
+DEFAULT_REGISTRY = AnalysisRegistry()

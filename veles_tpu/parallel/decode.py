@@ -820,9 +820,13 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
 
     cache_spec = P(None, None, None, axis, None)
     param_specs = None  # built on first call (needs n_blocks)
+    # the jitted program is memoized in the closure: jax.jit keys on
+    # the callable's IDENTITY, and a fresh shard_map wrapper per run()
+    # call would re-trace every generate (retrace.local-jit-dispatch)
+    tp_fn = None
 
     def run(params, embed_table, prompt_tokens):
-        nonlocal param_specs
+        nonlocal param_specs, tp_fn
         if isinstance(params["head"], dict):
             raise ValueError(
                 "tensor-parallel decode takes unquantized params (the "
@@ -852,10 +856,11 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
         # the TABLE is replicated (every device embeds the full token
         # vector); the VOCAB sharding lives in params["head"], whose
         # local logits all_gather back to full width
-        fn = jax.jit(shard_map(
-            device_run, mesh=mesh,
-            in_specs=(param_specs, P(), P(), cache_specs),
-            out_specs=P()))
+        if tp_fn is None:
+            tp_fn = jax.jit(shard_map(
+                device_run, mesh=mesh,
+                in_specs=(param_specs, P(), P(), cache_specs),
+                out_specs=P()))
         # place the shards explicitly (shard_map would otherwise
         # require pre-sharded inputs for non-replicated specs)
         packed = jax.tree.map(
@@ -867,7 +872,7 @@ def make_tp_generate(mesh, heads, n_tokens, axis="model"):
             lambda a: jax.device_put(
                 a, NamedSharding(
                     mesh, cache_spec if a.ndim == 5 else P())), cache)
-        return fn(packed, table_sharded, prompt_x, cache)
+        return tp_fn(packed, table_sharded, prompt_x, cache)
 
     return run
 
