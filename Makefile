@@ -1,7 +1,8 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr aot slo governor history analyze
+	regress mesh paged fleet-mr aot slo governor history analyze \
+	fleetscope
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -137,6 +138,22 @@ analyze:
 		--baseline analyze_baseline.json
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_analyze.py \
 		-m analyze -q
+
+# Fleet goodput observatory suite (docs/observability.md "Fleet
+# timeline + goodput"): span-summary shipping on update frames with
+# hostile-row ingestion caps, NTP-style clock alignment proven within
+# its own reported uncertainty (incl. the chaos frame-delay profile),
+# the goodput decomposition + ledger wasted-work accounting, the
+# persistent-straggler detector + fleet incident artifact, the
+# multi-process Chrome exporter, and the chaos slow-slave acceptance —
+# `observe fleet-trace` on a real loopback fleet deterministically
+# names the injected straggler and emits a Perfetto-loadable merged
+# trace with connected issue->do_job->apply chains. (The e2e also
+# carries the `slow` marker so tier-1 keeps its timeout margin; this
+# target runs it.)
+fleetscope:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleetscope.py \
+		-m fleetscope -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

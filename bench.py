@@ -1814,6 +1814,135 @@ def fleet_bench():
     return keys
 
 
+class _ObservatoryWorkflow:
+    """Minimal fleet-protocol workflow for :func:`fleetscope_section`:
+    the master side serves ``jobs`` integers, the slave side burns a
+    fixed busy-compute window per job — a real wire, real stamps, real
+    goodput accounting, no model in the way."""
+
+    checksum = "fleetscope-bench"
+
+    def __init__(self, jobs=(), job_busy_s=0.0):
+        self._jobs = list(jobs)
+        self.job_busy_s = job_busy_s
+        self.applied = []
+
+    def generate_initial_data_for_slave(self, slave):
+        return None
+
+    def generate_data_for_slave(self, slave):
+        return self._jobs.pop(0) if self._jobs else None
+
+    def apply_data_from_slave(self, update, slave):
+        self.applied.append(update)
+
+    def apply_initial_data_from_master(self, initial):
+        pass
+
+    def do_job(self, job, callback):
+        # sleep, not a busy spin: both slaves share one process (and
+        # one GIL) in the loopback bench — a spin would smear every
+        # other thread's measured residence
+        time.sleep(self.job_busy_s)
+        callback({"job": job})
+
+    def drop_slave(self, slave):
+        pass
+
+    def has_more_jobs(self):
+        return bool(self._jobs)
+
+
+def _observatory_fleet(n_jobs, busy_s, slow_factor=1.0, timeout=60.0,
+                       watch_straggler=False):
+    """One loopback master + two slaves; returns ``(master,
+    detect_ms)`` after the job stream drains — ``detect_ms`` is the
+    wall from fleet start to the straggler detector first naming a
+    slave (polled DURING the run; None when it never fired or
+    ``watch_straggler`` is off)."""
+    from veles_tpu.fleet.client import Client
+    from veles_tpu.fleet.server import Server
+
+    master = Server("127.0.0.1:0",
+                    _ObservatoryWorkflow(jobs=range(n_jobs)),
+                    secret="fleetscope-bench")
+    done = {"flag": False}
+    master.on_finished = lambda: done.update(flag=True)
+    master.start()
+    start = time.perf_counter()
+    clients = []
+    for index in range(2):
+        busy = busy_s * (slow_factor if index == 1 else 1.0)
+        client = Client("127.0.0.1:%d" % master.port,
+                        _ObservatoryWorkflow(job_busy_s=busy),
+                        secret="fleetscope-bench", chaos=False)
+        clients.append(client.start())
+    detect_at = None
+    deadline = start + timeout
+    while not done["flag"] and time.perf_counter() < deadline:
+        if watch_straggler and detect_at is None \
+                and master.scope.straggler_summary() is not None:
+            detect_at = time.perf_counter()
+        time.sleep(0.005)
+    master.drain(timeout=5.0)
+    for client in clients:
+        client.stop()
+    detect_ms = (None if detect_at is None
+                 else (detect_at - start) * 1e3)
+    return master, detect_ms
+
+
+def fleetscope_section():
+    """The fleet goodput observatory section (observe/fleetscope.py;
+    docs/observability.md "Fleet timeline + goodput"); keys:
+
+    - ``fleet_span_ship_overhead_ns``: record-path cost of one
+      completed-span summary landing in the slave's bounded ring
+      (lower is better — the flight-recorder overhead contract);
+    - ``fleet_goodput_fraction``: measured compute share of fleet wall
+      on a balanced two-slave loopback fleet (higher is better);
+    - ``fleet_straggler_detect_ms``: wall time from the straggler
+      fleet's first job until the detector names the slow slave
+      (lower is better)."""
+    from veles_tpu.observe.fleetscope import SpanRing
+
+    out = {"fleetscope_config": "loopback-2slaves"}
+    ring = SpanRing(capacity=512)
+    ring.enable()
+    best = None
+    for _ in range(3):
+        n = 20000
+        start = time.perf_counter()
+        for index in range(n):
+            ring.note_span("bench.span", "trace", "span%d" % index,
+                           None, 0.0, 1.0, 0)
+        per_note = (time.perf_counter() - start) / n * 1e9
+        best = per_note if best is None else min(best, per_note)
+    out["fleet_span_ship_overhead_ns"] = round(best, 1)
+    # balanced fleet: the goodput fraction of a healthy wire
+    master, _ = _observatory_fleet(n_jobs=24, busy_s=0.004)
+    try:
+        goodput = master.scope.goodput_summary(
+            wasted_s=master.ledger.snapshot().get("wasted_s", 0.0))
+        out["fleet_goodput_fraction"] = goodput["fraction"]
+        out["fleet_goodput_jobs"] = goodput["jobs"]
+    finally:
+        master.stop()
+    # straggler fleet: slave #2 sleeps 6x per job; detection latency
+    # is polled DURING the run (fleet start -> detector names it)
+    master, detect_ms = _observatory_fleet(
+        n_jobs=80, busy_s=0.003, slow_factor=6.0,
+        watch_straggler=True)
+    try:
+        straggler = master.scope.straggler_summary()
+        if detect_ms is not None and straggler is not None:
+            out["fleet_straggler_detect_ms"] = round(detect_ms, 1)
+            out["fleet_straggler_slave"] = straggler["slave"]
+    finally:
+        master.stop()
+    return out
+
+
 def _guarded(fn, *args, fallback=(None, []), **kwargs):
     """One failed section must not kill the headline line — but the
     failure has to be visible somewhere (stderr; stdout stays one JSON
@@ -1903,6 +2032,7 @@ def main(artifact_path=None):
     _add(_guarded(decode_continuous, fallback={}))
     _add(_guarded(reshard_bench, fallback={}))
     _add(_guarded(fleet_bench, fallback={}))
+    _add(_guarded(fleetscope_section, fallback={}))
     _add(_guarded(coldstart_section, fallback={}))
     _add(_guarded(pod_overhead, fallback={}))
     _add(_guarded(pallas_epilogue_compare, fallback={}))

@@ -1,0 +1,931 @@
+"""Fleet goodput observatory: cross-process trace assembly, clock
+alignment, goodput decomposition, straggler autopsy.
+
+The tentpole suite (docs/observability.md "Fleet timeline + goodput"):
+unit tests for the span ring / ingestion validation / NTP-style clock
+estimator / goodput math / straggler detector, the multi-process
+Chrome-exporter satellite, the ``observe fleet-trace`` CLI, and the
+chaos acceptance — a real loopback fleet with a seeded slow-slave
+chaos profile must deterministically name the injected straggler, land
+a fleet incident artifact, and export a Perfetto-loadable merged trace
+with clock-aligned issue → do_job → apply chains.
+
+``make fleetscope`` runs this module standalone; the chaos end-to-end
+rides the ``slow`` marker so tier-1 keeps its timeout margin.
+"""
+
+import json
+import math
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from veles_tpu.fleet.ledger import JobLedger
+from veles_tpu.observe.fleetscope import (
+    CLOCK_UNCERTAINTY_FLOOR_S, ClockEstimate, FleetScope, SpanRing,
+    SPAN_SHIP_MAX_ROWS, STRAGGLER_RATIO, STRAGGLER_WINDOWS, StepWindow,
+    assemble_fleet_trace, ensure_fleet_rules, fleet_trace_main,
+    get_span_ring, valid_span_rows)
+
+pytestmark = pytest.mark.fleetscope
+
+
+class _FakeSlave:
+    def __init__(self, sid, mid="m", pid=1):
+        self.id = sid
+        self.mid = mid
+        self.pid = pid
+
+
+# -- span ring (the slave-side record path) ---------------------------------
+
+class TestSpanRing:
+    def test_bounded_drop_oldest(self):
+        ring = SpanRing(capacity=8).enable()
+        for index in range(50):
+            ring.note_span("s%d" % index, "t", "sp%d" % index, None,
+                           0.0, 1.0, 0)
+        assert len(ring) == 8
+        rows = ring.drain()
+        assert [row[0] for row in rows] == \
+            ["s%d" % i for i in range(42, 50)]
+        assert len(ring) == 0
+        assert ring.noted_total == 50 and ring.shipped_total == 8
+
+    def test_disabled_is_noop(self):
+        ring = SpanRing(capacity=8)
+        ring.note_span("a", "t", "sp", None, 0.0, 1.0, 0)
+        assert len(ring) == 0 and ring.noted_total == 0
+
+    def test_drain_cap_per_frame(self):
+        ring = SpanRing(capacity=512).enable()
+        for index in range(300):
+            ring.note_span("s", "t", "sp%d" % index, None, 0.0, 1.0, 0)
+        first = ring.drain()
+        assert len(first) == SPAN_SHIP_MAX_ROWS
+        assert len(ring) == 300 - SPAN_SHIP_MAX_ROWS
+
+    def test_record_path_has_no_lock_and_truncates_names(self):
+        """The flight-recorder overhead contract: no lock attribute
+        anywhere on the ring, bounded memory, names truncated at note
+        time — the analyze lock.record-path rule gates the source."""
+        ring = SpanRing(capacity=4).enable()
+        assert not any("lock" in name or "mutex" in name
+                       for name in vars(ring))
+        ring.note_span("x" * 500, "t", "sp", None, 0.0, 1.0, 0)
+        assert len(ring.drain()[0][0]) <= 120
+
+
+class TestSpanShipping:
+    def test_tracer_feeds_completed_spans(self):
+        from veles_tpu.observe.tracing import Tracer, get_tracer
+
+        ring = get_span_ring()
+        was_enabled = ring.enabled
+        ring.drain(10 ** 6)
+        ring.enable()
+        tracer = get_tracer()
+        tracer_was = tracer.enabled
+        tracer.enable()
+        try:
+            with tracer.span("fleet.do_job", job_id=7) as span:
+                time.sleep(0.002)
+            tracer.event("fleet.issue", job_id=7)
+            rows = ring.drain()
+        finally:
+            tracer.enabled = tracer_was
+            ring.enabled = was_enabled
+        by_name = {row[0]: row for row in rows}
+        assert "fleet.do_job" in by_name and "fleet.issue" in by_name
+        do_job = by_name["fleet.do_job"]
+        assert do_job[1] == span.trace_id
+        assert do_job[2] == span.span_id
+        assert do_job[5] >= 2.0  # dur_ms covers the sleep
+        assert by_name["fleet.issue"][5] == 0.0  # events are instants
+
+    def test_disabled_ring_untouched_by_tracer(self):
+        from veles_tpu.observe.tracing import Tracer
+
+        ring = get_span_ring()
+        was_enabled = ring.enabled
+        ring.disable()
+        ring.drain(10 ** 6)
+        tracer = Tracer(enabled=True)
+        try:
+            with tracer.span("quiet"):
+                pass
+            assert len(ring) == 0
+        finally:
+            ring.enabled = was_enabled
+
+
+# -- clock alignment --------------------------------------------------------
+
+def _exchange(est, theta_true, d1, d2, residence, t0):
+    """Simulate one job->update exchange: master sends at t0 (master
+    clock), wire delays d1/d2, slave residence; feeds the estimator
+    the same theta/delta the server derives from the stamps."""
+    t1 = t0 + d1 + theta_true           # slave receive (slave clock)
+    t2 = t1 + residence                 # slave send (slave clock)
+    t3 = t0 + d1 + residence + d2       # master receive (master clock)
+    theta = ((t1 - t0) + (t2 - t3)) / 2.0
+    delta = (t3 - t0) - (t2 - t1)
+    est.observe(theta, delta)
+    return t3
+
+
+class TestClockEstimate:
+    def test_symmetric_delay_recovers_offset_exactly(self):
+        est = ClockEstimate()
+        _exchange(est, theta_true=5.0, d1=0.01, d2=0.01,
+                  residence=0.05, t0=100.0)
+        assert abs(est.offset_s - 5.0) < 1e-9
+        assert est.uncertainty_s == pytest.approx(
+            0.01 + CLOCK_UNCERTAINTY_FLOOR_S)
+
+    def test_asymmetric_delay_within_reported_uncertainty(self):
+        """The NTP error bound: |estimate - truth| <= delta/2 holds for
+        ANY delay asymmetry — the uncertainty the estimator reports is
+        a true bound, not a vibe."""
+        est = ClockEstimate()
+        _exchange(est, theta_true=-3.0, d1=0.002, d2=0.038,
+                  residence=0.1, t0=50.0)
+        assert abs(est.offset_s - (-3.0)) <= est.uncertainty_s
+
+    def test_chaos_frame_delay_profile_stays_within_bound(self):
+        """The chaos frame-delay satellite: seeded random delays (the
+        fleet/chaos.py delay profile shape) on every exchange — the
+        min-round-trip filter keeps the estimate within its own
+        reported bound, and the bound itself stays below the worst
+        injected delay."""
+        rng = random.Random(1)
+        theta_true = 2.5
+        est = ClockEstimate()
+        t = 10.0
+        for _ in range(40):
+            d1 = rng.uniform(0.0005, 0.02)
+            d2 = rng.uniform(0.0005, 0.02)
+            if rng.random() < 0.5:  # the injected frame delay
+                d1 += 0.02
+            if rng.random() < 0.5:
+                d2 += 0.02
+            t = _exchange(est, theta_true, d1, d2,
+                          residence=rng.uniform(0.01, 0.05), t0=t) + 0.1
+        assert abs(est.offset_s - theta_true) <= est.uncertainty_s
+        assert est.uncertainty_s <= 0.041  # never worse than max delay
+        assert est.samples == 40
+
+    def test_filter_prefers_min_round_trip(self):
+        est = ClockEstimate()
+        _exchange(est, theta_true=1.0, d1=0.04, d2=0.001,
+                  residence=0.02, t0=0.0)
+        loose = est.uncertainty_s
+        _exchange(est, theta_true=1.0, d1=0.001, d2=0.001,
+                  residence=0.02, t0=1.0)
+        assert est.uncertainty_s < loose
+        assert abs(est.offset_s - 1.0) < 1e-6
+
+    def test_to_master_mapping(self):
+        est = ClockEstimate()
+        _exchange(est, theta_true=7.0, d1=0.001, d2=0.001,
+                  residence=0.01, t0=0.0)
+        assert est.to_master(107.0) == pytest.approx(100.0, abs=1e-6)
+
+
+# -- ingestion validation ---------------------------------------------------
+
+class TestSpanValidation:
+    GOOD = ["fleet.do_job", "t" * 16, "s" * 16, "p" * 16, 12.5, 3.25, 7]
+
+    def test_good_row_passes(self):
+        assert len(valid_span_rows([list(self.GOOD)])) == 1
+
+    def test_hostile_rows_dropped(self):
+        bad = [
+            None, "string", 42, [],                       # not rows
+            ["n", "t", "s", "p", 1.0],                    # short
+            [1, "t", "s", "p", 1.0, 1.0, 0],              # name not str
+            ["n", "t", "", "p", 1.0, 1.0, 0],             # empty span id
+            ["n", "t", "s" * 200, "p", 1.0, 1.0, 0],      # oversized id
+            ["n", 5, "s", "p", 1.0, 1.0, 0],              # trace not str
+            ["n", "t", "s", 5, 1.0, 1.0, 0],              # parent not str
+            ["n", "t", "s", "p", float("nan"), 1.0, 0],   # t0 nan
+            ["n", "t", "s", "p", 1.0, -1.0, 0],           # negative dur
+            ["n", "t", "s", "p", 1.0, float("inf"), 0],   # inf dur
+            ["n", "t", "s", "p", True, 1.0, 0],           # bool t0
+        ]
+        assert valid_span_rows(bad) == []
+
+    def test_row_volume_capped_and_name_truncated(self):
+        rows = [["x" * 500, None, "sp%d" % i, None, 0.0, 1.0, 0]
+                for i in range(1000)]
+        out = valid_span_rows(rows)
+        assert len(out) == SPAN_SHIP_MAX_ROWS
+        assert all(len(row[0]) <= 120 for row in out)
+
+    def test_bad_tid_degrades_to_zero(self):
+        row = list(self.GOOD)
+        row[6] = "boom"
+        assert valid_span_rows([row])[0][6] == 0
+
+
+class TestFleetScopeIngestion:
+    def test_round_trip_builds_clock_and_pair(self):
+        scope = FleetScope()
+        slave = _FakeSlave("slave-1")
+        scope.note_issue(1, slave, now=100.0)
+        msg = {"job_id": 1, "mono": [205.01, 205.06], "job_ms": 40.0,
+               "spans": [list(TestSpanValidation.GOOD)]}
+        pair = scope.note_update(slave, msg, now=100.07)
+        assert pair is not None
+        assert pair["rtt"] == pytest.approx(0.07)
+        assert pair["residence"] == pytest.approx(0.05)
+        assert pair["compute"] == pytest.approx(0.04)
+        clocks = scope.clock_summary()
+        assert clocks["m:1"]["slave"] == "slave-1"
+        # true offset 105s, symmetric 10ms wire legs -> exact
+        assert clocks["m:1"]["offset_ms"] == pytest.approx(105000.0,
+                                                           abs=1.0)
+        assert len(scope.spans) == 1
+
+    def test_duplicate_replay_deduped(self):
+        """A chaos duplicate-update replay ships the same span rows
+        twice and re-echoes the same job_id: spans must not double,
+        and the second frame has no pending stamp to pair."""
+        scope = FleetScope()
+        slave = _FakeSlave("slave-1")
+        scope.note_issue(1, slave, now=0.0)
+        msg = {"job_id": 1, "mono": [10.0, 10.01], "job_ms": 5.0,
+               "spans": [list(TestSpanValidation.GOOD)]}
+        assert scope.note_update(slave, msg, now=0.05) is not None
+        assert scope.note_update(slave, dict(msg), now=0.09) is None
+        assert len(scope.spans) == 1
+        assert scope.spans_ingested["slave-1"] == 1
+
+    def test_garbage_stamps_ignored(self):
+        scope = FleetScope()
+        slave = _FakeSlave("slave-1")
+        for bad in ({"job_id": "x"}, {"job_id": 2},
+                    {"job_id": 1, "mono": "zzz"},
+                    {"job_id": 1, "mono": [1.0]},
+                    {"job_id": 1, "mono": [float("nan"), 2.0]},
+                    {"job_id": 1, "mono": [5.0, 1.0]}):
+            scope.note_issue(1, slave, now=0.0)
+            assert scope.note_update(slave, bad, now=1.0) is None
+        assert scope.clock_summary() == {}
+
+    def test_zombie_update_cannot_consume_reissued_stamp(self):
+        """A requeued lease's job_id gets re-issued to another slave:
+        the zombie's late (fenced) update must not consume the
+        re-issued slave's pending stamp pair — its mixed-origin
+        stamps would poison the clock and orphan the real booking."""
+        scope = FleetScope()
+        zombie = _FakeSlave("slave-1", pid=1)
+        healthy = _FakeSlave("slave-2", pid=2)
+        scope.note_issue(1, zombie, now=0.0)
+        # the lease expires and the job re-issues to slave-2
+        scope.note_issue(1, healthy, now=1.0)
+        late = {"job_id": 1, "mono": [9.0, 9.01], "job_ms": 5.0}
+        assert scope.note_update(zombie, late, now=1.1) is None
+        assert scope.clock_summary() == {}
+        # the genuine update still pairs against ITS issue stamp
+        real = {"job_id": 1, "mono": [50.0, 50.02], "job_ms": 15.0}
+        pair = scope.note_update(healthy, real, now=1.2)
+        assert pair is not None
+        assert pair["rtt"] == pytest.approx(0.2)
+        assert "m:2" in scope.clock_summary()
+
+    def test_rollback_report_last_wins(self):
+        scope = FleetScope()
+        slave = _FakeSlave("slave-1")
+        scope.note_update(slave, {"rollback_ms": 100.0}, now=1.0)
+        scope.note_update(slave, {"rollback_ms": 250.0}, now=2.0)
+        assert scope.goodput_summary()["wasted_s"] == \
+            pytest.approx(0.25)
+
+
+# -- goodput decomposition --------------------------------------------------
+
+class TestGoodput:
+    def test_decomposition_adds_up(self):
+        scope = FleetScope()
+        slave = _FakeSlave("slave-1")
+        scope.note_issue(1, slave, now=0.0)
+        msg = {"job_id": 1, "mono": [50.01, 50.07], "job_ms": 40.0}
+        pair = scope.note_update(slave, msg, now=0.08)
+        scope.book_update("slave-1", pair, now=0.08)
+        summary = scope.goodput_summary()
+        assert summary["jobs"] == 1
+        assert summary["compute_s"] == pytest.approx(0.04)
+        assert summary["host_s"] == pytest.approx(0.02)   # 60ms - 40ms
+        assert summary["wire_s"] == pytest.approx(0.02)   # 80ms - 60ms
+        assert summary["idle_s"] == pytest.approx(0.0)
+        assert summary["fraction"] == pytest.approx(0.5)
+
+    def test_idle_gap_between_jobs(self):
+        scope = FleetScope()
+        slave = _FakeSlave("slave-1")
+        scope.note_issue(1, slave, now=0.0)
+        pair = scope.note_update(
+            slave, {"job_id": 1, "mono": [10.0, 10.05], "job_ms": 50.0},
+            now=0.05)
+        scope.book_update("slave-1", pair, now=0.05)
+        # 0.95s gap before the next job's round trip starts
+        scope.note_issue(2, slave, now=1.0)
+        pair = scope.note_update(
+            slave, {"job_id": 2, "mono": [20.0, 20.05], "job_ms": 50.0},
+            now=1.05)
+        scope.book_update("slave-1", pair, now=1.05)
+        summary = scope.goodput_summary()
+        assert summary["idle_s"] == pytest.approx(0.95)
+        assert summary["compute_s"] == pytest.approx(0.1)
+
+    def test_ledger_requeue_books_wasted_seconds(self):
+        """Requeued-after-death work: the lease's in-flight seconds
+        land in the ledger's wasted tally, which the server feeds into
+        the goodput summary."""
+        ledger = JobLedger()
+        job = ledger.issue("slave-1", timeout=60.0, now=1000.0)
+        ledger.requeue_for_slave("slave-1", now=1002.5)
+        snap = ledger.snapshot()
+        assert snap["wasted_s"] == pytest.approx(2.5)
+        expired = ledger.issue("slave-1", timeout=10.0, now=2000.0)
+        assert ledger.expire_if_outstanding(expired, now=2011.0)
+        assert ledger.snapshot()["wasted_s"] == pytest.approx(13.5)
+        # DONE leases never count as waste
+        done = ledger.issue("slave-1", timeout=60.0, now=3000.0)
+        assert ledger.settle(done, "slave-1") is None
+        assert ledger.snapshot()["wasted_s"] == pytest.approx(13.5)
+        scope = FleetScope()
+        summary = scope.goodput_summary(wasted_s=snap["wasted_s"])
+        assert summary["wasted_s"] == pytest.approx(2.5)
+
+
+# -- straggler detection ----------------------------------------------------
+
+def _feed(scope, sid, times):
+    window = scope.windows.setdefault(sid, StepWindow())
+    for value in times:
+        window.push(value)
+
+
+class TestStraggler:
+    def test_names_the_slow_slave_after_k_windows(self):
+        scope = FleetScope()
+        _feed(scope, "slave-1", [0.01] * 5)
+        _feed(scope, "slave-2", [0.05] * 5)
+        events = []
+        for step in range(STRAGGLER_WINDOWS):
+            event = scope.evaluate_straggler("slave-2", now=float(step))
+            events.append(event)
+        assert events[:-1] == [None] * (STRAGGLER_WINDOWS - 1)
+        assert events[-1]["slave"] == "slave-2"
+        assert events[-1]["score"] == pytest.approx(5.0)
+        assert events[-1]["windows"] == STRAGGLER_WINDOWS
+        assert scope.straggler_summary()["slave"] == "slave-2"
+        # the fast slave never breaches
+        assert scope.scores["slave-1"] < 1.0
+
+    def test_single_slave_fleet_has_no_straggler(self):
+        scope = FleetScope()
+        _feed(scope, "slave-1", [0.5] * 10)
+        assert scope.evaluate_straggler("slave-1", now=0.0) is None
+
+    def test_recovery_clears_the_verdict(self):
+        scope = FleetScope()
+        _feed(scope, "slave-1", [0.01] * 10)
+        _feed(scope, "slave-2", [0.05] * 10)
+        for step in range(STRAGGLER_WINDOWS):
+            scope.evaluate_straggler("slave-2", now=float(step))
+        assert scope.straggler_summary() is not None
+        # the slave recovers: fresh fast samples pull its median down
+        _feed(scope, "slave-2", [0.01] * 100)
+        assert scope.evaluate_straggler("slave-2", now=99.0) is None
+        assert scope.straggler_summary() is None
+
+    def test_ratio_threshold_respected(self):
+        scope = FleetScope()
+        _feed(scope, "slave-1", [0.010] * 5)
+        below = 0.010 * (STRAGGLER_RATIO - 0.1)
+        _feed(scope, "slave-2", [below] * 5)
+        for step in range(STRAGGLER_WINDOWS + 2):
+            assert scope.evaluate_straggler("slave-2",
+                                            now=float(step)) is None
+
+    def test_dropped_slave_leaves_the_scoring_pool(self):
+        """A departed slave's frozen window must not skew the
+        rest-of-fleet median, and a straggler verdict naming a dead
+        slave is flagged departed (kept visible), never pinned as a
+        live breach forever."""
+        scope = FleetScope()
+        _feed(scope, "slave-1", [0.01] * 5)
+        _feed(scope, "slave-2", [0.05] * 5)
+        _feed(scope, "slave-3", [0.011] * 5)
+        for step in range(STRAGGLER_WINDOWS):
+            scope.evaluate_straggler("slave-2", now=float(step))
+        assert scope.straggler_summary()["slave"] == "slave-2"
+        scope.drop_slave("slave-2")
+        verdict = scope.straggler_summary()
+        assert verdict["slave"] == "slave-2" and verdict["departed"]
+        # the survivors now score against each other only: the dead
+        # slave's 50ms median no longer inflates slave-3's score
+        scope.evaluate_straggler("slave-3", now=10.0)
+        assert scope.scores["slave-3"] == pytest.approx(1.1)
+        assert "slave-2" not in scope._streaks
+        # a re-tracked sid rejoins the pool
+        scope.track_window("slave-2", scope.windows["slave-2"])
+        assert "slave-2" not in scope._departed
+
+    def test_fleet_rules_not_evaluated_by_the_sampler(self):
+        """The fleet rules are detector-owned (external=True): the
+        history sampler's rule pass must skip them — sampler-cadence
+        evaluation would race autopsy_tick's state writes and fire
+        without the detector's per-job window semantics."""
+        from veles_tpu.observe.history import MetricHistory
+        from veles_tpu.observe.metrics import MetricsRegistry
+
+        history = MetricHistory(registry=MetricsRegistry())
+        straggler_rule, _ = ensure_fleet_rules(history)
+        assert straggler_rule.external
+        rows = [("veles_fleet_straggler_score", "gauge",
+                 (("slave", "slave-2"),), 99.0)]
+        for step in range(STRAGGLER_WINDOWS + 2):
+            history.sample(now=float(step), rows=list(rows))
+        assert straggler_rule.streak == 0
+        assert straggler_rule.fired_total == 0
+        assert history.anomalies_total == 0
+
+    def test_hang_timeout_reads_the_same_window(self):
+        """Satellite: SlaveDescription's mean+3σ hang threshold and
+        the straggler detector read ONE StepWindow implementation."""
+        from veles_tpu.fleet.server import SlaveDescription
+
+        slave = SlaveDescription("slave-1", {})
+        assert slave.job_times == []
+        for value in (1.0, 2.0, 3.0, 4.0):
+            slave.record_job_time(value)
+        assert slave.job_times == [1.0, 2.0, 3.0, 4.0]
+        mean = 2.5
+        sigma = (sum((t - mean) ** 2 for t in (1, 2, 3, 4)) / 4) ** 0.5
+        assert slave.timeout(0.0) == pytest.approx(mean + 3 * sigma)
+        assert slave.timeout(1000.0) == 1000.0  # floor kept
+        assert slave.window.hang_timeout(0.0) == slave.timeout(0.0)
+        # the cap still holds (the old job_times bound)
+        for _ in range(300):
+            slave.record_job_time(1.0)
+        assert len(slave.job_times) == SlaveDescription.JOB_TIMES_KEEP
+
+
+class TestFleetRules:
+    def _history(self, tmp_path):
+        from veles_tpu.observe.history import (IncidentRecorder,
+                                               MetricHistory)
+        from veles_tpu.observe.metrics import MetricsRegistry
+
+        return MetricHistory(
+            registry=MetricsRegistry(enabled=False),
+            incidents=IncidentRecorder(directory=str(tmp_path),
+                                       cooldown_s=0.0))
+
+    def test_rules_booked_idempotently(self, tmp_path):
+        history = self._history(tmp_path)
+        first = ensure_fleet_rules(history)
+        second = ensure_fleet_rules(history)
+        assert first == second
+        names = [rule.name for rule in history.rules]
+        assert names.count("fleet_straggler") == 1
+        assert names.count("fleet_goodput") == 1
+
+    def test_autopsy_fires_incident_naming_straggler(self, tmp_path):
+        """The acceptance core, synthetically: a persistent straggler
+        lands a fleet incident artifact whose trigger names the slave,
+        with the goodput breach as the lead reference."""
+        history = self._history(tmp_path)
+        scope = FleetScope()
+        slave = _FakeSlave("slave-2")
+        _feed(scope, "slave-1", [0.01] * 6)
+        # feed goodput so the fraction breaches (mostly host time)
+        scope.note_issue(1, slave, now=0.0)
+        pair = scope.note_update(
+            slave, {"job_id": 1, "mono": [5.0, 5.1], "job_ms": 10.0},
+            now=0.1)
+        scope.book_update("slave-2", pair, now=0.1)
+        path = None
+        # one sample per tick: the detector needs MIN_SAMPLES history
+        # before scoring, then STRAGGLER_WINDOWS breaching windows
+        for step in range(STRAGGLER_WINDOWS * 2 + 2):
+            _feed(scope, "slave-2", [0.05])
+            path = path or scope.autopsy_tick(
+                "slave-2", history, now=float(step + 1))
+        assert path is not None and os.path.exists(path)
+        with open(path) as fin:
+            doc = json.load(fin)
+        assert doc["reason"] == "fleet_straggler"
+        assert doc["trigger"]["labels"] == [["slave", "slave-2"]]
+        assert doc["trigger"]["straggler"]["slave"] == "slave-2"
+        breaching = {row["name"] for row in doc["breaching"]}
+        assert "fleet_straggler" in breaching
+        assert "fleet_goodput" in breaching  # fraction 0.1 <= 0.5
+        lead = doc["leading_indicator"]
+        assert lead["reference"] == "fleet_goodput"
+        # trend series recorded for the timeline
+        assert history.get("veles_fleet_straggler_score",
+                           {"slave": "slave-2"}) is not None
+        assert history.get("veles_fleet_goodput_fraction") is not None
+        # cooldown: an immediate second firing is suppressed
+        rule = next(r for r in history.rules
+                    if r.name == "fleet_straggler")
+        assert rule.fired_total == 1
+
+    def test_autopsy_without_history_still_detects(self):
+        scope = FleetScope()
+        _feed(scope, "slave-1", [0.01] * 6)
+        _feed(scope, "slave-2", [0.05] * 6)
+        for step in range(STRAGGLER_WINDOWS):
+            scope.autopsy_tick("slave-2", None, now=float(step))
+        assert scope.straggler_summary()["slave"] == "slave-2"
+
+
+# -- the Chrome exporter satellite ------------------------------------------
+
+def _span_events(pid, trace_id, name, span_id, parent, t0, dur,
+                 tid=1):
+    base = {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent, "pid": pid, "tid": tid}
+    return [dict(base, etype="begin", mono=t0),
+            dict(base, etype="end", mono=t0 + dur)]
+
+
+class TestChromeMultiprocess:
+    def test_process_rows_do_not_collapse(self):
+        from veles_tpu.observe.trace_export import chrome_trace
+
+        events = (_span_events(111, "tr", "a", "s1", None, 1.0, 0.5)
+                  + _span_events(222, "tr", "b", "s2", "s1", 1.2, 0.1))
+        trace = chrome_trace(events)
+        metadata = [e for e in trace["traceEvents"]
+                    if e.get("ph") == "M"]
+        names = {e["name"] for e in metadata}
+        assert "process_name" in names and "thread_name" in names
+        process_rows = [e for e in metadata
+                        if e["name"] == "process_name"]
+        assert len(process_rows) == 2
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert len({e["pid"] for e in spans}) == 2
+        # stable small pids, first-appearance order
+        assert sorted(e["pid"] for e in spans) == [1, 2]
+
+    def test_process_names_metadata(self):
+        from veles_tpu.observe.trace_export import chrome_trace
+
+        events = _span_events("m:1", "tr", "a", "s1", None, 0.0, 1.0)
+        trace = chrome_trace(events,
+                             process_names={"m:1": "slave slave-1"})
+        row = next(e for e in trace["traceEvents"]
+                   if e.get("ph") == "M"
+                   and e["name"] == "process_name")
+        assert row["args"]["name"] == "slave slave-1"
+
+    def test_span_tree_still_connects(self):
+        from veles_tpu.observe.trace_export import chrome_trace, \
+            span_tree
+
+        events = (_span_events(1, "tr", "root", "s1", None, 0.0, 1.0)
+                  + _span_events(2, "tr", "child", "s2", "s1", 0.1,
+                                 0.5))
+        trees = span_tree(chrome_trace(events))
+        assert trees == {"tr": {"s1": None, "s2": "s1"}}
+
+
+class TestAssembleFleetTrace:
+    def _payload(self, offset_s=4.0):
+        # master issues at mono 10.0, applies at 10.2; the slave (clock
+        # ahead by offset_s) ran do_job in between at its own stamps
+        master_spans = []
+        for event in (
+                {"name": "fleet.issue", "etype": "single", "mono": 10.0,
+                 "trace_id": "tr1", "span_id": "i1",
+                 "parent_id": None, "tid": 5, "pid": 999},
+                {"name": "fleet.apply", "etype": "begin", "mono": 10.2,
+                 "trace_id": "tr1", "span_id": "a1",
+                 "parent_id": "d1", "tid": 5, "pid": 999},
+                {"name": "fleet.apply", "etype": "end", "mono": 10.25,
+                 "trace_id": "tr1", "span_id": "a1",
+                 "parent_id": "d1", "tid": 5, "pid": 999},
+                # a master copy of a span the slave ALSO shipped (the
+                # same-host shared-ring case): must dedupe
+                {"name": "fleet.do_job", "etype": "begin",
+                 "mono": 10.05, "trace_id": "tr1", "span_id": "d1",
+                 "parent_id": "i1", "tid": 6, "pid": 999}):
+            master_spans.append(dict(event, kind="span"))
+        slave_t0 = 10.05 + offset_s
+        return {
+            "kind": "fleetscope", "schema": 1, "master_pid": 999,
+            "master_mid": "mid0",
+            "status": {"goodput": {"jobs": 2, "fraction": 0.8,
+                                   "compute_s": 1.0, "host_s": 0.1,
+                                   "wire_s": 0.1, "idle_s": 0.05,
+                                   "wasted_s": 0.0}},
+            "clocks": {"mid0:7": {"slave": "slave-1",
+                                  "offset_ms": offset_s * 1e3,
+                                  "uncertainty_ms": 1.0,
+                                  "samples": 4}},
+            "slave_spans": [
+                {"proc": "mid0:7", "slave": "slave-1",
+                 "name": "fleet.do_job", "trace_id": "tr1",
+                 "span_id": "d1", "parent_id": "i1", "tid": 9,
+                 "t0": slave_t0, "dur_ms": 100.0,
+                 "t0_master": slave_t0 - offset_s}],
+            "master_spans": master_spans,
+        }
+
+    def test_one_row_per_process_and_aligned_chain(self):
+        payload = self._payload()
+        trace = assemble_fleet_trace(payload)
+        events = trace["traceEvents"]
+        process_rows = {e["args"]["name"] for e in events
+                        if e.get("ph") == "M"
+                        and e["name"] == "process_name"}
+        assert process_rows == {"master (mid0 pid 999)",
+                                "slave slave-1 (mid0:7)"}
+        spans = {e["name"]: e for e in events if e.get("ph") != "M"}
+        issue, do_job, apply_ = (spans["fleet.issue"],
+                                 spans["fleet.do_job"],
+                                 spans["fleet.apply"])
+        # per-process rows: do_job renders on the slave's row
+        assert do_job["pid"] != issue["pid"]
+        assert issue["pid"] == apply_["pid"]
+        # the master's duplicate do_job copy was deduped
+        assert sum(1 for e in events
+                   if e.get("ph") != "M"
+                   and e["name"] == "fleet.do_job") == 1
+        # clock-aligned: issue (0) < do_job (50ms) < apply (200ms)
+        assert issue["ts"] <= do_job["ts"] <= apply_["ts"]
+        assert do_job["ts"] == pytest.approx(50e3, abs=1e3)
+        assert do_job["dur"] == pytest.approx(100e3, abs=1.0)
+        # the one-trace chain survives assembly
+        assert do_job["args"]["parent_id"] == "i1"
+        assert apply_["args"]["parent_id"] == "d1"
+
+    def test_cli_round_trip(self, tmp_path, capsys):
+        saved = tmp_path / "fleet_debug.json"
+        saved.write_text(json.dumps(self._payload()))
+        out = tmp_path / "fleet.trace.json"
+        assert fleet_trace_main(str(saved), output=str(out)) == 0
+        trace = json.loads(out.read_text())
+        assert any(e.get("ph") == "M" for e in trace["traceEvents"])
+        text = capsys.readouterr().out
+        assert "process row" in text
+        assert "goodput 80.0%" in text
+        assert "ui.perfetto.dev" in text
+
+    def test_cli_rejects_garbage(self, tmp_path, capsys):
+        bad = tmp_path / "nope.json"
+        bad.write_text("{\"kind\": \"other\"}")
+        assert fleet_trace_main(str(bad)) == 1
+        assert fleet_trace_main(str(tmp_path / "missing.json")) == 1
+
+    def test_observe_subcommand_dispatch(self, tmp_path, capsys):
+        from veles_tpu.observe.trace_export import main as observe_main
+
+        saved = tmp_path / "fleet_debug.json"
+        saved.write_text(json.dumps(self._payload()))
+        out = tmp_path / "cli.trace.json"
+        assert observe_main(["fleet-trace", str(saved),
+                             "-o", str(out)]) == 0
+        assert json.loads(out.read_text())["traceEvents"]
+
+
+class TestDashboardCell:
+    def test_fleet_cell_renders_goodput_and_straggler(self):
+        from veles_tpu.web_status import format_fleet_health
+
+        cell = format_fleet_health({
+            "plane": "data",
+            "ledger": {"done": 10, "issued": 12, "requeued": 2},
+            "goodput": {"jobs": 10, "fraction": 0.62, "wasted_s": 1.5},
+            "straggler": {"slave": "slave-2", "score": 4.1}})
+        assert "goodput 62%" in cell
+        assert "1.5s wasted" in cell
+        assert "straggler slave-2 (4.1x median)" in cell
+
+    def test_fleet_cell_quiet_without_fleetscope_data(self):
+        from veles_tpu.web_status import format_fleet_health
+
+        cell = format_fleet_health({"ledger": {"done": 1, "issued": 1}})
+        assert "goodput" not in cell and "straggler" not in cell
+
+
+# -- bench key directions (satellite) ---------------------------------------
+
+class TestDirections:
+    def test_fleetscope_bench_directions(self):
+        from veles_tpu.observe.regress import _lower_is_better
+
+        assert not _lower_is_better("fleet_goodput_fraction")
+        assert _lower_is_better("fleet_straggler_detect_ms")
+        assert _lower_is_better("fleet_span_ship_overhead_ns")
+
+
+# -- the chaos slow-slave acceptance (real loopback fleet) ------------------
+
+class _ScriptedWorkflow:
+    """Minimal fleet-protocol workflow: the master side serves job
+    integers, the slave side sleeps a fixed per-job wall."""
+
+    checksum = "fleetscope-e2e"
+
+    def __init__(self, jobs=(), job_sleep_s=0.0):
+        self._jobs = list(jobs)
+        self.job_sleep_s = job_sleep_s
+        self.applied = []
+
+    def generate_initial_data_for_slave(self, slave):
+        return None
+
+    def generate_data_for_slave(self, slave):
+        return self._jobs.pop(0) if self._jobs else None
+
+    def apply_data_from_slave(self, update, slave):
+        self.applied.append(update)
+
+    def apply_initial_data_from_master(self, initial):
+        pass
+
+    def do_job(self, job, callback):
+        time.sleep(self.job_sleep_s)
+        callback({"job": job})
+
+    def drop_slave(self, slave):
+        pass
+
+    def has_more_jobs(self):
+        return bool(self._jobs)
+
+
+@pytest.mark.slow
+class TestChaosSlowSlaveE2E:
+    def test_chaos_straggler_named_and_trace_assembled(self, tmp_path):
+        """The acceptance criterion: a loopback fleet with the seeded
+        slow-slave chaos profile on one slave (and frame-delay jitter
+        on the other) deterministically names the injected straggler
+        in fleet_status(), lands a fleet incident artifact, keeps the
+        clock aligned within its own bound, and `observe fleet-trace`
+        emits a Perfetto-loadable merged trace (saved payload AND
+        --live) with connected, clock-ordered issue→do_job→apply
+        chains."""
+        import urllib.request
+
+        from veles_tpu.fleet.chaos import ChaosConfig, ChaosMonkey
+        from veles_tpu.fleet.client import Client
+        from veles_tpu.fleet.server import Server
+        from veles_tpu.observe.history import (IncidentRecorder,
+                                               MetricHistory,
+                                               get_metric_history,
+                                               set_metric_history)
+        from veles_tpu.observe.metrics import MetricsRegistry
+        from veles_tpu.observe.tracing import get_tracer
+
+        tracer = get_tracer()
+        tracer_was = tracer.enabled
+        tracer.enable()
+        previous_history = get_metric_history()
+        history = MetricHistory(
+            registry=MetricsRegistry(enabled=False),
+            incidents=IncidentRecorder(directory=str(tmp_path),
+                                       cooldown_s=0.0))
+        set_metric_history(history)
+        get_span_ring().drain(10 ** 6)
+        master = Server("127.0.0.1:0",
+                        _ScriptedWorkflow(jobs=range(80)),
+                        secret="fleetscope-e2e", metrics_port=0)
+        done = threading.Event()
+        master.on_finished = done.set
+        clients = []
+        try:
+            master.start()
+            # slave A: frame-delay jitter only (alignment stressor)
+            delay = ChaosMonkey(ChaosConfig(
+                seed=1, frame_delay=0.5, frame_delay_ms=10.0))
+            fast = Client("127.0.0.1:%d" % master.port,
+                          _ScriptedWorkflow(job_sleep_s=0.003),
+                          secret="fleetscope-e2e", chaos=delay)
+            # slave B: the injected straggler — every job stretched
+            slow_chaos = ChaosMonkey(ChaosConfig(
+                seed=1, slow_job=1.0, slow_job_ms=40.0))
+            slow = Client("127.0.0.1:%d" % master.port,
+                          _ScriptedWorkflow(job_sleep_s=0.003),
+                          secret="fleetscope-e2e", chaos=slow_chaos)
+            clients = [fast.start(), slow.start()]
+            assert done.wait(60.0), "fleet did not finish"
+            master.drain(timeout=10.0)
+            status = master.fleet_status()
+            # every configured fault actually fired
+            assert slow_chaos.counters["jobs_slowed"] >= \
+                STRAGGLER_WINDOWS + STRAGGLER_WINDOWS
+            assert delay.counters["frames_delayed"] > 0
+            # --- straggler named deterministically -------------------
+            straggler = status.get("straggler")
+            assert straggler is not None
+            assert straggler["slave"] == slow.sid
+            assert straggler["score"] >= STRAGGLER_RATIO
+            # per-slave stats persist on the scope even after the
+            # slaves disconnect at end-of-stream
+            slow_stats = master.scope.slave_stats(slow.sid)
+            fast_stats = master.scope.slave_stats(fast.sid)
+            assert slow_stats["straggler_score"] >= STRAGGLER_RATIO
+            assert fast_stats["step_ms"] < slow_stats["step_ms"]
+            # --- goodput decomposition -------------------------------
+            goodput = status["goodput"]
+            assert goodput["jobs"] >= 60
+            # the stretch is injected residence, not workflow compute:
+            # it must land in HOST time and drag the fraction down
+            assert goodput["host_s"] > 0.15  # >= 6 jobs x 40ms stretch
+            assert 0.0 < goodput["fraction"] < 0.6
+            # --- clock alignment within its own bound ----------------
+            clocks = status["clock"]
+            assert clocks, "no clock estimates"
+            for row in clocks.values():
+                # same physical clock: the truth is offset 0, so the
+                # estimate must sit within its own uncertainty
+                assert abs(row["offset_ms"]) <= \
+                    row["uncertainty_ms"] + 1.0
+                assert row["uncertainty_ms"] < 500.0
+            # --- fleet incident artifact names the straggler ---------
+            incidents = [name for name in os.listdir(str(tmp_path))
+                         if name.startswith("incident-")
+                         and "fleet_straggler" in name]
+            assert incidents, "no fleet incident artifact"
+            with open(os.path.join(str(tmp_path),
+                                   sorted(incidents)[-1])) as fin:
+                doc = json.load(fin)
+            assert doc["reason"] == "fleet_straggler"
+            assert doc["trigger"]["labels"] == [["slave", slow.sid]]
+            assert doc["leading_indicator"]["reference"] in (
+                "fleet_goodput", "fleet_straggler")
+            # --- span shipping actually happened ---------------------
+            assert sum(master.scope.spans_ingested.values()) > 0
+            # --- fleet-trace: saved payload + --live -----------------
+            payload = master.fleet_debug()
+            saved = tmp_path / "fleet_debug.json"
+            saved.write_text(json.dumps(payload))
+            out = tmp_path / "merged.trace.json"
+            assert fleet_trace_main(str(saved),
+                                    output=str(out)) == 0
+            trace = json.loads(out.read_text())
+            self._check_trace(trace)
+            live_out = tmp_path / "live.trace.json"
+            url = "http://127.0.0.1:%d" % master.metrics_port
+            with urllib.request.urlopen("%s/debug/fleet" % url,
+                                        timeout=10) as resp:
+                assert json.loads(
+                    resp.read().decode())["kind"] == "fleetscope"
+            assert fleet_trace_main(live=url,
+                                    output=str(live_out)) == 0
+            assert json.loads(live_out.read_text())["traceEvents"]
+        finally:
+            for client in clients:
+                client.stop()
+            master.stop()
+            set_metric_history(previous_history)
+            tracer.enabled = tracer_was
+            get_span_ring().drain(10 ** 6)
+
+    def _check_trace(self, trace):
+        events = trace["traceEvents"]
+        process_rows = [e for e in events if e.get("ph") == "M"
+                        and e["name"] == "process_name"]
+        # at least the master row and the slave-process row (both
+        # loopback slaves share one OS process, hence one row)
+        assert len(process_rows) >= 2
+        by_trace = {}
+        for event in events:
+            if event.get("ph") == "M":
+                continue
+            trace_id = event.get("args", {}).get("trace_id")
+            if trace_id:
+                by_trace.setdefault(trace_id, []).append(event)
+        chains = [evs for evs in by_trace.values()
+                  if {"fleet.issue", "fleet.do_job", "fleet.apply"}
+                  <= {ev["name"] for ev in evs}]
+        assert chains, "no connected issue->do_job->apply chain"
+        checked = 0
+        for evs in chains:
+            by_name = {ev["name"]: ev for ev in evs}
+            issue = by_name["fleet.issue"]
+            do_job = by_name["fleet.do_job"]
+            apply_ = by_name["fleet.apply"]
+            # one trace, connected across the wire
+            assert do_job["args"]["parent_id"] == \
+                issue["args"]["span_id"]
+            assert apply_["args"]["parent_id"] == \
+                do_job["args"]["span_id"]
+            # clock-aligned ordering (50ms slack >> the uncertainty)
+            slack_us = 50e3
+            assert issue["ts"] <= do_job["ts"] + slack_us
+            assert do_job["ts"] <= apply_["ts"] + slack_us
+            checked += 1
+        assert checked >= 3

@@ -857,7 +857,14 @@ class TestTraceExport:
         out = tmp_path / "trace.json"
         count = export_chrome_trace(str(src), str(out))
         trace = json.loads(out.read_text())
-        assert count == len(trace["traceEvents"]) == 3
+        assert count == len(trace["traceEvents"])
+        spans = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+        assert len(spans) == 3
+        # the multi-process satellite: process/thread metadata rows
+        # ride along so merged traces keep one row per process
+        metadata = {e["name"] for e in trace["traceEvents"]
+                    if e["ph"] == "M"}
+        assert metadata == {"process_name", "thread_name"}
         complete = {e["name"]: e for e in trace["traceEvents"]
                     if e["ph"] == "X"}
         assert set(complete) == {"parent", "child"}

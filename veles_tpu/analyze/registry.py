@@ -51,6 +51,15 @@ RECORD_PATH_FUNCTIONS = {
                            "MetricHistory.record_control",
                            "MetricHistory._ingest",
                            "_Series.push"},
+    # the fleet goodput observatory: the span ring sits on the slave's
+    # span-finish path, the rest on the master's event loop per frame;
+    # incident writes live in FleetScope.autopsy_tick, NOT declared
+    "observe/fleetscope.py": {"SpanRing.note_span", "SpanRing.drain",
+                              "ClockEstimate.observe",
+                              "StepWindow.push",
+                              "FleetScope.note_issue",
+                              "FleetScope.note_update",
+                              "FleetScope.book_update"},
 }
 
 #: module-path suffix -> {class name: (exempt method names,)}; every

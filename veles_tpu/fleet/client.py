@@ -46,10 +46,12 @@ import asyncio
 import os
 import random
 import threading
+import time
 
 from veles_tpu.core.logger import Logger
 from veles_tpu.fleet.protocol import (
     ProtocolError, machine_id, read_frame, resolve_secret, write_frame)
+from veles_tpu.observe.fleetscope import get_span_ring
 from veles_tpu.observe.metrics import get_metrics_registry
 from veles_tpu.observe.tracing import get_tracer, parse_trace_field
 
@@ -109,6 +111,18 @@ class Client(Logger):
         self.sid = None
         self.master_epoch = None
         self.jobs_done = 0
+        #: wall ms of the last workflow job run (ships as ``job_ms``
+        #: so the master's goodput decomposition can split compute
+        #: from host time inside our residence window)
+        self._last_job_ms_ = 0.0
+        #: cumulative rollback-discarded compute (control plane): work
+        #: whose update was lost and re-done bit-identically — ships on
+        #: update frames for the master's wasted-work accounting
+        self.rollback_ms = 0.0
+        # completed-span summaries ride our update frames (observe/
+        # fleetscope.py): enable the bounded process ring; it only
+        # fills while tracing is on
+        get_span_ring().enable()
         self._attempts = 0
         self._loop = None
         self._thread = None
@@ -320,6 +334,10 @@ class Client(Logger):
                     self.info("no more jobs; exiting")
                     return True
                 job_id = msg.get("job_id")
+                # NTP stamp pair for the master's clock aligner
+                # (observe/fleetscope.py): our receive mono now, our
+                # send mono stamped just before the update write
+                rx_mono = time.monotonic()
                 if self.control_plane:
                     self._maybe_rollback(msg)
                 # the master's fleet.issue context rides the job frame;
@@ -351,14 +369,28 @@ class Client(Logger):
                 # weight payload is omitted ENTIRELY (the master
                 # rejects frames that carry one)
                 frame = {"type": "update",
-                         "job_id": job_id, "epoch": self.master_epoch}
+                         "job_id": job_id, "epoch": self.master_epoch,
+                         # [job-receipt mono, update-send mono]: the
+                         # slave half of the clock-alignment exchange;
+                         # the send stamp is filled right before write
+                         "mono": [rx_mono, 0.0],
+                         "job_ms": round(self._last_job_ms_, 3)}
                 if self.control_plane:
                     frame["results"] = update
                     frame["tick"] = self._applied_ticks_
-                else:
+                if self.rollback_ms > 0:
+                    frame["rollback_ms"] = round(self.rollback_ms, 3)
+                if not self.control_plane:
                     frame["update"] = update
                 if job_span.context() is not None:
                     frame["trace"] = list(job_span.context())
+                ring = get_span_ring()
+                if len(ring):
+                    # completed-span summaries since the last frame
+                    # (bounded rows; the master validates + dedupes)
+                    rows = ring.drain()
+                    if rows:
+                        frame["spans"] = rows
                 registry = get_metrics_registry()
                 if registry.enabled:
                     # piggyback this slave's counter/gauge snapshot so
@@ -403,6 +435,7 @@ class Client(Logger):
                         rows = history.fleet_summary()
                         if rows:
                             frame["history"] = rows
+                frame["mono"][1] = time.monotonic()
                 await self._write(writer, frame, shm_threshold=shm_thr)
                 if self.control_plane:
                     # epoch fence? the workflow hands over the bulk
@@ -459,6 +492,11 @@ class Client(Logger):
             return
         rollback = getattr(self.workflow, "rollback_job", None)
         rolled = bool(rollback()) if callable(rollback) else False
+        if rolled:
+            # the discarded application's compute is re-done on the
+            # replay — book it as wasted work for the master's goodput
+            # accounting (ships cumulative as ``rollback_ms``)
+            self.rollback_ms += self._last_job_ms_
         self.rollbacks += 1
         self._applied_ticks_ = acked
         self.warning(
@@ -507,8 +545,13 @@ class Client(Logger):
         def launch():
             self.workflow.do_job(job, callback)
 
+        started = time.monotonic()
         await loop.run_in_executor(None, launch)
         update = await future
+        # the workflow's own wall only: the chaos slow-slave stretch
+        # below is injected residence the goodput decomposition must
+        # book as HOST time, not compute
+        self._last_job_ms_ = (time.monotonic() - started) * 1000.0
         if self.chaos is not None:
             await self.chaos.stretch_job()  # slow-slave fault
         self.jobs_done += 1

@@ -75,6 +75,11 @@ class JobLedger:
             "issued": 0, "done": 0,
             "requeued_dropped": 0, "requeued_expired": 0,
         }
+        #: wasted-work accounting (observe/fleetscope.py goodput): the
+        #: in-flight seconds of every lease that was REQUEUED — work a
+        #: slave (probably) did whose result was discarded and re-run
+        #: elsewhere (requeued-after-death / hang-expired)
+        self.wasted_seconds = 0.0
         self.fenced = {
             FENCE_UNKNOWN: 0, FENCE_DUPLICATE: 0, FENCE_REQUEUED: 0,
             FENCE_FOREIGN: 0, FENCE_STALE_EPOCH: 0,
@@ -125,11 +130,12 @@ class JobLedger:
             self.fenced[FENCE_STALE_EPOCH] += 1
         return FENCE_STALE_EPOCH
 
-    def requeue_for_slave(self, sid):
+    def requeue_for_slave(self, sid, now=None):
         """Mark every OUTSTANDING lease of a dropped slave REQUEUED (the
         Loader requeues the actual minibatches via ``drop_slave``; this
         records the transition and arms the fence against a zombie's late
         updates). Returns the requeued job ids."""
+        now = time.time() if now is None else now
         with self._lock:
             requeued = []
             # snapshot: _retire's GC pops settled leases from the same
@@ -138,6 +144,8 @@ class JobLedger:
                 if lease.sid == sid and lease.state == OUTSTANDING:
                     lease.state = REQUEUED
                     self.counters["requeued_dropped"] += 1
+                    self.wasted_seconds += max(0.0,
+                                               now - lease.issued_at)
                     self._retire(lease.job_id)
                     requeued.append(lease.job_id)
             return requeued
@@ -154,6 +162,7 @@ class JobLedger:
                 return False
             lease.state = REQUEUED
             self.counters["requeued_expired"] += 1
+            self.wasted_seconds += max(0.0, now - lease.issued_at)
             self._retire(job_id)
             return True
 
@@ -187,6 +196,7 @@ class JobLedger:
                              + self.counters["requeued_expired"]),
                 "requeued_dropped": self.counters["requeued_dropped"],
                 "requeued_expired": self.counters["requeued_expired"],
+                "wasted_s": round(self.wasted_seconds, 3),
                 "fenced": dict(self.fenced),
                 "fenced_total": sum(self.fenced.values()),
             }

@@ -25,7 +25,17 @@ Message schema (master <-> slave, after the hello/welcome handshake):
   ``update`` (the data-plane per-unit payload list, weights included)
   or — control plane — ``results`` (scalar metrics list) + ``tick``
   (the slave's local applied-job counter; a control-plane master
-  REJECTS frames carrying an ``update`` key);
+  REJECTS frames carrying an ``update`` key). Observability freight
+  rides along (observe/fleetscope.py; every field validated + bounded
+  at ingestion, the hostile-slave doctrine): ``mono`` ([job-receipt,
+  update-send] slave monotonic stamps — the slave half of the
+  master's NTP-style clock alignment), ``job_ms`` (the workflow's own
+  job wall, so the master can split compute from host residence),
+  ``spans`` (completed-span summary rows ``[name, trace_id, span_id,
+  parent_id, t0, dur_ms, tid]``, at most SPAN_SHIP_MAX_ROWS per
+  frame), ``rollback_ms`` (cumulative rollback-discarded compute —
+  wasted-work accounting), plus the pre-existing ``metrics`` /
+  ``history`` snapshot piggybacks;
 - ``update_ack``: optional ``fenced`` (the rejection verdict — the
   slave must not answer a fenced ack with another job_request);
 - ``sync`` (control plane only): ``sync`` (per-unit epoch-fence weight

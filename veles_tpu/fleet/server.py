@@ -18,6 +18,7 @@ Reference ``veles/server.py``. Kept semantics:
 """
 
 import asyncio
+import os
 import threading
 import time
 import uuid
@@ -28,6 +29,7 @@ from veles_tpu.fleet.ledger import FENCE_STALE_EPOCH, JobLedger
 from veles_tpu.fleet.protocol import (
     COMPRESS_THRESHOLD, ProtocolError, machine_id, read_frame,
     resolve_secret, write_frame)
+from veles_tpu.observe.fleetscope import FleetScope, StepWindow
 from veles_tpu.observe.flight import get_flight_recorder
 from veles_tpu.observe.metrics import bridge, publish_fleet
 from veles_tpu.observe.tracing import get_tracer, parse_trace_field
@@ -54,7 +56,11 @@ class SlaveDescription:
         self.backend = info.get("backend", "?")
         self.state = "WAIT"
         self.jobs_done = 0
-        self.job_times = []
+        #: per-slave step-time history: ONE implementation
+        #: (observe/fleetscope.py StepWindow) behind the adaptive hang
+        #: timeout AND the fleet straggler detector — the server shares
+        #: this window with its FleetScope via ``track_window``
+        self.window = StepWindow(keep=self.JOB_TIMES_KEEP)
         self.job_started = None
         self.paused = False
         self.chaos_counters = None  # latest fault tallies from the slave
@@ -68,20 +74,19 @@ class SlaveDescription:
         #: autopsies span the fleet
         self.history_rows = None
 
+    @property
+    def job_times(self):
+        """The raw step-time samples (compat view of the window)."""
+        return self.window.samples
+
     def record_job_time(self, duration):
-        self.job_times.append(duration)
-        if len(self.job_times) > self.JOB_TIMES_KEEP:
-            del self.job_times[:-self.JOB_TIMES_KEEP]
+        self.window.push(duration)
 
     def timeout(self, default):
         """mean + 3σ adaptive hang threshold (reference
-        ``server.py:619-635``)."""
-        if len(self.job_times) < 3:
-            return default
-        mean = sum(self.job_times) / len(self.job_times)
-        var = sum((t - mean) ** 2
-                  for t in self.job_times) / len(self.job_times)
-        return max(mean + 3.0 * var ** 0.5, default)
+        ``server.py:619-635``), read from the SAME window the
+        straggler detector scores."""
+        return self.window.hang_timeout(default)
 
     def as_dict(self):
         return {"id": self.id, "mid": self.mid, "pid": self.pid,
@@ -171,6 +176,10 @@ class Server(Logger):
         #: are cumulative per process, so keeping the last report per
         #: process survives reconnects without double counting
         self._chaos_reports = {}
+        #: the fleet goodput observatory (observe/fleetscope.py):
+        #: per-slave step windows + clock alignment + shipped-span
+        #: store + goodput decomposition + the straggler detector
+        self.scope = FleetScope()
         self._next_id = 0
         self._pending_requests = []  # backpressured (sid, writer)
         self._writers = {}
@@ -245,8 +254,15 @@ class Server(Logger):
             def do_GET(self):
                 if serve_metrics(self):
                     return
-                if self.path.split("?")[0] == "/healthz":
+                path = self.path.split("?")[0]
+                if path == "/healthz":
                     reply(self, server.fleet_status())
+                    return
+                if path == "/debug/fleet":
+                    # the fleet-trace payload (observe/fleetscope.py):
+                    # master spans + shipped slave spans + clocks +
+                    # goodput, assembled by `observe fleet-trace`
+                    reply(self, server.fleet_debug())
                     return
                 self.send_error(404)
 
@@ -394,6 +410,9 @@ class Server(Logger):
             slave.shm_threshold = COMPRESS_THRESHOLD if shm_ok else None
             self.slaves[sid] = slave
             self._writers[sid] = writer
+            # the hang timeout and the straggler detector read ONE
+            # step-time window (observe/fleetscope.py)
+            self.scope.track_window(sid, slave.window)
             initial = await self._in_thread(
                 self.workflow.generate_initial_data_for_slave, slave)
             await write_frame(writer, {"type": "welcome", "id": sid,
@@ -491,6 +510,9 @@ class Server(Logger):
                                    slave=slave.id)
         if issue.context() is not None:
             frame["trace"] = list(issue.context())
+        # clock-alignment t0: the job-send stamp this lease's update
+        # round trip is measured against (observe/fleetscope.py)
+        self.scope.note_issue(job_id, slave, time.monotonic())
         await write_frame(writer, frame, self._secret,
                           shm_threshold=getattr(slave, "shm_threshold",
                                                 None))
@@ -525,6 +547,12 @@ class Server(Logger):
             if master_history is not None:
                 master_history.ingest_summary(slave.id,
                                               slave.history_rows)
+        # span-summary + clock-stamp ingestion (observe/fleetscope.py;
+        # validated + bounded like the metric rows above): runs for
+        # every frame — even a frame the fence later rejects carries
+        # real spans and a real round trip
+        update_mono = time.monotonic()
+        stamp_pair = self.scope.note_update(slave, msg, update_mono)
         if self.control_plane and "update" in msg:
             # a data-plane weight payload on the control-plane wire is
             # a protocol violation (zombie or misconfigured peer
@@ -580,6 +608,10 @@ class Server(Logger):
             slave.record_job_time(time.time() - slave.job_started)
             slave.job_started = None
         slave.jobs_done += 1
+        # goodput decomposition: the accepted update's round trip
+        # splits into compute/host/wire, the gap since this slave's
+        # previous settle into idle (observe/fleetscope.py)
+        self.scope.book_update(slave.id, stamp_pair, update_mono)
         if slave.jobs_done == 1 and self.respawn_manager is not None \
                 and slave.mid != "?":
             # reset the respawn budget only once the slave proves it
@@ -599,6 +631,13 @@ class Server(Logger):
             if isinstance(msg.get("job_id"), int):
                 self._accepted_jobs[key] = msg["job_id"]
             self._jobs_since_sync += 1
+        # straggler detection + trend recording + (cooldown-limited)
+        # fleet incident artifact — OFF the record path by design
+        # (observe/fleetscope.py autopsy_tick may write a file)
+        from veles_tpu.observe.history import get_metric_history
+        self.scope.autopsy_tick(
+            slave.id, get_metric_history(),
+            wasted_s=self.ledger.snapshot().get("wasted_s", 0.0))
         await write_frame(writer, {"type": "update_ack"}, self._secret)
         slave.state = "WAIT"
         await self._retry_pending()
@@ -736,6 +775,9 @@ class Server(Logger):
         slave = self.slaves.pop(sid, None)
         if slave is not None:
             slave.job_started = None  # disarm any in-flight hang timer
+        # scoring hygiene: a departed slave leaves the straggler
+        # detector's reference pool (observe/fleetscope.py)
+        self.scope.drop_slave(sid)
         # explicit job-level requeue: every lease still OUTSTANDING for
         # this slave transitions to REQUEUED (the workflow's drop_slave
         # below requeues the actual minibatch payloads) and its late
@@ -853,7 +895,16 @@ class Server(Logger):
             for key, value in counters.items():
                 if isinstance(value, (int, float)):
                     chaos[key] = chaos.get(key, 0) + value
-        status = {"slaves": [s.as_dict() for s in slaves],
+        ledger_snap = self.ledger.snapshot()
+        slave_rows = [s.as_dict() for s in slaves]
+        for row in slave_rows:
+            # the fleetscope per-slave truth: median step time + the
+            # straggler score vs the fleet median (one implementation
+            # with the hang timeout — observe/fleetscope.py)
+            stats = self.scope.slave_stats(row.get("id"))
+            if stats:
+                row.update(stats)
+        status = {"slaves": slave_rows,
                   # .copy() is a single C-level op (GIL-atomic), unlike
                   # sorted() iterating the live set under a concurrent
                   # hang-check blacklist.add
@@ -861,8 +912,18 @@ class Server(Logger):
                   "queued_jobs": len(pending),
                   "epoch": self.epoch,
                   "plane": self.plane,
-                  "ledger": self.ledger.snapshot(),
+                  "ledger": ledger_snap,
                   "chaos": chaos}
+        goodput = self.scope.goodput_summary(
+            wasted_s=ledger_snap.get("wasted_s", 0.0))
+        if goodput["jobs"]:
+            status["goodput"] = goodput
+        straggler = self.scope.straggler_summary()
+        if straggler is not None:
+            status["straggler"] = straggler
+        clocks = self.scope.clock_summary()
+        if clocks:
+            status["clock"] = clocks
         if self.control_plane:
             status["sync"] = dict(self._sync_counters)
             status["payload_rejects"] = self._payload_rejects
@@ -871,6 +932,27 @@ class Server(Logger):
         if reduce_rows:
             status["reduce"] = reduce_rows
         return status
+
+    def fleet_debug(self):
+        """The ``GET /debug/fleet`` payload (docs/observability.md,
+        "Fleet timeline + goodput"): everything ``veles_tpu observe
+        fleet-trace`` needs to assemble the merged, clock-aligned
+        timeline — this process's flight-ring span events, the shipped
+        slave spans mapped onto the master timeline, the per-process
+        clock estimates, and the goodput/straggler status."""
+        entries = get_flight_recorder().entries()
+        return {
+            "kind": "fleetscope",
+            "schema": 1,
+            "now_mono": time.monotonic(),
+            "master_pid": os.getpid(),
+            "master_mid": machine_id(),
+            "status": self.fleet_status(),
+            "clocks": self.scope.clock_summary(),
+            "slave_spans": self.scope.span_rows(),
+            "master_spans": [entry for entry in entries
+                             if entry.get("kind") == "span"],
+        }
 
     @staticmethod
     def _mine_reduce_rows(rows):

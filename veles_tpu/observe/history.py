@@ -88,7 +88,13 @@ FLEET_MAX_POINTS = 32
 #: dashboard (the trend set an on-call scans first)
 SUMMARY_PREFIXES = ("veles_ctrl_", "veles_slo_", "veles_serving_",
                     "veles_kv_", "veles_anomaly_", "veles_mfu_ratio",
-                    "veles_governor_")
+                    "veles_governor_", "veles_fleet_goodput",
+                    "veles_fleet_straggler")
+
+#: rules that stand in for "the user-visible breach" when computing an
+#: incident's leading-indicator lead time: SLO burn for serving,
+#: goodput collapse for the fleet (observe/fleetscope.py)
+REFERENCE_RULES = ("slo_burn", "ctrl_burn", "fleet_goodput")
 
 #: unicode sparkline ramp (web-status cells + the incident CLI)
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
@@ -650,6 +656,14 @@ class MetricHistory:
     def _check_rules(self, now):
         fired = []
         for rule in list(self.rules):
+            if getattr(rule, "external", False):
+                # detector-owned rules (observe/fleetscope.py books
+                # fleet_straggler/fleet_goodput with external=True):
+                # their state is synced — and their firing decided —
+                # by the owning detector's own cadence; sampler-side
+                # evaluation would race those writes and double-fire
+                # with different window semantics
+                continue
             try:
                 event = rule.evaluate(self, now)
             except Exception:
@@ -919,10 +933,9 @@ class IncidentRecorder:
         breaching = history.breaching_rules()
         leading = breaching[0] if breaching else rule
         # the user-visible breach the lead is measured against: the
-        # SLO-burn rule when it is breaching, else the trigger
+        # SLO-burn/goodput rule when it is breaching, else the trigger
         reference = next(
-            (r for r in breaching if r.name in ("slo_burn",
-                                                "ctrl_burn")), rule)
+            (r for r in breaching if r.name in REFERENCE_RULES), rule)
         lead_ms = 0.0
         if leading.breach_since is not None \
                 and reference.breach_since is not None:
@@ -1208,7 +1221,7 @@ def _live_doc(url):
     breaching.sort(key=lambda r: r["breach_since"])
     leading = breaching[0] if breaching else None
     reference = next((r for r in breaching
-                      if r.get("name") in ("slo_burn", "ctrl_burn")),
+                      if r.get("name") in REFERENCE_RULES),
                      leading)
     lead_ms = 0.0
     if leading and reference \

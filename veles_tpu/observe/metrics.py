@@ -614,6 +614,51 @@ def publish_fleet(registry, server):
         registry.set("veles_fleet_slave_power", row.get("power", 0.0),
                      labels={"slave": sid},
                      help="reported computing power per slave")
+        if isinstance(row.get("step_ms"), (int, float)):
+            registry.set("veles_fleet_slave_step_ms", row["step_ms"],
+                         labels={"slave": sid},
+                         help="median per-job step time per slave "
+                              "(observe/fleetscope.py StepWindow)")
+        if isinstance(row.get("straggler_score"), (int, float)):
+            registry.set("veles_fleet_straggler_score",
+                         row["straggler_score"],
+                         labels={"slave": sid},
+                         help="per-slave median step time over the "
+                              "fleet median (persistent straggler at "
+                              ">= 1.75x for 3 windows — "
+                              "observe/fleetscope.py)")
+    # fleet goodput decomposition + clock alignment
+    # (observe/fleetscope.py; docs/observability.md "Fleet timeline +
+    # goodput")
+    goodput = status.get("goodput")
+    if isinstance(goodput, dict):
+        registry.set("veles_fleet_goodput_fraction",
+                     goodput.get("fraction", 1.0),
+                     help="share of accounted fleet wall time spent "
+                          "in slave compute (higher is better)")
+        for component in ("compute", "wire", "host", "idle", "wasted"):
+            value = goodput.get(component + "_s")
+            if isinstance(value, (int, float)):
+                registry.counter_set(
+                    "veles_fleet_goodput_seconds_total", value,
+                    labels={"component": component},
+                    help="fleet wall-time decomposition by component "
+                         "(compute/wire/host/idle/wasted)")
+    for proc, row in sorted((status.get("clock") or {}).items()):
+        if not isinstance(row, dict):
+            continue
+        sid = str(row.get("slave", proc))
+        if isinstance(row.get("offset_ms"), (int, float)):
+            registry.set("veles_fleet_clock_offset_ms",
+                         row["offset_ms"], labels={"slave": sid},
+                         help="estimated slave-clock offset vs the "
+                              "master timeline (NTP-style from "
+                              "job/update stamp pairs)")
+        if isinstance(row.get("uncertainty_ms"), (int, float)):
+            registry.set("veles_fleet_clock_uncertainty_ms",
+                         row["uncertainty_ms"], labels={"slave": sid},
+                         help="clock-offset uncertainty bound (half "
+                              "the best filtered wire round trip)")
     # re-export each slave's piggybacked counter/gauge snapshot under
     # its slave id — one scrape of the master sees the whole fleet
     slave_rows = server.slave_metrics()

@@ -79,6 +79,15 @@ SCHEMA_VERSION = 1
 #: regression); "_anomaly_rate" regresses UP (more rule firings for
 #: the same seeded fault profile means the rules got noisier, the
 #: detector equivalent of governor oscillation).
+#: The fleet goodput-observatory keys (observe/fleetscope.py, bench
+#: fleetscope_section): fleet_goodput_fraction uses the
+#: higher-is-better default (less of the fleet's wall time doing
+#: useful compute is a regression — the bare "_fraction" suffix is
+#: deliberately NOT lower-better; only _hit_fraction /
+#: _overhead_fraction are); fleet_straggler_detect_ms rides "_ms" (a
+#: slower straggler detector regressed) and
+#: fleet_span_ship_overhead_ns rides "_ns" (the span ring growing its
+#: record-path tax is a regression).
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness", "_compiles", "burn_rate", "_transitions",

@@ -10,6 +10,14 @@ links); unpaired begins become begin ("B") events so a crashed run's
 half-open spans stay visible; legacy span events without trace ids
 (the pre-observability ``Logger.event`` stream) still export, keyed by
 name+source, so old event files remain loadable.
+
+Multi-process input (a merged fleet dump, or several processes'
+JSONL streams concatenated): every distinct source pid gets a STABLE
+small Chrome pid plus ``process_name``/``thread_name`` metadata
+events, so merged traces render one row per process instead of
+collapsing onto the exporting process's implicit pid. The fleet
+assembly (``observe/fleetscope.py``, ``veles_tpu observe
+fleet-trace``) rides this same path with clock-aligned slave spans.
 """
 
 import json
@@ -45,11 +53,31 @@ def _args(event):
     return out
 
 
-def chrome_trace(events):
-    """Span events -> the ``{"traceEvents": [...]}`` dict."""
+def chrome_trace(events, process_names=None):
+    """Span events -> the ``{"traceEvents": [...]}`` dict.
+
+    ``process_names`` optionally maps a source pid (whatever the
+    events carry in their ``pid`` field — an OS pid, or a fleet
+    process key like ``"mid:pid"``) to a display name for its
+    ``process_name`` metadata row; unnamed processes render as
+    ``pid <value>``."""
     stamps = [float(e["mono"]) for e in events if "mono" in e] or \
         [float(e.get("time", 0.0)) for e in events]
     t0 = min(stamps) if stamps else 0.0
+    procs = {}    # source pid -> stable small Chrome pid
+    threads = set()  # (chrome pid, tid) seen
+
+    def _pid_of(event):
+        key = event.get("pid", event.get("session", 0))
+        try:
+            hash(key)
+        except TypeError:
+            key = str(key)
+        index = procs.get(key)
+        if index is None:
+            index = procs[key] = len(procs) + 1
+        return index
+
     open_spans = {}
     trace_events = []
     for event in events:
@@ -58,11 +86,16 @@ def chrome_trace(events):
             continue
         key = event.get("span_id") or (
             "%s/%s" % (event.get("name"), event.get("source")))
+        tid = event.get("tid", 0)
+        if isinstance(tid, bool) or not isinstance(tid, int):
+            tid = 0
+        pid = _pid_of(event)
+        threads.add((pid, tid))
         base = {
             "name": str(event.get("name", "?")),
             "cat": str(event.get("trace_id") or "events"),
-            "pid": event.get("pid", event.get("session", 0)),
-            "tid": event.get("tid", 0),
+            "pid": pid,
+            "tid": tid,
             "args": _args(event),
         }
         if etype == "single":
@@ -89,7 +122,21 @@ def chrome_trace(events):
         trace_events.append(dict(base, ph="B",
                                  ts=_stamp_us(event, t0)))
     trace_events.sort(key=lambda e: e["ts"])
-    return {"traceEvents": trace_events,
+    # process/thread metadata rows: stable per-process pids so a
+    # merged multi-process trace renders one row per process
+    metadata = []
+    for key, index in procs.items():
+        label = (process_names or {}).get(key)
+        if label is None:
+            label = "pid %s" % (key,)
+        metadata.append({"name": "process_name", "ph": "M",
+                         "pid": index, "tid": 0, "ts": 0,
+                         "args": {"name": str(label)}})
+    for pid, tid in sorted(threads, key=str):
+        metadata.append({"name": "thread_name", "ph": "M",
+                         "pid": pid, "tid": tid, "ts": 0,
+                         "args": {"name": "tid %s" % (tid,)}})
+    return {"traceEvents": metadata + trace_events,
             "displayTimeUnit": "ms"}
 
 
@@ -119,8 +166,9 @@ def span_tree(trace):
 
 def main(argv=None):
     """``veles_tpu observe`` entry point: ``export-trace`` (Chrome
-    trace), ``blackbox`` (flight-recorder dumps) and ``regress`` (the
-    bench sentinel gate)."""
+    trace), ``fleet-trace`` (the merged fleet timeline), ``blackbox``
+    (flight-recorder dumps) and ``regress`` (the bench sentinel
+    gate)."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -135,6 +183,21 @@ def main(argv=None):
                                        "enable_event_recording)")
     export.add_argument("-o", "--output", default=None,
                         help="output path (default: <events>.trace.json)")
+    fleet = sub.add_parser(
+        "fleet-trace",
+        help="assemble the merged master+slave fleet timeline into a "
+             "Perfetto-loadable Chrome trace (observe/fleetscope.py): "
+             "a saved GET /debug/fleet payload, or --live URL of the "
+             "fleet metrics sidecar")
+    fleet.add_argument("artifact", nargs="?", default=None,
+                       help="saved /debug/fleet JSON (or an artifact "
+                            "embedding one under 'fleetscope')")
+    fleet.add_argument("--live", default=None, metavar="URL",
+                       help="fetch <URL>/debug/fleet instead of a "
+                            "file")
+    fleet.add_argument("-o", "--output", default=None,
+                       help="trace output path (default: "
+                            "<artifact>.trace.json / fleet.trace.json)")
     blackbox = sub.add_parser(
         "blackbox",
         help="inspect flight-recorder black-box dumps (observe/"
@@ -186,6 +249,13 @@ def main(argv=None):
     regress.add_argument("--json", action="store_true",
                          help="machine-readable findings")
     args = parser.parse_args(argv)
+    if args.command == "fleet-trace":
+        if not args.artifact and not args.live:
+            parser.error("observe fleet-trace needs an ARTIFACT or "
+                         "--live URL")
+        from veles_tpu.observe.fleetscope import fleet_trace_main
+        return fleet_trace_main(args.artifact, live=args.live,
+                                output=args.output)
     if args.command == "blackbox":
         from veles_tpu.observe.flight import blackbox_main
         return blackbox_main(args.path, tail=args.tail)

@@ -32,6 +32,7 @@ import time
 import uuid
 
 from veles_tpu.core.logger import get_event_recorder
+from veles_tpu.observe.fleetscope import get_span_ring
 from veles_tpu.observe.flight import get_flight_recorder
 
 #: the serving trace header: "<trace_id>/<span_id>" (hex)
@@ -81,7 +82,8 @@ class Span:
     and the recording thread (``tid``)."""
 
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
-                 "attrs", "_token", "_finished", "_annotation")
+                 "attrs", "_token", "_finished", "_annotation",
+                 "_t0_mono")
 
     def __init__(self, tracer, name, trace_id, parent_id, **attrs):
         self.tracer = tracer
@@ -93,6 +95,7 @@ class Span:
         self._token = None
         self._finished = False
         self._annotation = None
+        self._t0_mono = None
 
     def context(self):
         """The (trace_id, span_id) pair to hand across threads or
@@ -106,15 +109,32 @@ class Span:
         return self
 
     def _record(self, etype):
+        mono = time.monotonic()
         payload = dict(
             name=self.name, etype=etype, trace_id=self.trace_id,
             span_id=self.span_id, parent_id=self.parent_id,
-            mono=time.monotonic(), tid=threading.get_ident(),
+            mono=mono, tid=threading.get_ident(),
             pid=os.getpid(), **self.attrs)
         get_event_recorder().record(**payload)
         # the black box holds the last spans regardless of which
         # EventRecorder instance is active (flight.py; bounded append)
         get_flight_recorder().note_span(payload)
+        if etype == "begin":
+            self._t0_mono = mono
+            return
+        # COMPLETED spans (end/single) feed the fleet span ring
+        # (observe/fleetscope.py): a fleet slave piggybacks these
+        # summaries on its update frames so the master can assemble
+        # the cross-process timeline. Disabled ring = one attribute
+        # check; the ring itself is bounded and lock-free.
+        ring = get_span_ring()
+        if ring.enabled:
+            t0 = self._t0_mono if etype == "end" \
+                and self._t0_mono is not None else mono
+            ring.note_span(self.name, self.trace_id, self.span_id,
+                           self.parent_id, t0,
+                           max(0.0, (mono - t0) * 1000.0),
+                           threading.get_ident())
 
     def __enter__(self):
         self._token = _current.set(self)

@@ -161,6 +161,18 @@ def format_fleet_health(fleet):
         if idles:
             cell += " · idle %d%%" % round(100 * max(idles))
         parts.append(cell)
+    goodput = fleet.get("goodput")
+    if isinstance(goodput, dict) and goodput.get("jobs"):
+        cell = "goodput %d%%" % round(
+            100.0 * (goodput.get("fraction") or 0.0))
+        if goodput.get("wasted_s"):
+            cell += " · %.1fs wasted" % goodput["wasted_s"]
+        parts.append(cell)
+    straggler = fleet.get("straggler")
+    if isinstance(straggler, dict) and straggler.get("slave"):
+        parts.append("straggler %s (%.1fx median)"
+                     % (straggler["slave"],
+                        straggler.get("score", 0.0)))
     chaos = fleet.get("chaos")
     if isinstance(chaos, dict):
         fired = ", ".join("%s %s" % (v, k.replace("_", " "))
@@ -680,7 +692,8 @@ class StatusNotifier:
             status["fleet"] = {
                 key: fleet.get(key)
                 for key in ("epoch", "queued_jobs", "ledger", "chaos",
-                            "plane", "sync", "reduce")}
+                            "plane", "sync", "reduce", "goodput",
+                            "straggler")}
         # serving-survival observability (docs/serving_robustness.md):
         # a serving API mirrors its breaker state and trip/rebuild/
         # shed/expired counters onto the dashboard. Two attachment
