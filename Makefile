@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
 	regress mesh paged fleet-mr aot slo governor history analyze \
-	fleetscope
+	fleetscope servescope
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -154,6 +154,21 @@ analyze:
 fleetscope:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleetscope.py \
 		-m fleetscope -q
+
+# Serving goodput observatory suite (docs/observability.md "Serving
+# goodput + slot timeline"): the lock-free per-dispatch accounting
+# ring, EXACT per-cause token-waste math against the real dense and
+# paged engines (bucket pad, duplicate rows, span/page overshoot,
+# dead slots, lag-tail discards), the wall decomposition, the per-slot
+# occupancy timeline + `observe serve-trace` Perfetto assembly (saved
+# and --live), /debug/serve + the /debug/ index, and the chaos
+# waste-profile acceptance — a seeded injection must land an incident
+# artifact naming EXACTLY the injected dominant cause. (The e2e
+# carries the `slow` marker so tier-1 keeps its timeout margin; this
+# target runs it.)
+servescope:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_servescope.py \
+		-m servescope -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

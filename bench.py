@@ -1943,6 +1943,79 @@ def fleetscope_section():
     return out
 
 
+def servescope_section(embed=128, heads=4, blocks=2, vocab=512,
+                       slots=4, budget=16, chunk=4):
+    """The serving goodput observatory section
+    (observe/servescope.py; docs/observability.md "Serving goodput +
+    slot timeline"); keys:
+
+    - ``serve_scope_note_ns``: record-path cost of one per-dispatch
+      accounting note (lower is better — the flight-recorder overhead
+      contract);
+    - ``serve_goodput_fraction``: useful share of dispatched tokens
+      on a staggered mixed-length continuous-batching drain (higher
+      is better);
+    - ``serve_waste_share`` + per-cause ``serve_<cause>_waste_share``:
+      the waste decomposition of the same run (all lower-better under
+      ``make regress``);
+    - ``serve_slot_occupancy_fraction``: live share of decode
+      lane-steps (higher is better)."""
+    from veles_tpu.observe.servescope import ServeScope, \
+        get_serve_scope
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import ContinuousDecoder
+
+    out = {"servescope_config": "s%d_b%d_c%d_e%d_h%d_L%d_v%d"
+                                % (slots, budget, chunk, embed, heads,
+                                   blocks, vocab)}
+    # record-path overhead: one dispatch note on a throwaway scope
+    probe = ServeScope()
+    best = None
+    for _ in range(3):
+        n = 20000
+        start = time.perf_counter()
+        for _ in range(n):
+            probe.note_dispatch(4, 8, 6, 12, 0.0)
+        per_note = (time.perf_counter() - start) / n * 1e9
+        best = per_note if best is None else min(best, per_note)
+    out["serve_scope_note_ns"] = round(best, 1)
+    # the measured decomposition: a staggered mixed-length drain on
+    # the PROCESS scope (reset first — the bench owns this process),
+    # so buckets/groups/span tiles/dead slots all contribute
+    scope = get_serve_scope()
+    scope.reset()
+    rng = numpy.random.RandomState(0)
+    params = init_transformer_params(rng, blocks, embed, heads, vocab)
+    table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.02)
+    dec = ContinuousDecoder(params, table, heads, slots=slots,
+                            max_len=256, n_tokens=budget)
+    pending = [rng.randint(0, vocab, n).tolist()
+               for n in (24, 40, 72, 100, 24, 56, 88, 33)]
+    for _ in range(min(slots, len(pending))):
+        dec.submit(pending.pop())
+
+    def admit():
+        if pending:
+            dec.submit(pending.pop())
+
+    dec.drain_pipelined(chunk, admit=admit)
+    goodput = scope.goodput_summary()
+    out["serve_goodput_fraction"] = goodput["fraction"]
+    total = goodput["useful_tokens"] + goodput["waste_tokens"]
+    if total:
+        out["serve_waste_share"] = round(
+            goodput["waste_tokens"] / total, 4)
+        for cause, tokens in sorted(scope.waste.items()):
+            out["serve_%s_waste_share" % cause] = round(tokens / total,
+                                                        4)
+    occupancy = scope.occupancy()["fraction"]
+    if occupancy is not None:
+        out["serve_slot_occupancy_fraction"] = occupancy
+    return out
+
+
 def _guarded(fn, *args, fallback=(None, []), **kwargs):
     """One failed section must not kill the headline line — but the
     failure has to be visible somewhere (stderr; stdout stays one JSON
@@ -2033,6 +2106,7 @@ def main(artifact_path=None):
     _add(_guarded(reshard_bench, fallback={}))
     _add(_guarded(fleet_bench, fallback={}))
     _add(_guarded(fleetscope_section, fallback={}))
+    _add(_guarded(servescope_section, fallback={}))
     _add(_guarded(coldstart_section, fallback={}))
     _add(_guarded(pod_overhead, fallback={}))
     _add(_guarded(pallas_epilogue_compare, fallback={}))
@@ -2415,6 +2489,13 @@ def serve_main(profile_dir=None, artifact_path=None):
             # sampler overhead with history on vs off, and the
             # chaos-driven incident MTTD + anomaly rate
             section = _guarded(history_section, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # the serving goodput observatory (docs/observability.md
+            # "Serving goodput + slot timeline"): useful-vs-waste
+            # token decomposition + slot occupancy of a staggered
+            # drain, with the per-cause shares regress-gated
+            section = _guarded(servescope_section, fallback={})
             out.update(section)
             artifact.update(section)
         out["decode_histograms"] = registry.histogram_summary(

@@ -230,6 +230,35 @@ class TestCompare:
         better = {key: value / 2 for key, value in old.items()}
         assert regressions(compare(old, better)) == []
 
+    def test_servescope_key_directions(self):
+        """The serving goodput-observatory keys (bench
+        servescope_section / observe/servescope.py):
+        serve_goodput_fraction and the occupancy fraction are
+        HIGHER-better (less useful work is a regression), every
+        *_waste_share key — aggregate and per-cause — regresses UP,
+        and the record-path overhead rides the _ns rule."""
+        old = {"serve_goodput_fraction": 0.8,
+               "serve_slot_occupancy_fraction": 0.7,
+               "serve_waste_share": 0.2,
+               "serve_dead_slot_waste_share": 0.1,
+               "serve_group_dup_waste_share": 0.05,
+               "serve_scope_note_ns": 500.0}
+        worse = {"serve_goodput_fraction": 0.4,
+                 "serve_slot_occupancy_fraction": 0.3,
+                 "serve_waste_share": 0.6,
+                 "serve_dead_slot_waste_share": 0.3,
+                 "serve_group_dup_waste_share": 0.15,
+                 "serve_scope_note_ns": 1500.0}
+        bad = {f["key"] for f in regressions(compare(old, worse))}
+        assert bad == set(old)
+        better = {"serve_goodput_fraction": 0.95,
+                  "serve_slot_occupancy_fraction": 0.9,
+                  "serve_waste_share": 0.05,
+                  "serve_dead_slot_waste_share": 0.02,
+                  "serve_group_dup_waste_share": 0.01,
+                  "serve_scope_note_ns": 250.0}
+        assert regressions(compare(old, better)) == []
+
     def test_type_change_is_a_regression(self):
         new = dict(self.OLD, decode_step_ms="fast")
         assert regressions(compare(self.OLD, new))[0]["verdict"] \

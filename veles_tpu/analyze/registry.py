@@ -60,6 +60,19 @@ RECORD_PATH_FUNCTIONS = {
                               "FleetScope.note_issue",
                               "FleetScope.note_update",
                               "FleetScope.book_update"},
+    # the serving goodput observatory: every note_* sits on the
+    # serving driver's per-dispatch hot path (and inject_waste on the
+    # chaos monkey's before_step, same thread); incident writes live
+    # in ServeScope.autopsy_tick, NOT declared
+    "observe/servescope.py": {"ServeScope._mark",
+                              "ServeScope.note_idle",
+                              "ServeScope.note_admit",
+                              "ServeScope.note_dispatch",
+                              "ServeScope.note_collect",
+                              "ServeScope.inject_waste",
+                              "ServeScope.note_slot_admit",
+                              "ServeScope.note_slot_first",
+                              "ServeScope.note_slot_retire"},
 }
 
 #: module-path suffix -> {class name: (exempt method names,)}; every

@@ -160,6 +160,10 @@ def serve_metrics(handler, registry=None):
     # and incident autopsies exist for anything scrapeable (idempotent)
     from veles_tpu.observe.history import start_history_sampler
     start_history_sampler()
+    # the serving goodput families (observe/servescope.py) ride every
+    # mount as well — gated inside the collector on actual traffic
+    from veles_tpu.observe.servescope import ensure_serve_registered
+    ensure_serve_registered(registry)
     accept = str(getattr(handler, "headers", {}).get("Accept") or "")
     if "application/openmetrics-text" in accept:
         reply(handler, registry.expose(openmetrics=True),
@@ -168,6 +172,58 @@ def serve_metrics(handler, registry=None):
     else:
         reply(handler, registry.expose(),
               content_type="text/plain; version=0.0.4; charset=utf-8")
+    return True
+
+
+#: the debug surfaces the serving HTTP mounts share, path -> one-line
+#: description — what the ``GET /debug/`` index answers so operators
+#: stop guessing paths (the fleet metrics sidecar passes its own map
+#: with ``/debug/fleet``)
+DEBUG_SURFACES = {
+    "/debug/requests": "request-truth ledger: in-flight + slowest "
+                       "resolved request rows (observe/reqledger.py)",
+    "/debug/history": "metric flight recorder: windowed series tails "
+                      "+ anomaly-rule states (observe/history.py)",
+    "/debug/serve": "serving goodput observatory: per-slot occupancy "
+                    "timeline + token-waste decomposition "
+                    "(observe/servescope.py; assemble with `veles_tpu "
+                    "observe serve-trace`)",
+}
+
+
+def serve_debug_index(handler, surfaces=None):
+    """Route ``GET /debug`` / ``GET /debug/``: list the debug surfaces
+    mounted on this server (path -> description) so operators discover
+    ``/debug/requests``, ``/debug/history``, ``/debug/serve`` and the
+    fleet sidecar's ``/debug/fleet`` instead of guessing. Returns True
+    when the path was handled."""
+    path = handler.path.split("?")[0]
+    if path not in ("/debug", "/debug/"):
+        return False
+    reply(handler, {"surfaces": dict(DEBUG_SURFACES
+                                     if surfaces is None
+                                     else surfaces)})
+    return True
+
+
+def serve_debug_serve(handler, scope=None, ledger=None):
+    """Route ``GET /debug/serve``: the serving goodput observatory's
+    payload (``observe/servescope.py``) — goodput/waste decomposition,
+    the per-slot occupancy timeline and the request-ledger rows it
+    merges with, assembled into a Perfetto trace by ``veles_tpu
+    observe serve-trace [ARTIFACT | --live URL]``. Mounted on the
+    serving surfaces beside ``/debug/requests``; returns True when
+    handled."""
+    path = handler.path.split("?")[0]
+    if path != "/debug/serve":
+        return False
+    if scope is None:
+        from veles_tpu.observe.servescope import get_serve_scope
+        scope = get_serve_scope()
+    if ledger is None:
+        from veles_tpu.observe.reqledger import get_request_ledger
+        ledger = get_request_ledger()
+    reply(handler, scope.debug_snapshot(ledger=ledger))
     return True
 
 
@@ -234,8 +290,10 @@ def enable_metrics():
     been trending."""
     from veles_tpu.observe.history import start_history_sampler
     from veles_tpu.observe.metrics import get_metrics_registry
+    from veles_tpu.observe.servescope import ensure_serve_registered
     from veles_tpu.observe.xla_stats import ensure_registered
     registry = ensure_registered(get_metrics_registry().enable())
+    ensure_serve_registered(registry)
     start_history_sampler()
     return registry
 

@@ -264,6 +264,15 @@ class Server(Logger):
                     # goodput, assembled by `observe fleet-trace`
                     reply(self, server.fleet_debug())
                     return
+                if path in ("/debug", "/debug/"):
+                    # the debug index (core/httpd.serve_debug_index
+                    # contract): this sidecar mounts the fleet payload
+                    reply(self, {"surfaces": {
+                        "/debug/fleet": "fleet goodput observatory: "
+                        "master+slave spans, clocks, straggler "
+                        "verdict (observe/fleetscope.py; assemble "
+                        "with `veles_tpu observe fleet-trace`)"}})
+                    return
                 self.send_error(404)
 
         self._metrics_httpd, self.metrics_port = start_server(

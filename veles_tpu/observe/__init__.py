@@ -43,6 +43,15 @@ Three coordinated parts (docs/observability.md):
   ``/debug/history`` surface, web-status sparklines, fleet piggyback
   and the ``veles_tpu observe incident`` CLI — the governor's
   burn/pressure sensing reads the same store the autopsies report;
+- :mod:`veles_tpu.observe.servescope` — the serving goodput
+  observatory: a bounded lock-free per-dispatch accounting ring fed by
+  the slot engine (dense and paged) decomposing serving wall into
+  prefill/decode/host/idle and dispatched tokens into useful vs
+  waste-by-cause (bucket padding, duplicate rows, span/page overshoot,
+  dead slots, discards), per-slot occupancy timelines behind
+  ``GET /debug/serve`` and ``veles_tpu observe serve-trace``, and
+  detector-owned waste/occupancy anomaly rules whose incidents name
+  the dominant waste cause;
 - :mod:`veles_tpu.observe.regress` — the artifact-proof bench sentinel:
   incremental atomic BENCH writes with SHA-256 sidecars, and the
   ``veles_tpu observe regress`` comparison gate (``make regress``).
@@ -68,6 +77,9 @@ from veles_tpu.observe.metrics import (  # noqa: F401
     publish_serving_health)
 from veles_tpu.observe.reqledger import (  # noqa: F401
     RequestLedger, get_request_ledger)
+from veles_tpu.observe.servescope import (  # noqa: F401
+    ServeScope, ensure_serve_registered, get_serve_scope,
+    publish_serve_scope)
 from veles_tpu.observe.slo import (  # noqa: F401
     SLOEngine, get_slo_engine, observe_request, parse_objectives)
 from veles_tpu.observe.tracing import (  # noqa: F401

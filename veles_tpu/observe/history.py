@@ -87,9 +87,9 @@ FLEET_MAX_POINTS = 32
 #: series prefixes worth shipping to the master / showing on the
 #: dashboard (the trend set an on-call scans first)
 SUMMARY_PREFIXES = ("veles_ctrl_", "veles_slo_", "veles_serving_",
-                    "veles_kv_", "veles_anomaly_", "veles_mfu_ratio",
-                    "veles_governor_", "veles_fleet_goodput",
-                    "veles_fleet_straggler")
+                    "veles_serve_", "veles_kv_", "veles_anomaly_",
+                    "veles_mfu_ratio", "veles_governor_",
+                    "veles_fleet_goodput", "veles_fleet_straggler")
 
 #: rules that stand in for "the user-visible breach" when computing an
 #: incident's leading-indicator lead time: SLO burn for serving,

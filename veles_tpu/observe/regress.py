@@ -88,10 +88,21 @@ SCHEMA_VERSION = 1
 #: slower straggler detector regressed) and
 #: fleet_span_ship_overhead_ns rides "_ns" (the span ring growing its
 #: record-path tax is a regression).
+#: The serving goodput-observatory keys (observe/servescope.py, bench
+#: servescope_section): serve_goodput_fraction and the occupancy
+#: fraction use the higher-is-better default (less of the dispatched
+#: work being useful — or fewer lane-steps carrying a live request —
+#: is a regression; the bare "_fraction" stays higher-better, the
+#: fleetscope doctrine); "_waste_share" regresses UP — both the
+#: aggregate serve_waste_share and the per-cause
+#: serve_<cause>_waste_share keys, so a padding/overshoot/dead-slot
+#: cause quietly growing its share fails the gate even while
+#: tokens/sec holds; serve_scope_note_ns rides "_ns" (the accounting
+#: ring growing its record-path tax is a regression).
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness", "_compiles", "burn_rate", "_transitions",
-                 "_ns", "_anomaly_rate")
+                 "_ns", "_anomaly_rate", "_waste_share")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

@@ -166,9 +166,10 @@ def span_tree(trace):
 
 def main(argv=None):
     """``veles_tpu observe`` entry point: ``export-trace`` (Chrome
-    trace), ``fleet-trace`` (the merged fleet timeline), ``blackbox``
-    (flight-recorder dumps) and ``regress`` (the bench sentinel
-    gate)."""
+    trace), ``fleet-trace`` (the merged fleet timeline),
+    ``serve-trace`` (the per-slot serving occupancy timeline),
+    ``blackbox`` (flight-recorder dumps) and ``regress`` (the bench
+    sentinel gate)."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -198,6 +199,22 @@ def main(argv=None):
     fleet.add_argument("-o", "--output", default=None,
                        help="trace output path (default: "
                             "<artifact>.trace.json / fleet.trace.json)")
+    serve = sub.add_parser(
+        "serve-trace",
+        help="assemble the per-slot serving occupancy timeline + "
+             "request waterfalls into a Perfetto-loadable Chrome "
+             "trace (observe/servescope.py): a saved GET /debug/serve "
+             "payload, or --live URL of a serving surface")
+    serve.add_argument("artifact", nargs="?", default=None,
+                       help="saved /debug/serve JSON (or an artifact "
+                            "embedding one under 'servescope')")
+    serve.add_argument("--live", default=None, metavar="URL",
+                       help="fetch <URL>/debug/serve instead of a "
+                            "file")
+    serve.add_argument("-o", "--output", default=None,
+                       help="trace output path (default: "
+                            "<artifact>.trace.json / "
+                            "serve.trace.json)")
     blackbox = sub.add_parser(
         "blackbox",
         help="inspect flight-recorder black-box dumps (observe/"
@@ -255,6 +272,13 @@ def main(argv=None):
                          "--live URL")
         from veles_tpu.observe.fleetscope import fleet_trace_main
         return fleet_trace_main(args.artifact, live=args.live,
+                                output=args.output)
+    if args.command == "serve-trace":
+        if not args.artifact and not args.live:
+            parser.error("observe serve-trace needs an ARTIFACT or "
+                         "--live URL")
+        from veles_tpu.observe.servescope import serve_trace_main
+        return serve_trace_main(args.artifact, live=args.live,
                                 output=args.output)
     if args.command == "blackbox":
         from veles_tpu.observe.flight import blackbox_main

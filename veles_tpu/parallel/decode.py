@@ -683,6 +683,50 @@ def dispatch_program(fn, default):
     return getattr(fn, "program_name", default)
 
 
+# -- dispatched-work accounting (observe/servescope.py) -----------------------
+
+def admit_waste(bucket, lens, rows):
+    """Token decomposition of ONE admission dispatch: ``lens`` live
+    prompt/tail lengths prefilled into ``bucket``-position rows, the
+    group padded to ``rows`` rows with duplicates. Returns
+    ``(live, bucket_pad, group_dup)`` token counts — ONE definition
+    for the serving goodput observatory and its tests, owned by the
+    module that shapes the dispatch."""
+    lens = [int(n) for n in lens]
+    live = sum(lens)
+    pad = sum(int(bucket) - n for n in lens)
+    dup = (int(rows) - len(lens)) * int(bucket)
+    return live, pad, dup
+
+
+def span_overshoot_tokens(lens, span, chunk):
+    """Masked attended positions PAST each live slot's sequence across
+    one chunked decode dispatch: every lane-step attends ``span``
+    positions, a slot at length ``n`` is live to ``n + i`` at step
+    ``i`` — the rest is span-tile overshoot (exact zeros by the
+    masking contract, but dispatched work all the same). Exact sum of
+    ``max(0, span - (n + i))`` over ``i in 1..chunk`` per slot, in
+    closed form."""
+    span = int(span)
+    chunk = int(chunk)
+    total = 0
+    for n in lens:
+        d = span - int(n)
+        k = min(chunk, max(0, d - 1))
+        total += k * d - k * (k + 1) // 2
+    return total
+
+
+def page_overshoot_tokens(lens, pages, page_size, chunk):
+    """The paged twin of :func:`span_overshoot_tokens`: each live slot
+    gathers ``pages`` pages (``pages * page_size`` positions) per
+    step, live to its sequence length — the rest is page-bucket
+    overshoot (scratch rows and tail positions of partially-filled
+    pages)."""
+    return span_overshoot_tokens(lens, int(pages) * int(page_size),
+                                 chunk)
+
+
 # -- tensor-parallel decode (Megatron-style weight sharding) ------------------
 
 def _repack_block(blk, heads):

@@ -46,7 +46,9 @@ from veles_tpu.observe.flight import get_flight_recorder
 from veles_tpu.observe.metrics import (bridge, get_metrics_registry,
                                        publish_decoder,
                                        publish_serving_health)
+from veles_tpu.observe.history import get_metric_history
 from veles_tpu.observe.reqledger import get_request_ledger
+from veles_tpu.observe.servescope import get_serve_scope
 from veles_tpu.observe.slo import get_slo_engine, observe_request
 from veles_tpu.observe.tracing import (NULL_SPAN, TRACE_HEADER,
                                        current_context,
@@ -271,6 +273,7 @@ class ServingHealth:
         self._pool_ref = None
         self._slo_ref = None
         self._governor_ref = None
+        self._scope_ref = None
         self._latencies = {
             kind: collections.deque(maxlen=self.LATENCY_WINDOW)
             for kind in self.LATENCY_KINDS}
@@ -322,6 +325,18 @@ class ServingHealth:
         with self._lock:
             self._governor_ref = weakref.ref(governor) \
                 if governor is not None else None
+
+    def attach_servescope(self, scope):
+        """Mirror the serving goodput observatory's occupancy /
+        goodput / waste-share summary into the health snapshot
+        (weakly referenced, like the pool and the SLO engine) so
+        ``/healthz`` and the web-status serving cell answer
+        "occupancy N% · goodput N%" beside the survival counters."""
+        import weakref
+
+        with self._lock:
+            self._scope_ref = weakref.ref(scope) if scope is not None \
+                else None
 
     def retry_after_s(self, need=1):
         """The honest Retry-After price for this surface's 429/503s,
@@ -445,8 +460,14 @@ class ServingHealth:
                 else None
             governor = self._governor_ref() \
                 if self._governor_ref is not None else None
+            scope = self._scope_ref() if self._scope_ref is not None \
+                else None
         if pool is not None:
             snap["pool"] = pool.snapshot()
+        if scope is not None:
+            summary = scope.summary()
+            if summary is not None:
+                snap["servescope"] = summary
         if slo is not None:
             summary = slo.summary()
             if summary is not None:
@@ -501,7 +522,9 @@ class RESTfulAPI(Unit):
                                           QuietHandlerMixin,
                                           enable_metrics, read_body,
                                           serve_debug_history,
+                                          serve_debug_index,
                                           serve_debug_requests,
+                                          serve_debug_serve,
                                           serve_health, serve_metrics,
                                           start_server)
 
@@ -530,6 +553,10 @@ class RESTfulAPI(Unit):
                 if serve_debug_requests(self):
                     return
                 if serve_debug_history(self):
+                    return
+                if serve_debug_serve(self):
+                    return
+                if serve_debug_index(self):
                     return
                 if not serve_health(self, api.health):
                     self.send_error(404)
@@ -925,6 +952,15 @@ class ContinuousDecoder:
         #: bounded ring so a breaker trip can dump the tail that led
         #: to it (flight.py — one flag check + append per dispatch)
         self.flight = get_flight_recorder()
+        #: the serving goodput observatory (observe/servescope.py):
+        #: every admit/step/dispatch books its live vs padded vs
+        #: duplicate rows, span/page overshoot and dead-slot
+        #: lane-steps into the process scope — bounded, lock-free,
+        #: one flag check per dispatch (the flight-ring discipline);
+        #: breaker-rebuilt decoders keep accounting into the same
+        #: scope (rids carry over, so the slot timeline never
+        #: cross-talks)
+        self.scope = get_serve_scope()
         #: request-truth plane (observe/reqledger.py): when a ledger is
         #: attached (GenerateAPI wires the process ledger; rebuilds
         #: re-attach via _decoder_kwargs), every dispatch books its
@@ -1047,6 +1083,8 @@ class ContinuousDecoder:
                     del self._slot_req[slot]
                     self._free.append(slot)
                     self._release_slot_pages(slot)
+                    self.scope.note_slot_retire(rid,
+                                                reason="cancelled")
                     break
         del self._budget[rid]
         self.results.pop(rid, None)
@@ -1141,6 +1179,9 @@ class ContinuousDecoder:
             self.dispatch_counts["admit_requests"] += len(group)
             self.flight.note("admit", bucket=bucket, group=len(group),
                              ms=round(elapsed * 1000, 3))
+            self._note_scope_admit("dense", bucket, len(group),
+                                   len(rows),
+                                   [len(r[1]) for r in group], elapsed)
             if self.dispatch_log is not None:
                 self.dispatch_log.append(("admit", bucket, len(group)))
             if self.ledger is not None:
@@ -1155,11 +1196,37 @@ class ContinuousDecoder:
                 self._slot_req[slot] = rid
                 self._slot_len[slot] = len(prompt)
                 self.admitted_at[rid] = now
+                self.scope.note_slot_admit(slot, rid, "dense",
+                                           bucket=bucket,
+                                           trace=self._trace.get(rid))
 
     # -- paged admission (docs/paged_kv.md) -------------------------------
-    def _book_admit(self, kind, elapsed, group, bucket):
+    def _note_scope_admit(self, kind, bucket, group, rows, lens,
+                          elapsed):
+        """ONE copy of the goodput observatory's admission-waste
+        booking — the dense path and the paged ``_book_admit``
+        families share it, so the live/pad/duplicate decomposition
+        can never drift between engines. ``rows`` = padded group
+        size, ``lens`` = live prompt/tail lengths (empty for hit
+        admissions, which dispatch zero tokens)."""
+        if not self.scope.enabled:
+            return
+        from veles_tpu.parallel.decode import admit_waste
+        live, pad, dup = admit_waste(bucket, lens, rows)
+        self.scope.note_admit(kind, bucket, group, rows, live, pad,
+                              dup, elapsed)
+
+    def _book_admit(self, kind, elapsed, group, bucket, rows=None,
+                    lens=None):
         """Shared admission bookkeeping: timings, metrics, flight ring,
-        dispatch log — one copy for the cold/tail/hit families."""
+        dispatch log, the goodput observatory's waste decomposition
+        (``rows`` = padded group size, ``lens`` = live prompt/tail
+        lengths; a hit admission dispatches zero tokens) — one copy
+        for the cold/tail/hit families."""
+        lens = lens if lens is not None else []
+        self._note_scope_admit(kind, bucket, len(group),
+                               rows if rows is not None
+                               else len(group), lens, elapsed)
         self.timings["admit_s"] += elapsed
         self.metrics.observe(
             "veles_decode_admit_seconds", elapsed,
@@ -1280,7 +1347,9 @@ class ContinuousDecoder:
                     fold_keys(rows),
                     jnp.asarray([len(r[1]) for r in rows], jnp.int32))
                 elapsed = time.perf_counter() - t0
-            self._book_admit("cold", elapsed, group, bucket)
+            self._book_admit("cold", elapsed, group, bucket,
+                             rows=len(rows),
+                             lens=[len(r[1]) for r in group])
             if self.ledger is not None:
                 program, aot_served = self._dispatch_attribution(
                     admit, "paged.admit")
@@ -1289,6 +1358,9 @@ class ContinuousDecoder:
                 self._slot_len[slot] = len(prompt)
                 self._slot_pages[slot] = list(pages)
                 self.admitted_at[rid] = now
+                self.scope.note_slot_admit(slot, rid, "cold",
+                                           bucket=bucket,
+                                           trace=self._trace.get(rid))
                 if self.ledger is not None:
                     self.ledger.note_admit(
                         self._ledger_rows.get(rid), "cold",
@@ -1324,7 +1396,9 @@ class ContinuousDecoder:
                     tail_x, fold_keys(rows),
                     jnp.asarray([len(r[1]) for r in rows], jnp.int32))
                 elapsed = time.perf_counter() - t0
-            self._book_admit("tail", elapsed, group, tail_bucket)
+            self._book_admit("tail", elapsed, group, tail_bucket,
+                             rows=len(rows),
+                             lens=[len(r[1]) - r[4] for r in group])
             if self.ledger is not None:
                 program, aot_served = self._dispatch_attribution(
                     admit_tail, "paged.admit_tail")
@@ -1334,6 +1408,9 @@ class ContinuousDecoder:
                 self._slot_pages[slot] = list(entry["pages"]) \
                     + list(pages)
                 self.admitted_at[rid] = now
+                self.scope.note_slot_admit(slot, rid, "tail",
+                                           bucket=tail_bucket,
+                                           trace=self._trace.get(rid))
                 if self.ledger is not None:
                     self.ledger.note_admit(
                         self._ledger_rows.get(rid), "tail",
@@ -1362,7 +1439,8 @@ class ContinuousDecoder:
                     jnp.stack([r[3]["logits"] for r in rows]),
                     fold_keys(rows))
                 elapsed = time.perf_counter() - t0
-            self._book_admit("hit", elapsed, group, 0)
+            self._book_admit("hit", elapsed, group, 0,
+                             rows=len(rows))
             if self.ledger is not None:
                 program, aot_served = self._dispatch_attribution(
                     admit_hit, "paged.admit_hit")
@@ -1371,6 +1449,8 @@ class ContinuousDecoder:
                 self._slot_len[slot] = len(prompt)
                 self._slot_pages[slot] = list(entry["pages"])
                 self.admitted_at[rid] = now
+                self.scope.note_slot_admit(slot, rid, "hit",
+                                           trace=self._trace.get(rid))
                 if self.ledger is not None:
                     self.ledger.note_admit(
                         self._ledger_rows.get(rid), "hit",
@@ -1479,26 +1559,33 @@ class ContinuousDecoder:
         if not self._slot_req:
             return {}
         snapshot = dict(self._slot_req)
+        scope_lens = [self._slot_len[s] for s in snapshot] \
+            if self.scope.enabled else None
+        span = pb = 0
+        t0 = time.perf_counter()
         if self.paged:
             from veles_tpu.parallel.kv_pool import paged_slot_step
             step = (self._aot.paged_step if self._aot is not None
                     else self._paged_fns[3] if self._paged_fns
                     else paged_slot_step)
+            table = self._page_table_array(1)
+            pb = int(table.shape[1])
             self.state, emitted = step(
                 self.params, self.embed_table, self.heads, self.state,
-                self._page_table_array(1), jnp.asarray(self._active()),
+                table, jnp.asarray(self._active()),
                 jnp.float32(self.temperature or 1.0),
                 sample=bool(self.temperature), top_k=self.top_k)
         else:
             step = (self._aot.step if self._aot is not None
                     else self._sharded_fns[1] if self._sharded_fns
                     else slot_step)
+            span = self._attended_span(1)
             self.state, emitted = step(
                 self.params, self.embed_table, self.heads, self.state,
                 jnp.asarray(self._active()),
                 jnp.float32(self.temperature or 1.0),
                 sample=bool(self.temperature), top_k=self.top_k,
-                span=self._attended_span(1))
+                span=span)
         for slot in snapshot:
             self._slot_len[slot] += 1
         self.dispatch_counts["step"] += 1
@@ -1508,9 +1595,27 @@ class ContinuousDecoder:
             ledger_aot = self._dispatch_attribution(
                 step, "paged.step" if self.paged else "decode.step")[1]
         emitted = numpy.asarray(emitted)
+        if self.scope.enabled:
+            # the step path syncs inline, so the whole call is one
+            # decode-compute window; every active lane keeps its token
+            from veles_tpu.parallel.decode import (
+                page_overshoot_tokens, span_overshoot_tokens)
+            overshoot = (page_overshoot_tokens(scope_lens, pb,
+                                               self.page_size, 1)
+                         if self.paged
+                         else span_overshoot_tokens(scope_lens, span,
+                                                    1))
+            elapsed = time.perf_counter() - t0
+            self.scope.note_dispatch(1, self.slots, len(snapshot),
+                                     overshoot, elapsed,
+                                     paged=self.paged, span=span,
+                                     pages=pb)
+            self.scope.note_collect(len(snapshot), len(snapshot), 0.0)
         out = {}
         for slot, rid in snapshot.items():
             token = int(emitted[slot])
+            if not self.results[rid]:
+                self.scope.note_slot_first(rid)
             self.results[rid].append(token)
             out[rid] = token
             if ledger_aot is not None:
@@ -1528,6 +1633,7 @@ class ContinuousDecoder:
                 self._retire_trace(rid)
                 self._free.append(slot)
                 self._release_slot_pages(slot)
+                self.scope.note_slot_retire(rid)
         self.steps += 1
         return out
 
@@ -1580,6 +1686,7 @@ class ContinuousDecoder:
         if self.dispatch_log is not None:
             self.dispatch_log.append(("collect", emitted.shape[0]))
         out = {}
+        kept_total = 0
         for slot, rid in snapshot.items():
             if rid not in self._budget:
                 continue  # retired while this chunk was in flight
@@ -1588,6 +1695,9 @@ class ContinuousDecoder:
             tokens = stream[:keep]
             if self.eos is not None and self.eos in tokens:
                 tokens = tokens[:tokens.index(self.eos) + 1]
+            kept_total += len(tokens)
+            if tokens and not self.results[rid]:
+                self.scope.note_slot_first(rid)
             self.results[rid].extend(tokens)
             out[rid] = tokens
             if self.ledger is not None and tokens:
@@ -1607,10 +1717,18 @@ class ContinuousDecoder:
                 self.admitted_at.pop(rid, None)
                 self._ledger_rows.pop(rid, None)
                 self._retire_trace(rid)
+                self.scope.note_slot_retire(rid)
                 if self._slot_req.get(slot) == rid:
                     del self._slot_req[slot]
                     self._free.append(slot)
                     self._release_slot_pages(slot)
+        if self.scope.enabled:
+            # live lane-steps dispatched vs tokens actually delivered:
+            # the gap is the lag-1 retirement tails, budget clamps and
+            # post-eos positions — cause "discard"
+            self.scope.note_collect(
+                len(snapshot) * int(emitted.shape[0]), kept_total,
+                elapsed)
         return out
 
     def dispatch_chunk(self, chunk):
@@ -1627,6 +1745,9 @@ class ContinuousDecoder:
         if not self._slot_req:
             return None
         snapshot = dict(self._slot_req)
+        scope_lens = [self._slot_len[s] for s in snapshot] \
+            if self.scope.enabled else None
+        span = pb = 0
         # span writes stay outside the timed window (see decode.admit)
         with self._span("paged.dispatch" if self.paged
                         else "decode.dispatch",
@@ -1639,9 +1760,11 @@ class ContinuousDecoder:
                              if self._aot is not None
                              else self._paged_fns[4] if self._paged_fns
                              else paged_slot_step_many)
+                table = self._page_table_array(chunk)
+                pb = int(table.shape[1])
                 self.state, emitted = step_many(
                     self.params, self.embed_table, self.heads,
-                    self.state, self._page_table_array(chunk),
+                    self.state, table,
                     jnp.asarray(self._active()), chunk,
                     jnp.float32(self.temperature or 1.0),
                     sample=bool(self.temperature), top_k=self.top_k)
@@ -1651,13 +1774,26 @@ class ContinuousDecoder:
                              else self._sharded_fns[2]
                              if self._sharded_fns
                              else slot_step_many)
+                span = self._attended_span(chunk)
                 self.state, emitted = step_many(
                     self.params, self.embed_table, self.heads,
                     self.state, jnp.asarray(self._active()), chunk,
                     jnp.float32(self.temperature or 1.0),
                     sample=bool(self.temperature), top_k=self.top_k,
-                    span=self._attended_span(chunk))
+                    span=span)
             elapsed = time.perf_counter() - t0
+        if self.scope.enabled:
+            from veles_tpu.parallel.decode import (
+                page_overshoot_tokens, span_overshoot_tokens)
+            overshoot = (page_overshoot_tokens(scope_lens, pb,
+                                               self.page_size, chunk)
+                         if self.paged
+                         else span_overshoot_tokens(scope_lens, span,
+                                                    chunk))
+            self.scope.note_dispatch(chunk, self.slots, len(snapshot),
+                                     overshoot, elapsed,
+                                     paged=self.paged, span=span,
+                                     pages=pb)
         self.timings["dispatch_s"] += elapsed
         self.metrics.observe(
             "veles_decode_dispatch_seconds", elapsed,
@@ -1916,6 +2052,13 @@ class GenerateAPI:
             self.health.attach_pool(self.decoder.pool)
         if self.slo is not None:
             self.health.attach_slo(self.slo)
+        #: the serving goodput observatory (observe/servescope.py):
+        #: the decoder feeds the process scope per dispatch; the
+        #: driver books queue-empty idle and runs the waste/occupancy
+        #: autopsy OFF the record path; /healthz and the web-status
+        #: cell mirror its occupancy/goodput summary
+        self.scope = get_serve_scope()
+        self.health.attach_servescope(self.scope)
         #: closed-loop governor (observe/governor.py,
         #: root.common.serve.governor / --serve-governor): the control
         #: loop over the sensors above. None without config — the
@@ -2344,9 +2487,13 @@ class GenerateAPI:
                     # gap, or the first chunk of the next burst feeds
                     # the whole idle wall time into the step-time EMA
                     self.decoder._last_chunk_done = None
-                    if not self._wake.wait(timeout=0.05):
-                        continue
-                    self._wake.clear()
+                    idle_from = time.monotonic()
+                    woke = self._wake.wait(timeout=0.05)
+                    # queue-empty wall lands in the goodput
+                    # decomposition as idle, not host
+                    self.scope.note_idle(time.monotonic() - idle_from)
+                    if woke:
+                        self._wake.clear()
                     continue
                 try:
                     if self.chaos is not None:
@@ -2356,6 +2503,16 @@ class GenerateAPI:
                         self.decoder.collect_chunk(self._pending)
                     self._pending = current
                     self._note_progress(waiting)
+                    # the waste/occupancy autopsy (OFF the record
+                    # path): trend series + detector-owned anomaly
+                    # rules + a cooldown-limited incident naming the
+                    # dominant waste cause; a broken autopsy must
+                    # never take the driver down
+                    try:
+                        self.scope.autopsy_tick(get_metric_history())
+                    except Exception:
+                        import traceback
+                        traceback.print_exc()
                 except Exception as exc:  # device/runtime failure
                     import traceback
                     traceback.print_exc()
@@ -2372,7 +2529,9 @@ class GenerateAPI:
                                           QuietHandlerMixin, read_body,
                                           reply, retry_after_headers,
                                           serve_debug_history,
+                                          serve_debug_index,
                                           serve_debug_requests,
+                                          serve_debug_serve,
                                           serve_health, serve_metrics,
                                           start_server)
 
@@ -2405,6 +2564,10 @@ class GenerateAPI:
                 if serve_debug_requests(self, api.ledger):
                     return
                 if serve_debug_history(self):
+                    return
+                if serve_debug_serve(self, api.scope, api.ledger):
+                    return
+                if serve_debug_index(self):
                     return
                 if not serve_health(self, api.health):
                     self.send_error(404)
@@ -2578,10 +2741,17 @@ class GenerateAPI:
                     api.governor.observe_bucket(
                         decoder.bucket_for(len(prompt)))
                 staged_at = time.monotonic()
+                # slot-timeline linkage survives a disabled tracer:
+                # the client's propagated trace id (trace_hint) rides
+                # the holder so the occupancy entry still links to the
+                # request (span id None — there is no server span)
+                trace_ctx = ctx
+                if trace_ctx is None and trace_hint:
+                    trace_ctx = (trace_hint, None)
                 holder = {"event": threading.Event(),
                           "staged_at": staged_at,
                           "deadline": staged_at + deadline_s,
-                          "trace": req_span.context(),
+                          "trace": trace_ctx,
                           "ledger_row": row}
                 if booked.get("reserved"):
                     holder["pool"] = booked["pool"]

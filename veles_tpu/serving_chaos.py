@@ -37,6 +37,15 @@ full fidelity afterwards:
   CompileTracker, firing its storm detector (the governor's proactive
   breaker guard).
 
+**Waste profiles** (deterministic, step-indexed — the compile-storm
+injection idiom pointed at the serving goodput observatory,
+``observe/servescope.py``): ``waste_cause`` + ``waste_tokens`` +
+``waste_at`` + ``waste_steps`` book that many synthetic tokens of the
+named waste cause into the process ServeScope on each driver step
+inside the window, then clear — so the waste-share anomaly rule
+breaches and the incident artifact must name EXACTLY the injected
+cause (:meth:`ServingChaosConfig.expected_leading_cause`).
+
 The fault-inject and fault-clear instants land in ``stamps`` (mono
 clocks) so the bench can measure demote→recover wall time.
 
@@ -91,7 +100,9 @@ class ServingChaosConfig(ChaosConfigBase):
                  latency_ramp_ms=0.0, latency_ramp_steps=0,
                  latency_ramp_hold=0,
                  pool_flood_pages=0, pool_flood_at=0,
-                 pool_flood_steps=0, compile_storm_at=None):
+                 pool_flood_steps=0, compile_storm_at=None,
+                 waste_cause=None, waste_tokens=0, waste_at=0,
+                 waste_steps=0):
         self._set_probabilities(
             step_fail=step_fail, slow_step=slow_step,
             disconnect=disconnect, garbage_body=garbage_body,
@@ -121,13 +132,28 @@ class ServingChaosConfig(ChaosConfigBase):
             if compile_storm_at < 0:
                 raise ValueError("compile_storm_at must be >= 0")
         self.compile_storm_at = compile_storm_at
+        if waste_cause is not None:
+            from veles_tpu.observe.servescope import WASTE_CAUSES
+            if waste_cause not in WASTE_CAUSES:
+                raise ValueError(
+                    "waste_cause must be one of %s, got %r"
+                    % (", ".join(WASTE_CAUSES), waste_cause))
+        self.waste_cause = waste_cause
+        self.waste_tokens = int(waste_tokens)
+        self.waste_at = int(waste_at)
+        self.waste_steps = int(waste_steps)
+        if self.waste_tokens < 0 or self.waste_at < 0 \
+                or self.waste_steps < 0:
+            raise ValueError("waste profile knobs must be >= 0")
 
     @property
     def any_profile(self):
-        """True when a burn-inducing profile is configured."""
+        """True when a burn-inducing or waste profile is configured."""
         return bool((self.latency_ramp_ms and self.latency_ramp_steps)
                     or self.pool_flood_pages
-                    or self.compile_storm_at is not None)
+                    or self.compile_storm_at is not None
+                    or (self.waste_cause and self.waste_tokens
+                        and self.waste_steps))
 
     def expected_leading_series(self):
         """The metric series each configured burn profile is expected
@@ -145,7 +171,18 @@ class ServingChaosConfig(ChaosConfigBase):
             out["pool_flood"] = "veles_kv_pages_reserved"
         if self.compile_storm_at is not None:
             out["compile_storm"] = "veles_xla_recompile_storms_total"
+        if self.waste_cause and self.waste_tokens and self.waste_steps:
+            out["waste_profile"] = "veles_serve_waste_share"
         return out
+
+    def expected_leading_cause(self):
+        """The waste cause the configured waste profile injects — what
+        the serving goodput observatory's incident artifact must name
+        as ``dominant_cause`` (tests and the bench assert against
+        exactly this), or None without a waste profile."""
+        if self.waste_cause and self.waste_tokens and self.waste_steps:
+            return self.waste_cause
+        return None
 
 
 class ServingChaosMonkey(Logger):
@@ -162,7 +199,8 @@ class ServingChaosMonkey(Logger):
         self.counters = {"steps_failed": 0, "steps_slowed": 0,
                          "disconnects": 0, "garbage_bodies": 0,
                          "oversize_bodies": 0, "ramp_stalls": 0,
-                         "pool_floods": 0, "compile_storms": 0}
+                         "pool_floods": 0, "compile_storms": 0,
+                         "waste_injections": 0}
         #: driver-step index: the burn profiles are step-indexed, so a
         #: (config, workload) pair replays the same fault schedule
         self._step = 0
@@ -198,7 +236,11 @@ class ServingChaosMonkey(Logger):
             pool_flood_pages=cfg.get("pool_flood_pages", 0),
             pool_flood_at=cfg.get("pool_flood_at", 0),
             pool_flood_steps=cfg.get("pool_flood_steps", 0),
-            compile_storm_at=cfg.get("compile_storm_at", None))
+            compile_storm_at=cfg.get("compile_storm_at", None),
+            waste_cause=cfg.get("waste_cause", None),
+            waste_tokens=cfg.get("waste_tokens", 0),
+            waste_at=cfg.get("waste_at", 0),
+            waste_steps=cfg.get("waste_steps", 0))
         if not cfg.get("enabled",
                        config.any_enabled or config.any_profile):
             return None
@@ -278,6 +320,21 @@ class ServingChaosMonkey(Logger):
             elif self._flood_pages is not None \
                     and step >= cfg.pool_flood_at + cfg.pool_flood_steps:
                 self.release_flood()
+        if cfg.waste_cause and cfg.waste_tokens and cfg.waste_steps:
+            if cfg.waste_at <= step < cfg.waste_at + cfg.waste_steps:
+                # synthetic waste of the NAMED cause into the process
+                # ServeScope (the compile-storm injection idiom): the
+                # waste-share rule must breach and the incident must
+                # name exactly this cause — deterministic per step
+                from veles_tpu.observe.servescope import \
+                    get_serve_scope
+                get_serve_scope().inject_waste(cfg.waste_cause,
+                                               cfg.waste_tokens)
+                self.counters["waste_injections"] += 1
+                if step == cfg.waste_at:
+                    self.stamps["waste_start"] = time.monotonic()
+            elif step == cfg.waste_at + cfg.waste_steps:
+                self.stamps.setdefault("waste_clear", time.monotonic())
         if cfg.compile_storm_at is not None \
                 and step == cfg.compile_storm_at:
             from veles_tpu.observe.xla_stats import get_compile_tracker

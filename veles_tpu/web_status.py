@@ -242,6 +242,23 @@ def format_serving_health(serving):
         parts.append("burn %.1fx (%s/%s)"
                      % (slo["burn_rate"], slo.get("objective"),
                         slo.get("window")))
+    scope = serving.get("servescope")
+    if isinstance(scope, dict):
+        # the goodput-observatory pair (observe/servescope.py): slot
+        # occupancy and the useful share of dispatched tokens — the
+        # "was the chip time worth it" cell beside the burn rate
+        occupancy = scope.get("occupancy")
+        if isinstance(occupancy, (int, float)):
+            parts.append("occupancy %d%%" % round(occupancy * 100))
+        goodput = scope.get("goodput")
+        if isinstance(goodput, (int, float)):
+            parts.append("goodput %d%%" % round(goodput * 100))
+        cause = scope.get("dominant_cause")
+        share = scope.get("waste_share")
+        if cause and isinstance(share, (int, float)) and share >= 0.25:
+            # only call the cause out once waste is worth a look
+            parts.append("waste %d%% (%s)" % (round(share * 100),
+                                              cause))
     pool = serving.get("pool")
     if isinstance(pool, dict):
         # the paged-KV pair (docs/paged_kv.md): page occupancy and the
@@ -402,9 +419,12 @@ class WebStatusServer(Logger):
 
     def start(self):
         from http.server import BaseHTTPRequestHandler
-        from veles_tpu.core.httpd import (BodyTooLarge, enable_metrics,
+        from veles_tpu.core.httpd import (DEBUG_SURFACES, BodyTooLarge,
+                                          enable_metrics,
                                           QuietHandlerMixin, read_body,
                                           reply, serve_debug_history,
+                                          serve_debug_index,
+                                          serve_debug_serve,
                                           serve_metrics, start_server)
 
         enable_metrics()
@@ -433,6 +453,15 @@ class WebStatusServer(Logger):
                 if serve_metrics(self):
                     pass
                 elif serve_debug_history(self):
+                    pass
+                elif serve_debug_serve(self):
+                    pass
+                elif serve_debug_index(self, surfaces={
+                        path: text for path, text
+                        in DEBUG_SURFACES.items()
+                        if path != "/debug/requests"}):
+                    # the index lists what THIS server mounts (the
+                    # dashboard has no request-ledger endpoint)
                     pass
                 elif self.path.startswith("/service"):
                     reply(self, server.statuses())
