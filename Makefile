@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
 	regress mesh paged fleet-mr aot slo governor history analyze \
-	fleetscope servescope
+	fleetscope servescope deploy
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -169,6 +169,22 @@ fleetscope:
 servescope:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_servescope.py \
 		-m servescope -q
+
+# Zero-downtime deploy suite (docs/zero_downtime.md): the live weight
+# hot-swap seam (outputs change, rollback restores bit-identically,
+# poisoned checkpoints refused with the old weights still serving,
+# zero 5xx across the swap window), the blue-green rollback
+# predicate's edge cases under an explicit clock (idle-green no
+# verdict, blue-baseline suppression, breach-streak + dwell
+# hysteresis), torn/tampered executable-cache entries refused loudly
+# once and repaired, and the chaos acceptances — a seeded bad-green
+# ramp auto-rolls back naming the leading indicator in the incident
+# artifact with zero shed and blue streams bit-identical; a clean
+# green promotes. (The engine-booting chaos cases ride the `slow`
+# marker so tier-1 keeps its timeout margin; this target runs them.)
+deploy:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_deploy.py \
+		-m deploy -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

@@ -1479,11 +1479,14 @@ def coldstart_child(kind, bundle=None, cfg=None):
     the keys stay CI-comparable wherever the bench runs):
     ``kind="build"`` writes the bundle; ``kind="live"`` boots a
     serving decoder by tracing + compiling, ``kind="aot"`` by loading
-    the bundle — time to the first generated chunk, then a warmup over
-    every prompt bucket, then the XLA compile tally the decode
-    programs booked (``observe/xla_stats``). Prints one JSON line;
-    the AOT child's ``compiles == 0`` is the device-truth zero-retrace
-    proof the regression sentinel pins."""
+    the bundle, ``kind="cached"`` by loading it through the persistent
+    executable cache (the sibling ``<bundle>.xcache/`` —
+    docs/zero_downtime.md) — time to the first generated chunk, then a
+    warmup over every prompt bucket, then the XLA compile tally the
+    decode programs booked (``observe/xla_stats``). Prints one JSON
+    line; the AOT child's ``compiles == 0`` is the device-truth
+    zero-retrace proof the regression sentinel pins, and the cached
+    child's ``aot.compiled_live == 0`` is the cache-hit proof."""
     import time
 
     cfg = dict(COLDSTART_CFG, **(cfg or {}))
@@ -1514,12 +1517,26 @@ def coldstart_child(kind, bundle=None, cfg=None):
             "bytes": os.path.getsize(bundle)}
         print(json.dumps(out))
         return out
+    if kind == "warm":
+        # `veles_tpu aot warm-cache`'s path: compile EVERY program
+        # synchronously and persist the executables, so the cached
+        # twin measures a fully-warm boot (a serving boot's lazy
+        # prefetch can exit before the tail of the bundle is stored)
+        from veles_tpu.aot.loader import load_bundle
+        t0 = time.perf_counter()
+        programs = load_bundle(bundle, eager=True, prefetch=False,
+                               exec_cache=True)
+        out = dict(programs.stats(),
+                   warm_ms=round((time.perf_counter() - t0) * 1000.0,
+                                 1))
+        print(json.dumps(out))
+        return out
     prompt = rng.randint(0, cfg["vocab"], 12)
     t0 = time.perf_counter()
     aot = None
-    if kind == "aot":
+    if kind in ("aot", "cached"):
         from veles_tpu.aot.loader import load_bundle
-        aot = load_bundle(bundle)
+        aot = load_bundle(bundle, exec_cache=(kind == "cached"))
     dec = ContinuousDecoder(params, table, cfg["heads"],
                             slots=cfg["slots"], max_len=cfg["max_len"],
                             n_tokens=cfg["n_tokens"], aot=aot)
@@ -1596,6 +1613,15 @@ def coldstart_section(repeats=2):
     aot = child("aot")
     if not live or not aot:
         return {}
+    # persistent executable cache (docs/zero_downtime.md): the
+    # warm-cache pass compiles + persists every program into the
+    # sibling <bundle>.xcache/, then fresh twins measure the cached
+    # boot — every decode program must come from the cache
+    # (compiled_live pinned 0; the regress sentinel watches the _ms
+    # key).
+    cached = None
+    if child("warm", runs=1) is not None:
+        cached = child("cached")
     out = {
         "coldstart_live_to_first_token_ms": live["first_token_ms"],
         "coldstart_to_first_token_ms": aot["first_token_ms"],
@@ -1611,6 +1637,19 @@ def coldstart_section(repeats=2):
                             % (cfg["blocks"], cfg["embed"],
                                cfg["slots"], cfg["max_len"]),
     }
+    if cached:
+        stats = cached.get("aot") or {}
+        xc = stats.get("exec_cache") or {}
+        out.update({
+            "coldstart_cached_to_first_token_ms":
+                cached["first_token_ms"],
+            "coldstart_cached_compiles": cached["compiles"],
+            "coldstart_cached_from_cache": stats.get("from_cache"),
+            "coldstart_cached_compiled_live": stats.get(
+                "compiled_live"),
+            "coldstart_cached_hits": xc.get("hits"),
+            "coldstart_cached_rejects": xc.get("rejects"),
+        })
     return out
 
 
@@ -2285,6 +2324,90 @@ def governor_section():
     return out
 
 
+def deploy_section(swaps=3):
+    """Zero-downtime deploy bench (docs/zero_downtime.md): hot-swap
+    live weights under sustained client traffic and measure the SEAM,
+    not throughput —
+
+    - ``deploy_swap_ms``: request_swap -> drain -> weight install ->
+      probe decode -> resume, best wall time over ``swaps`` swaps
+      (lower-better via the ``_ms`` regress rule);
+    - ``deploy_swap_shed_requests``: non-200 responses observed by a
+      client hammering /generate across every swap window — the
+      zero-downtime contract pins this at 0 (the ``_shed_requests``
+      regress rule watches the direction; a 0 baseline passes the
+      ratio gate vacuously, so tests/test_deploy.py enforces the pin
+      as a hard assert too).
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import GenerateAPI
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 32, 64
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.1)
+    versions = [init_transformer_params(
+        numpy.random.RandomState(7 + i), 2, embed, heads, vocab)
+        for i in range(swaps)]
+    api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                      n_tokens=5, chunk=2, port=0)
+    api.start()
+    url = "http://127.0.0.1:%d/generate" % api.port
+    shed = []
+    served = [0]
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            req = urllib.request.Request(
+                url, data=json.dumps({"tokens": [1, 2, 3]}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+                served[0] += 1
+            except urllib.error.HTTPError as exc:
+                shed.append(exc.code)
+            except Exception:
+                if not stop.is_set():
+                    shed.append(-1)
+
+    out = {}
+    client = threading.Thread(target=pound)
+    try:
+        client.start()
+        deadline = time.monotonic() + 30
+        while not served[0] and time.monotonic() < deadline:
+            time.sleep(0.01)  # warm the decode programs first
+        best_ms = None
+        for i, new_params in enumerate(versions):
+            t0 = time.perf_counter()
+            api.swap_params(new_params, version="bench-v%d" % (i + 2))
+            swap_ms = (time.perf_counter() - t0) * 1000.0
+            if best_ms is None or swap_ms < best_ms:
+                best_ms = swap_ms
+            time.sleep(0.1)  # traffic between swap windows
+        out = {
+            "deploy_swap_ms": round(best_ms, 1),
+            "deploy_swap_shed_requests": len(shed),
+            "deploy_swap_served_requests": served[0],
+            "deploy_swaps": api.health.counter("param_swaps"),
+            "deploy_config": "swaps=%d,slots=2,embed=%d" % (swaps,
+                                                            embed),
+        }
+    finally:
+        stop.set()
+        client.join(60)
+        api.stop()
+    return out
+
+
 def history_section():
     """Metric flight recorder bench (docs/observability.md): the cost
     of always-on trend memory, and how fast it notices a fault —
@@ -2483,6 +2606,12 @@ def serve_main(profile_dir=None, artifact_path=None):
             # fault->demote->recover wall time, transition count and
             # per-tier SLO attainment under a seeded latency ramp
             section = _guarded(governor_section, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # zero-downtime deploys (docs/zero_downtime.md): hot-swap
+            # wall time under live traffic, with the shed-request
+            # count pinned 0 (the zero-downtime contract)
+            section = _guarded(deploy_section, fallback={})
             out.update(section)
             artifact.update(section)
             # the metric flight recorder (docs/observability.md):

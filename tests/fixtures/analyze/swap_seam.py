@@ -1,0 +1,22 @@
+"""Seeded ``deploy.swap-seam`` violation: a handler-side hot patch
+clobbers the live decoder's weights directly instead of routing the
+swap through the drive loop's drained seam."""
+
+
+class ToyDecoder:
+    def __init__(self, params, embed_table):
+        self.params = params            # sanctioned: pre-publication
+        self.embed_table = embed_table
+
+    def swap_params(self, new_params):
+        old = self.params
+        self.params = new_params        # sanctioned: the seam itself
+        return old
+
+
+class ToyHandler:
+    def __init__(self, decoder):
+        self.decoder = decoder
+
+    def hot_patch(self, new_params):
+        self.decoder.params = new_params  # analyze-expect: deploy.swap-seam

@@ -42,6 +42,7 @@ RULE_FIXTURES = [
     ("retrace.shape-key", "shape_key.py"),
     ("donation.read-after-dispatch", "donation.py"),
     ("shared.rmw", "shared_rmw.py"),
+    ("deploy.swap-seam", "swap_seam.py"),
     ("metric.naming", "metric_naming.py"),
     ("metric.help", "metric_help.py"),
 ]

@@ -5,6 +5,7 @@ fleet protocol, the gradient merge inside the tick psums over the
 ICI-role mesh."""
 
 import threading
+import time
 
 import jax
 
@@ -91,14 +92,27 @@ class TestFleetPod:
     def test_two_pod_slaves_converge(self):
         """Two slaves, each running data=2 over its own device pair —
         the full DCN x ICI composition — must reach the same accuracy
-        class as a single slave."""
-        kw = _kw(max_epochs=4)
+        class as a single slave.
+
+        Two scheduling coin flips are pinned here (the test used to
+        fail ~50%): (a) 8 epochs, not 4 — at 4 the async two-slave
+        interleaving only reaches the <=40 bound when the connect race
+        starves one slave (measured: even 13/12 splits land at 50-73
+        errors, by epoch 8 every interleaving lands at 21-27); (b) s2
+        is held back until s1 has completed its first job, so neither
+        slave can drain the whole job stream before the other
+        connects."""
+        kw = _kw(max_epochs=8)
         master, wf_m, thread = _run_master(kw)
         s1, w1 = _run_pod_slave(master.agent.port, kw, jax.devices()[:2])
         s2, w2 = _run_pod_slave(master.agent.port, kw,
                                 jax.devices()[2:4])
         t1 = threading.Thread(target=s1.run, daemon=True)
         t1.start()
+        deadline = time.time() + 60
+        while s1.agent.jobs_done == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert s1.agent.jobs_done > 0, "s1 never completed a job"
         s2.run()
         t1.join(120)
         thread.join(120)

@@ -59,6 +59,23 @@ class TestPackage:
         with pytest.raises(ValueError):
             pkg.validate_manifest({"name": "x", "workflow": "w",
                                    "requires": ["numpy", "numpy>=1"]})
+        # the version is a server path component AND a deploy/SLO
+        # identity: reject traversal-shaped versions at pack time
+        with pytest.raises(ValueError, match="version"):
+            pkg.validate_manifest({"name": "x", "workflow": "w",
+                                   "version": "../2.0"})
+
+    def test_deploy_version_identity(self):
+        """``deploy_version`` is the string rollouts/incidents stamp —
+        name@version, server-default 1.0 when the manifest omits it."""
+        manifest = {"name": "toy-model", "workflow": "w.py",
+                    "version": "2.0"}
+        assert pkg.deploy_version(manifest) == "toy-model@2.0"
+        assert pkg.deploy_version({"name": "toy-model",
+                                   "workflow": "w.py"}) == "toy-model@1.0"
+        with pytest.raises(ValueError, match="version"):
+            pkg.deploy_version({"name": "toy-model", "workflow": "w.py",
+                                "version": "v 2"})
 
     def test_unpack_rejects_traversal(self, tmp_path):
         buf = io.BytesIO()

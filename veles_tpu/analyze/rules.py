@@ -13,6 +13,9 @@ Every rule encodes an invariant a past PR paid for:
 - ``shared.rmw`` — the thread-shared-state census: non-GIL-atomic
   read-modify-write on declared handler+driver classes must hold the
   class lock;
+- ``deploy.swap-seam`` — the zero-downtime deploy doctrine (ISSUE 16):
+  live weights are only rebound inside the drain seam
+  (``__init__``/``swap_params``), never reached into from outside;
 - ``metric.naming`` / ``metric.help`` — PR 5's Prometheus grammar
   (promoted from ``tests/test_observe.py::TestMetricNamingLint``) plus
   HELP-string presence per family.
@@ -807,6 +810,84 @@ class SharedRmwRule(Rule):
         return None
 
 
+# -- zero-downtime deploys (ISSUE 16's drain-seam doctrine) ----------------
+
+#: the live-weight attributes a serving engine exposes
+_WEIGHT_ATTRS = {"params", "embed_table"}
+#: the only methods sanctioned to write them on ``self``: the
+#: constructor (no concurrency before publication) and the drain-seam
+#: swap itself
+_SEAM_METHODS = {"__init__", "swap_params"}
+
+
+class SwapSeamRule(Rule):
+    """``deploy.swap-seam``: live weights (``.params`` /
+    ``.embed_table``) may only be written inside the drain seam. The
+    serving drive loop reads them on every dispatch; a handler thread
+    (or governor callback) assigning ``decoder.params = new`` races
+    requests mid-decode onto half-swapped weights. The sanctioned
+    writers are ``__init__`` (no concurrency before publication) and
+    the object's own ``swap_params`` — which the drive loop invokes
+    via ``request_swap`` only once both engines are drained. Reaching
+    through another object (``self.decoder.params = ...``) is never
+    sanctioned: route it through ``request_swap()``."""
+
+    id = "deploy.swap-seam"
+    family = "deploy"
+    doc = ("live weights may only be written at the drain seam "
+           "(__init__/swap_params on self; request_swap otherwise)")
+
+    def check_file(self, path, tree, lines):
+        findings = []
+
+        def visit(node, fn_name):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                fn_name = node.name
+            for target in self._write_targets(node):
+                findings.append(self._judge(path, target, fn_name))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_name)
+
+        visit(tree, None)
+        return [f for f in findings if f is not None]
+
+    @staticmethod
+    def _write_targets(node):
+        """Attribute targets of assignments to a weight attribute."""
+        if isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = []
+            for t in node.targets:
+                targets.extend(t.elts if isinstance(t, ast.Tuple)
+                               else [t])
+        else:
+            return ()
+        return [t for t in targets
+                if isinstance(t, ast.Attribute)
+                and t.attr in _WEIGHT_ATTRS]
+
+    def _judge(self, path, target, fn_name):
+        owner = target.value
+        on_self = isinstance(owner, ast.Name) and owner.id == "self"
+        if on_self and fn_name in _SEAM_METHODS:
+            return None
+        dotted = _dotted(target) or target.attr
+        if on_self:
+            detail = ("an engine may only rebind its own weights in "
+                      "__init__ or swap_params")
+        else:
+            detail = ("reaching into another object's live weights "
+                      "races the drive loop mid-dispatch — call "
+                      "request_swap() so the swap lands at the "
+                      "drained seam")
+        return Finding(
+            self.id, path, target.lineno,
+            "write to %s outside the drain seam — %s"
+            % (dotted, detail))
+
+
 # -- metric hygiene (PR 5's grammar, promoted from the test suite) ---------
 
 #: stricter than METRIC_NAME_RE: the repo convention is lowercase
@@ -937,4 +1018,4 @@ def default_rules():
             UnpinnedOutShardingsRule(), LocalJitDispatchRule(),
             UnhashableStaticRule(), JitInLoopRule(), ShapeKeyRule(),
             DonationReadAfterDispatchRule(), SharedRmwRule(),
-            MetricNamingRule(), MetricHelpRule()]
+            SwapSeamRule(), MetricNamingRule(), MetricHelpRule()]

@@ -7,7 +7,11 @@
 - ``inspect``: print a bundle's manifest summary;
 - ``verify``: check the sidecar, the content-addressed members and the
   compatibility gate against THIS machine — exit 0 loadable, 1 refused
-  (stale field named), 2 unreadable/tampered.
+  (stale field named), 2 unreadable/tampered;
+- ``warm-cache``: compile every program NOW and persist the
+  executables into the on-disk cache beside the bundle
+  (``aot/exec_cache.py``, docs/zero_downtime.md), so the next boot on
+  this machine deserializes instead of compiling.
 """
 
 import argparse
@@ -127,6 +131,37 @@ def _verify(args):
     return 0
 
 
+def _warm_cache(args):
+    from veles_tpu.aot.loader import AotCompatError, load_bundle
+    from veles_tpu.aot.artifact import read_bundle
+    from veles_tpu.serving import build_serve_mesh
+
+    try:
+        manifest, _ = read_bundle(args.bundle)
+    except (OSError, ValueError) as exc:
+        print("UNREADABLE: %s" % exc)
+        return 2
+    mesh = None
+    try:
+        if args.mesh:
+            mesh = build_serve_mesh(args.mesh)
+        elif manifest.get("mesh") is not None:
+            mesh = build_serve_mesh(
+                dict(manifest["mesh"].get("axes") or {}))
+    except ValueError as exc:
+        print("REFUSED: mesh: %s" % exc)
+        return 1
+    try:
+        programs = load_bundle(args.bundle, mesh=mesh, eager=True,
+                               prefetch=False,
+                               exec_cache=args.cache or True)
+    except AotCompatError as exc:
+        print("REFUSED: %s: %s" % (exc.field, exc))
+        return 1
+    print(json.dumps(programs.stats(), indent=1, sort_keys=True))
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="veles_tpu aot")
     sub = parser.add_subparsers(dest="action", required=True)
@@ -176,6 +211,17 @@ def main(argv=None):
     verify.add_argument("--mesh", default=None,
                         metavar="AXIS=N[,AXIS=N...]")
     verify.set_defaults(func=_verify)
+
+    warm = sub.add_parser("warm-cache", help="compile every program "
+                          "and persist the executables into the "
+                          "on-disk cache beside the bundle")
+    warm.add_argument("bundle")
+    warm.add_argument("--mesh", default=None,
+                      metavar="AXIS=N[,AXIS=N...]")
+    warm.add_argument("--cache", default=None, metavar="DIR",
+                      help="cache directory (default: "
+                      "<bundle>.xcache beside the bundle)")
+    warm.set_defaults(func=_warm_cache)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -175,15 +175,23 @@ class _Entry:
     parallel compile, not a queue."""
 
     __slots__ = ("row", "blob", "mesh", "compiled", "compile_seconds",
-                 "lock")
+                 "lock", "cache", "cache_key", "from_cache")
 
-    def __init__(self, row, blob, mesh):
+    def __init__(self, row, blob, mesh, cache=None, cache_key=None):
         self.row = row
         self.blob = blob
         self.mesh = mesh
         self.compiled = None
         self.compile_seconds = 0.0
         self.lock = threading.Lock()
+        #: persistent executable cache (aot/exec_cache.py) + this
+        #: program's content address in it; None = cache disabled
+        self.cache = cache
+        self.cache_key = cache_key
+        #: True when the executable was deserialized from the
+        #: persistent cache instead of XLA-compiled (the bench's
+        #: load-time-compiles-pinned-zero proof reads this)
+        self.from_cache = False
 
     def get(self):
         if self.compiled is not None:
@@ -191,8 +199,18 @@ class _Entry:
         with self.lock:
             if self.compiled is None:
                 t0 = time.perf_counter()
-                compiled = _compile_entry(self.row, self.blob,
-                                          self.mesh)
+                compiled = None
+                if self.cache is not None \
+                        and self.cache_key is not None:
+                    compiled = self.cache.load(self.cache_key)
+                    if compiled is not None:
+                        self.from_cache = True
+                if compiled is None:
+                    compiled = _compile_entry(self.row, self.blob,
+                                              self.mesh)
+                    if self.cache is not None \
+                            and self.cache_key is not None:
+                        self.cache.store(self.cache_key, compiled)
                 self.compile_seconds = time.perf_counter() - t0
                 _tally_wall(self.compile_seconds)
                 self.blob = None  # the executable replaces the bytes
@@ -205,13 +223,15 @@ class AotPrograms:
     dispatch stats, and the decoder-binding facade."""
 
     def __init__(self, manifest, entries, path=None,
-                 load_seconds=0.0):
+                 load_seconds=0.0, exec_cache=None):
         self.manifest = manifest
         self.path = path
         self.geometry = manifest.get("geometry")
         self.chunk = manifest.get("chunk")
         self._entries = entries         # (name, key tuple) -> _Entry
         self.load_seconds = load_seconds
+        #: persistent executable cache in use, or None (exec_cache.py)
+        self.exec_cache = exec_cache
         self._lock = threading.Lock()
         self._prefetchers = []
         self._prefetch_stop = threading.Event()
@@ -350,15 +370,25 @@ class AotPrograms:
     def stats(self):
         compiled = sum(1 for e in self._entries.values()
                        if e.compiled is not None)
+        from_cache = sum(1 for e in self._entries.values()
+                         if e.from_cache)
         compile_seconds = sum(e.compile_seconds
                               for e in self._entries.values())
         with self._lock:
-            return {"programs": len(self._entries),
-                    "compiled": compiled,
-                    "compile_seconds": round(compile_seconds, 4),
-                    "load_seconds": round(self.load_seconds, 4),
-                    "hits": dict(self.hits),
-                    "misses": dict(self.misses)}
+            out = {"programs": len(self._entries),
+                   "compiled": compiled,
+                   # executables deserialized from the persistent
+                   # cache vs XLA-compiled live this process — the
+                   # cached-boot "load-time compiles pinned 0" proof
+                   "from_cache": from_cache,
+                   "compiled_live": compiled - from_cache,
+                   "compile_seconds": round(compile_seconds, 4),
+                   "load_seconds": round(self.load_seconds, 4),
+                   "hits": dict(self.hits),
+                   "misses": dict(self.misses)}
+        if self.exec_cache is not None:
+            out["exec_cache"] = self.exec_cache.stats()
+        return out
 
     # -- serving facade ---------------------------------------------------
     def bind(self, decoder):
@@ -609,7 +639,8 @@ def install_fused_tick(programs, specs, norm_type="none", mesh=None,
     return steps
 
 
-def load_bundle(path, mesh=None, eager=False, prefetch=True):
+def load_bundle(path, mesh=None, eager=False, prefetch=True,
+                exec_cache=None):
     """Read, gate and load a bundle. Returns :class:`AotPrograms`.
     Raises :class:`AotCompatError` (stale bundle, named field) or
     ``ValueError`` (tampered/torn bundle) — in both cases nothing
@@ -622,18 +653,36 @@ def load_bundle(path, mesh=None, eager=False, prefetch=True):
     ``eager=True`` instead blocks until everything is compiled (the
     pre-warmed replica); ``prefetch=False`` disables the background
     threads (deterministic tests). Every path compiles from serialized
-    StableHLO — zero Python tracing in all cases."""
+    StableHLO — zero Python tracing in all cases.
+
+    ``exec_cache`` enables the persistent executable cache
+    (``aot/exec_cache.py``): ``True`` = the conventional
+    ``<bundle>.xcache`` sibling directory, a string = that directory,
+    ``False`` = off, ``None`` (default) = resolve from
+    ``root.common.serve.aot_cache``. With a warm cache a matching
+    machine deserializes executables instead of XLA-compiling them —
+    ``coldstart_cached_to_first_token_ms`` approaches pure weight
+    load. A torn or mismatching entry is refused loudly and that
+    program compiles live (docs/zero_downtime.md)."""
+    from veles_tpu.aot.exec_cache import (cache_fingerprint, entry_key,
+                                          resolve_cache)
+
     t0 = time.perf_counter()
     manifest, members = read_bundle(path)
     check_compat(manifest, mesh=mesh)
+    cache = resolve_cache(exec_cache, path)
+    fingerprint = cache_fingerprint(mesh) if cache is not None else None
     entries = {}
     for row in manifest.get("programs", ()):
         entries[(row["name"], tuple(row["key"]))] = _Entry(
-            row, members[row["member"]], mesh)
+            row, members[row["member"]], mesh, cache=cache,
+            cache_key=(entry_key(row, fingerprint)
+                       if cache is not None else None))
     load_seconds = time.perf_counter() - t0
     _tally_wall(load_seconds)
     programs = AotPrograms(manifest, entries, path=path,
-                           load_seconds=load_seconds)
+                           load_seconds=load_seconds,
+                           exec_cache=cache)
     if eager:
         programs.compile_all()
     elif prefetch:
@@ -674,3 +723,22 @@ def publish_aot_stats(registry):
             "veles_aot_misses_total", count,
             labels={"program": name},
             help="dispatches that fell back to live compilation")
+    from veles_tpu.aot.exec_cache import totals as xc_totals
+    xc = xc_totals()
+    if any(xc.values()):
+        registry.counter_set(
+            "veles_aot_exec_cache_hits_total", xc["hits"],
+            help="executables deserialized from the persistent "
+                 "executable cache instead of XLA-compiled")
+        registry.counter_set(
+            "veles_aot_exec_cache_misses_total", xc["misses"],
+            help="persistent-executable-cache lookups that fell "
+                 "back to live XLA compilation")
+        registry.counter_set(
+            "veles_aot_exec_cache_writes_total", xc["writes"],
+            help="executables serialized into the persistent "
+                 "executable cache")
+        registry.counter_set(
+            "veles_aot_exec_cache_rejects_total", xc["rejects"],
+            help="torn/tampered persistent-cache entries refused "
+                 "by the sha256 sidecar check")

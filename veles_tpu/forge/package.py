@@ -45,6 +45,12 @@ def validate_manifest(manifest):
             raise ValueError("manifest is missing %r" % field)
     if not _NAME_RE.match(manifest["name"]):
         raise ValueError("invalid package name %r" % manifest["name"])
+    version = manifest.get("version")
+    if version is not None and not _NAME_RE.match(str(version)):
+        # the version becomes a server filesystem component, an SLO
+        # label value and a rollout/incident identity — fail at pack
+        # time, not at upload (server) or deploy (serving) time
+        raise ValueError("invalid package version %r" % version)
     requires = manifest.get("requires", [])
     if not isinstance(requires, list) \
             or not all(isinstance(r, str) for r in requires):
@@ -60,6 +66,20 @@ def validate_manifest(manifest):
             raise ValueError("%r listed in 'requires' twice" % project)
         seen.add(project)
     return manifest
+
+
+def deploy_version(manifest):
+    """The canonical deploy identity of a package: ``name@version``
+    (version defaulting to the server's ``1.0``). This is the string
+    zero-downtime deploys stamp everywhere one rollout must be
+    traceable end to end — ``GenerateAPI.begin_rollout(version=...)``,
+    the SLO engine's per-version burn slices, the rollback incident
+    artifact and the ledger's governor actuations all carry it, so an
+    operator can join "which package" to "which incident" without a
+    side channel."""
+    validate_manifest(manifest)
+    return "%s@%s" % (manifest["name"],
+                      str(manifest.get("version") or "1.0"))
 
 
 def pack(directory, out_path=None):

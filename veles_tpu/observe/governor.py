@@ -388,6 +388,15 @@ class ServingGovernor(Logger):
             self._decay_buckets(now)
         return True
 
+    def note_deploy(self, action, api, reason="", **attrs):
+        """Book a deploy-plane actuation (veles_tpu/rollout.py:
+        traffic shifts, rollbacks, suppressions, promotes) through
+        the SAME ledger as tier transitions — every rollout decision
+        is a governor actuation, visible in /debug/governor and the
+        flight ring beside the demotes it may have raced."""
+        self.counters[action] = self.counters.get(action, 0) + 1
+        self._note(action, api, reason=reason, **attrs)
+
     def _note(self, action, api, burn=None, reason="", **attrs):
         """Book one ledger-visible actuation: transition history,
         counters already bumped by the caller, flight-recorder ring."""

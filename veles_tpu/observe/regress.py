@@ -98,11 +98,16 @@ SCHEMA_VERSION = 1
 #: serve_<cause>_waste_share keys, so a padding/overshoot/dead-slot
 #: cause quietly growing its share fails the gate even while
 #: tokens/sec holds; serve_scope_note_ns rides "_ns" (the accounting
-#: ring growing its record-path tax is a regression).
+#: ring growing its record-path tax is a regression);
+#: "_shed_requests" regresses UP (deploy_swap_shed_requests is pinned
+#: at 0 — any shed across the swap window breaks the zero-downtime
+#: contract, enforced as a hard assert in tests/test_deploy.py since
+#: a 0 baseline passes the ratio gate vacuously).
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness", "_compiles", "burn_rate", "_transitions",
-                 "_ns", "_anomaly_rate", "_waste_share")
+                 "_ns", "_anomaly_rate", "_waste_share",
+                 "_shed_requests")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

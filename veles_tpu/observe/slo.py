@@ -190,9 +190,12 @@ class SLOEngine:
         return tenant
 
     def record(self, ttft_s=None, tpot_s=None, ok=True, tenant="",
-               now=None):
+               version="", now=None):
         """Book one resolved request into the current bucket (the
-        aggregate series plus, when ``tenant`` is set, its slice)."""
+        aggregate series plus, when ``tenant`` is set, its slice, and
+        when ``version`` is set, the deploy-version slice the
+        blue-green rollback predicate compares —
+        veles_tpu/rollout.py)."""
         if now is None:
             now = time.monotonic()
         start = now - now % self.bucket_seconds
@@ -216,13 +219,20 @@ class SLOEngine:
                     self._tenants &= live
             cells = self._buckets[-1][1]
             tenant_key = self._tenant_key(tenant)
+            # version slices are tagged with a TUPLE second element so
+            # they can never collide with a tenant literally named
+            # "blue"/"green" (bounded: two live versions at most)
             for objective in self.objectives:
                 good, counted = objective.classify(ttft_s, tpot_s, ok)
                 if not counted:
                     continue
-                for key in ((objective.name, None),) + (
-                        ((objective.name, tenant_key),)
-                        if tenant_key else ()):
+                keys = [(objective.name, None)]
+                if tenant_key:
+                    keys.append((objective.name, tenant_key))
+                if version:
+                    keys.append((objective.name,
+                                 ("version", str(version)[:64])))
+                for key in keys:
                     cell = cells.setdefault(key, [0, 0])
                     cell[0] += int(good)
                     cell[1] += 1
@@ -252,17 +262,25 @@ class SLOEngine:
                     cell = sums.setdefault(key, [0, 0])
                     cell[0] += good
                     cell[1] += total
-            for (objective, tenant), (good, total) in sorted(
+            for (objective, tag), (good, total) in sorted(
                     sums.items(), key=lambda kv: (kv[0][0],
-                                                  kv[0][1] or "")):
+                                                  str(kv[0][1] or ""))):
                 if not total:
                     continue
+                # tag: None = aggregate, str = tenant slice,
+                # ("version", v) = deploy-version slice
+                tenant = version = None
+                if isinstance(tag, tuple):
+                    version = tag[1]
+                else:
+                    tenant = tag
                 ratio = good / total
                 budget = 1.0 - by_target[objective]
                 burn = (1.0 - ratio) / budget if budget > 0 else 0.0
                 rows.append({
                     "objective": objective,
                     "tenant": tenant,
+                    "version": version,
                     "window": "%ds" % int(window),
                     "ratio": round(ratio, 6),
                     "error_budget_remaining": round(1.0 - burn, 6),
@@ -270,6 +288,43 @@ class SLOEngine:
                     "count": total,
                 })
         return rows
+
+    def version_burn(self, version, now=None):
+        """The deploy-version slice's worst burn over the SHORTEST
+        window (the rollback predicate's sensor — same shape and cost
+        as :meth:`summary`, filtered to the version's cells), or None
+        without traffic on that slice."""
+        if now is None:
+            now = time.monotonic()
+        window = self.windows[0]
+        horizon = now - window
+        tag = ("version", str(version)[:64])
+        sums = {}
+        with self._lock:
+            for start, cells in self._buckets:
+                if start + self.bucket_seconds <= horizon:
+                    continue
+                for (objective, cell_tag), (good, total) \
+                        in cells.items():
+                    if cell_tag != tag:
+                        continue
+                    cell = sums.setdefault(objective, [0, 0])
+                    cell[0] += good
+                    cell[1] += total
+        worst = None
+        for objective in self.objectives:
+            good, total = sums.get(objective.name, (0, 0))
+            if not total:
+                continue
+            budget = 1.0 - objective.target
+            burn = (1.0 - good / total) / budget if budget > 0 else 0.0
+            burn = round(burn, 6)
+            if worst is None or burn > worst["burn_rate"]:
+                worst = {"burn_rate": burn,
+                         "objective": objective.name,
+                         "window": "%ds" % int(window),
+                         "count": total}
+        return worst
 
     def summary(self, now=None):
         """The dashboard cell AND the governor's per-tick sensor: the
@@ -323,6 +378,8 @@ class SLOEngine:
                           "window": row["window"]}
                 if row["tenant"] is not None:
                     labels["tenant"] = row["tenant"]
+                if row.get("version") is not None:
+                    labels["version"] = row["version"]
                 out.append((labels, row[key]))
             return out
 
@@ -427,7 +484,8 @@ def observe_request(row, engine=None, registry=None, health=None):
     ok = row.get("outcome") == "completed"
     if engine is not None:
         engine.record(ttft_s=ttft, tpot_s=tpot, ok=ok,
-                      tenant=row.get("tenant") or "")
+                      tenant=row.get("tenant") or "",
+                      version=row.get("deploy") or "")
     if health is not None and tpot is not None:
         health.record_latency("tpot", tpot)
     if registry is not None and registry.enabled:

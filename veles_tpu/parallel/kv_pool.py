@@ -691,6 +691,22 @@ class PagePool:
         self.release(entry["pages"])
         return True
 
+    def flush_prefix_cache(self):
+        """Drop EVERY prefix entry (and its page refs) — the weight
+        hot-swap seam (docs/zero_downtime.md): cached pages hold KV
+        bytes and logits computed under the OLD weights, so one
+        reused prefix after a swap would splice stale activations
+        into new-weight streams. Pages still mapped by live slots
+        stay resident until those slots retire (they finish on the
+        old weights by the drain contract). Returns the number of
+        entries dropped."""
+        dropped = 0
+        with self._lock:
+            while self._evict_lru():
+                dropped += 1
+            self.cache.page_shadow.clear()
+        return dropped
+
     # -- admission reservations (pool-aware backpressure) -----------------
     def try_reserve(self, n):
         """Reserve worst-case page demand for one admission: the sum of

@@ -274,6 +274,7 @@ class ServingHealth:
         self._slo_ref = None
         self._governor_ref = None
         self._scope_ref = None
+        self._deploy_ref = None
         self._latencies = {
             kind: collections.deque(maxlen=self.LATENCY_WINDOW)
             for kind in self.LATENCY_KINDS}
@@ -354,6 +355,18 @@ class ServingHealth:
         if pool is not None:
             return pool.retry_after(need)
         return 1.0
+
+    def attach_deploy(self, api):
+        """Mirror the deploy state — the serving weights' version
+        stamp and, while a blue-green rollout is live, its
+        ``snapshot()`` — into the health snapshot (weakly referenced,
+        like the pool) so ``/healthz`` answers "which weights, and is
+        a rollout ramping" (docs/zero_downtime.md)."""
+        import weakref
+
+        with self._lock:
+            self._deploy_ref = weakref.ref(api) if api is not None \
+                else None
 
     def attach_pool(self, pool):
         """Mirror a paged KV pool's occupancy/prefix-cache state into
@@ -462,6 +475,13 @@ class ServingHealth:
                 if self._governor_ref is not None else None
             scope = self._scope_ref() if self._scope_ref is not None \
                 else None
+            deploy = self._deploy_ref() \
+                if self._deploy_ref is not None else None
+        if deploy is not None:
+            snap["version"] = getattr(deploy, "version", None)
+            rollout = getattr(deploy, "_rollout", None)
+            if rollout is not None:
+                snap["rollout"] = rollout.snapshot()
         if pool is not None:
             snap["pool"] = pool.snapshot()
         if scope is not None:
@@ -921,6 +941,13 @@ class ContinuousDecoder:
         self.results = {}        # request id -> [token, ...]
         self.admitted_at = {}    # request id -> monotonic admit stamp
         self._next_id = 0
+        #: deploy identity (docs/zero_downtime.md): the version tag
+        #: these weights serve under (hot-swap / rollout stamps it)
+        #: and the blue-green role ("green" on a rollout's candidate
+        #: engine) — the chaos bad-deploy profiles and the ledger's
+        #: version stamping key off both
+        self.version = None
+        self.rollout_role = None
         self.steps = 0
         self.tokens_out = 0
         self.cancelled = 0
@@ -1027,6 +1054,79 @@ class ContinuousDecoder:
             self._done_trace[rid] = trace
             while len(self._done_trace) > 4 * self.slots + 8:
                 self._done_trace.popitem(last=False)
+
+    def swap_params(self, new_params, new_embed_table=None):
+        """Live weight hot-swap (docs/zero_downtime.md): replace the
+        weights IN PLACE — slots, pools, compiled programs and the
+        request-id counter all survive; only the parameter leaves
+        change. The checkpoint arrives in whatever layout it was
+        saved in (typically the train layout); on a serving mesh it
+        moves onto the live leaves' exact serve placement via
+        :func:`~veles_tpu.parallel.reshard.reshard` (pure data
+        movement — bit-exact, arxiv 2112.01075), so every compiled
+        program keeps its layout contract without retracing.
+
+        Caller contract (``GenerateAPI._apply_swap``): the decoder is
+        IDLE — drained behind the breaker's drain-then-swap seam —
+        and the caller keeps the returned ``(old_params,
+        old_embed_table)`` pair as the one-slot rollback stash (a
+        failed probe decode on the new weights restores it through
+        this same method, an identity reshard). The prefix cache is
+        flushed HERE: cached pages hold KV bytes computed under the
+        OLD weights.
+
+        Raises ValueError when the checkpoint's tree structure, leaf
+        shapes or dtypes do not match the serving params — a
+        mismatched swap would invalidate every compiled program, so
+        it is refused up front (the ACT capability-gate lesson) and
+        the old weights keep serving."""
+        import jax
+
+        from veles_tpu.parallel.decode import quantize_params
+
+        if self.quantize and not isinstance(new_params["head"], dict):
+            # quantize the FULL weights before any placement — the
+            # constructor's order, so each shard's int8 payload is
+            # bit-identical to a cold boot on the same checkpoint
+            new_params = quantize_params(new_params)
+        new_table = (new_embed_table if new_embed_table is not None
+                     else self.embed_table)
+        old_leaves, old_tree = jax.tree.flatten(
+            (self.params, self.embed_table))
+        new_leaves, new_tree = jax.tree.flatten(
+            (new_params, new_table))
+        if old_tree != new_tree:
+            raise ValueError(
+                "swap refused: checkpoint tree structure does not "
+                "match the serving params (%s vs %s)"
+                % (new_tree, old_tree))
+        paths = jax.tree_util.tree_flatten_with_path(
+            (self.params, self.embed_table))[0]
+        for (path, old_leaf), new_leaf in zip(paths, new_leaves):
+            if tuple(old_leaf.shape) != tuple(new_leaf.shape) \
+                    or old_leaf.dtype != new_leaf.dtype:
+                raise ValueError(
+                    "swap refused: leaf %s is %s%s in the checkpoint "
+                    "but %s%s live — a mismatched swap would "
+                    "invalidate every compiled program"
+                    % (jax.tree_util.keystr(path), new_leaf.dtype,
+                       tuple(new_leaf.shape), old_leaf.dtype,
+                       tuple(old_leaf.shape)))
+        if self.mesh is not None:
+            # train -> serve layout transition: target each live
+            # leaf's exact placement, so sharded swap tokens equal
+            # single-chip swap tokens and no program recompiles
+            from veles_tpu.parallel.reshard import reshard
+            dst = jax.tree.unflatten(
+                old_tree, [leaf.sharding.spec for leaf in old_leaves])
+            (new_params, new_table), _ = reshard(
+                (new_params, new_table), self.mesh, dst, label="swap")
+        old = (self.params, self.embed_table)
+        self.params = new_params
+        self.embed_table = new_table
+        if self.pool is not None:
+            self.pool.flush_prefix_cache()
+        return old
 
     def submit(self, prompt_tokens, n_tokens=None, trace=None):
         """Queue one prompt (1-D int sequence); returns the request id.
@@ -1868,6 +1968,27 @@ class ContinuousDecoder:
                            % max_steps)
 
 
+def _non_finite_leaf(tree):
+    """The keypath of the first floating weight leaf containing a
+    non-finite value, or None when clean — the deploy gate's
+    poisoned-checkpoint check (docs/zero_downtime.md). Evaluated
+    device-side per leaf (one scalar readback each), so a sharded
+    checkpoint is never gathered to the host. Integer leaves (int8
+    tier payloads) cannot hold NaN and are skipped."""
+    import jax
+    import jax.numpy as jnp
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        dtype = getattr(leaf, "dtype", None)
+        # issubdtype, not numpy kind: bfloat16 registers as a custom
+        # (void-kind) numpy dtype but is a jnp.floating subtype
+        if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+            continue
+        if not bool(jnp.isfinite(jnp.asarray(leaf)).all()):
+            return jax.tree_util.keystr(path)
+    return None
+
+
 class GenerateAPI:
     """HTTP front for :class:`ContinuousDecoder` — the LLM analogue of
     :class:`RESTfulAPI` (which serves per-tick forward passes, the
@@ -2059,6 +2180,9 @@ class GenerateAPI:
         #: cell mirror its occupancy/goodput summary
         self.scope = get_serve_scope()
         self.health.attach_servescope(self.scope)
+        # deploy state on /healthz: the weight version stamp and a
+        # live rollout's snapshot (docs/zero_downtime.md)
+        self.health.attach_deploy(self)
         #: closed-loop governor (observe/governor.py,
         #: root.common.serve.governor / --serve-governor): the control
         #: loop over the sensors above. None without config — the
@@ -2085,6 +2209,22 @@ class GenerateAPI:
         self._tier_block_until = 0.0
         #: the governor's proactive-trip request (actuator d)
         self._trip_request = None
+        #: zero-downtime deploy plane (docs/zero_downtime.md): the
+        #: pending request_swap() holder — driver-applied behind the
+        #: SAME drain-then-swap seam as the tier request — the
+        #: one-slot rollback stash (raw params of the version the
+        #: last successful swap/promote replaced, for rollback_swap)
+        #: and the serving version tag
+        self._swap_request = None
+        self._param_stash = None
+        self.version = None
+        #: blue-green rollout (veles_tpu/rollout.py): the staged
+        #: begin_rollout() holder, the live rollout controller, and
+        #: the green engine bundle {"decoder", "waiting", "pending",
+        #: "params", "embed_table"} — all driver-thread owned
+        self._rollout_request = None
+        self._rollout = None
+        self._green = None
         self._staged = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -2124,6 +2264,13 @@ class GenerateAPI:
             observe_request(row, engine=self.slo,
                             registry=get_metrics_registry(),
                             health=self.health)
+        rollout = self._rollout
+        if rollout is not None and "deploy" in holder:
+            # the rollback predicate's per-role request feed (bounded
+            # deque appends — safe from this thread or the handler's
+            # backstop)
+            rollout.note_resolved(holder["deploy"],
+                                  outcome == "completed")
         holder["event"].set()
 
     def _drain_staged(self):
@@ -2135,6 +2282,21 @@ class GenerateAPI:
                 prompt, budget, holder = self._staged.get_nowait()
             except queue.Empty:
                 break
+            # blue-green routing (veles_tpu/rollout.py): while a
+            # rollout is live, the tenant's FIXED hash point against
+            # the current fraction picks the engine — green tenants
+            # submit into the candidate decoder and book into its own
+            # waiting map, blue tenants stay on the primary path
+            # byte-for-byte (the bit-identity contract)
+            rollout = self._rollout
+            green = self._green
+            target, bucket, role = self.decoder, waiting, None
+            if green is not None and rollout is not None:
+                role = ("green" if rollout.routes_green(
+                    holder.get("tenant") or "") else "blue")
+                if role == "green":
+                    target = green["decoder"]
+                    bucket = green["waiting"]
             # the request may have been admitted (worst-case pages
             # reserved) against a PREVIOUS decoder's pool with a
             # breaker rebuild racing its staging: move the reservation
@@ -2145,7 +2307,7 @@ class GenerateAPI:
             # the fresh pool).
             reserved = holder.pop("pool_reserved", 0)
             if reserved:
-                pool = self.decoder.pool
+                pool = target.pool
                 if pool is not None and holder.get("pool") is not pool:
                     holder["pool"].unreserve(reserved)
                     if pool.try_reserve(reserved):
@@ -2170,8 +2332,8 @@ class GenerateAPI:
                     # and found nothing to release — release here
                     holder["pool"].unreserve(reserved)
             try:
-                rid = self.decoder.submit(prompt, budget,
-                                          trace=holder.get("trace"))
+                rid = target.submit(prompt, budget,
+                                    trace=holder.get("trace"))
             except ValueError as exc:
                 # belt-and-braces: the handler pre-validated, but a
                 # failed submit must never kill the driver thread —
@@ -2187,7 +2349,7 @@ class GenerateAPI:
                 # the handler's pre-swap snapshot — re-stamp it here so
                 # every demoted request's row truthfully names its tier
                 # (and a promote-raced row drops back to the base tier)
-                served_tier = self.decoder.quantize or "bf16"
+                served_tier = target.quantize or "bf16"
                 row["quant"] = served_tier
                 if served_tier != self._base_tier:
                     if row.get("tier") != served_tier:
@@ -2195,10 +2357,20 @@ class GenerateAPI:
                                          tier=served_tier)
                 elif row.get("tier"):
                     row["tier"] = served_tier
-            self.decoder.ledger_link(rid, row)
+            if role is not None:
+                # deploy attribution: the role feeds the per-version
+                # SLO slices (observe_request -> slo.record) and the
+                # rollback predicate; the version names the weights
+                holder["deploy"] = role
+                if row is not None:
+                    row["deploy"] = role
+                    row["version"] = (rollout.version
+                                      if role == "green"
+                                      else self.version or "blue")
+            target.ledger_link(rid, row)
             get_tracer().event("serve.submit",
                                parent=holder.get("trace"), rid=rid)
-            waiting[rid] = holder
+            bucket[rid] = holder
         return waiting
 
     def _fail_all(self, waiting, message, outcome="errors", code=503):
@@ -2216,16 +2388,20 @@ class GenerateAPI:
                 return
             self._resolve(holder, outcome, error=message, code=code)
 
-    def _expire_deadlines(self, waiting):
+    def _expire_deadlines(self, waiting, decoder=None):
         """Cancel every request whose deadline passed: the decoder slot
         frees immediately, the results entry is reaped, the client gets
-        a 504 — a timed-out handler no longer leaks either."""
+        a 504 — a timed-out handler no longer leaks either.
+        ``decoder`` defaults to the primary engine; a rollout's green
+        engine passes its own (each engine expires its own map)."""
+        if decoder is None:
+            decoder = self.decoder
         now = time.monotonic()
         for rid in [r for r, h in waiting.items()
                     if h.get("deadline") is not None
                     and now >= h["deadline"]]:
             holder = waiting.pop(rid)
-            self.decoder.cancel(rid)
+            decoder.cancel(rid)
             get_tracer().event("serve.expire",
                                parent=holder.get("trace"), rid=rid)
             self._resolve(holder, "expired", error="deadline exceeded",
@@ -2249,6 +2425,19 @@ class GenerateAPI:
         # a pending graceful swap is moot: the rebuild below lands on
         # the governed tier directly (_governed_kwargs)
         self._tier_request = None
+        # pending deploy operations resolve with the trip (their
+        # callers must not block out the timeout), and a live rollout
+        # aborts — the breaker rebuild only reconstructs the PRIMARY
+        # engine, so green requests would otherwise starve
+        for pending in (self._swap_request, self._rollout_request):
+            if pending is not None:
+                pending["error"] = "breaker tripped: %s" % exc
+                pending["event"].set()
+        self._swap_request = None
+        self._rollout_request = None
+        if self._green is not None:
+            self._abort_green(
+                "blue breaker tripped during rollout: %s" % exc)
         self._tripped = "decode driver failed: %s; rebuilding" % exc
         self._fail_all(waiting, self._tripped, outcome="shed", code=503)
 
@@ -2351,6 +2540,11 @@ class GenerateAPI:
         swap's backoff is armed, and idempotent at the live tier."""
         if time.monotonic() < self._tier_block_until:
             return
+        if self._green is not None or self._rollout_request is not None:
+            # one deploy-plane operation at a time: a tier rebuild
+            # would race the rollout's two-engine bookkeeping; the
+            # governor simply re-requests after the rollout lands
+            return
         if tier == (self.decoder.quantize or "bf16"):
             self._tier_request = None
             return
@@ -2361,6 +2555,271 @@ class GenerateAPI:
         top of the next drive pass (shed retryably + rebuild behind
         the probe) — a predicted stall is handled like a real one."""
         self._trip_request = reason
+
+    # -- zero-downtime deploy seams (docs/zero_downtime.md) ---------------
+    def request_swap(self, new_params, new_embed_table=None,
+                     version=None):
+        """Stage a live weight hot-swap: the driver stops admitting,
+        drains every in-flight request on the OLD weights (nobody is
+        shed), then swaps + probes behind the breaker's
+        drain-then-swap seam (:meth:`_apply_swap`). Returns the
+        request holder — its ``event`` sets when the swap landed or
+        was refused; ``error`` carries the refusal. Latest-wins: a
+        newer request supersedes an unapplied one (which resolves
+        with an error). Refused while a blue-green rollout is live —
+        one deploy-plane operation at a time."""
+        if self._green is not None or self._rollout_request is not None:
+            holder = {"event": threading.Event(),
+                      "error": "refused: a blue-green rollout is in "
+                               "progress"}
+            holder["event"].set()
+            return holder
+        holder = {"event": threading.Event(), "params": new_params,
+                  "embed_table": new_embed_table, "version": version}
+        previous, self._swap_request = self._swap_request, holder
+        if previous is not None:
+            previous["error"] = "superseded by a newer swap request"
+            previous["event"].set()
+        self._wake.set()
+        return holder
+
+    def swap_params(self, new_params, new_embed_table=None,
+                    version=None, timeout=120.0):
+        """Blocking :meth:`request_swap`: True when the new weights
+        serve; raises RuntimeError with the refusal reason (the old
+        weights still serving — a refused swap sheds nothing) or on
+        timeout."""
+        holder = self.request_swap(new_params, new_embed_table,
+                                   version=version)
+        if not holder["event"].wait(timeout):
+            raise RuntimeError("weight swap timed out after %.0fs"
+                               % timeout)
+        if "error" in holder:
+            raise RuntimeError(holder["error"])
+        return True
+
+    def rollback_swap(self, timeout=120.0):
+        """Swap back to the version the last successful swap (or
+        rollout promote) replaced — the operator's one-step undo,
+        served from the one-slot stash through the same drain seam."""
+        if self._param_stash is None:
+            raise RuntimeError("nothing to roll back to")
+        params, embed_table, version = self._param_stash
+        return self.swap_params(params, embed_table, version=version,
+                                timeout=timeout)
+
+    def begin_rollout(self, new_params, new_embed_table=None,
+                      version="green", config=None, timeout=120.0):
+        """Start a blue-green rollout: build + probe a SECOND engine
+        on the new weights, shift tenant slices onto it along the
+        configured fraction ladder, and auto-roll back when the green
+        slice's burn/ttft trend breaks from the blue baseline
+        (veles_tpu/rollout.py). Blocks until the green engine passed
+        (or refused) its probe; returns the
+        :class:`~veles_tpu.rollout.BlueGreenRollout` controller."""
+        if self._swap_request is not None:
+            raise RuntimeError("refused: a weight hot-swap is pending")
+        holder = {"event": threading.Event(), "params": new_params,
+                  "embed_table": new_embed_table, "version": version,
+                  "config": config}
+        previous, self._rollout_request = self._rollout_request, holder
+        if previous is not None:
+            previous["error"] = "superseded by a newer rollout request"
+            previous["event"].set()
+        self._wake.set()
+        if not holder["event"].wait(timeout):
+            raise RuntimeError("rollout start timed out after %.0fs"
+                               % timeout)
+        if "error" in holder:
+            raise RuntimeError(holder["error"])
+        return holder["rollout"]
+
+    def _apply_swap(self, holder):
+        """The live weight hot-swap (driver thread; both engines
+        idle): validate the checkpoint, swap behind the drain seam,
+        probe the new weights end to end, and on ANY failure restore
+        the old pair atomically from the one-slot stash. No request
+        is shed on either path — the staged queue held while the
+        swap was pending and drains into whichever weights won."""
+        flight = get_flight_recorder()
+        new_params = holder["params"]
+        new_table = holder.get("embed_table")
+        if self.chaos is not None:
+            new_params = self.chaos.maybe_poison_swap(new_params)
+        old = None
+        probe = None
+        try:
+            bad = _non_finite_leaf(new_params if new_table is None
+                                   else (new_params, new_table))
+            if bad is not None:
+                raise ValueError("non-finite weights at %s — the "
+                                 "checkpoint is poisoned" % bad)
+            old = self.decoder.swap_params(new_params, new_table)
+            probe = self.decoder.submit([0], 1)
+            before = (self.chaos.before_step
+                      if self.chaos is not None else None)
+            self.decoder.run_until_drained(max_steps=8,
+                                           chunk=self.chunk,
+                                           before_step=before)
+            if not self.decoder.done(probe):
+                raise RuntimeError("probe decode did not finish")
+            self.decoder.results.pop(probe, None)
+            probe = None
+        except Exception as exc:
+            import traceback
+            traceback.print_exc()
+            if probe is not None:
+                try:
+                    self.decoder.cancel(probe)
+                except Exception:
+                    pass
+            if old is not None:
+                # the one-slot rollback: restore the old pair through
+                # the same seam (an identity reshard — 0 bytes move)
+                try:
+                    self.decoder.swap_params(old[0], old[1])
+                except Exception as restore_exc:
+                    # old weights unrestorable on top of a failed
+                    # swap: this device state is not trustworthy —
+                    # trip and rebuild from the held raw params
+                    self.request_trip("weight-swap rollback failed: %s"
+                                      % restore_exc)
+            self.health.incr("swap_failures")
+            flight.note("deploy.swap_refused", error=str(exc)[:200],
+                        version=str(holder.get("version")))
+            try:
+                from veles_tpu.rollout import note_swap_failure
+                note_swap_failure(str(exc),
+                                  version=holder.get("version"))
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            holder["error"] = ("swap refused, old weights serving: %s"
+                               % exc)
+            holder["event"].set()
+            return False
+        # success: the new checkpoint is authoritative for every
+        # future breaker rebuild, and the replaced raw params become
+        # the one-slot rollback stash
+        self._param_stash = (self._decoder_kwargs["params"],
+                             self._decoder_kwargs["embed_table"],
+                             self.version)
+        self._decoder_kwargs["params"] = holder["params"]
+        if new_table is not None:
+            self._decoder_kwargs["embed_table"] = new_table
+        self.version = holder.get("version")
+        self.decoder.version = self.version
+        self.health.incr("param_swaps")
+        flight.note("deploy.swap", version=str(self.version))
+        holder["event"].set()
+        return True
+
+    def _start_green(self, holder):
+        """Build + probe the green engine for a blue-green rollout
+        (driver thread). The green decoder shares the primary
+        engine's AOT bundle, mesh and compiled-program caches but NOT
+        its KV pool or prefix cache (old-weight KV must never serve
+        green streams); its request ids sit 2^20 above blue's so
+        ledger rows and slot timelines never collide."""
+        from veles_tpu.rollout import BlueGreenRollout, RolloutConfig
+
+        if self._green is not None:
+            holder["error"] = "a rollout is already in progress"
+            holder["event"].set()
+            return
+        kwargs = dict(self._decoder_kwargs)
+        kwargs["params"] = holder["params"]
+        if holder.get("embed_table") is not None:
+            kwargs["embed_table"] = holder["embed_table"]
+        try:
+            bad = _non_finite_leaf((kwargs["params"],
+                                    kwargs["embed_table"]))
+            if bad is not None:
+                raise ValueError("non-finite weights at %s — the "
+                                 "checkpoint is poisoned" % bad)
+            decoder = self._build_probed_decoder(kwargs)
+        except Exception as exc:
+            import traceback
+            traceback.print_exc()
+            self.health.incr("rollout_failures")
+            get_flight_recorder().note("deploy.green_refused",
+                                       error=str(exc)[:200])
+            holder["error"] = "green build/probe refused: %s" % exc
+            holder["event"].set()
+            return
+        decoder._next_id = self.decoder._next_id + (1 << 20)
+        decoder.rollout_role = "green"
+        decoder.version = holder.get("version") or "green"
+        config = holder.get("config")
+        if config is None:
+            config = RolloutConfig.from_config()
+        self._green = {"decoder": decoder, "waiting": {},
+                       "pending": None, "params": holder["params"],
+                       "embed_table": holder.get("embed_table")}
+        self._rollout = BlueGreenRollout(decoder.version,
+                                         config=config)
+        self._rollout.start(api=self)
+        self.health.incr("rollouts")
+        holder["rollout"] = self._rollout
+        holder["event"].set()
+
+    def _abort_green(self, reason):
+        """Tear the green engine down NOW (engine failure / blue
+        breaker trip): green in-flight requests shed retryably — the
+        zero-shed contract covers governed rollbacks, where green
+        drains first; it cannot cover an engine that died — and the
+        rollout lands in ``rolled_back`` with the reason."""
+        green, self._green = self._green, None
+        if green is None:
+            return
+        for holder in list(green["waiting"].values()):
+            self._resolve(holder, "shed", error=str(reason), code=503)
+        green["waiting"].clear()
+        if self._rollout is not None:
+            self._rollout.abort(reason, api=self)
+        self.health.incr("rollout_aborts")
+        get_flight_recorder().note("deploy.abort",
+                                   reason=str(reason)[:200])
+
+    def _rollout_step(self, waiting):
+        """Drive the rollout's engine-surgery transitions (driver
+        thread): finalize a rollback once green drained (zero shed —
+        every green in-flight request finished first), and promote
+        once the ladder reached full traffic and blue drained (the
+        green decoder BECOMES the primary; the replaced weights go to
+        the rollback stash)."""
+        rollout, green = self._rollout, self._green
+        if rollout is None or green is None:
+            return
+        gdec = green["decoder"]
+        if rollout.state == "rolling_back":
+            if not gdec.busy and green["pending"] is None \
+                    and not green["waiting"]:
+                self._green = None
+                rollout.finish_rollback(api=self)
+                self.health.incr("rollbacks")
+            return
+        if rollout.state == "promote_ready":
+            if self.decoder.busy or self._pending is not None \
+                    or waiting:
+                return
+            self._param_stash = (self._decoder_kwargs["params"],
+                                 self._decoder_kwargs["embed_table"],
+                                 self.version)
+            self._decoder_kwargs["params"] = green["params"]
+            if green["embed_table"] is not None:
+                self._decoder_kwargs["embed_table"] = \
+                    green["embed_table"]
+            gdec.rollout_role = None
+            self._install_decoder(gdec)
+            self.version = rollout.version
+            # green's in-flight work rides over: its waiting map and
+            # lag-1 pending chunk belong to the (new) primary now
+            waiting.update(green["waiting"])
+            self._pending = green["pending"]
+            self._green = None
+            rollout.finish_promote(api=self)
+            self.health.incr("promotes")
 
     def _apply_tier(self, tier):
         """The graceful tier swap: the decoder is idle (the driver
@@ -2387,29 +2846,39 @@ class GenerateAPI:
                                    base=self._base_tier)
         return True
 
-    def _note_progress(self, waiting):
+    def _note_progress(self, waiting, decoder=None):
         """Post-collect bookkeeping: record queue-wait (staged ->
         admitted into a slot) and time-to-first-token for the health
-        window, and resolve every request whose stream completed."""
+        window, and resolve every request whose stream completed.
+        Runs once per drive pass per engine (``decoder`` defaults to
+        the primary; the green engine passes its own)."""
+        if decoder is None:
+            decoder = self.decoder
         now = time.monotonic()
         for rid in list(waiting):
             holder = waiting[rid]
             staged_at = holder.get("staged_at")
             if "queue_waited" not in holder:
-                admitted = self.decoder.admitted_at.get(rid)
+                admitted = decoder.admitted_at.get(rid)
                 if admitted is not None:
                     holder["queue_waited"] = True
                     if staged_at is not None:
                         self.health.record_latency(
                             "queue_wait", max(0.0, admitted - staged_at))
             if "first_token" not in holder \
-                    and self.decoder.results.get(rid):
+                    and decoder.results.get(rid):
                 holder["first_token"] = True
                 if staged_at is not None:
-                    self.health.record_latency(
-                        "ttft", max(0.0, now - staged_at))
-            if self.decoder.done(rid):
-                tokens = self.decoder.results.pop(rid)
+                    waited = max(0.0, now - staged_at)
+                    self.health.record_latency("ttft", waited)
+                    # per-role ttft feeds the rollout's green-vs-blue
+                    # trend comparison (veles_tpu/rollout.py)
+                    if self._rollout is not None \
+                            and "deploy" in holder:
+                        self._rollout.note_ttft(holder["deploy"],
+                                                waited, now=now)
+            if decoder.done(rid):
+                tokens = decoder.results.pop(rid)
                 get_tracer().event("serve.complete",
                                    parent=holder.get("trace"),
                                    rid=rid, tokens=len(tokens))
@@ -2469,19 +2938,52 @@ class GenerateAPI:
                     self._pending = None
                     self._trip(RuntimeError(reason), waiting)
                     continue
-                if self._tier_request is None:
+                if self._rollout_request is not None:
+                    holder = self._rollout_request
+                    self._rollout_request = None
+                    self._start_green(holder)
+                if self._tier_request is None \
+                        and self._swap_request is None:
                     waiting.update(self._drain_staged())
-                # while a tier swap is pending the staged queue HOLDS:
-                # in-flight requests drain at their admitted tier (the
-                # bit-identity contract), then the idle branch swaps
-                # and the next pass admits into the new-tier decoder
+                # while a tier swap OR weight swap is pending the
+                # staged queue HOLDS: in-flight requests drain on the
+                # admitted tier/weights (the bit-identity contract),
+                # then the idle branch swaps and the next pass admits
+                # into the new decoder/weights
                 self._expire_deadlines(waiting)
-                if not self.decoder.busy and self._pending is None:
+                green = self._green
+                if green is not None:
+                    self._expire_deadlines(green["waiting"],
+                                           decoder=green["decoder"])
+                    # the rollout's control loop rides the driver
+                    # thread like the governor's; a broken rollout
+                    # must never take the driver down
+                    if self._rollout is not None:
+                        try:
+                            self._rollout.tick(self)
+                        except Exception:
+                            import traceback
+                            traceback.print_exc()
+                    self._rollout_step(waiting)
+                    green = self._green  # _rollout_step may clear it
+                blue_idle = not self.decoder.busy \
+                    and self._pending is None
+                green_idle = green is None \
+                    or (not green["decoder"].busy
+                        and green["pending"] is None)
+                if blue_idle and green_idle:
                     if self._tier_request is not None:
                         tier = self._tier_request
                         self._tier_request = None
                         if tier != (self.decoder.quantize or "bf16"):
                             self._apply_tier(tier)
+                        continue
+                    if self._swap_request is not None:
+                        # both engines drained on the old weights (the
+                        # staged queue held) — the hot-swap seam
+                        holder = self._swap_request
+                        self._swap_request = None
+                        self._apply_swap(holder)
                         continue
                     # idle: the MFU cadence baseline must not span the
                     # gap, or the first chunk of the next burst feeds
@@ -2496,13 +2998,14 @@ class GenerateAPI:
                         self._wake.clear()
                     continue
                 try:
-                    if self.chaos is not None:
-                        self.chaos.before_step(self.decoder)
-                    current = self.decoder.dispatch_chunk(self.chunk)
-                    if self._pending is not None:
-                        self.decoder.collect_chunk(self._pending)
-                    self._pending = current
-                    self._note_progress(waiting)
+                    if not blue_idle:
+                        if self.chaos is not None:
+                            self.chaos.before_step(self.decoder)
+                        current = self.decoder.dispatch_chunk(self.chunk)
+                        if self._pending is not None:
+                            self.decoder.collect_chunk(self._pending)
+                        self._pending = current
+                        self._note_progress(waiting)
                     # the waste/occupancy autopsy (OFF the record
                     # path): trend series + detector-owned anomaly
                     # rules + a cooldown-limited incident naming the
@@ -2518,9 +3021,40 @@ class GenerateAPI:
                     traceback.print_exc()
                     self._pending = None
                     self._trip(exc, waiting)
+                    continue
+                if green is not None and self._green is green:
+                    # the green engine steps in the SAME drive pass
+                    # (lag-1 on its own pending chunk); a green
+                    # failure aborts the rollout, never the primary
+                    try:
+                        gdec = green["decoder"]
+                        if not green_idle:
+                            if self.chaos is not None:
+                                self.chaos.before_step(gdec)
+                            current = gdec.dispatch_chunk(self.chunk)
+                            if green["pending"] is not None:
+                                gdec.collect_chunk(green["pending"])
+                            green["pending"] = current
+                            self._note_progress(green["waiting"],
+                                                decoder=gdec)
+                    except Exception as exc:
+                        import traceback
+                        traceback.print_exc()
+                        green["pending"] = None
+                        self._abort_green("green engine failed: %s"
+                                          % exc)
         finally:
             self._pending = None
             self._fail_all(waiting, "server stopped")
+            green, self._green = self._green, None
+            if green is not None:
+                self._fail_all(green["waiting"], "server stopped")
+            for attr in ("_swap_request", "_rollout_request"):
+                holder = getattr(self, attr)
+                setattr(self, attr, None)
+                if holder is not None and not holder["event"].is_set():
+                    holder["error"] = "server stopped"
+                    holder["event"].set()
 
     # -- HTTP -------------------------------------------------------------
     def start(self):
@@ -2752,6 +3286,7 @@ class GenerateAPI:
                           "staged_at": staged_at,
                           "deadline": staged_at + deadline_s,
                           "trace": trace_ctx,
+                          "tenant": tenant,
                           "ledger_row": row}
                 if booked.get("reserved"):
                     holder["pool"] = booked["pool"]

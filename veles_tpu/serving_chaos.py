@@ -102,7 +102,8 @@ class ServingChaosConfig(ChaosConfigBase):
                  pool_flood_pages=0, pool_flood_at=0,
                  pool_flood_steps=0, compile_storm_at=None,
                  waste_cause=None, waste_tokens=0, waste_at=0,
-                 waste_steps=0):
+                 waste_steps=0, deploy_green_ramp_ms=0.0,
+                 deploy_green_ramp_steps=0, deploy_poison_nan=False):
         self._set_probabilities(
             step_fail=step_fail, slow_step=slow_step,
             disconnect=disconnect, garbage_body=garbage_body,
@@ -145,15 +146,27 @@ class ServingChaosConfig(ChaosConfigBase):
         if self.waste_tokens < 0 or self.waste_at < 0 \
                 or self.waste_steps < 0:
             raise ValueError("waste profile knobs must be >= 0")
+        # bad-deploy profiles (docs/zero_downtime.md): the blue-green
+        # rollback predicate's proving ground
+        self.deploy_green_ramp_ms = float(deploy_green_ramp_ms)
+        self.deploy_green_ramp_steps = int(deploy_green_ramp_steps)
+        if self.deploy_green_ramp_ms < 0 \
+                or self.deploy_green_ramp_steps < 0:
+            raise ValueError("deploy green ramp knobs must be >= 0")
+        self.deploy_poison_nan = bool(deploy_poison_nan)
 
     @property
     def any_profile(self):
-        """True when a burn-inducing or waste profile is configured."""
+        """True when a burn-inducing, waste or bad-deploy profile is
+        configured."""
         return bool((self.latency_ramp_ms and self.latency_ramp_steps)
                     or self.pool_flood_pages
                     or self.compile_storm_at is not None
                     or (self.waste_cause and self.waste_tokens
-                        and self.waste_steps))
+                        and self.waste_steps)
+                    or (self.deploy_green_ramp_ms
+                        and self.deploy_green_ramp_steps)
+                    or self.deploy_poison_nan)
 
     def expected_leading_series(self):
         """The metric series each configured burn profile is expected
@@ -173,6 +186,14 @@ class ServingChaosConfig(ChaosConfigBase):
             out["compile_storm"] = "veles_xla_recompile_storms_total"
         if self.waste_cause and self.waste_tokens and self.waste_steps:
             out["waste_profile"] = "veles_serve_waste_share"
+        if self.deploy_green_ramp_ms and self.deploy_green_ramp_steps:
+            # a latency-regressed candidate breaches the green ttft
+            # plane before anything else (veles_tpu/rollout.py)
+            from veles_tpu.rollout import TTFT_SERIES
+            out["deploy_green_ramp"] = TTFT_SERIES
+        if self.deploy_poison_nan:
+            from veles_tpu.rollout import SWAP_SERIES
+            out["deploy_poison"] = SWAP_SERIES
         return out
 
     def expected_leading_cause(self):
@@ -204,6 +225,12 @@ class ServingChaosMonkey(Logger):
         #: driver-step index: the burn profiles are step-indexed, so a
         #: (config, workload) pair replays the same fault schedule
         self._step = 0
+        #: green-engine step index (the deploy_green_ramp profile is
+        #: indexed on GREEN steps only — the candidate regresses, the
+        #: primary must stay untouched for the bit-identity contract)
+        self._green_step = 0
+        #: the poisoned-swap profile fires exactly once
+        self._poison_done = False
         #: harness-forced end of the latency ramp (clear_ramp)
         self._ramp_cleared = False
         #: pages the pool-flood profile currently holds hostage; done
@@ -240,7 +267,11 @@ class ServingChaosMonkey(Logger):
             waste_cause=cfg.get("waste_cause", None),
             waste_tokens=cfg.get("waste_tokens", 0),
             waste_at=cfg.get("waste_at", 0),
-            waste_steps=cfg.get("waste_steps", 0))
+            waste_steps=cfg.get("waste_steps", 0),
+            deploy_green_ramp_ms=cfg.get("deploy_green_ramp_ms", 0.0),
+            deploy_green_ramp_steps=cfg.get("deploy_green_ramp_steps",
+                                            0),
+            deploy_poison_nan=cfg.get("deploy_poison_nan", False))
         if not cfg.get("enabled",
                        config.any_enabled or config.any_profile):
             return None
@@ -283,6 +314,21 @@ class ServingChaosMonkey(Logger):
         cfg = self.config
         step = self._step
         self._step += 1
+        if cfg.deploy_green_ramp_ms and cfg.deploy_green_ramp_steps \
+                and getattr(decoder, "rollout_role", None) == "green":
+            # bad-deploy profile: ONLY the green candidate's steps
+            # stall (linear ramp to the peak, then hold) — the rollout
+            # predicate must see green's ttft break from blue's
+            # untouched baseline and roll back on its own
+            gstep = self._green_step
+            self._green_step += 1
+            if gstep == 0:
+                self.stamps["green_ramp_start"] = time.monotonic()
+            stall = cfg.deploy_green_ramp_ms \
+                * min(1.0, (gstep + 1) / cfg.deploy_green_ramp_steps)
+            self.counters["green_ramp_stalls"] = \
+                self.counters.get("green_ramp_stalls", 0) + 1
+            time.sleep(stall / 1000.0)
         if cfg.latency_ramp_ms and cfg.latency_ramp_steps \
                 and not self._ramp_cleared:
             window = cfg.latency_ramp_steps + cfg.latency_ramp_hold
@@ -350,6 +396,33 @@ class ServingChaosMonkey(Logger):
                 self.stamps["storm_at"] = time.monotonic()
                 self.warning("chaos: injected recompile storm")
 
+    def maybe_poison_swap(self, params):
+        """The poisoned-checkpoint profile (``deploy_poison_nan``):
+        replace the first floating leaf of the FIRST swap's params
+        with NaNs — ``GenerateAPI._apply_swap``'s non-finite gate must
+        refuse it, restore the old weights from the one-slot stash,
+        and shed nobody. Fires once; returns ``params`` (poisoned or
+        untouched)."""
+        if not self.config.deploy_poison_nan or self._poison_done:
+            return params
+        self._poison_done = True
+        import jax
+        import jax.numpy as jnp
+
+        leaves, tree = jax.tree.flatten(params)
+        for index, leaf in enumerate(leaves):
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is None \
+                    or not jnp.issubdtype(dtype, jnp.floating):
+                continue
+            leaves[index] = jnp.full_like(leaf, float("nan"))
+            break
+        self.counters["poisoned_swaps"] = \
+            self.counters.get("poisoned_swaps", 0) + 1
+        self.stamps["poison_at"] = time.monotonic()
+        self.warning("chaos: poisoning swap checkpoint with NaNs")
+        return jax.tree.unflatten(tree, leaves)
+
     def clear_ramp(self):
         """End the latency ramp NOW (the harness clears a held fault;
         idempotent)."""
@@ -387,3 +460,20 @@ class ServingChaosMonkey(Logger):
                            "garbage_body": "garbage_bodies",
                            "oversize_body": "oversize_bodies"}[fired]] += 1
         return fired
+
+
+# -- artifact faults (harness-side helper) -----------------------------------
+
+def tear_file(path, frac=0.5):
+    """Truncate ``path`` to ``frac`` of its bytes (a torn write / a
+    crashed copy) WITHOUT touching any sidecar — the persistent
+    executable cache's sha256 check must refuse the entry and fall
+    back to live compilation (aot/exec_cache.py,
+    docs/zero_downtime.md). Returns the new size."""
+    import os
+
+    size = os.path.getsize(path)
+    keep = max(0, int(size * float(frac)))
+    with open(path, "rb+") as fobj:
+        fobj.truncate(keep)
+    return keep
