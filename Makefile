@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
 	regress mesh paged fleet-mr aot slo governor history analyze \
-	fleetscope servescope deploy
+	fleetscope servescope deploy elastic
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -185,6 +185,24 @@ servescope:
 deploy:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_deploy.py \
 		-m deploy -q
+
+# Elastic replicated serving suite (docs/elastic_serving.md): the
+# consistent-hash affinity ring's stability under replica churn (zero
+# foreign keys remap), pressure spill, the per-request lease's
+# exactly-once delivery fence (half-stream failover, hedged
+# double-delivery discard, Retry-After-priced backoff), the honest
+# all-down 503, the real transport's half-stream EOF verdict, the
+# control plane's leave-one-out collapse detector + ledger-visible
+# lifecycle actuations (drain/retire/dead/adopt, min_active
+# suppression, cooldown), the incident artifact naming the replica,
+# and the kill -9 chaos acceptance — N same-seed subprocess replicas,
+# one killed mid-traffic, every request completing through failover
+# bit-identically with zero non-retryable 5xx. (The subprocess
+# acceptance rides the `slow` marker so tier-1 keeps its timeout
+# margin; this target runs it.)
+elastic:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_router.py \
+		-m elastic -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

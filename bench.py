@@ -2408,6 +2408,198 @@ def deploy_section(swaps=3):
     return out
 
 
+#: same-seed CPU subprocess replica for the elastic bench — identical
+#: weights to its twin so the router's failover stays bit-identical
+#: (the same child tests/test_router.py's chaos acceptance boots).
+#: Each decode dispatch is PACED by a deterministic slow-step chaos
+#: profile: the toy model's compute is too small to bind a core, so
+#: without pacing the 1-vs-2-replica ratio measures scheduler noise
+#: on however many cores the bench host has (= 0.6-1.7x run to run
+#: on one core). Paced, the replica is service-time-bound — sleeps
+#: overlap across processes on any core count — and the ratio
+#: isolates the quantity this section regress-gates: the FRONT's
+#: ability to spread load across the ring.
+_ELASTIC_CHILD = r"""
+import json, time
+import numpy
+import jax.numpy as jnp
+from veles_tpu.parallel.transformer_step import init_transformer_params
+from veles_tpu.serving import GenerateAPI
+from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                     ServingChaosMonkey)
+
+rng = numpy.random.RandomState(0)
+params = init_transformer_params(rng, 2, 16, 4, 11)
+table = jnp.asarray(rng.randn(11, 16).astype(numpy.float32) * 0.3)
+pacer = ServingChaosMonkey(ServingChaosConfig(seed=1, slow_step=1.0,
+                                              slow_step_ms=8.0))
+api = GenerateAPI(params, table, 4, slots=2, max_len=32, n_tokens=5,
+                  chunk=2, port=0, chaos=pacer)
+api.start()
+print(json.dumps({"port": api.port}), flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+
+def elastic_section(window_s=3.0, threads=8):
+    """Elastic replicated serving bench (docs/elastic_serving.md):
+    scale efficiency + the failover seam of the router front, over
+    same-seed service-paced CPU subprocess replica twins (see
+    ``_ELASTIC_CHILD`` for why they are paced) —
+
+    - ``elastic_tokens_per_sec_{1replica,2replica}``: router-front
+      decode throughput with 1 vs 2 replicas under the same client
+      pressure, and ``elastic_scale_x`` = their ratio (the elastic
+      claim: adding a replica buys near-linear goodput, >= 1.7x at
+      toy sizes; a dropped ratio = the router became the bottleneck,
+      higher-better under the regress sentinel);
+    - ``elastic_failover_ms``: kill -9 one of the two replicas under
+      live traffic and take the router's best measured fail-to-win
+      latency (attempt failure -> winning offer on the next replica;
+      lower-better via the ``_ms`` regress rule);
+    - ``elastic_affinity_hit_rate``: the fraction of keyed requests
+      the ring routed to their primary prefix-cache owner during the
+      2-replica window (affinity decayed = prefix caches go cold
+      across the spread).
+    """
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    from veles_tpu.router import build_router
+
+    spec = ("poll_interval_s=0.2,fail_threshold=2,cooldown_s=0.0,"
+            "hedge_after_s=5.0,backoff_s=0.01,page_size=4")
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def spawn(n):
+        env = _cpu8_env()
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        procs, urls = [], []
+        try:
+            for _ in range(n):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _ELASTIC_CHILD], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, cwd=repo))
+            for proc in procs:
+                line = proc.stdout.readline()
+                if not line:
+                    raise RuntimeError("replica died: %s"
+                                       % proc.stderr.read()[-2000:])
+                urls.append("http://127.0.0.1:%d"
+                            % json.loads(line)["port"])
+        except Exception:
+            for proc in procs:
+                proc.kill()
+            raise
+        return procs, urls
+
+    def post(url, tokens, timeout=60):
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"tokens": tokens}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    # page-aligned (page_size=4) distinct-prefix prompts: each rides
+    # affinity to one owner, spreading the set across the ring
+    prompts = [[i + 1, i + 2, i + 3, i + 4] for i in range(threads)]
+
+    def pound_window(front, seconds):
+        done = [0]
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def pound(prompt):
+            while not stop.is_set():
+                try:
+                    body = post(front, prompt)
+                except Exception:
+                    continue
+                with lock:
+                    done[0] += len(body.get("tokens", ()))
+
+        workers = [threading.Thread(target=pound, args=(p,))
+                   for p in prompts]
+        for t in workers:
+            t.start()
+        t0 = time.perf_counter()
+        time.sleep(seconds)
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for t in workers:
+            t.join(60)
+        return done[0] / elapsed
+
+    def measure(n):
+        procs, urls = spawn(n)
+        plane, router = build_router(urls, spec=spec)
+        router.start()
+        try:
+            front = "http://127.0.0.1:%d" % router.port
+            for url in urls:  # warm each replica's decode program
+                post(url, [1, 2, 3, 4])
+            post(front, prompts[0])
+            rate = pound_window(front, window_s)
+            snap = router.snapshot()
+            failover_ms = None
+            if n > 1:
+                # the failover seam: kill -9 replica 0 under load,
+                # take the router's best fail-to-win sample
+                stop = threading.Event()
+
+                def pound(prompt):
+                    while not stop.is_set():
+                        try:
+                            post(front, prompt)
+                        except Exception:
+                            continue
+
+                workers = [threading.Thread(target=pound, args=(p,))
+                           for p in prompts]
+                for t in workers:
+                    t.start()
+                time.sleep(0.3)
+                procs[0].send_signal(signal.SIGKILL)
+                deadline = time.monotonic() + 20
+                while not router.failover_ms_samples() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                stop.set()
+                for t in workers:
+                    t.join(60)
+                samples = router.failover_ms_samples()
+                failover_ms = min(samples) if samples else None
+            return rate, snap, failover_ms
+        finally:
+            router.stop()
+            for proc in procs:
+                proc.kill()
+
+    rate1, _, _ = measure(1)
+    rate2, snap2, failover_ms = measure(2)
+    hits = snap2["counters"].get("affinity_hits", 0)
+    misses = snap2["counters"].get("affinity_misses", 0)
+    out = {
+        "elastic_tokens_per_sec_1replica": round(rate1, 1),
+        "elastic_tokens_per_sec_2replica": round(rate2, 1),
+        "elastic_scale_x": round(rate2 / rate1, 3) if rate1 else None,
+        "elastic_affinity_hit_rate": round(
+            hits / (hits + misses), 3) if hits + misses else None,
+        "elastic_config": "replicas=1v2,slots=2,threads=%d,"
+                          "window=%.1fs,paced_8ms,cpu_subprocess"
+                          % (threads, window_s),
+    }
+    if failover_ms is not None:
+        out["elastic_failover_ms"] = round(failover_ms, 1)
+    return out
+
+
 def history_section():
     """Metric flight recorder bench (docs/observability.md): the cost
     of always-on trend memory, and how fast it notices a fault —
@@ -2612,6 +2804,13 @@ def serve_main(profile_dir=None, artifact_path=None):
             # wall time under live traffic, with the shed-request
             # count pinned 0 (the zero-downtime contract)
             section = _guarded(deploy_section, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # elastic replicated serving (docs/elastic_serving.md):
+            # router-front scale efficiency 1 -> 2 subprocess
+            # replicas, the kill -9 fail-to-win latency, and the
+            # prefix-affinity hit rate across the spread
+            section = _guarded(elastic_section, fallback={})
             out.update(section)
             artifact.update(section)
             # the metric flight recorder (docs/observability.md):

@@ -401,6 +401,204 @@ class TestRegressDirections:
         assert _lower_is_better("deploy_swap_shed_requests")
         assert _lower_is_better("deploy_swap_ms")
 
+    def test_elastic_keys_directions(self):
+        """The elastic bench keys (docs/elastic_serving.md): failover
+        latency regresses UP; throughput, scale efficiency and the
+        affinity hit rate regress DOWN (the higher-better default)."""
+        from veles_tpu.observe.regress import _lower_is_better
+        assert _lower_is_better("elastic_failover_ms")
+        assert not _lower_is_better("elastic_tokens_per_sec_1replica")
+        assert not _lower_is_better("elastic_tokens_per_sec_2replica")
+        assert not _lower_is_better("elastic_scale_x")
+        assert not _lower_is_better("elastic_affinity_hit_rate")
+
+
+# -- the swap seam's reshard receipt (satellite: wire reshard into swap) -----
+
+class TestSwapReshardSeam:
+
+    def test_mesh_swap_is_slice_only_zero_wire_bytes(self):
+        """The train->serve transition INSIDE the hot-swap seam: a
+        host (train-layout) checkpoint swapped onto a live serve mesh
+        must move 0 bytes on the wire — replicated -> sharded lowers
+        to local slices, never a collective — and the swapped engine
+        must stream bit-identically to a cold single-chip boot on the
+        same checkpoint."""
+        from veles_tpu.parallel.mesh import build_mesh
+        from veles_tpu.serving import ContinuousDecoder
+        # a mesh-divisible vocab (the tensor-parallel axis shards
+        # heads/ffn/vocab; the module default VOCAB=11 cannot)
+        vocab = 16
+        rng = numpy.random.RandomState(0)
+        params = init_transformer_params(rng, 2, EMBED, HEADS, vocab)
+        table = jnp.asarray(
+            rng.randn(vocab, EMBED).astype(numpy.float32) * 0.3)
+        params2 = init_transformer_params(numpy.random.RandomState(99),
+                                          2, EMBED, HEADS, vocab)
+        mesh = build_mesh(devices=jax.devices()[:4], data=1, model=4)
+        dec = ContinuousDecoder(params, table, HEADS, slots=2,
+                                max_len=32, n_tokens=5, mesh=mesh)
+        assert dec.last_swap_stats is None
+        dec.swap_params(params2)
+        stats = dec.last_swap_stats
+        assert stats is not None, \
+            "a mesh swap must leave its reshard receipt"
+        assert stats["bytes"] == 0, \
+            "host checkpoint -> serve mesh must be slice-only " \
+            "(0 wire bytes), got %r" % (stats,)
+        assert set(stats["counts"]) <= {"slice", "keep"}, \
+            stats["counts"]
+        # bit-identity across the seam: the hot-swapped mesh engine
+        # equals a cold single-chip engine on the same checkpoint
+        single = ContinuousDecoder(params2, table, HEADS, slots=2,
+                                   max_len=32, n_tokens=5)
+        prompts = [[1, 2, 3], [4, 5, 6, 7], [2, 2]]
+        for p in prompts:
+            dec.submit(p)
+            single.submit(p)
+        dec.run_until_drained(chunk=2)
+        single.run_until_drained(chunk=2)
+        assert dec.results == single.results
+
+    def test_single_chip_swap_leaves_no_receipt(self):
+        params, table, params2 = _model()
+        from veles_tpu.serving import ContinuousDecoder
+        dec = ContinuousDecoder(params, table, HEADS, slots=2,
+                                max_len=32, n_tokens=5)
+        dec.swap_params(params2)
+        assert dec.last_swap_stats is None
+
+
+# -- the deploy rollout CLI verb (satellite: fetch+verify+begin_rollout) -----
+
+class _RolloutRecorder:
+    """The injectable ``api`` seam: a live-enough GenerateAPI stand-in
+    whose decoder carries the real tree structure."""
+
+    class _Decoder:
+        def __init__(self, params, table):
+            self.params = params
+            self.embed_table = table
+
+    def __init__(self, params, table, refuse=None):
+        self.decoder = self._Decoder(params, table)
+        self.calls = []
+        self._refuse = refuse
+
+    def begin_rollout(self, new_params, new_embed_table=None,
+                      version="green", timeout=120.0):
+        if self._refuse is not None:
+            raise self._refuse
+        self.calls.append({"version": version, "timeout": timeout,
+                           "params": new_params,
+                           "table": new_embed_table})
+
+
+class TestDeployRolloutCLI:
+
+    def _package(self, tmp_path, params, table, tamper=False,
+                 weights=True):
+        """A real packed package: manifest + sha-sidecar'd serving
+        checkpoint (forge/package.py conventions)."""
+        import hashlib
+        import veles_tpu.forge.package as pkg
+        from veles_tpu.deploy_cli import save_serving_checkpoint
+        d = tmp_path / ("pkg_tampered" if tamper else "pkg")
+        d.mkdir()
+        (d / "wf.py").write_text("# serving checkpoint carrier\n")
+        artifacts = []
+        if weights:
+            with open(d / "weights.npz", "wb") as fout:
+                save_serving_checkpoint(fout, params, table)
+            digest = hashlib.sha256(
+                (d / "weights.npz").read_bytes()).hexdigest()
+            if tamper:
+                digest = "0" * 64
+            (d / "weights.npz.sha256").write_text(
+                "%s  weights.npz\n" % digest)
+            artifacts = ["weights.npz"]
+        (d / "manifest.json").write_text(json.dumps({
+            "name": "toy-serve", "version": "2.0", "workflow": "wf.py",
+            "artifacts": artifacts}))
+        path, _ = pkg.pack(str(d))
+        return path
+
+    def test_exit_code_matrix(self, tmp_path, monkeypatch):
+        import veles_tpu.serving as serving
+        from veles_tpu.deploy_cli import (EXIT_OK, EXIT_PACKAGE,
+                                          EXIT_ROLLOUT, EXIT_TAMPERED,
+                                          main, rollout_package)
+        import io as _io
+        params, table, params2 = _model()
+        path = self._package(tmp_path, params2, table)
+        sink = _io.StringIO()
+
+        # 0: resolve + verify + begin_rollout, stamped name@version
+        api = _RolloutRecorder(params, table)
+        assert rollout_package(path, api=api, out=sink) == EXIT_OK
+        assert len(api.calls) == 1
+        assert api.calls[0]["version"] == "toy-serve@2.0"
+        got = jax.tree.leaves((api.calls[0]["params"],
+                               api.calls[0]["table"]))
+        want = jax.tree.leaves((params2, table))
+        for a, b in zip(got, want):
+            numpy.testing.assert_array_equal(numpy.asarray(a),
+                                             numpy.asarray(b))
+
+        # 2: unresolvable / malformed / missing-weights packages
+        assert rollout_package(str(tmp_path / "absent.tar.gz"),
+                               api=api, out=sink) == EXIT_PACKAGE
+        garbage = tmp_path / "garbage.tar.gz"
+        garbage.write_bytes(b"not a tarball")
+        assert rollout_package(str(garbage), api=api,
+                               out=sink) == EXIT_PACKAGE
+        nw_dir = tmp_path / "nw"
+        nw_dir.mkdir()
+        no_weights = self._package(nw_dir, params2, table,
+                                   weights=False)
+        assert rollout_package(no_weights, api=api,
+                               out=sink) == EXIT_PACKAGE
+
+        # 2: checkpoint that cannot assemble against the live tree
+        mismatched = _RolloutRecorder({"only": table}, table)
+        assert rollout_package(path, api=mismatched,
+                               out=sink) == EXIT_PACKAGE
+        assert mismatched.calls == []
+
+        # 3: tampered artifact refused before any weight byte parses
+        bad = self._package(tmp_path, params2, table, tamper=True)
+        assert rollout_package(bad, api=api, out=sink) == EXIT_TAMPERED
+
+        # 4: no live serving api in this process
+        monkeypatch.setattr(serving, "_CURRENT_API", None)
+        assert rollout_package(path, api=None, out=sink) == EXIT_ROLLOUT
+
+        # 4: the live api refuses the rollout (one already in flight)
+        busy = _RolloutRecorder(
+            params, table, refuse=RuntimeError("already in flight"))
+        assert rollout_package(path, api=busy, out=sink) == EXIT_ROLLOUT
+
+        # the CLI surface maps straight through
+        assert main(["rollout", path, "--timeout", "5"],
+                    api=_RolloutRecorder(params, table)) == EXIT_OK
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        import io as _io
+        from veles_tpu.deploy_cli import (load_serving_checkpoint,
+                                          save_serving_checkpoint)
+        params, table, _ = _model()
+        buf = _io.BytesIO()
+        save_serving_checkpoint(buf, params, table)
+        got_params, got_table = load_serving_checkpoint(
+            buf.getvalue(), params, table)
+        for a, b in zip(jax.tree.leaves((params, table)),
+                        jax.tree.leaves((got_params, got_table))):
+            numpy.testing.assert_array_equal(numpy.asarray(a),
+                                             numpy.asarray(b))
+        with pytest.raises(ValueError, match="leaves"):
+            load_serving_checkpoint(buf.getvalue(), {"one": table},
+                                    table)
+
 
 # -- chaos deploy proof (slow tier) ------------------------------------------
 

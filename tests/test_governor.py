@@ -239,6 +239,89 @@ class TestHysteresis:
         assert governor.last_burn is None
 
 
+class TestDeployAwareDemotion:
+    """Satellite (ISSUE 17): a demotion whose burn is attributable to
+    a RAMPING green slice is suppressed — the rollout predicate owns
+    the bad-deploy response (rollback); demoting the whole surface
+    would punish healthy blue traffic."""
+
+    class VersionedSLO(StubSLO):
+        """A StubSLO whose surface burn comes from the green-ramp
+        profile while the per-version slices tell the attribution
+        story."""
+
+        def __init__(self, burns, green_burns, blue_burns):
+            super().__init__(burns)
+            self.green = list(green_burns)
+            self.blue = list(blue_burns)
+
+        def version_burn(self, version, now=None):
+            series = self.green if version == "green" else self.blue
+            burn = series.pop(0) if series else 0.0
+            if burn is None:
+                return None
+            return {"version": version, "burn_rate": burn}
+
+    class ShiftingRollout:
+        state = "shifting"
+
+    def run(self, green_burns, blue_burns, rollout, deploy_aware=True):
+        # the synthetic green-ramp burn profile: the surface-wide burn
+        # crosses the demote bar every tick (green's regression
+        # dominates the aggregate), green's slice ramps with it, blue
+        # holds flat
+        surface = [5.0] * len(green_burns)
+        governor = ServingGovernor(GovernorConfig(
+            demote_burn=2.0, recover_burn=1.0, cooldown_s=1.0,
+            interval_s=1.0, prewarm=False, breaker_guard=False,
+            deploy_aware=deploy_aware), clock=lambda: 0.0)
+        api = StubApi([])
+        api.slo = self.VersionedSLO(surface, green_burns, blue_burns)
+        api._rollout = rollout
+        for second in range(len(surface)):
+            governor.tick(api, now=float(second))
+        return governor, api
+
+    def test_green_ramp_burn_suppresses_demotion(self):
+        ramp = [2.5, 3.5, 4.5, 5.5, 6.0]  # the ramping green slice
+        governor, api = self.run(ramp, [0.2] * len(ramp),
+                                 self.ShiftingRollout())
+        assert governor.counters["demotions"] == 0
+        assert governor.counters["demotes_suppressed_deploy"] >= 1
+        assert not governor.demoted
+        actions = [t["action"] for t in governor.transitions]
+        assert "demote_suppressed_deploy" in actions
+        note = next(t for t in governor.transitions
+                    if t["action"] == "demote_suppressed_deploy")
+        assert "deploy-attributable" in note["reason"]
+
+    def test_ambient_burn_still_demotes_during_rollout(self):
+        # BOTH slices burn: ambient load, not the candidate — the
+        # governor must still protect the surface
+        ramp = [5.0] * 5
+        governor, _ = self.run(ramp, [4.0] * 5, self.ShiftingRollout())
+        assert governor.counters["demotions"] == 1
+        assert governor.counters["demotes_suppressed_deploy"] == 0
+
+    def test_no_rollout_means_no_suppression(self):
+        governor, _ = self.run([5.0] * 5, [0.2] * 5, None)
+        assert governor.counters["demotions"] == 1
+
+    def test_terminal_rollout_state_does_not_suppress(self):
+        class Promoted:
+            state = "promoted"
+        governor, _ = self.run([5.0] * 5, [0.2] * 5, Promoted())
+        assert governor.counters["demotions"] == 1
+
+    def test_knob_off_restores_unconditional_demotion(self):
+        governor, _ = self.run([5.0] * 5, [0.2] * 5,
+                               self.ShiftingRollout(),
+                               deploy_aware=False)
+        assert governor.counters["demotions"] == 1
+        spec = parse_governor_spec("deploy_aware=0")
+        assert spec.deploy_aware is False
+
+
 class TestRetryAfterPricing:
     """Satellite: the five hardcoded ``Retry-After: "1"`` headers are
     one priced helper, clamped [1, 60] like the pool gate."""

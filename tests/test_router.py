@@ -1,0 +1,1013 @@
+"""Elastic replicated serving: the fault-tolerant router front + the
+replica control plane (veles_tpu/router.py, veles_tpu/fleet/
+serve_plane.py; docs/elastic_serving.md).
+
+Fast tier drives the router against a SCRIPTED transport (no real
+replicas): consistent-hash affinity stability under replica churn,
+pressure spill, the per-request lease's exactly-once fence
+(half-stream failover, hedged double-delivery discard), Retry-After-
+priced backoff, the honest all-down 503, and the real ``_http_post``
+transport's half-stream EOF verdict against a socket that lies about
+Content-Length. The control plane's leave-one-out collapse detector,
+lifecycle actuations (drain/retire/dead/adopt, min_active
+suppression), and the incident artifact NAMING the replica run as
+units with explicit clocks and synthetic /healthz snapshots.
+
+The ``slow``-marked chaos acceptance boots N real ``GenerateAPI``
+subprocess replicas from one seed and kill -9s one mid-traffic: every
+request must complete through failover with bit-identical greedy
+tokens vs the fault-free run, zero non-retryable 5xx, and the
+detector must name the dead replica in the ledger and the incident
+artifact.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veles_tpu.fleet.serve_plane import (COLLAPSE_RULE,
+                                         FLEET_PRESSURE_SERIES,
+                                         REPLICA_GOODPUT_SERIES,
+                                         ServePlane, ServePlaneConfig)
+from veles_tpu.router import (ElasticRouter, HashRing, RouterConfig,
+                              _http_post, build_router, prefix_key)
+
+pytestmark = pytest.mark.elastic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- harness -----------------------------------------------------------------
+
+#: a healthy replica's /healthz, as the plane's fetch sees it
+def healthy_snap(goodput=1.0, inflight=0, limit=8, pages_used=0,
+                 pages_total=64):
+    return {"servescope": {"goodput_fraction": goodput},
+            "inflight": inflight,
+            "governor": {"effective_limit": limit},
+            "pool": {"pages_used": pages_used,
+                     "pages_total": pages_total},
+            "counters": {"completed": 0}}
+
+
+class ScriptedTransport:
+    """Attempt transport keyed by replica URL prefix: each behavior is
+    ``fn(body, headers, timeout) -> (status, headers, payload)`` or
+    raises (a transport failure, exactly like a dead socket)."""
+
+    def __init__(self):
+        self.behavior = {}
+        self._lock = threading.Lock()
+        self.calls = []
+
+    def set(self, url, fn):
+        self.behavior[url.rstrip("/")] = fn
+
+    def __call__(self, url, body, headers, timeout):
+        with self._lock:
+            self.calls.append(url)
+        for prefix, fn in self.behavior.items():
+            if url.startswith(prefix):
+                return fn(body, headers, timeout)
+        raise ConnectionRefusedError("no behavior for %s" % url)
+
+
+def ok_behavior(name):
+    """Deterministic tokens from the prompt — IDENTICAL across
+    replicas, like same-seed weights (the bit-identity contract)."""
+    def fn(body, headers, timeout):
+        tokens = json.loads(body.decode())["tokens"]
+        out = [(sum(tokens) + i) % 97 for i in range(3)]
+        return 200, {}, json.dumps({"tokens": out,
+                                    "served_by": name}).encode()
+    return fn
+
+
+def busy_behavior(price):
+    def fn(body, headers, timeout):
+        return 429, {"Retry-After": str(price)}, b'{"error":"full"}'
+    return fn
+
+
+def dead_behavior(body, headers, timeout):
+    raise ConnectionResetError("kill -9")
+
+
+def make_plane(n=2, standby=0, fetch=None, **over):
+    cfg = ServePlaneConfig(**dict({"poll_interval_s": 0.01,
+                                   "cooldown_s": 0.0}, **over))
+    replicas = ["http://127.0.0.1:%d" % (9000 + i) for i in range(n)]
+    sb = ["http://127.0.0.1:%d" % (9500 + i) for i in range(standby)]
+    return ServePlane(replicas, standby=sb, config=cfg,
+                      fetch=fetch if fetch is not None
+                      else (lambda url: healthy_snap()))
+
+
+def make_router(plane, transport, **over):
+    cfg = RouterConfig(**dict({"port": 0, "hedge_after_s": 5.0,
+                               "backoff_s": 0.0, "page_size": 4},
+                              **over))
+    return ElasticRouter(plane, config=cfg, transport=transport)
+
+
+def body_for(tokens):
+    return json.dumps({"tokens": list(tokens)}).encode()
+
+
+def wait_until(predicate, timeout=10.0, tick=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(tick)
+    return False
+
+
+@pytest.fixture
+def no_history():
+    from veles_tpu.observe.history import (get_metric_history,
+                                           set_metric_history)
+    previous = get_metric_history()
+    set_metric_history(None)
+    try:
+        yield
+    finally:
+        set_metric_history(previous)
+
+
+@pytest.fixture
+def isolated_history(tmp_path, monkeypatch):
+    """A private MetricHistory + incident recorder so the collapse
+    detector's rule and artifact are observable without ambient serve
+    rules claiming the leading indicator."""
+    import veles_tpu.observe.servescope as servescope
+    from veles_tpu.observe.history import (IncidentRecorder,
+                                           MetricHistory,
+                                           get_metric_history,
+                                           set_metric_history)
+    from veles_tpu.observe.metrics import MetricsRegistry
+    monkeypatch.setattr(servescope, "MIN_EVAL_TOKENS", 10 ** 9)
+    history = MetricHistory(
+        registry=MetricsRegistry(enabled=True), interval_s=0.01,
+        capacity=256, series_cap=64, rules=[],
+        incidents=IncidentRecorder(cooldown_s=0.0,
+                                   directory=str(tmp_path)))
+    previous = get_metric_history()
+    set_metric_history(history)
+    try:
+        yield history
+    finally:
+        set_metric_history(previous)
+
+
+# -- affinity: the consistent-hash ring + prefix key -------------------------
+
+class TestAffinity:
+
+    def keys(self, n=256):
+        return [("key-%d" % i).encode() for i in range(n)]
+
+    def test_ring_stable_under_replica_join(self):
+        """Adding one replica must remap ONLY the keys the newcomer
+        takes — every other prefix keeps its owner (the whole reason
+        the cache hit rate survives churn)."""
+        before = HashRing(["r-a", "r-b", "r-c"])
+        after = HashRing(["r-a", "r-b", "r-c", "r-d"])
+        moved = [k for k in self.keys()
+                 if before.owners(k)[0] != after.owners(k)[0]
+                 and after.owners(k)[0] != "r-d"]
+        assert moved == []
+
+    def test_ring_stable_under_replica_leave(self):
+        """Removing a replica remaps only ITS keys; survivors' keys
+        stay put."""
+        before = HashRing(["r-a", "r-b", "r-c"])
+        after = HashRing(["r-a", "r-b"])
+        moved = [k for k in self.keys()
+                 if before.owners(k)[0] != "r-c"
+                 and before.owners(k)[0] != after.owners(k)[0]]
+        assert moved == []
+
+    def test_owners_order_distinct_and_complete(self):
+        ring = HashRing(["r-a", "r-b", "r-c"])
+        order = ring.owners(b"some-key")
+        assert sorted(order) == ["r-a", "r-b", "r-c"]
+
+    def test_empty_ring_owns_nothing(self):
+        assert HashRing([]).owners(b"k") == []
+
+    def test_prefix_key_page_aligned(self):
+        """Only WHOLE pages are reusable: the key ignores the partial
+        tail, and a sub-page prompt has no key at all (chase load, not
+        affinity)."""
+        assert prefix_key([1, 2, 3], page_size=4) is None
+        base = prefix_key([1, 2, 3, 4], page_size=4)
+        assert base is not None
+        assert prefix_key([1, 2, 3, 4, 9], page_size=4) == base
+        assert prefix_key([1, 2, 3, 4, 9, 9, 9], page_size=4) == base
+        assert prefix_key([1, 2, 3, 5], page_size=4) != base
+
+    def test_pick_prefers_affinity_primary(self, no_history):
+        plane = make_plane(n=3)
+        router = make_router(plane, ScriptedTransport())
+        for rep in plane.replicas:
+            rep.pressure = 0.0
+        key = prefix_key([7, 7, 7, 7], page_size=4)
+        ring = router._ring_for(r.name for r in plane.replicas)
+        primary = ring.owners(key)[0]
+        rep, is_primary = router._pick(key, set())
+        assert rep.name == primary
+        assert is_primary is True
+
+    def test_pick_spills_over_pressure(self, no_history):
+        """A primary owner above spill_pressure yields to the next
+        ring owner — affinity is a preference, not a hot spot."""
+        plane = make_plane(n=3)
+        router = make_router(plane, ScriptedTransport(),
+                             spill_pressure=0.9)
+        key = prefix_key([7, 7, 7, 7], page_size=4)
+        ring = router._ring_for(r.name for r in plane.replicas)
+        order = ring.owners(key)
+        for rep in plane.replicas:
+            rep.pressure = 0.95 if rep.name == order[0] else 0.1
+        rep, is_primary = router._pick(key, set())
+        assert rep.name == order[1]
+        assert is_primary is False
+
+    def test_pick_without_key_chases_least_pressure(self, no_history):
+        plane = make_plane(n=3)
+        router = make_router(plane, ScriptedTransport())
+        for rep, p in zip(plane.replicas, (0.8, 0.2, 0.5)):
+            rep.pressure = p
+        rep, is_primary = router._pick(None, set())
+        assert rep is plane.replicas[1]
+        assert is_primary is False
+
+    def test_pick_skips_excluded_and_unroutable(self, no_history):
+        plane = make_plane(n=3)
+        router = make_router(plane, ScriptedTransport())
+        plane.replicas[0].state = "draining"
+        rep, _ = router._pick(None, {plane.replicas[1].name})
+        assert rep is plane.replicas[2]
+        rep, _ = router._pick(None, {plane.replicas[1].name,
+                                     plane.replicas[2].name})
+        assert rep is None
+
+
+# -- the lease fence + failover machinery ------------------------------------
+
+class TestLeaseFailover:
+
+    def test_transport_death_fails_over_transparently(self, no_history):
+        """A replica that dies mid-attempt (connection reset = the
+        kill -9 verdict) fails its lease attempt; the next replica
+        completes the SAME request."""
+        plane = make_plane(n=2)
+        transport = ScriptedTransport()
+        transport.set(plane.replicas[0].url, dead_behavior)
+        transport.set(plane.replicas[1].url, ok_behavior("r1"))
+        router = make_router(plane, transport)
+        # a sub-page prompt has no affinity key: the pick is by
+        # (pressure, leases, name), so the DEAD replica goes first
+        tokens = [1, 2, 3]
+        lease = router.dispatch(tokens, body_for(tokens), {},
+                                time.monotonic() + 30)
+        assert lease.outcome is not None
+        status, payload, replica = lease.outcome
+        assert status == 200
+        assert replica == plane.replicas[1].name
+        assert json.loads(payload.decode())["served_by"] == "r1"
+        assert router.counter("failovers") == 1
+        assert lease.failure_count() == 1
+        rep_name, kind, price = lease.failures[0]
+        assert rep_name == plane.replicas[0].name
+        assert kind.startswith("transport:")
+        assert price is None
+        assert wait_until(lambda: len(router.failover_ms_samples()) == 1)
+        assert plane.replicas[0].failures == 1
+        assert plane.replicas[1].failures == 0
+
+    def test_busy_replica_prices_the_backoff(self, no_history):
+        """A 429's Retry-After is the failed replica's own price: the
+        retry backoff uses IT, not the blind base, and the busy
+        verdict never trips the failure counter."""
+        plane = make_plane(n=2)
+        transport = ScriptedTransport()
+        transport.set(plane.replicas[0].url, busy_behavior(3.5))
+        transport.set(plane.replicas[1].url, busy_behavior(1.5))
+        sleeps = []
+        router = make_router(plane, transport, max_attempts=2)
+        router._sleep = sleeps.append
+        tokens = [5, 6, 7, 8]
+        lease = router.dispatch(tokens, body_for(tokens), {},
+                                time.monotonic() + 30)
+        assert lease.outcome is None
+        assert router.counter("retries") == 2
+        assert router.counter("failovers") == 0
+        # the backoff before attempt 2 uses attempt 1's OWN price
+        assert sleeps and sleeps[0] == lease.failures[0][2]
+        assert lease.last_price() == lease.failures[1][2]
+        assert {f[2] for f in lease.failures} == {3.5, 1.5}
+        assert plane.replicas[0].failures == 0, \
+            "busy is not broken: 429 must not advance the death count"
+
+    def test_hedged_double_delivery_is_fence_discarded(self,
+                                                      no_history):
+        """The exactly-once fence: a slow replica hedged past
+        hedge_after_s loses the race; when it finally answers, its
+        verdict is counted and DROPPED — never double-delivered."""
+        plane = make_plane(n=2)
+        release = threading.Event()
+        slow_name = []
+
+        def slow(body, headers, timeout):
+            release.wait(10)
+            return 200, {}, b'{"served_by": "slow", "tokens": [9]}'
+
+        transport = ScriptedTransport()
+        key = prefix_key([1, 2, 3, 4], page_size=4)
+        ring = HashRing([r.name for r in plane.replicas])
+        primary = ring.owners(key)[0]
+        for rep in plane.replicas:
+            if rep.name == primary:
+                slow_name.append(rep.name)
+                transport.set(rep.url, slow)
+            else:
+                transport.set(rep.url, ok_behavior("fast"))
+        router = make_router(plane, transport, hedge_after_s=0.05)
+        tokens = [1, 2, 3, 4]
+        lease = router.dispatch(tokens, body_for(tokens), {},
+                                time.monotonic() + 30)
+        assert lease.outcome is not None
+        assert lease.outcome[2] != slow_name[0], \
+            "the hedge must win while the primary hangs"
+        release.set()
+        assert wait_until(lambda: router.counter("late_discards") == 1)
+        assert lease.late == 1
+        assert lease.outcome[2] != slow_name[0], \
+            "the late answer must not overwrite the winner"
+
+    def test_exhausted_replica_set_leaves_no_outcome(self, no_history):
+        plane = make_plane(n=2)
+        transport = ScriptedTransport()
+        transport.set(plane.replicas[0].url, dead_behavior)
+        transport.set(plane.replicas[1].url, dead_behavior)
+        router = make_router(plane, transport)
+        tokens = [1, 2, 3, 4]
+        lease = router.dispatch(tokens, body_for(tokens), {},
+                                time.monotonic() + 30)
+        assert lease.outcome is None
+        assert lease.failure_count() == 2
+        assert {name for name, _, _ in lease.failures} == \
+            {r.name for r in plane.replicas}
+
+    def test_non_retryable_verdict_passes_through(self, no_history):
+        """A replica 400 is a verdict about the REQUEST: no failover
+        tour, the status relays as-is."""
+        plane = make_plane(n=2)
+        transport = ScriptedTransport()
+
+        def reject(body, headers, timeout):
+            return 400, {}, b'{"error":"bad tokens"}'
+
+        transport.set(plane.replicas[0].url, reject)
+        transport.set(plane.replicas[1].url, reject)
+        router = make_router(plane, transport)
+        tokens = [1, 2, 3, 4]
+        lease = router.dispatch(tokens, body_for(tokens), {},
+                                time.monotonic() + 30)
+        assert lease.outcome is not None
+        assert lease.outcome[0] == 400
+        assert router.counter("failovers") == 0
+        assert len(transport.calls) == 1
+
+
+class TestHttpTransport:
+    """The REAL attempt transport against sockets that misbehave."""
+
+    def _serve_once(self, conn_script):
+        """One-shot TCP server running ``conn_script(conn)`` on the
+        first connection; returns the URL."""
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("127.0.0.1", 0))
+        server.listen(1)
+        port = server.getsockname()[1]
+
+        def run():
+            conn, _ = server.accept()
+            try:
+                conn.recv(65536)
+                conn_script(conn)
+            finally:
+                conn.close()
+                server.close()
+
+        threading.Thread(target=run, daemon=True).start()
+        return "http://127.0.0.1:%d" % port
+
+    def test_half_stream_eof_raises(self):
+        """A replica that dies mid-body (headers promised 1000 bytes,
+        the socket delivered 10 and closed — the kill -9 shape) must
+        RAISE, so the attempt fails over instead of delivering a
+        truncated stream."""
+        def half(conn):
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Length: 1000\r\n\r\n"
+                         b"0123456789")
+
+        url = self._serve_once(half)
+        with pytest.raises(Exception):
+            _http_post(url, b"{}", {}, timeout=10)
+
+    def test_error_status_returns_as_verdict(self):
+        """HTTP error statuses are replica VERDICTS, not transport
+        failures: they return normally with headers intact."""
+        def busy(conn):
+            conn.sendall(b"HTTP/1.1 429 Too Many Requests\r\n"
+                         b"Retry-After: 7\r\n"
+                         b"Content-Length: 2\r\n\r\n{}")
+
+        url = self._serve_once(busy)
+        status, headers, payload = _http_post(url, b"{}", {},
+                                              timeout=10)
+        assert status == 429
+        assert headers.get("Retry-After") == "7"
+        assert payload == b"{}"
+
+
+# -- the HTTP front ----------------------------------------------------------
+
+def post_router(url, payload, headers=None):
+    """POST returning (status, body_dict, headers) — error statuses
+    included."""
+    data = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + "/generate", data=data,
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode()), \
+                dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        with err:
+            return err.code, json.loads(err.read().decode() or "{}"), \
+                dict(err.headers or {})
+
+
+class TestRouterFront:
+
+    def _start(self, transport, n=2, plane_over=None, **cfg_over):
+        plane = make_plane(n=n, **dict({"poll_interval_s": 30.0},
+                                       **(plane_over or {})))
+        router = make_router(plane, transport, **cfg_over)
+        router.start()
+        return plane, router, "http://127.0.0.1:%d" % router.port
+
+    def test_routes_and_relays_with_replica_header(self, no_history):
+        transport = ScriptedTransport()
+        plane, router, url = self._start(transport)
+        try:
+            for rep in plane.replicas:
+                transport.set(rep.url, ok_behavior(rep.name))
+            status, body, headers = post_router(
+                url, {"tokens": [1, 2, 3, 4]},
+                headers={"X-Veles-Trace": "t-42"})
+            assert status == 200
+            assert body["tokens"] == [(10 + i) % 97 for i in range(3)]
+            names = {r.name for r in plane.replicas}
+            assert headers.get("X-Veles-Replica") in names
+            assert body["served_by"] == headers["X-Veles-Replica"]
+            assert headers.get("X-Veles-Trace") == "t-42"
+            assert router.health.counter("completed") == 1
+        finally:
+            router.stop()
+
+    def test_bad_request_is_400_without_a_replica_call(self,
+                                                       no_history):
+        transport = ScriptedTransport()
+        plane, router, url = self._start(transport)
+        try:
+            for payload in (b"not json", b"{}",
+                            json.dumps({"tokens": []}).encode(),
+                            json.dumps({"tokens": [1, True]}).encode(),
+                            json.dumps({"tokens": "abc"}).encode()):
+                status, body, _ = post_router(url, payload)
+                assert status == 400, payload
+                assert "error" in body
+            assert transport.calls == [], \
+                "a bad request does not deserve a failover tour"
+        finally:
+            router.stop()
+
+    def test_all_replicas_down_is_honest_503(self, no_history):
+        """Every replica dead -> 503 with an integer Retry-After >= 1
+        (the control plane's detection horizon) and the per-replica
+        failure list — never a hang, never a bare 500."""
+        transport = ScriptedTransport()
+        plane, router, url = self._start(
+            transport, plane_over={"fail_threshold": 3})
+        try:
+            for rep in plane.replicas:
+                transport.set(rep.url, dead_behavior)
+            status, body, headers = post_router(
+                url, {"tokens": [1, 2, 3, 4]})
+            assert status == 503
+            retry_after = int(headers["Retry-After"])
+            assert retry_after >= 1
+            assert {f["replica"] for f in body["failures"]} == \
+                {r.name for r in plane.replicas}
+            assert all(f["kind"].startswith("transport:")
+                       for f in body["failures"])
+            assert router.counter("all_down") == 1
+            assert router.health.counter("shed") == 1
+            assert router.health.snapshot()["inflight"] == 0
+        finally:
+            router.stop()
+
+    def test_no_routable_replica_rejects_unready(self, no_history):
+        transport = ScriptedTransport()
+        plane, router, url = self._start(transport)
+        try:
+            for rep in plane.replicas:
+                rep.state = "dead"
+            assert router.health.ready is False
+            status, _, headers = post_router(
+                url, {"tokens": [1, 2, 3, 4]})
+            assert status == 503
+            assert "Retry-After" in headers
+            ready = urllib.request.Request(url + "/readyz")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(ready, timeout=10)
+            assert err.value.code == 503
+        finally:
+            router.stop()
+
+    def test_debug_and_metrics_surfaces(self, no_history):
+        transport = ScriptedTransport()
+        plane, router, url = self._start(transport)
+        try:
+            for rep in plane.replicas:
+                transport.set(rep.url, ok_behavior(rep.name))
+                rep.goodput, rep.pressure = 1.0, 0.25
+            post_router(url, {"tokens": [1, 2, 3, 4]})
+            with urllib.request.urlopen(url + "/debug/router",
+                                        timeout=10) as resp:
+                snap = json.loads(resp.read().decode())
+            assert snap["counters"]["requests"] == 1
+            assert snap["plane"]["active"] == 2
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=10) as resp:
+                scrape = resp.read().decode()
+            assert "veles_router_requests_total" in scrape
+            assert "veles_router_replica_goodput" in scrape
+            assert "veles_router_replica_pressure" in scrape
+        finally:
+            router.stop()
+
+
+# -- the control plane: detector + lifecycle ---------------------------------
+
+class TestServePlane:
+
+    def run_polls(self, plane, snaps_by_name, polls, start=0.0):
+        """Drive ``poll`` with an explicit clock; ``snaps_by_name``
+        maps replica name -> snapshot | None (poll failure) |
+        callable(poll_index)."""
+        poll_index = [0]
+
+        def fetch(url):
+            rep = next(r for r in plane.replicas if r.url == url)
+            snap = snaps_by_name.get(rep.name, healthy_snap())
+            if callable(snap):
+                snap = snap(poll_index[0])
+            if snap is None:
+                raise ConnectionRefusedError("down")
+            return snap
+
+        plane._fetch = fetch
+        for i in range(polls):
+            poll_index[0] = i
+            plane.poll(now=start + float(i))
+
+    def test_leave_one_out_drains_then_retires(self, no_history):
+        """One replica's goodput collapses below retire_ratio x the
+        rest-median for retire_polls -> drain; with no leases it
+        retires the same pass."""
+        plane = make_plane(n=3, retire_polls=2, retire_ratio=0.5)
+        victim = plane.replicas[0].name
+        self.run_polls(plane, {victim: healthy_snap(goodput=0.05)}, 3)
+        assert plane.replicas[0].state == "retired"
+        assert plane.counters["replica_drain"] == 1
+        assert plane.counters["replica_retire"] == 1
+        actions = [(t["action"], t["replica"])
+                   for t in plane.transitions]
+        assert ("replica_drain", victim) in actions
+        assert ("replica_retire", victim) in actions
+
+    def test_fleet_wide_brownout_names_nobody(self, no_history):
+        """Every replica equally slow is a capacity problem, not a
+        straggler: relative scoring must not scapegoat one replica."""
+        plane = make_plane(n=3, retire_polls=2)
+        snaps = {r.name: healthy_snap(goodput=0.1)
+                 for r in plane.replicas}
+        self.run_polls(plane, snaps, 5)
+        assert plane.counters["replica_drain"] == 0
+        assert all(r.state == "active" for r in plane.replicas)
+
+    def test_draining_replica_waits_for_leases(self, no_history):
+        plane = make_plane(n=3, retire_polls=1)
+        victim = plane.replicas[0]
+        victim.note_dispatch()  # one live lease
+        self.run_polls(plane, {victim.name: healthy_snap(goodput=0.0)},
+                       2)
+        assert victim.state == "draining", \
+            "retire must wait for the lease to finish"
+        victim.note_done(True)
+        plane.poll(now=10.0)
+        assert victim.state == "retired"
+
+    def test_dead_after_fail_threshold_with_standby_backfill(
+            self, no_history):
+        """A replica whose /healthz stops answering crosses
+        fail_threshold -> DEAD, and a standby backfills to hold
+        min_active."""
+        plane = make_plane(n=1, standby=1, fail_threshold=3)
+        victim = plane.replicas[0].name
+        self.run_polls(plane, {victim: None}, 3)
+        assert plane.find(victim).state == "dead"
+        assert plane.counters["replica_dead"] == 1
+        assert plane.counters["replica_adopt"] == 1
+        assert len(plane.active()) == 1
+        actions = [t["action"] for t in plane.transitions]
+        assert actions.index("replica_dead") \
+            < actions.index("replica_adopt")
+
+    def test_min_active_suppression_is_ledger_visible(self,
+                                                      no_history):
+        """A retire that would empty the fleet below min_active with
+        no standby is SUPPRESSED — and the ledger says so."""
+        plane = make_plane(n=2, retire_polls=2, min_active=2)
+        victim = plane.replicas[0].name
+        self.run_polls(plane, {victim: healthy_snap(goodput=0.0)}, 4)
+        assert plane.find(victim).state == "active"
+        assert plane.counters["replica_drain"] == 0
+        assert plane.counters["replica_retire_suppressed"] >= 1
+        note = next(t for t in plane.transitions
+                    if t["action"] == "replica_retire_suppressed")
+        assert note["replica"] == victim
+        assert "min_active" in note["reason"]
+
+    def test_adopt_under_sustained_pressure_only(self, no_history):
+        """Mean fleet pressure >= adopt_pressure for adopt_polls
+        consecutive polls adopts ONE standby; a single spike does
+        not."""
+        plane = make_plane(n=2, standby=1, adopt_pressure=0.8,
+                           adopt_polls=3)
+        hot = {r.name: healthy_snap(inflight=8, limit=8)
+               for r in plane.active()}
+        cool = {r.name: healthy_snap(inflight=1, limit=8)
+                for r in plane.active()}
+        self.run_polls(plane, hot, 2)
+        self.run_polls(plane, cool, 1, start=2.0)
+        assert plane.counters["replica_adopt"] == 0, \
+            "a spike shorter than adopt_polls must not adopt"
+        self.run_polls(plane, hot, 3, start=3.0)
+        assert plane.counters["replica_adopt"] == 1
+        assert len(plane.active()) == 3
+
+    def test_cooldown_bounds_actuation_rate(self, no_history):
+        """Hysteresis + cooldown: two simultaneous collapses actuate
+        ONE drain per cooldown window — a flapping fleet cannot
+        thrash."""
+        plane = make_plane(n=4, retire_polls=1, cooldown_s=100.0)
+        bad = {plane.replicas[0].name: healthy_snap(goodput=0.0),
+               plane.replicas[1].name: healthy_snap(goodput=0.0)}
+        self.run_polls(plane, bad, 3)
+        assert plane.counters["replica_drain"] == 1
+
+    def test_collapse_cuts_incident_naming_the_replica(
+            self, isolated_history):
+        """The acceptance's artifact contract: a drain fires the
+        detector-owned rule and the incident's leading indicator NAMES
+        the replica on the per-replica goodput series."""
+        history = isolated_history
+        plane = make_plane(n=3, retire_polls=2)
+        victim = plane.replicas[0].name
+        self.run_polls(plane, {victim: healthy_snap(goodput=0.0)}, 3)
+        rule = next(r for r in history.rules
+                    if r.name == COLLAPSE_RULE)
+        assert rule.external is True, \
+            "the sampler must never evaluate the detector-owned rule"
+        doc = history.incidents.last_doc
+        assert doc is not None, "a drain must cut an incident artifact"
+        leading = doc["leading_indicator"]
+        assert leading["series"] == REPLICA_GOODPUT_SERIES
+        assert ["replica", victim] in leading["labels"]
+        assert history.incidents.last_path is not None
+
+    def test_control_series_recorded(self, isolated_history):
+        """The plane's sensor readings ride the metric-history plane:
+        per-replica goodput (labelled) and fleet pressure are control
+        series the incident autopsy can replay."""
+        history = isolated_history
+        plane = make_plane(n=2)
+        self.run_polls(plane, {}, 2)
+        snap = history.debug_snapshot(window=60.0, now=2.0)
+        rows = {(r["name"], tuple(sorted(r["labels"].items())))
+                for r in snap["series"]}
+        names = {name for name, _ in rows}
+        assert REPLICA_GOODPUT_SERIES in names
+        assert FLEET_PRESSURE_SERIES in names
+        for rep in plane.replicas:
+            assert (REPLICA_GOODPUT_SERIES,
+                    (("replica", rep.name),)) in rows
+
+    def test_registry_rejects_duplicates_and_drops_departed(
+            self, no_history):
+        plane = make_plane(n=2, standby=0)
+        with pytest.raises(ValueError, match="already registered"):
+            plane.add_standby(plane.replicas[0].url)
+        fresh = plane.add_standby("http://127.0.0.1:9900")
+        assert fresh.state == "standby"
+        assert plane.drop_replica(fresh.name) is fresh
+        assert plane.find(fresh.name) is None
+
+
+# -- configuration -----------------------------------------------------------
+
+class TestConfig:
+
+    def test_shared_subtree_splits_by_key_set(self):
+        """Both configs read the ONE router subtree, each skipping the
+        other's keys."""
+        spec = ("hedge_after_s=1.5,retire_polls=5,max_attempts=2,"
+                "adopt_pressure=0.7")
+        router_cfg = RouterConfig.from_spec(spec)
+        plane_cfg = ServePlaneConfig.from_spec(spec)
+        assert router_cfg.hedge_after_s == 1.5
+        assert router_cfg.max_attempts == 2
+        assert plane_cfg.retire_polls == 5
+        assert plane_cfg.adopt_pressure == 0.7
+
+    def test_unknown_key_raises_naming_the_flag(self):
+        with pytest.raises(ValueError, match="root.common.serve.router"):
+            RouterConfig.from_spec("no_such_knob=1")
+        with pytest.raises(ValueError, match="no_such_knob"):
+            ServePlaneConfig.from_spec("no_such_knob=1")
+
+    @pytest.mark.parametrize("kwargs", [
+        {"max_inflight": -1}, {"hedge_after_s": 0},
+        {"max_attempts": 0}, {"backoff_s": -0.1},
+        {"page_size": 0}, {"spill_pressure": 1.5}])
+    def test_router_validation(self, kwargs):
+        with pytest.raises(ValueError):
+            RouterConfig(**kwargs)
+
+    @pytest.mark.parametrize("kwargs", [
+        {"poll_interval_s": 0}, {"fail_threshold": 0},
+        {"retire_ratio": 1.0}, {"retire_polls": 0},
+        {"goodput_floor": 0}, {"adopt_pressure": 0},
+        {"cooldown_s": -1}, {"min_active": 0}])
+    def test_plane_validation(self, kwargs):
+        with pytest.raises(ValueError):
+            ServePlaneConfig(**kwargs)
+
+    def test_unbounded_admission_spelling(self):
+        assert RouterConfig(max_inflight=0).max_inflight is None
+        assert RouterConfig(max_inflight="").max_inflight is None
+        assert RouterConfig(max_inflight=8).max_inflight == 8
+
+    def test_build_router_wires_both_halves(self, no_history):
+        plane, router = build_router(
+            ["http://127.0.0.1:9000", "127.0.0.1:9001"],
+            standby=["127.0.0.1:9100"],
+            spec="vnodes=16,retire_polls=4")
+        assert router.plane is plane
+        assert router.config.vnodes == 16
+        assert plane.config.retire_polls == 4
+        assert len(plane.active()) == 2
+        assert len(plane.standby()) == 1
+        assert plane.replicas[1].url == "http://127.0.0.1:9001"
+
+    def test_duplicate_replica_names_refused(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            ServePlane(["http://127.0.0.1:9000",
+                        "127.0.0.1:9000"])
+
+
+# -- the replica chaos planner -----------------------------------------------
+
+class TestReplicaChaos:
+
+    def test_deterministic_schedule(self):
+        from veles_tpu.serving_chaos import (ReplicaChaosConfig,
+                                             ReplicaChaosMonkey)
+        cfg = ReplicaChaosConfig(kill_at=2, kill_index=1, slow_at=1,
+                                 slow_ticks=2, slow_index=0,
+                                 poison_healthz_at=4, poison_index=2)
+        monkey = ReplicaChaosMonkey(cfg)
+        schedule = {tick: monkey.actions(tick) for tick in range(6)}
+        assert schedule[0] == []
+        assert schedule[1] == [("pause", 0)]
+        assert schedule[2] == [("kill", 1)]
+        assert schedule[3] == [("resume", 0)]
+        assert schedule[4] == [("poison_healthz", 2)]
+        assert schedule[5] == []
+        assert monkey.counters == {"kills": 1, "pauses": 1,
+                                   "resumes": 1, "healthz_poisons": 1}
+        assert "kill_at" in monkey.stamps
+
+    def test_flap_toggles_on_period(self):
+        from veles_tpu.serving_chaos import (ReplicaChaosConfig,
+                                             ReplicaChaosMonkey)
+        monkey = ReplicaChaosMonkey(ReplicaChaosConfig(flap_period=2,
+                                                       flap_index=1))
+        acts = [monkey.actions(t) for t in range(7)]
+        assert acts[2] == [("pause", 1)]
+        assert acts[4] == [("resume", 1)]
+        assert acts[6] == [("pause", 1)]
+        assert acts[1] == acts[3] == acts[5] == []
+
+    def test_every_profile_leads_on_replica_goodput(self):
+        from veles_tpu.serving_chaos import (REPLICA_PROFILES,
+                                             ReplicaChaosConfig)
+        cfg = ReplicaChaosConfig(kill_at=1, slow_at=1, slow_ticks=1,
+                                 flap_period=2, poison_healthz_at=1)
+        leading = cfg.expected_leading_series()
+        assert set(leading) == set(REPLICA_PROFILES)
+        assert set(leading.values()) == {REPLICA_GOODPUT_SERIES}
+
+    def test_validation(self):
+        from veles_tpu.serving_chaos import ReplicaChaosConfig
+        with pytest.raises(ValueError):
+            ReplicaChaosConfig(kill_at=-1)
+        with pytest.raises(ValueError):
+            ReplicaChaosConfig(slow_at=1, slow_ticks=-1)
+        with pytest.raises(ValueError):
+            ReplicaChaosConfig(flap_period=-2)
+        assert ReplicaChaosConfig().any_profile is False
+        assert ReplicaChaosConfig(kill_at=0).any_profile is True
+
+
+# -- the kill -9 chaos acceptance --------------------------------------------
+
+CHILD = r"""
+import json, sys, time
+import numpy
+import jax.numpy as jnp
+from veles_tpu.parallel.transformer_step import init_transformer_params
+from veles_tpu.serving import GenerateAPI
+
+rng = numpy.random.RandomState(0)
+params = init_transformer_params(rng, 2, 16, 4, 11)
+table = jnp.asarray(rng.randn(11, 16).astype(numpy.float32) * 0.3)
+api = GenerateAPI(params, table, 4, slots=2, max_len=32, n_tokens=5,
+                  chunk=2, port=0)
+api.start()
+print(json.dumps({"port": api.port}), flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+
+@pytest.mark.slow
+class TestElasticChaosAcceptance:
+    """The ISSUE's acceptance: N same-seed subprocess replicas, kill
+    -9 one mid-traffic — every request completes through failover
+    bit-identically, zero non-retryable 5xx, and the control plane
+    names the dead replica."""
+
+    def _spawn_replicas(self, n):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        procs, urls = [], []
+        try:
+            for _ in range(n):
+                proc = subprocess.Popen(
+                    [sys.executable, "-c", CHILD], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, cwd=REPO)
+                procs.append(proc)
+            for proc in procs:
+                line = proc.stdout.readline()
+                assert line, proc.stderr.read()[-2000:]
+                port = json.loads(line)["port"]
+                urls.append("http://127.0.0.1:%d" % port)
+        except Exception:
+            for proc in procs:
+                proc.kill()
+            raise
+        return procs, urls
+
+    def test_kill9_failover_is_bit_identical_and_named(
+            self, isolated_history):
+        from veles_tpu.serving_chaos import (ReplicaChaosConfig,
+                                             ReplicaChaosMonkey)
+        history = isolated_history
+        procs, urls = self._spawn_replicas(3)
+        router = None
+        try:
+            plane, router = build_router(
+                urls, spec="poll_interval_s=0.2,fail_threshold=2,"
+                           "cooldown_s=0.0,hedge_after_s=2.0,"
+                           "backoff_s=0.01,page_size=4")
+            router.start()
+            front = "http://127.0.0.1:%d" % router.port
+            prompts = [[1, 2, 3, 4], [5, 6, 7, 8], [2, 4, 6, 8],
+                       [9, 1, 9, 1], [3, 3, 3, 3], [1, 2, 3, 4, 5]]
+            # warm every replica's decode program first (each prompt
+            # rides affinity to one replica; hit them all directly)
+            for url in urls:
+                status, body, _ = post_router(url, {"tokens": [1, 2, 3]})
+                assert status == 200, body
+
+            # the fault-free baseline THROUGH the router
+            baseline = {}
+            for prompt in prompts:
+                status, body, _ = post_router(front, {"tokens": prompt})
+                assert status == 200, body
+                baseline[tuple(prompt)] = body["tokens"]
+
+            # chaos: sustained traffic, kill -9 replica 0 at tick 1
+            monkey = ReplicaChaosMonkey(ReplicaChaosConfig(kill_at=1,
+                                                           kill_index=0))
+            results, errors = [], []
+            lock = threading.Lock()
+
+            def pound(prompt, rounds=6):
+                for _ in range(rounds):
+                    try:
+                        status, body, _ = post_router(
+                            front, {"tokens": prompt})
+                    except Exception as exc:
+                        with lock:
+                            errors.append(("transport", repr(exc)))
+                        continue
+                    with lock:
+                        results.append((tuple(prompt), status, body))
+
+            threads = [threading.Thread(target=pound, args=(p,))
+                       for p in prompts]
+            for t in threads:
+                t.start()
+            for tick in range(2):
+                for action, index in monkey.actions(tick):
+                    assert action == "kill"
+                    procs[index].send_signal(signal.SIGKILL)
+                time.sleep(0.05)
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+
+            # zero-shed failover: every request completed with the
+            # fault-free greedy tokens, zero non-retryable 5xx
+            assert errors == []
+            assert len(results) == len(prompts) * 6
+            for prompt, status, body in results:
+                assert status == 200, (status, body)
+                assert body["tokens"] == baseline[prompt], \
+                    "failover must stay bit-identical"
+            assert monkey.counters["kills"] == 1
+
+            # the detector names the dead replica in the ledger...
+            dead_name = plane.replicas[0].name
+            assert wait_until(
+                lambda: plane.find(dead_name).state == "dead",
+                timeout=30)
+            entry = next(t for t in plane.transitions
+                         if t["action"] == "replica_dead")
+            assert entry["replica"] == dead_name
+            # ...and in the incident artifact
+            assert wait_until(
+                lambda: history.incidents.last_doc is not None,
+                timeout=10)
+            doc = history.incidents.last_doc
+            leading = doc["leading_indicator"]
+            assert leading["series"] == REPLICA_GOODPUT_SERIES
+            assert ["replica", dead_name] in leading["labels"]
+
+            # the fleet keeps serving after the death
+            status, body, _ = post_router(front,
+                                          {"tokens": [1, 2, 3, 4]})
+            assert status == 200
+            assert body["tokens"] == baseline[(1, 2, 3, 4)]
+        finally:
+            if router is not None:
+                router.stop()
+            for proc in procs:
+                proc.kill()
+            for proc in procs:
+                proc.wait(timeout=30)

@@ -703,6 +703,12 @@ def main(argv=None):
     if argv and argv[0] == "analyze":
         from veles_tpu.analyze.cli import main as analyze_main
         return analyze_main(argv[1:])
+    if argv and argv[0] == "route":
+        from veles_tpu.router import main as route_main
+        return route_main(argv[1:])
+    if argv and argv[0] == "deploy":
+        from veles_tpu.deploy_cli import main as deploy_main
+        return deploy_main(argv[1:])
     return Main().run(argv)
 
 

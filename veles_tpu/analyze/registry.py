@@ -89,6 +89,14 @@ SHARED_CLASSES = {
     "observe/metrics.py": {"MetricsRegistry": ()},
     # jit wrappers on driver + prefetch threads book compile windows
     "observe/xla_stats.py": {"CompileTracker": ()},
+    # router handler threads + attempt threads race inside each Lease;
+    # handler threads and the control-plane poller share ElasticRouter
+    # tallies
+    "router.py": {"Lease": (), "ElasticRouter": ()},
+    # router handler threads bump lease/failure tallies on a Replica
+    # the poller thread scores (the plane's lifecycle state machine
+    # itself is single-writer on the poller thread)
+    "fleet/serve_plane.py": {"Replica": ()},
 }
 
 #: attribute names treated as locks by lock-nesting/census checks —

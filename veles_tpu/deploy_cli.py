@@ -1,0 +1,198 @@
+"""``veles_tpu deploy rollout PKG`` — forge-driven zero-downtime
+rollout in one CLI step (docs/zero_downtime.md).
+
+One verb chains what operators previously scripted by hand: resolve the
+package (a local ``.tar.gz`` path, or ``name[@version]`` fetched from
+the forge store), verify every artifact member against its sha256
+sidecar (``forge/package.py:verify_artifact_members`` — a tampered or
+torn package is REFUSED before any weight byte is parsed), load the
+serving checkpoint member, and hand it to the live
+``GenerateAPI.begin_rollout`` stamped with the package's canonical
+``name@version`` deploy identity — so the SLO burn slices, the ledger
+actuations and any rollback incident all trace back to exactly this
+package.
+
+Serving checkpoint convention: the manifest's ``weights`` key (default
+``weights.npz``) names an ``.npz`` member holding the flattened leaves
+of ``(params, embed_table)`` in ``jax.tree.flatten`` order, keyed
+``leaf_00000...`` — written by :func:`save_serving_checkpoint`,
+re-assembled against the LIVE api's tree structure (the swap seam
+re-validates shapes/dtypes; a mismatched checkpoint is refused there).
+
+Exit-code matrix (tested in ``tests/test_deploy.py``):
+
+====  ======================================================
+code  meaning
+====  ======================================================
+0     rollout began (the ramp proceeds under the live
+      predicate; promotion/rollback is the rollout's job)
+2     package unavailable or malformed (fetch failed, not an
+      archive, manifest invalid, weights member absent)
+3     tampered package (an artifact member's bytes do not
+      match its sha256 sidecar)
+4     no live serving api in this process, or the rollout was
+      refused (one already in flight / checkpoint rejected)
+====  ======================================================
+"""
+
+import argparse
+import io
+import json
+import os
+import sys
+import tarfile
+
+#: exit codes (the matrix above)
+EXIT_OK = 0
+EXIT_PACKAGE = 2
+EXIT_TAMPERED = 3
+EXIT_ROLLOUT = 4
+
+#: default serving-checkpoint member name
+WEIGHTS_MEMBER = "weights.npz"
+
+
+def save_serving_checkpoint(fileobj, params, embed_table):
+    """Write the ``(params, embed_table)`` pytree as the package's
+    ``weights.npz`` member payload: flattened leaves in
+    ``jax.tree.flatten`` order, keyed ``leaf_00000...``."""
+    import jax
+    import numpy
+
+    leaves, _ = jax.tree.flatten((params, embed_table))
+    numpy.savez(fileobj, **{"leaf_%05d" % i: numpy.asarray(leaf)
+                            for i, leaf in enumerate(leaves)})
+
+
+def load_serving_checkpoint(data, like_params, like_table):
+    """Re-assemble a ``weights.npz`` payload against the live api's
+    tree structure; returns ``(params, embed_table)``. Raises
+    ValueError on a leaf-count mismatch (the swap seam validates
+    shapes/dtypes per leaf afterwards)."""
+    import jax
+    import numpy
+
+    archive = numpy.load(io.BytesIO(data))
+    leaves = [archive[key] for key in sorted(archive.files)]
+    _, tree = jax.tree.flatten((like_params, like_table))
+    want = tree.num_leaves
+    if len(leaves) != want:
+        raise ValueError(
+            "checkpoint has %d leaves but the serving params have %d"
+            % (len(leaves), want))
+    return jax.tree.unflatten(tree, leaves)
+
+
+def _resolve_package(spec, forge_url, token):
+    """``spec`` -> package bytes: a local file path wins; otherwise
+    ``name[@version]`` is fetched from the forge store."""
+    if os.path.isfile(spec):
+        with open(spec, "rb") as fin:
+            return fin.read()
+    from veles_tpu.forge.client import ForgeClient
+
+    name, _, version = spec.partition("@")
+    client = ForgeClient(forge_url, token=token)
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        dest, _ = client.fetch(name, version=version or None,
+                               dest=os.path.join(tmp, "pkg.tar.gz"))
+        with open(dest, "rb") as fin:
+            return fin.read()
+
+
+def _extract_weights(blob, manifest):
+    member = manifest.get("weights", WEIGHTS_MEMBER)
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        try:
+            return tar.extractfile(tar.getmember(member)).read()
+        except KeyError:
+            raise ValueError(
+                "package has no serving checkpoint member %r" % member)
+
+
+def rollout_package(spec, api=None, forge_url=None, token=None,
+                    timeout=120.0, out=None):
+    """The ``deploy rollout`` verb's engine; returns an exit code from
+    the matrix. ``api`` defaults to this process's live
+    ``GenerateAPI`` (``serving.get_current_api``) — the injectable
+    seam the exit-code matrix test drives."""
+    from veles_tpu.forge.package import (TamperedPackageError,
+                                         deploy_version,
+                                         verify_artifact_members)
+    out = out if out is not None else sys.stderr
+    try:
+        blob = _resolve_package(spec, forge_url, token)
+    except Exception as err:
+        print("deploy rollout: cannot resolve package %r: %s"
+              % (spec, err), file=out)
+        return EXIT_PACKAGE
+    try:
+        manifest = verify_artifact_members(blob)
+    except TamperedPackageError as err:
+        print("deploy rollout: REFUSING tampered package: %s" % err,
+              file=out)
+        return EXIT_TAMPERED
+    except Exception as err:
+        print("deploy rollout: malformed package: %s" % err, file=out)
+        return EXIT_PACKAGE
+    try:
+        payload = _extract_weights(blob, manifest)
+    except Exception as err:
+        print("deploy rollout: %s" % err, file=out)
+        return EXIT_PACKAGE
+    if api is None:
+        from veles_tpu.serving import get_current_api
+        api = get_current_api()
+    if api is None:
+        print("deploy rollout: no live serving api in this process",
+              file=out)
+        return EXIT_ROLLOUT
+    version = deploy_version(manifest)
+    try:
+        like = api.decoder
+        params, table = load_serving_checkpoint(
+            payload, like.params, like.embed_table)
+    except Exception as err:
+        print("deploy rollout: checkpoint unreadable: %s" % err,
+              file=out)
+        return EXIT_PACKAGE
+    try:
+        api.begin_rollout(params, new_embed_table=table,
+                          version=version, timeout=timeout)
+    except Exception as err:
+        print("deploy rollout: rollout refused: %s" % err, file=out)
+        return EXIT_ROLLOUT
+    print(json.dumps({"rollout": version, "status": "shifting"}),
+          file=out)
+    return EXIT_OK
+
+
+def main(argv=None, api=None):
+    """``veles_tpu deploy <verb>`` dispatcher (today: ``rollout``)."""
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu deploy",
+        description="zero-downtime deploy verbs "
+                    "(docs/zero_downtime.md)")
+    sub = parser.add_subparsers(dest="verb", required=True)
+    ro = sub.add_parser(
+        "rollout",
+        help="fetch + sha-verify + begin_rollout in one step")
+    ro.add_argument("package",
+                    help="local package path or forge name[@version]")
+    ro.add_argument("--forge-url", default=None,
+                    help="forge store base URL (for name[@version])")
+    ro.add_argument("--token", default=None)
+    ro.add_argument("--timeout", type=float, default=120.0,
+                    help="green build+probe budget (seconds)")
+    args = parser.parse_args(argv)
+    if args.verb == "rollout":
+        return rollout_package(args.package, api=api,
+                               forge_url=args.forge_url,
+                               token=args.token,
+                               timeout=args.timeout, out=sys.stderr)
+    parser.error("unknown verb %r" % args.verb)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

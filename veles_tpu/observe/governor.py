@@ -99,13 +99,14 @@ class GovernorConfig:
     KEYS = ("demote_burn", "recover_burn", "cooldown_s", "interval_s",
             "ladder", "min_admit", "admit_factor", "pool_high",
             "prewarm", "prewarm_hot", "breaker_guard",
-            "guard_memory_frac", "enabled")
+            "guard_memory_frac", "deploy_aware", "enabled")
 
     def __init__(self, demote_burn=2.0, recover_burn=1.0,
                  cooldown_s=10.0, interval_s=0.25, ladder=("int8",),
                  min_admit=2, admit_factor=0.5, pool_high=0.85,
                  prewarm=True, prewarm_hot=3, breaker_guard=True,
-                 guard_memory_frac=0.97, flag="root.common.serve.governor"):
+                 guard_memory_frac=0.97, deploy_aware=True,
+                 flag="root.common.serve.governor"):
         self.demote_burn = float(demote_burn)
         self.recover_burn = float(recover_burn)
         if not 0 < self.recover_burn <= self.demote_burn:
@@ -158,6 +159,14 @@ class GovernorConfig:
         if not 0 < self.guard_memory_frac <= 1:
             raise ValueError("%s: guard_memory_frac must be in (0, 1], "
                              "got %r" % (flag, guard_memory_frac))
+        #: suppress tier demotions whose burn is attributable to a
+        #: ramping green slice rather than ambient load
+        #: (docs/zero_downtime.md): the rollout predicate owns the
+        #: bad-deploy response (rollback), and demoting the WHOLE
+        #: surface for one slice's regression would punish blue
+        #: traffic that is serving fine
+        self.deploy_aware = _parse_bool(deploy_aware, "deploy_aware",
+                                        flag)
 
 
 def parse_governor_spec(spec, flag="root.common.serve.governor"):
@@ -246,7 +255,8 @@ class ServingGovernor(Logger):
         self._ladder = tuple(config.ladder)
         self.counters = {"ticks": 0, "demotions": 0, "promotions": 0,
                          "guard_trips": 0, "prewarms": 0,
-                         "admit_resizes": 0}
+                         "admit_resizes": 0,
+                         "demotes_suppressed_deploy": 0}
         #: bounded actuation history: {action, tier, burn, reason, t,
         #: mono} — the /healthz + black-box replay payload
         self.transitions = collections.deque(maxlen=TRANSITION_CAP)
@@ -426,6 +436,18 @@ class ServingGovernor(Logger):
             return
         if burn >= self.config.demote_burn \
                 and self.level < len(self._ladder):
+            attributable = self._deploy_attributable(api, now)
+            if attributable:
+                # the burn is the ramping green slice's, not ambient
+                # load: the rollout predicate owns the response
+                # (rollback), so demoting the WHOLE surface would
+                # punish healthy blue traffic. Ledger-visible and
+                # cooldown-limited like a real transition.
+                self.counters["demotes_suppressed_deploy"] += 1
+                self._last_transition = now
+                self._note("demote_suppressed_deploy", api, burn=burn,
+                           reason=attributable)
+                return
             self.level += 1
             self.counters["demotions"] += 1
             self._last_transition = now
@@ -439,6 +461,45 @@ class ServingGovernor(Logger):
             self._note("promote", api, burn=burn,
                        reason="burn %.3g <= %.3g"
                        % (burn, self.config.recover_burn))
+
+    def _deploy_attributable(self, api, now):
+        """The rollout-interplay predicate (docs/zero_downtime.md):
+        a truthy reason string when the surface-wide burn is
+        attributable to a RAMPING green slice — a rollout is shifting,
+        the green slice's burn is past the demote bar, and the blue
+        (primary) slice's burn sits inside the recover band. Ambient
+        load burns BOTH slices, so a healthy blue acquits it; a green
+        regression is the rollout predicate's to roll back, not this
+        loop's to demote. False otherwise (including with
+        ``deploy_aware`` off, no live rollout, or no SLO engine — no
+        slices, no attribution)."""
+        if not self.config.deploy_aware:
+            return False
+        rollout = getattr(api, "_rollout", None)
+        if rollout is None \
+                or getattr(rollout, "state", None) != "shifting":
+            return False
+        engine = getattr(api, "slo", None)
+        if engine is None:
+            return False
+        try:
+            green = engine.version_burn("green", now=now)
+            blue = engine.version_burn("blue", now=now)
+        except Exception:
+            return False
+        if green is None:
+            return False
+        green_burn = float(green["burn_rate"])
+        blue_burn = float(blue["burn_rate"]) if blue is not None \
+            else 0.0
+        if green_burn >= self.config.demote_burn \
+                and blue_burn <= self.config.recover_burn:
+            return ("green slice burn %.3g >= %.3g while blue holds "
+                    "%.3g <= %.3g — deploy-attributable, rollout owns "
+                    "the response"
+                    % (green_burn, self.config.demote_burn, blue_burn,
+                       self.config.recover_burn))
+        return False
 
     def _reconcile_tier(self, api):
         """Ask the driver for a graceful swap whenever the decoder's

@@ -25,6 +25,13 @@ sentinel schema, the driver wrapper (``{"tail": ..., "parsed": ...}``),
 a flat bench line — and RECOVERS keys from a truncated tail with a
 scanning parser, because the round artifacts we must compare against
 already lost their heads.
+
+The elastic router keys (docs/elastic_serving.md) ride the existing
+direction rules: ``elastic_failover_ms`` is lower-better via the
+``_ms`` suffix; ``elastic_scale_x``, ``elastic_affinity_hit_rate``
+and ``elastic_tokens_per_sec_*`` take the higher-better default, so
+a dropped scale efficiency or affinity hit rate fails the gate
+(directions pinned in tests/test_deploy.py).
 """
 
 import hashlib

@@ -226,6 +226,17 @@ class InteractiveLoader(Loader):
             return numpy.loadtxt(path, **self.loadtxt_kwargs)
 
 
+#: weakref to the newest started GenerateAPI (the deploy CLI's target)
+_CURRENT_API = None
+
+
+def get_current_api():
+    """This process's live serving api (the newest
+    ``GenerateAPI.start()``), or None — ``deploy_cli.rollout_package``
+    targets it when no api is injected."""
+    return _CURRENT_API() if _CURRENT_API is not None else None
+
+
 class ServingHealth:
     """Thread-safe health + counter registry shared by the serving HTTP
     surfaces; ``snapshot()`` backs ``/healthz``, the web-status
@@ -810,6 +821,11 @@ class ContinuousDecoder:
                 params, embed_table, heads, mesh, axis=mesh_axis)
         self.params = params
         self.embed_table = embed_table
+        #: the last hot-swap's reshard receipt ({"bytes", "seconds",
+        #: "counts"} — parallel/reshard.py) or None before any swap /
+        #: off-mesh; the deploy surfaces expose it so a train->serve
+        #: transition can be PINNED slice-only (0 wire bytes)
+        self.last_swap_stats = None
         self.heads = heads
         self.slots = slots
         if self.quantize == "int8-kv":
@@ -1119,8 +1135,14 @@ class ContinuousDecoder:
             from veles_tpu.parallel.reshard import reshard
             dst = jax.tree.unflatten(
                 old_tree, [leaf.sharding.spec for leaf in old_leaves])
-            (new_params, new_table), _ = reshard(
+            (new_params, new_table), stats = reshard(
                 (new_params, new_table), self.mesh, dst, label="swap")
+            # the transition's wire receipt: a host (train-layout)
+            # checkpoint onto a serve mesh must be slice-only — 0
+            # bytes on the wire (pinned in test_deploy.py)
+            self.last_swap_stats = stats
+        else:
+            self.last_swap_stats = None
         old = (self.params, self.embed_table)
         self.params = new_params
         self.embed_table = new_table
@@ -3070,6 +3092,12 @@ class GenerateAPI:
                                           start_server)
 
         api = self
+        # the deploy CLI's seam (deploy_cli.py): the newest started
+        # surface is THE process's deploy target (weakly referenced —
+        # a stopped/collected api drops out on its own)
+        global _CURRENT_API
+        import weakref
+        _CURRENT_API = weakref.ref(self)
         # the telemetry plane (docs/observability.md): /metrics on this
         # surface exposes the health counters and the decoder's
         # dispatch/timing state via weakly-referenced scrape bridges

@@ -462,6 +462,139 @@ class ServingChaosMonkey(Logger):
         return fired
 
 
+# -- replica-level chaos (the elastic router's proving ground) ---------------
+
+#: the replica fault profiles the elastic serving acceptance drives
+#: (docs/elastic_serving.md)
+REPLICA_PROFILES = ("replica_kill", "replica_slow", "replica_flap",
+                    "poison_healthz")
+
+
+class ReplicaChaosConfig:
+    """Deterministic, TICK-indexed replica fault schedule — the burn
+    profiles' step-indexed idiom lifted to the fleet level (no RNG:
+    a (config, workload) pair replays the same schedule). A tick is
+    one harness control-loop pass (typically one router poll).
+
+    - ``kill_at``/``kill_index`` — kill -9 replica ``kill_index`` at
+      tick ``kill_at`` (mid-stream death: in-flight leases must fail
+      over with bit-identical tokens);
+    - ``slow_at``/``slow_ticks``/``slow_index`` — SIGSTOP the replica
+      for ``slow_ticks`` ticks, then SIGCONT (slow-then-recovered: its
+      late responses must be fence-discarded, never double-delivered);
+    - ``flap_period``/``flap_index`` — toggle pause/resume every
+      ``flap_period`` ticks (a flapping replica must not thrash the
+      lifecycle: hysteresis + cooldown hold);
+    - ``poison_healthz_at``/``poison_index`` — make the replica's
+      ``/healthz`` lie (claims healthy while goodput collapses): the
+      leave-one-out detector must name it anyway, because it scores
+      RELATIVE goodput, not self-reported readiness.
+    """
+
+    def __init__(self, kill_at=None, kill_index=0, slow_at=None,
+                 slow_ticks=0, slow_index=0, flap_period=0,
+                 flap_index=0, poison_healthz_at=None, poison_index=0):
+        if kill_at is not None and int(kill_at) < 0:
+            raise ValueError("kill_at must be >= 0")
+        self.kill_at = None if kill_at is None else int(kill_at)
+        self.kill_index = int(kill_index)
+        if slow_at is not None and int(slow_at) < 0:
+            raise ValueError("slow_at must be >= 0")
+        self.slow_at = None if slow_at is None else int(slow_at)
+        self.slow_ticks = int(slow_ticks)
+        if self.slow_ticks < 0:
+            raise ValueError("slow_ticks must be >= 0")
+        self.slow_index = int(slow_index)
+        self.flap_period = int(flap_period)
+        if self.flap_period < 0:
+            raise ValueError("flap_period must be >= 0")
+        self.flap_index = int(flap_index)
+        if poison_healthz_at is not None and int(poison_healthz_at) < 0:
+            raise ValueError("poison_healthz_at must be >= 0")
+        self.poison_healthz_at = None if poison_healthz_at is None \
+            else int(poison_healthz_at)
+        self.poison_index = int(poison_index)
+
+    @property
+    def any_profile(self):
+        return (self.kill_at is not None or self.slow_at is not None
+                or self.flap_period > 0
+                or self.poison_healthz_at is not None)
+
+    def expected_leading_series(self):
+        """Every replica profile collapses the named replica's goodput
+        relative to the rest of the fleet, so the incident artifact's
+        leading indicator is always the per-replica goodput control
+        series (``fleet/serve_plane.py``)."""
+        from veles_tpu.fleet.serve_plane import REPLICA_GOODPUT_SERIES
+        out = {}
+        if self.kill_at is not None:
+            out["replica_kill"] = REPLICA_GOODPUT_SERIES
+        if self.slow_at is not None:
+            out["replica_slow"] = REPLICA_GOODPUT_SERIES
+        if self.flap_period > 0:
+            out["replica_flap"] = REPLICA_GOODPUT_SERIES
+        if self.poison_healthz_at is not None:
+            out["poison_healthz"] = REPLICA_GOODPUT_SERIES
+        return out
+
+
+class ReplicaChaosMonkey(Logger):
+    """The replica fault PLANNER: the harness owns the replica
+    processes (it spawned them), so the monkey only decides — each
+    :meth:`actions` call returns the (action, replica_index) pairs due
+    at that tick and the harness executes them (``kill`` -> SIGKILL,
+    ``pause``/``resume`` -> SIGSTOP/SIGCONT, ``poison_healthz`` -> flip
+    the replica's health endpoint to lie). Fault instants land in
+    ``stamps`` so the bench prices failover latency from the kill
+    instant, not from detection."""
+
+    #: the actions a harness must implement
+    ACTIONS = ("kill", "pause", "resume", "poison_healthz")
+
+    def __init__(self, config):
+        super().__init__(logger_name="serve.ReplicaChaos")
+        self.config = config
+        self.counters = {"kills": 0, "pauses": 0, "resumes": 0,
+                         "healthz_poisons": 0}
+        self.stamps = {}
+        self._flap_paused = False
+
+    def actions(self, tick):
+        """The (action, replica_index) pairs due at ``tick`` — fixed
+        order: kill, slow, flap, poison."""
+        cfg = self.config
+        due = []
+        if cfg.kill_at is not None and tick == cfg.kill_at:
+            due.append(("kill", cfg.kill_index))
+            self.counters["kills"] += 1
+            self.stamps["kill_at"] = time.monotonic()
+            self.warning("chaos: kill -9 replica %d", cfg.kill_index)
+        if cfg.slow_at is not None:
+            if tick == cfg.slow_at:
+                due.append(("pause", cfg.slow_index))
+                self.counters["pauses"] += 1
+                self.stamps["slow_start"] = time.monotonic()
+            elif tick == cfg.slow_at + cfg.slow_ticks:
+                due.append(("resume", cfg.slow_index))
+                self.counters["resumes"] += 1
+                self.stamps["slow_clear"] = time.monotonic()
+        if cfg.flap_period > 0 and tick > 0 \
+                and tick % cfg.flap_period == 0:
+            action = "resume" if self._flap_paused else "pause"
+            self._flap_paused = not self._flap_paused
+            due.append((action, cfg.flap_index))
+            self.counters["pauses" if action == "pause"
+                          else "resumes"] += 1
+            self.stamps.setdefault("flap_start", time.monotonic())
+        if cfg.poison_healthz_at is not None \
+                and tick == cfg.poison_healthz_at:
+            due.append(("poison_healthz", cfg.poison_index))
+            self.counters["healthz_poisons"] += 1
+            self.stamps["poison_healthz_at"] = time.monotonic()
+        return due
+
+
 # -- artifact faults (harness-side helper) -----------------------------------
 
 def tear_file(path, frac=0.5):
