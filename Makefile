@@ -1,8 +1,8 @@
 PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
-	regress mesh paged fleet-mr aot slo governor history analyze \
-	fleetscope servescope deploy elastic
+	regress mesh paged paged-kernel fleet-mr aot slo governor history \
+	analyze fleetscope servescope deploy elastic
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -51,6 +51,19 @@ mesh:
 paged:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_paged.py \
 		-m paged -q
+
+# Fused paged-attention kernel suite (docs/paged_kv.md "The fused
+# kernel"): kernel-vs-gather token bit-identity through the real
+# serving engine via Pallas interpret mode (bf16 + int8-KV, mid-flight
+# joins, tail/hit admissions), the ragged admission path's per-row
+# masking + exact page allocation, the capability-probe fallback
+# matrix (FORCE toggle / config / backend auto), tile_pad waste
+# accounting with span/page overshoot pinned 0, and the warmed-sweep
+# zero-retrace guard. (The interpret-mode composites ride the `slow`
+# marker so tier-1 keeps its timeout margin; this target runs them.)
+paged-kernel:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+		tests/test_paged_kernel.py -m paged_kernel -q
 
 # Compiler-visible fleet aggregation suite (docs/compiler_fleet.md):
 # the mapreduce primitives (f32 bit-exact vs psum, bf16/int8 quantized

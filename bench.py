@@ -1325,6 +1325,98 @@ def decode_paged(embed=256, heads=8, blocks=2, vocab=2048,
     return out
 
 
+def decode_paged_kernel(embed=64, heads=8, blocks=2, vocab=512,
+                        page_size=None, budget=8, lengths=None,
+                        repeats=3):
+    """The fused paged-attention kernel section (docs/paged_kv.md "The
+    fused kernel", ROADMAP item 5): the Pallas kernel tier measured
+    against the page-table gather it replaces, same decoder, same
+    traffic — two claims:
+
+    - **length flatness**: per-step decode time with one live sequence
+      at each length (``decode_paged_kernel_step_len<L>_ms``,
+      min-of-``repeats``), summarized as the max/min ratio
+      ``decode_paged_kernel_step_flatness`` (lower is better; the
+      kernel walks live pages only, so step cost should track live
+      tokens — the gather path's cost tracks the page bucket).
+    - **mixed-length speedup**: one step over slots live at EVERY
+      length at once — the ragged occupancy a real server holds —
+      kernel vs gather (``decode_paged_{kernel,gather}_step_mixed_ms``
+      and ``decode_paged_kernel_speedup`` = gather/kernel, higher is
+      better; > 1 is the win the waste counters predict).
+
+    Both sides run through ``ContinuousDecoder`` with the probe FORCED
+    (``ops.paged_attention.FORCE_PAGED_KERNEL`` + ``jax.clear_caches``
+    — the jitted step reads the probe at trace time), so the numbers
+    include the full dispatch path, not a bare kernel microbench. Off
+    TPU the kernel runs in Pallas interpret mode: correct but
+    emulated, so the speedup key is only a hardware claim on TPU
+    (``decode_paged_kernel_config`` records the backend). Directions
+    ride the registered ``_ms``/``_flatness`` lower-better and
+    ``_speedup`` higher-better suffixes (observe/regress.py)."""
+    from veles_tpu.ops import paged_attention as pgatt
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import ContinuousDecoder
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if page_size is None:
+        # the TPU construction check requires span-tile multiples;
+        # interpret mode off-TPU keeps the sweep small instead
+        page_size = 128 if on_tpu else 16
+    if lengths is None:
+        lengths = ((128, 256, 512) if on_tpu else (16, 48, 96))
+    rng = numpy.random.RandomState(0)
+    params = init_transformer_params(rng, blocks, embed, heads, vocab)
+    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.02).astype(jnp.bfloat16)
+    max_len = max(lengths) + budget + 4
+    out = {}
+
+    def step_ms(force, lens):
+        pgatt.FORCE_PAGED_KERNEL = force
+        jax.clear_caches()
+        dec = ContinuousDecoder(
+            params, table, heads, slots=len(lens), max_len=max_len,
+            n_tokens=budget, paged=True, page_size=page_size)
+        for live in lens:
+            dec.submit(rng.randint(0, vocab, live), budget)
+        dec.step()  # admit + compile the step program
+        dec.step()  # untimed warmup: steady-state caches, no compile
+        dec.step()
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            dec.step()
+            times.append(time.perf_counter() - t0)
+        return min(times) * 1000
+
+    force_prev = pgatt.FORCE_PAGED_KERNEL
+    try:
+        per_len = [step_ms(True, [live]) for live in lengths]
+        for live, ms in zip(lengths, per_len):
+            out["decode_paged_kernel_step_len%d_ms" % live] = round(
+                ms, 3)
+        out["decode_paged_kernel_step_flatness"] = round(
+            max(per_len) / max(min(per_len), 1e-9), 4)
+        mixed = list(lengths)
+        kernel_ms = step_ms(True, mixed)
+        gather_ms = step_ms(False, mixed)
+        out["decode_paged_kernel_step_mixed_ms"] = round(kernel_ms, 3)
+        out["decode_paged_gather_step_mixed_ms"] = round(gather_ms, 3)
+        out["decode_paged_kernel_speedup"] = round(
+            gather_ms / max(kernel_ms, 1e-9), 4)
+    finally:
+        pgatt.FORCE_PAGED_KERNEL = force_prev
+        jax.clear_caches()
+    out["decode_paged_kernel_config"] = (
+        "%s_ps%d_b%d_L%d_e%d_h%d_v%d_len%s"
+        % (jax.default_backend(), page_size, budget, blocks, embed,
+           heads, vocab, "x".join(str(n) for n in lengths)))
+    return out
+
+
 def reshard_section(blocks=2, embed=256, heads=8, vocab=2048,
                     slots=4, budget=24, chunk=8, repeats=5):
     """The train↔serve layout transition, measured (ROADMAP item 1 /
@@ -2779,6 +2871,13 @@ def serve_main(profile_dir=None, artifact_path=None):
             # the paged-KV section (docs/paged_kv.md): length flatness,
             # cold-vs-cached admission, concurrency at fixed HBM
             section = _guarded(decode_paged, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # the fused paged-attention kernel (docs/paged_kv.md "The
+            # fused kernel"): per-length step flatness + the
+            # mixed-length kernel-vs-gather speedup at ragged
+            # occupancy (interpret-mode emulation off TPU)
+            section = _guarded(decode_paged_kernel, fallback={})
             out.update(section)
             artifact.update(section)
             # the mesh tier (docs/sharded_serving.md): train<->serve

@@ -1,0 +1,37 @@
+"""Seeded violations for the fused paged-attention kernel's jit
+surface (ops/paged_attention.py): the kernel wrapper carries static
+``page_size``/``block_h`` arguments, so a careless integration could
+(a) rebuild the jit inside the per-request serve loop — a fresh
+traced callable per admitted request, the per-request retrace the
+capability-probe doctrine exists to prevent — or (b) key the statics
+on an unhashable block-shape list. The SHIPPED module does neither
+(tests/test_analyze.py asserts the real kernel surface is
+retrace-clean); this fixture proves the rules would catch both
+regressions at the exact line."""
+
+import functools
+
+import jax
+
+
+def _paged_attend(q, page_table, lengths, *, page_size, block_h):
+    return q
+
+
+def serve_requests(requests, page_size):
+    outs = []
+    for q, page_table, lengths in requests:
+        attend = functools.partial(_paged_attend, page_size=page_size,
+                                   block_h=8)
+        step = jax.jit(attend)  # analyze-expect: retrace.jit-in-loop
+        outs.append(step(q, page_table, lengths))
+    return outs
+
+
+@functools.partial(jax.jit, static_argnames=("block_shape",))
+def tuned_attend(q, block_shape):
+    return q
+
+
+def admit(q):
+    return tuned_attend(q, block_shape=[8, 128])  # analyze-expect: retrace.unhashable-static

@@ -40,6 +40,12 @@ RULE_FIXTURES = [
     ("retrace.unhashable-static", "unhashable_static.py"),
     ("retrace.jit-in-loop", "jit_in_loop.py"),
     ("retrace.shape-key", "shape_key.py"),
+    # ISSUE 18: the fused paged-attention kernel's jit surface —
+    # the static-arg wrapper rebuilt per request + an unhashable
+    # block-shape static, both in one fixture (the real
+    # ops/paged_attention.py is asserted clean below)
+    ("retrace.jit-in-loop", "paged_kernel_retrace.py"),
+    ("retrace.unhashable-static", "paged_kernel_retrace.py"),
     ("donation.read-after-dispatch", "donation.py"),
     ("shared.rmw", "shared_rmw.py"),
     ("deploy.swap-seam", "swap_seam.py"),
@@ -100,6 +106,22 @@ class TestRuleCorpus:
                                         registry=fixture_registry())
         assert not errors
         assert findings == []
+
+    def test_paged_kernel_surface_retrace_clean(self):
+        """ISSUE 18 acceptance: the fused kernel's static-arg
+        signature (page_size/block_h statics in ops/paged_attention.py
+        and the probe-switched attend seam in parallel/kv_pool.py)
+        must not reintroduce a per-request retrace — the whole retrace
+        rule family yields ZERO findings on the REAL files, with the
+        real package registry (paged_kernel_retrace.py proves the
+        same rules fire on the seeded regressions)."""
+        paths = [os.path.join(REPO_ROOT, "veles_tpu", "ops",
+                              "paged_attention.py"),
+                 os.path.join(REPO_ROOT, "veles_tpu", "parallel",
+                              "kv_pool.py")]
+        findings, errors = run_analysis(paths, rule_filter="retrace")
+        assert not errors
+        assert [(f.rule, f.line) for f in findings] == []
 
     def test_whole_corpus_matches_markers(self):
         """Directory run: the union of every fixture's markers, each

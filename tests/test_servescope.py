@@ -452,7 +452,7 @@ class TestMetricsAndHealth:
     def test_waste_causes_cover_the_catalog(self):
         assert set(WASTE_CAUSES) == {
             "bucket_pad", "group_dup", "span_overshoot",
-            "page_overshoot", "dead_slot", "discard"}
+            "page_overshoot", "tile_pad", "dead_slot", "discard"}
 
 
 # -- trace assembly + the serve-trace CLI -----------------------------------

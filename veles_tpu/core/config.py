@@ -200,6 +200,10 @@ root.common.update({
         "deadline": 300.0,
         "rebuild_backoff": 0.5,
         "rebuild_backoff_max": 30.0,
+        # fused paged-attention tier (ops/paged_attention.py): None =
+        # backend auto (kernel on TPU, page-table gather elsewhere);
+        # True/False force (--serve-paged-kernel)
+        "paged_kernel": None,
     },
     "fleet": {
         "job_timeout": 120.0,

@@ -110,6 +110,16 @@ SCHEMA_VERSION = 1
 #: at 0 — any shed across the swap window breaks the zero-downtime
 #: contract, enforced as a hard assert in tests/test_deploy.py since
 #: a 0 baseline passes the ratio gate vacuously).
+#: The fused paged-attention kernel keys (ops/paged_attention.py,
+#: bench decode_paged_kernel): the per-length
+#: decode_paged_kernel_step_len<L>_ms and the mixed-occupancy
+#: decode_paged_{kernel,gather}_step_mixed_ms ride "_ms";
+#: decode_paged_kernel_step_flatness rides "_flatness" (the kernel's
+#: whole claim is that step cost tracks live tokens — flatness
+#: drifting up means the live-page walk stopped paying);
+#: decode_paged_kernel_speedup (gather/kernel at ragged occupancy)
+#: uses the higher-is-better default via "_speedup", so the
+#: kernel-vs-gather win is itself regress-gated.
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness", "_compiles", "burn_rate", "_transitions",

@@ -53,7 +53,8 @@ Units caveat (documented in docs/observability.md): the token plane
 counts MLP token-steps (prompt positions, decode lane-steps) for
 ``bucket_pad`` / ``group_dup`` / ``dead_slot`` / ``discard``, and
 masked ATTENDED positions for ``span_overshoot`` / ``page_overshoot``
-— one decomposition of dispatched work, not a FLOP-exact model.
+/ ``tile_pad`` — one decomposition of dispatched work, not a
+FLOP-exact model.
 
 See docs/observability.md ("Serving goodput + slot timeline") and
 tests/test_servescope.py (``make servescope``).
@@ -84,8 +85,12 @@ OPEN_SLOT_CAP = 4096
 #: - dead_slot: inactive lanes advanced through decode dispatches
 #: - discard: live-lane tokens computed but never delivered (lag-1
 #:   retirement tails, budget clamp, post-eos)
+#: - tile_pad: dead lanes of each live slot's LAST partial page on the
+#:   fused-kernel path (ops/paged_attention.py) — the kernel attends
+#:   live pages only, so span/page overshoot is structurally zero and
+#:   the residual books here instead of mis-crediting zero work done
 WASTE_CAUSES = ("bucket_pad", "group_dup", "span_overshoot",
-                "page_overshoot", "dead_slot", "discard")
+                "page_overshoot", "dead_slot", "discard", "tile_pad")
 
 #: wall components the serving seconds decompose into
 WALL_COMPONENTS = ("prefill_compute", "decode_compute", "host", "idle")
@@ -208,11 +213,13 @@ class ServeScope:
                            round(float(elapsed) * 1e3, 3), now])
 
     def note_dispatch(self, chunk, slots, active, overshoot, elapsed,
-                      now=None, paged=False, span=0, pages=0):
+                      now=None, paged=False, span=0, pages=0,
+                      kernel=False):
         """One decode dispatch of ``chunk`` steps over ``slots`` lanes
         (``active`` live): books dead-slot lane-steps, the span/page
-        overshoot positions, and the lane-step occupancy numerators
-        (record path)."""
+        overshoot positions — or, on the fused-kernel path
+        (``kernel=True``), the last-partial-page ``tile_pad`` residual
+        — and the lane-step occupancy numerators (record path)."""
         if not self.enabled:
             return
         if now is None:
@@ -224,11 +231,14 @@ class ServeScope:
         slots = int(slots)
         dead = max(0, slots - active) * chunk
         self.waste["dead_slot"] += dead
-        self.waste["page_overshoot" if paged
+        self.waste["tile_pad" if kernel
+                   else "page_overshoot" if paged
                    else "span_overshoot"] += int(overshoot)
         self.total_lane_steps += slots * chunk
         self.live_lane_steps += active * chunk
-        self._ring.append(["dispatch", "paged" if paged else "dense",
+        self._ring.append(["dispatch",
+                           "kernel" if kernel
+                           else "paged" if paged else "dense",
                            chunk, slots, active,
                            int(pages) if paged else int(span),
                            int(overshoot), dead,

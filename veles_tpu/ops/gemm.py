@@ -473,9 +473,34 @@ def autotune_main(argv=None):
                         help="tune the int8 dequant-fused matvec "
                              "(ops/quant.py) instead of the GEMM: "
                              "shapes are MxKxN")
+    parser.add_argument("--paged-attention", action="store_true",
+                        help="tune the fused paged-attention kernel's "
+                             "head-block size "
+                             "(ops/paged_attention.py) instead of the "
+                             "GEMM: shapes are PSxD (page size x head "
+                             "dim)")
     args = parser.parse_args(argv)
     dtype = getattr(jnp, args.dtype)
     failed = 0
+    if args.paged_attention:
+        from veles_tpu.ops.paged_attention import (
+            autotune_paged_attention)
+        for spec in args.shapes.split(","):
+            ps, d = (int(x) for x in spec.lower().split("x"))
+            block_h = autotune_paged_attention(ps, d, iters=args.iters)
+            key = "pgatt:%dx%d" % (ps, d)
+            try:
+                with open(_cache_path()) as fin:
+                    persisted = key in json.load(fin)
+            except (OSError, ValueError):
+                persisted = False
+            if not persisted:
+                failed += 1
+            print(json.dumps({"shape": [ps, d],
+                              "block_h": int(block_h),
+                              "persisted": persisted,
+                              "cache": _cache_path()}))
+        return 1 if failed else 0
     if args.int8:
         from veles_tpu.ops.quant import autotune_int8
         for spec in args.shapes.split(","):

@@ -1,0 +1,423 @@
+"""Fused ragged paged attention: the page-table walk moves into the kernel.
+
+The paged slot engine (``parallel/kv_pool.py``) attends each decode
+step over a GATHERED span: every slot's pages are materialized into a
+contiguous ``(S, PB * page_size, H, D)`` buffer sized to the LONGEST
+live sequence, and masking zeroes the overshoot. The math is exact,
+but the dispatched work is not — a slot at length 40 in a batch whose
+longest neighbor spans 12 pages attends (and gathers HBM for) all 12,
+and the servescope waste plane names the bill precisely:
+``span_overshoot``/``page_overshoot`` (ROADMAP item 5; PR 15's
+decomposition).
+
+This module deletes that overshoot at the kernel level (the ACT lesson,
+PAPERS.md arxiv 2510.09932 — accelerator-specific codegen behind a
+capability probe with a portable fallback):
+
+- :func:`paged_attend` / :func:`paged_attend_int8` — Pallas
+  flash-style kernels gridded over ``(slot, page)`` that walk the page
+  table DIRECTLY: the table and the per-slot live lengths ride as
+  prefetched scalars (``PrefetchScalarGridSpec``), each grid cell DMAs
+  exactly one physical page into VMEM (the index map reads
+  ``page_table[s, p]`` — no gathered copy of the pool ever exists),
+  and an online-softmax accumulator (running ``(acc, m, l)`` à la
+  flash attention) merges a slot's pages left to right. Pages past a
+  slot's live count are SKIPPED (``pl.when`` — the copy of scratch
+  page 0 still streams, but zero FLOPs run), so attended work scales
+  with each slot's live tokens, not the padded max-span.
+- :func:`use_paged_kernel` — the capability probe
+  (``root.common.serve.paged_kernel`` / ``--serve-paged-kernel``;
+  ``None`` = auto: TPU-family backends only). Everywhere else the
+  established gather path runs unchanged — it IS the CPU bit-identity
+  contract (tests/test_paged.py), and interpret mode executes these
+  kernels on CPU to prove the kernel path's token streams match it
+  (tests/test_paged_kernel.py, marked ``slow``).
+- :func:`autotune_paged_attention` — block-size tuning per
+  ``(page_size, head_dim)`` through ``ops/gemm.py``'s existing
+  autotune cache (one artifact, the shared ``_sane_entry`` hygiene;
+  key ``pgatt:PSxD``). The tunable is ``block_h`` — heads fused per
+  MXU dot inside a grid cell.
+
+Numerical contract: the online-softmax merge is algebraically the
+gather path's masked softmax (masked positions contribute EXACT zeros
+— every live page holds at least one visible position, so the -1e30
+sentinels underflow to 0 against the running max), but the
+accumulation ORDER differs, so logits agree to f32 round-off rather
+than bitwise. The bit-identity the serving tier promises is at the
+TOKEN level and proven empirically in interpret mode; the probe keeps
+CPU serving on the gather path, so the repo's tier-1 contract is
+untouched.
+
+See docs/paged_kv.md ("The fused kernel") and ``make paged-kernel``.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas import tolerant: the gather path never needs it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - jax always ships pallas here
+    pl = pltpu = None
+
+from veles_tpu.core.config import root
+
+#: None = auto (config, then backend probe); True/False pin the kernel
+#: on/off for every paged dispatch — the test seam and emergency
+#: opt-out. Flipping it does NOT invalidate already-traced programs:
+#: the probe is read at TRACE time inside ``_paged_slot_step``, so
+#: tests toggling it must ``jax.clear_caches()``.
+FORCE_PAGED_KERNEL = None
+
+#: fallback heads-per-dot when no tuned entry exists for the
+#: (page_size, head_dim) bucket: whole-head groups up to this many
+#: heads feed one MXU dot per grid cell
+_DEFAULT_BLOCK_H = 8
+
+#: block_h candidates the autotuner races (filtered to divisors of the
+#: actual head count)
+_BLOCK_H_CANDIDATES = (1, 2, 4, 8, 16)
+
+
+def use_paged_kernel():
+    """The capability probe: should paged dispatches run the fused
+    kernel? Resolution order — :data:`FORCE_PAGED_KERNEL` (the test /
+    emergency seam), then ``root.common.serve.paged_kernel``
+    (``--serve-paged-kernel``), then auto: TPU-family backends only
+    (the gather path is the portable fallback AND the CPU bit-identity
+    reference). Read at trace time by ``kv_pool._paged_slot_step`` —
+    no jitted signature carries it, so the AOT facade, the sharded
+    paged fns and the ``paged.*`` instrument names extend unchanged."""
+    if FORCE_PAGED_KERNEL is not None:
+        return bool(FORCE_PAGED_KERNEL)
+    cfg = root.common.serve.get("paged_kernel", None)
+    if cfg is not None:
+        return bool(cfg)
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _interpret_default():
+    """Interpret mode off the TPU family: the SAME kernel runs (slowly,
+    emulated) on CPU — how the slow-marked bit-identity composite
+    proves the kernel path without hardware."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _tuned_block_h(page_size, head_dim, heads):
+    """Heads fused per in-kernel dot for this ``(page_size, head_dim)``
+    bucket: the persisted autotune verdict when one exists (clamped to
+    a divisor of ``heads`` — a tuned 8 still serves a 4-head toy),
+    else the default. Shares ``ops/gemm.py``'s cache artifact and its
+    ``_sane_entry`` hygiene — a poisoned row was dropped at load."""
+    from veles_tpu.ops import gemm
+
+    entry = gemm._load_cache().get(
+        "pgatt:%dx%d" % (int(page_size), int(head_dim)))
+    block_h = _DEFAULT_BLOCK_H
+    if entry and entry.get("blocks"):
+        block_h = int(entry["blocks"][0])
+    while block_h > 1 and heads % block_h:
+        block_h //= 2
+    return max(1, min(block_h, heads))
+
+
+# -- the kernels --------------------------------------------------------------
+
+def _online_merge(acc_ref, m_ref, l_ref, h0, bh, scores, visible, v_pv):
+    """One flash-attention merge step for the head slice
+    ``[h0:h0+bh]``: fold ``scores`` (bh, ps) masked by ``visible``
+    (1, ps) and their value product ``v_pv(p_weights) -> (bh, D)``
+    into the running ``(acc, m, l)`` accumulators. Masked positions
+    carry -1e30, which underflows to an EXACT zero against the running
+    max (every live page has at least one visible position, so the max
+    is always a real score)."""
+    scores = jnp.where(visible, scores, -1e30)
+    m_prev = m_ref[h0:h0 + bh, :]                      # (bh, lanes)
+    m_new = jnp.maximum(m_prev,
+                        jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new[:, :1])                 # (bh, ps)
+    acc_ref[h0:h0 + bh, :] = (alpha[:, :1] * acc_ref[h0:h0 + bh, :]
+                              + v_pv(p))
+    l_ref[h0:h0 + bh, :] = (alpha * l_ref[h0:h0 + bh, :]
+                            + jnp.sum(p, axis=1, keepdims=True))
+    m_ref[h0:h0 + bh, :] = m_new
+
+
+def _float_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size, heads, head_dim,
+                  block_h):
+    """Grid cell (slot s, logical page p): merge ONE physical page of
+    K/V (the index map already resolved ``page_table[s, p]``) into
+    slot s's online-softmax accumulators; finalize at the last page.
+    Scores and PV products accumulate in f32 (the gather path's
+    ``preferred_element_type`` discipline)."""
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+    pb = pl.num_programs(1)
+    length = len_ref[s]
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # live pages = length // page_size + 1 (the append for THIS step
+    # landed at position `length` before the attend — the gather
+    # path's `arange(span) <= lengths` contract)
+    @pl.when(p <= length // page_size)
+    def _merge():
+        q = q_ref[0].astype(jnp.float32)               # (H, D)
+        k = k_ref[0].astype(jnp.float32)               # (ps, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        idx = p * page_size + lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        visible = idx <= length                        # (1, ps)
+        scale = 1.0 / math.sqrt(float(head_dim))
+        for h0 in range(0, heads, block_h):
+            bh = min(block_h, heads - h0)
+            qg = q[h0:h0 + bh]                         # (bh, D)
+            kg = k[:, h0:h0 + bh, :]                   # (ps, bh, D)
+            scores = lax.dot_general(
+                qg, kg, (((1,), (2,)), ((0,), (1,))),
+                preferred_element_type=jnp.float32) * scale
+            vg = v[:, h0:h0 + bh, :]                   # (ps, bh, D)
+            _online_merge(
+                acc_ref, m_ref, l_ref, h0, bh, scores, visible,
+                lambda pw, vg=vg: lax.dot_general(
+                    pw, vg, (((1,), (0,)), ((0,), (1,))),
+                    preferred_element_type=jnp.float32))
+
+    @pl.when(p == pb - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[...] / l_ref[:, :1]
+
+
+def _int8_kernel(pt_ref, len_ref, q_ref, kq_ref, ks_ref, vq_ref,
+                 vs_ref, o_ref, acc_ref, m_ref, l_ref, *, page_size,
+                 heads, block_h):
+    """The int8-KV twin (head-major pages ``(P, H, D, ps)`` q8 +
+    ``(P, H, ps)`` scales — ``quant.int8_cache_attend``'s layout and
+    math, paged): the int8 payload feeds the MXU straight from VMEM,
+    dequantization fused via the per-position scales; the caller
+    pre-scaled q by 1/sqrt(D) (the int8 tier's convention)."""
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+    pb = pl.num_programs(1)
+    length = len_ref[s]
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(p <= length // page_size)
+    def _merge():
+        q = q_ref[0].astype(jnp.float32)               # (H, D)
+        kq = kq_ref[0].astype(jnp.float32)             # (H, D, ps)
+        vq = vq_ref[0].astype(jnp.float32)
+        ks = ks_ref[0]                                 # (H, ps) f32
+        vs = vs_ref[0]
+        idx = p * page_size + lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        visible = idx <= length
+        for h0 in range(0, heads, block_h):
+            bh = min(block_h, heads - h0)
+            qg = q[h0:h0 + bh]                         # (bh, D)
+            kg = kq[h0:h0 + bh]                        # (bh, D, ps)
+            scores = lax.dot_general(
+                qg, kg, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * ks[h0:h0 + bh]
+            vg = vq[h0:h0 + bh]                        # (bh, D, ps)
+            _online_merge(
+                acc_ref, m_ref, l_ref, h0, bh, scores, visible,
+                lambda pw, vg=vg, h0=h0, bh=bh: lax.dot_general(
+                    pw * vs[h0:h0 + bh], vg,
+                    (((1,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32))
+
+    @pl.when(p == pb - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[...] / l_ref[:, :1]
+
+
+#: lane width of the (m, l) running-statistic scratch rows — the TPU
+#: VPU lane count, so the broadcast layout stays register-friendly
+#: (every lane of a row holds the same value; interpret mode is
+#: indifferent)
+_STAT_LANES = 128
+
+
+def _grid_call(kernel, page_table, lengths, tensor_args, slots, pb,
+               heads, head_dim, in_specs, interpret):
+    """Shared pallas_call plumbing: grid ``(slots, pages)`` with the
+    page table + live lengths as prefetched scalars (index maps read
+    ``page_table[s, p]`` to route each cell's DMA at its physical
+    page), f32 ``(H, D)`` output per slot, online-softmax scratch in
+    VMEM. Both grid dims are sequential ("arbitrary") — the scratch
+    accumulators carry across the page dim and reinitialize per
+    slot."""
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, pb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, heads, head_dim),
+                               lambda s, p, pt, lens: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, head_dim), jnp.float32),
+            pltpu.VMEM((heads, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((heads, _STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, heads, head_dim),
+                                       jnp.float32),
+        interpret=interpret,
+    )(page_table, lengths, *tensor_args)
+
+
+def paged_attend(q, k_pages, v_pages, page_table, lengths, *,
+                 page_size, block_h=None, interpret=None):
+    """Fused paged decode attention, float tier. ``q`` (S, H, D);
+    ``k_pages``/``v_pages`` one block's pool leaf (P, page_size, H, D);
+    ``page_table`` (S, PB) int32 physical page ids in logical order
+    (padding rows point at scratch page 0); ``lengths`` (S,) int32
+    live lengths (position ``lengths[s]`` — this step's append — is
+    attended, the gather path's contract). Returns (S, H, D) f32 —
+    ``_cache_attend``'s output, without the gather."""
+    slots, heads, head_dim = q.shape
+    pb = page_table.shape[1]
+    if interpret is None:
+        interpret = _interpret_default()
+    if block_h is None:
+        block_h = _tuned_block_h(page_size, head_dim, heads)
+    kernel = functools.partial(
+        _float_kernel, page_size=int(page_size), heads=heads,
+        head_dim=head_dim, block_h=int(block_h))
+    in_specs = [
+        pl.BlockSpec((1, heads, head_dim),
+                     lambda s, p, pt, lens: (s, 0, 0)),
+        pl.BlockSpec((1, page_size, heads, head_dim),
+                     lambda s, p, pt, lens: (pt[s, p], 0, 0, 0)),
+        pl.BlockSpec((1, page_size, heads, head_dim),
+                     lambda s, p, pt, lens: (pt[s, p], 0, 0, 0)),
+    ]
+    return _grid_call(kernel, page_table, lengths,
+                      (q, k_pages, v_pages), slots, pb, heads,
+                      head_dim, in_specs, interpret)
+
+
+def paged_attend_int8(q, k_q, k_scale, v_q, v_scale, page_table,
+                      lengths, *, page_size, block_h=None,
+                      interpret=None):
+    """Fused paged decode attention, int8-KV tier. ``q`` (S, H, D)
+    float, ALREADY 1/sqrt(D)-scaled (the ``int8_cache_attend``
+    convention); ``k_q``/``v_q`` one block's head-major pool leaf
+    (P, H, D, page_size) int8 with (P, H, page_size) f32 scales.
+    Returns (S, H, D) f32."""
+    slots, heads, head_dim = q.shape
+    pb = page_table.shape[1]
+    if interpret is None:
+        interpret = _interpret_default()
+    if block_h is None:
+        block_h = _tuned_block_h(page_size, head_dim, heads)
+    kernel = functools.partial(
+        _int8_kernel, page_size=int(page_size), heads=heads,
+        block_h=int(block_h))
+    qspec = pl.BlockSpec((1, heads, head_dim),
+                         lambda s, p, pt, lens: (s, 0, 0))
+    kvspec = pl.BlockSpec((1, heads, head_dim, page_size),
+                          lambda s, p, pt, lens: (pt[s, p], 0, 0, 0))
+    sspec = pl.BlockSpec((1, heads, page_size),
+                         lambda s, p, pt, lens: (pt[s, p], 0, 0))
+    return _grid_call(kernel, page_table, lengths,
+                      (q, k_q, k_scale, v_q, v_scale), slots, pb,
+                      heads, head_dim,
+                      [qspec, kvspec, sspec, kvspec, sspec], interpret)
+
+
+# -- autotune (the shared ops/gemm.py cache) ----------------------------------
+
+def autotune_paged_attention(page_size, head_dim, heads=8, slots=8,
+                             pages_per_slot=4, iters=4):
+    """Race the ``block_h`` candidates for this ``(page_size,
+    head_dim)`` bucket against the XLA gather-path attend and persist
+    the verdict in the GEMM autotune cache (key ``pgatt:PSxD``, entry
+    ``{"blocks": [block_h], "seconds", "xla_seconds", "beats_xla"}`` —
+    the ``_sane_entry`` timing hygiene applies at persist and load, so
+    an underwater slope measurement is never recorded as physics).
+    Returns the winning ``block_h`` (the default off-TPU, where no
+    candidate can run)."""
+    from veles_tpu.ops import gemm
+
+    pool_pages = slots * pages_per_slot + 1
+    pb = pages_per_slot
+    rng = jax.random.key(0)
+    kq, kk, kv, kt = jax.random.split(rng, 4)
+    q = jax.random.normal(kq, (slots, heads, head_dim), jnp.float32)
+    k_pages = jax.random.normal(
+        kk, (pool_pages, page_size, heads, head_dim), jnp.float32)
+    v_pages = jax.random.normal(
+        kv, (pool_pages, page_size, heads, head_dim), jnp.float32)
+    page_table = jax.random.randint(
+        kt, (slots, pb), 1, pool_pages, jnp.int32)
+    lengths = jnp.arange(slots, dtype=jnp.int32) % (pb * page_size)
+
+    candidates = sorted({bh for bh in _BLOCK_H_CANDIDATES
+                         if bh <= heads and heads % bh == 0})
+    best, best_dt = None, float("inf")
+    for bh in candidates:
+        try:
+            dt = gemm._matmul_scan_time(
+                lambda v, bh=bh: paged_attend(
+                    v, k_pages, v_pages, page_table, lengths,
+                    page_size=page_size, block_h=bh,
+                    interpret=False).astype(v.dtype),
+                q, repeats=iters)
+        except Exception:
+            continue  # off-TPU / candidate does not compile
+        if dt < best_dt:
+            best, best_dt = bh, dt
+    if best is None:
+        return _tuned_block_h(page_size, head_dim, heads)
+
+    def gather_attend(v):
+        span = pb * page_size
+        kg = k_pages[page_table].reshape(slots, span, heads, head_dim)
+        vg = v_pages[page_table].reshape(slots, span, heads, head_dim)
+        mask = jnp.arange(span)[None, :] <= lengths[:, None]
+        s = jnp.einsum("shd,skhd->shk", v, kg,
+                       preferred_element_type=jnp.float32) \
+            / math.sqrt(float(head_dim))
+        s = jnp.where(mask[:, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("shk,skhd->shd", p, vg,
+                          preferred_element_type=jnp.float32)
+
+    xla_dt = gemm._matmul_scan_time(
+        lambda v: gather_attend(v).astype(v.dtype), q, repeats=iters)
+    entry = {"blocks": [best], "seconds": best_dt,
+             "xla_seconds": xla_dt,
+             # the GEMM autotuner's clear-margin doctrine: a sub-noise
+             # "win" must not flip serving onto the kernel
+             "beats_xla": best_dt < 0.97 * xla_dt}
+    if not gemm._sane_entry(entry):
+        import logging
+        logging.getLogger("paged_attention.autotune").warning(
+            "autotune pgatt:%dx%d measured an impossible timing "
+            "(kernel %.3g s, xla %.3g s); verdict NOT persisted — "
+            "re-run autotune for this bucket", page_size, head_dim,
+            best_dt, xla_dt)
+        return best
+    cache = gemm._load_cache()
+    cache["pgatt:%dx%d" % (int(page_size), int(head_dim))] = entry
+    gemm._persist_cache(cache)
+    return best

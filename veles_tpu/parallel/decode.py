@@ -727,6 +727,25 @@ def page_overshoot_tokens(lens, pages, page_size, chunk):
                                  chunk)
 
 
+def tile_pad_tokens(lens, page_size, chunk):
+    """The fused-kernel residual: the paged-attention kernel
+    (ops/paged_attention.py) walks only each slot's LIVE pages, so the
+    span/page overshoot of the gather formulations is structurally
+    zero — what remains is the dead tail of the last partial page,
+    ``ceil((n + 1) / page_size) * page_size - (n + 1)`` lanes per
+    slot-step for a slot live to ``n`` (position ``n`` itself is
+    attended: append precedes attend). Exact sum over ``i in
+    1..chunk`` with the slot live to ``n + i - 1`` at step ``i``."""
+    ps = int(page_size)
+    chunk = int(chunk)
+    total = 0
+    for n in lens:
+        for i in range(1, chunk + 1):
+            live = int(n) + i
+            total += -(-live // ps) * ps - live
+    return total
+
+
 # -- tensor-parallel decode (Megatron-style weight sharding) ------------------
 
 def _repack_block(blk, heads):

@@ -313,7 +313,19 @@ def _paged_slot_step(params, embed_table, heads, state, page_table,
     at scratch); the attended span is ``PB * page_size`` — the host
     sizes PB to the longest live sequence plus the dispatch's appends,
     so per-step cost scales with live tokens, one compiled program per
-    PB (the pages-per-slot bucket)."""
+    PB (the pages-per-slot bucket).
+
+    Two attend formulations behind ONE jitted signature: the portable
+    page-table GATHER (the CPU bit-identity reference), or — when
+    ``ops/paged_attention.use_paged_kernel()`` says so — the fused
+    Pallas kernel that walks the table directly and attends only each
+    slot's LIVE pages (span/page overshoot deleted at the kernel
+    level). The probe is read at TRACE time, so the ``paged.step`` /
+    ``paged.dispatch`` instrument names, the AOT facade and the
+    sharded-fns surface are identical either way; flipping the probe
+    does not invalidate already-traced programs (tests
+    ``jax.clear_caches()`` around it)."""
+    from veles_tpu.ops import paged_attention as pgatt
     from veles_tpu.parallel.decode import _cache_attend, _pick_token
 
     slots = state["lengths"].shape[0]
@@ -321,6 +333,7 @@ def _paged_slot_step(params, embed_table, heads, state, page_table,
     ps = _page_size_of(state)
     pb = page_table.shape[1]
     span = pb * ps
+    use_kernel = pgatt.use_paged_kernel()
     lengths = state["lengths"]
     if sample:
         step_keys = jax.vmap(jax.random.fold_in)(state["req_key"],
@@ -341,6 +354,10 @@ def _paged_slot_step(params, embed_table, heads, state, page_table,
         inv_sqrt = (embed // heads) ** -0.5
     else:
         mask = visible[:, None, None, :]
+    if use_kernel:
+        # the kernel resolves visibility from the prefetched lengths
+        # itself — no gathered span, no span-wide mask materialized
+        block_h = pgatt._tuned_block_h(ps, embed // heads, heads)
     new_k, new_v = state["k"], state["v"]
     new_ks = state.get("k_scale")
     new_vs = state.get("v_scale")
@@ -373,12 +390,18 @@ def _paged_slot_step(params, embed_table, heads, state, page_table,
                 new_vs = lax.dynamic_update_slice(
                     new_vs, jnp.transpose(vs[s:s + 1], (0, 2, 1))[None],
                     (i, page, 0, off))
-            pool = dict(state, k=new_k, v=new_v, k_scale=new_ks,
-                        v_scale=new_vs)
-            k8, kscale, v8, vscale = _gather_block_int8(pool, i,
-                                                        page_table)
-            att = int8_cache_attend(q * inv_sqrt, k8, kscale, v8,
-                                    vscale, mask_addend)
+            if use_kernel:
+                att = pgatt.paged_attend_int8(
+                    (q * inv_sqrt)[:, 0], new_k[i], new_ks[i],
+                    new_v[i], new_vs[i], page_table, lengths,
+                    page_size=ps, block_h=block_h)[:, None]
+            else:
+                pool = dict(state, k=new_k, v=new_v, k_scale=new_ks,
+                            v_scale=new_vs)
+                k8, kscale, v8, vscale = _gather_block_int8(pool, i,
+                                                            page_table)
+                att = int8_cache_attend(q * inv_sqrt, k8, kscale, v8,
+                                        vscale, mask_addend)
         else:
             for s in range(slots):
                 pos = lengths[s]
@@ -390,9 +413,14 @@ def _paged_slot_step(params, embed_table, heads, state, page_table,
                 new_v = lax.dynamic_update_slice(
                     new_v, v[s:s + 1][None].astype(new_v.dtype),
                     (i, page, off, 0, 0))
-            pool = dict(state, k=new_k, v=new_v)
-            k_g, v_g = _gather_block_float(pool, i, page_table)
-            att = _cache_attend(q, k_g, v_g, mask)
+            if use_kernel:
+                att = pgatt.paged_attend(
+                    q[:, 0], new_k[i], new_v[i], page_table, lengths,
+                    page_size=ps, block_h=block_h)[:, None]
+            else:
+                pool = dict(state, k=new_k, v=new_v)
+                k_g, v_g = _gather_block_float(pool, i, page_table)
+                att = _cache_attend(q, k_g, v_g, mask)
         att = att.astype(x.dtype)
         x = x + matmul_any(att.reshape(slots, 1, embed),
                            blk["wout"]) + blk["bout"]

@@ -785,8 +785,8 @@ class ContinuousDecoder:
                  max_len=512, n_tokens=32, eos=None,
                  temperature=0.0, top_k=0, key=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=False,
-                 page_size=None, pool_pages=None, prefix_cache=None,
-                 aot=None, ledger=None):
+                 page_size=None, pool_pages=None, paged_kernel=None,
+                 prefix_cache=None, aot=None, ledger=None):
         import collections
 
         import jax
@@ -877,6 +877,22 @@ class ContinuousDecoder:
                                                   chunk=n_tokens))
         else:
             self.pool_pages = None
+        #: fused-kernel tier (docs/paged_kv.md "The fused kernel"):
+        #: when engaged, the jitted paged step runs the Pallas
+        #: paged-attention kernel (ops/paged_attention.py) instead of
+        #: the page-table gather, and admission groups go RAGGED —
+        #: page-rounded widths, no pow2 row duplication. ``None``
+        #: defers to the global probe (FORCE toggle -> config ->
+        #: backend auto); an explicit override here must agree with
+        #: that probe, because the device fn reads the probe at trace
+        #: time (the jitted signature is shared with the gather path).
+        if paged:
+            from veles_tpu.ops.paged_attention import use_paged_kernel
+            self.paged_kernel = (use_paged_kernel()
+                                 if paged_kernel is None
+                                 else bool(paged_kernel))
+        else:
+            self.paged_kernel = False
         self.n_tokens = n_tokens
         self.eos = eos
         #: temperature > 0 samples; each request draws from its OWN
@@ -1417,9 +1433,16 @@ class ContinuousDecoder:
                 hits.append((rid, prompt, slot, entry))
                 continue
             if entry is not None:
-                tail_bucket = self.bucket_for(len(prompt) - shared)
-                pages = self.pool.alloc(
-                    kv_pool.pages_for(tail_bucket, ps))
+                # kernel path: tails group ragged under one key per
+                # prefix length (bucket 0 sentinel) and each row
+                # allocates EXACTLY its tail's pages — the pow2 bucket
+                # ladder only exists to bound the gather path's jit
+                # cache
+                tail_len = len(prompt) - shared
+                tail_bucket = (0 if self.paged_kernel
+                               else self.bucket_for(tail_len))
+                pages = self.pool.alloc(kv_pool.pages_for(
+                    tail_len if self.paged_kernel else tail_bucket, ps))
                 if pages is None:
                     self.pool.unlookup(entry)
                     break
@@ -1433,8 +1456,10 @@ class ContinuousDecoder:
                 tails[key].append((rid, prompt, slot, entry, shared,
                                    pages))
                 continue
-            bucket = self.bucket_for(len(prompt))
-            pages = self.pool.alloc(kv_pool.pages_for(bucket, ps))
+            bucket = (0 if self.paged_kernel
+                      else self.bucket_for(len(prompt)))
+            pages = self.pool.alloc(kv_pool.pages_for(
+                len(prompt) if self.paged_kernel else bucket, ps))
             if pages is None:
                 break
             self._queue.popleft()
@@ -1453,11 +1478,32 @@ class ContinuousDecoder:
 
         for bucket in cold_order:
             group = cold[bucket]
-            rows = self._pad_group(group)
+            if self.paged_kernel:
+                # ragged admission: ONE dispatch at the group's
+                # page-rounded max width — per-row live lengths mask
+                # the residual inside the device fn, so there is no
+                # pow2 row duplication and no bucket pad beyond the
+                # last partial page. Compile variants stay bounded:
+                # (rows, width) ranges over slots x page multiples,
+                # the same ladder the gather path's buckets walk.
+                rows = group
+                bucket = kv_pool.pages_for(
+                    max(len(r[1]) for r in rows), ps) * ps
+            else:
+                rows = self._pad_group(group)
             prompts = numpy.zeros((len(rows), bucket), numpy.int32)
             for j, (_, prompt, _, _) in enumerate(rows):
                 prompts[j, :len(prompt)] = prompt
             x = self.embed_table[jnp.asarray(prompts)]
+            # ragged rows own different page counts: short rows pad
+            # with the scratch page (garbage-by-definition, never
+            # visible behind the per-row length mask). Gather-path
+            # groups allocate uniformly, so the fill is total there.
+            n_pages = max(len(r[3]) for r in rows)
+            page_ids = numpy.full((len(rows), n_pages),
+                                  kv_pool.SCRATCH_PAGE, numpy.int32)
+            for j, (_, _, _, pg) in enumerate(rows):
+                page_ids[j, :len(pg)] = pg
             with self._span("paged.admit", [r[0] for r in group],
                             bucket=bucket, group=len(group)):
                 t0 = time.perf_counter()
@@ -1465,7 +1511,7 @@ class ContinuousDecoder:
                     self.params, self.embed_table, self.heads,
                     self.state,
                     jnp.asarray([r[2] for r in rows], jnp.int32),
-                    jnp.asarray([r[3] for r in rows], jnp.int32), x,
+                    jnp.asarray(page_ids), x,
                     fold_keys(rows),
                     jnp.asarray([len(r[1]) for r in rows], jnp.int32))
                 elapsed = time.perf_counter() - t0
@@ -1497,13 +1543,27 @@ class ContinuousDecoder:
         for key in tail_order:
             pp, tail_bucket = key
             group = tails[key]
-            rows = self._pad_group(group)
+            if self.paged_kernel:
+                # ragged tails: same doctrine as cold — page-rounded
+                # max tail width, per-row tail pages scratch-padded
+                # (prefix pages are uniform within the key, which
+                # keeps pp in it)
+                rows = group
+                tail_bucket = kv_pool.pages_for(
+                    max(len(r[1]) - r[4] for r in rows), ps) * ps
+            else:
+                rows = self._pad_group(group)
             tail_tokens = numpy.zeros((len(rows), tail_bucket),
                                       numpy.int32)
             for j, (_, prompt, _, _, shared, _) in enumerate(rows):
                 tail = prompt[shared:]
                 tail_tokens[j, :len(tail)] = tail
             tail_x = self.embed_table[jnp.asarray(tail_tokens)]
+            n_tail = max(len(r[5]) for r in rows)
+            tail_pages = numpy.full((len(rows), n_tail),
+                                    kv_pool.SCRATCH_PAGE, numpy.int32)
+            for j, r in enumerate(rows):
+                tail_pages[j, :len(r[5])] = r[5]
             with self._span("paged.admit_tail", [r[0] for r in group],
                             bucket=tail_bucket, group=len(group),
                             prefix_pages=pp):
@@ -1514,7 +1574,7 @@ class ContinuousDecoder:
                     jnp.asarray([r[2] for r in rows], jnp.int32),
                     jnp.asarray([r[3]["pages"] for r in rows],
                                 jnp.int32),
-                    jnp.asarray([r[5] for r in rows], jnp.int32),
+                    jnp.asarray(tail_pages),
                     tail_x, fold_keys(rows),
                     jnp.asarray([len(r[1]) for r in rows], jnp.int32))
                 elapsed = time.perf_counter() - t0
@@ -1721,9 +1781,16 @@ class ContinuousDecoder:
             # the step path syncs inline, so the whole call is one
             # decode-compute window; every active lane keeps its token
             from veles_tpu.parallel.decode import (
-                page_overshoot_tokens, span_overshoot_tokens)
-            overshoot = (page_overshoot_tokens(scope_lens, pb,
-                                               self.page_size, 1)
+                page_overshoot_tokens, span_overshoot_tokens,
+                tile_pad_tokens)
+            # kernel path attends live pages only: the gathered-span
+            # overshoot is structurally gone, and the residual — the
+            # last partial page's dead lanes — books as tile_pad so
+            # the waste ledger never silently credits zero
+            overshoot = (tile_pad_tokens(scope_lens, self.page_size, 1)
+                         if self.paged_kernel
+                         else page_overshoot_tokens(scope_lens, pb,
+                                                    self.page_size, 1)
                          if self.paged
                          else span_overshoot_tokens(scope_lens, span,
                                                     1))
@@ -1731,7 +1798,8 @@ class ContinuousDecoder:
             self.scope.note_dispatch(1, self.slots, len(snapshot),
                                      overshoot, elapsed,
                                      paged=self.paged, span=span,
-                                     pages=pb)
+                                     pages=pb,
+                                     kernel=self.paged_kernel)
             self.scope.note_collect(len(snapshot), len(snapshot), 0.0)
         out = {}
         for slot, rid in snapshot.items():
@@ -1906,16 +1974,22 @@ class ContinuousDecoder:
             elapsed = time.perf_counter() - t0
         if self.scope.enabled:
             from veles_tpu.parallel.decode import (
-                page_overshoot_tokens, span_overshoot_tokens)
-            overshoot = (page_overshoot_tokens(scope_lens, pb,
-                                               self.page_size, chunk)
+                page_overshoot_tokens, span_overshoot_tokens,
+                tile_pad_tokens)
+            overshoot = (tile_pad_tokens(scope_lens, self.page_size,
+                                         chunk)
+                         if self.paged_kernel
+                         else page_overshoot_tokens(scope_lens, pb,
+                                                    self.page_size,
+                                                    chunk)
                          if self.paged
                          else span_overshoot_tokens(scope_lens, span,
                                                     chunk))
             self.scope.note_dispatch(chunk, self.slots, len(snapshot),
                                      overshoot, elapsed,
                                      paged=self.paged, span=span,
-                                     pages=pb)
+                                     pages=pb,
+                                     kernel=self.paged_kernel)
         self.timings["dispatch_s"] += elapsed
         self.metrics.observe(
             "veles_decode_dispatch_seconds", elapsed,
@@ -2066,8 +2140,8 @@ class GenerateAPI:
                  max_queue=None, deadline=None, rebuild_backoff=None,
                  rebuild_backoff_max=None, chaos=None, quantize=None,
                  tile=None, mesh=None, mesh_axis="model", paged=None,
-                 page_size=None, pool_pages=None, aot=None, slo=None,
-                 ledger=None, governor=None):
+                 page_size=None, pool_pages=None, paged_kernel=None,
+                 aot=None, slo=None, ledger=None, governor=None):
         import queue
 
         from veles_tpu.core.config import root
@@ -2114,6 +2188,14 @@ class GenerateAPI:
             page_size = serve_cfg.get("page_size", None)
         if pool_pages is None:
             pool_pages = serve_cfg.get("pool_pages", None)
+        #: fused paged-attention tier (--serve-paged-kernel /
+        #: root.common.serve.paged_kernel): None = backend auto (the
+        #: ops/paged_attention.py probe). Resolved HERE so breaker
+        #: rebuilds reconstruct the same attend formulation — a tier
+        #: flip across a rebuild would silently change step compile
+        #: keys and retrace the warmed sweep.
+        if paged_kernel is None:
+            paged_kernel = serve_cfg.get("paged_kernel", None)
         #: AOT compiled-program boot (--serve-aot PATH /
         #: root.common.serve.aot — docs/aot_artifacts.md): load the
         #: bundle ONCE here, so the decoder and every breaker-rebuild
@@ -2167,7 +2249,8 @@ class GenerateAPI:
             temperature=temperature, top_k=top_k, eos=eos, key=key,
             quantize=quantize, tile=tile, mesh=mesh,
             mesh_axis=mesh_axis, paged=bool(paged),
-            page_size=page_size, pool_pages=pool_pages, aot=aot,
+            page_size=page_size, pool_pages=pool_pages,
+            paged_kernel=paged_kernel, aot=aot,
             ledger=self.ledger)
         self.decoder = ContinuousDecoder(**self._decoder_kwargs)
         self.vocab = embed_table.shape[0]
