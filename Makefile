@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
 	regress mesh paged paged-kernel fleet-mr aot slo governor history \
-	analyze fleetscope servescope deploy elastic
+	analyze fleetscope servescope deploy elastic replay
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -216,6 +216,21 @@ deploy:
 elastic:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_router.py \
 		-m elastic -q
+
+# Traffic record-replay + capacity-cliff suite (docs/traffic_replay.md):
+# the anonymized trace schema round trip (salted tenant hashes, no
+# prompt text, sha256 sidecar refusal), lossy-trace stamping off the
+# ledger's loss counters, bit-identical seeded warp plans, the
+# open-loop replayer's shed/error bookkeeping, the capacity
+# controller's escalate-then-backoff loop on a scripted endpoint, the
+# recorded-traffic chaos profile, and the live acceptance — `observe
+# record --live` then `observe capacity --live` escalates warp until
+# the SLO burns and the report names the first-breaching series. (The
+# live-endpoint acceptances ride the `slow` marker so tier-1 keeps its
+# timeout margin; this target runs them.)
+replay:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_replay.py \
+		-m replay -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

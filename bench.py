@@ -2500,6 +2500,86 @@ def deploy_section(swaps=3):
     return out
 
 
+def replay_section(requests=16):
+    """Traffic record-replay round-trip fidelity
+    (docs/traffic_replay.md): record a short staggered two-tenant
+    trace from a live GenerateAPI's request ledger, replay it at 1x
+    open-loop against a FRESH endpoint, and book the fidelity as
+    regress-guarded numbers —
+
+    - ``replay_fidelity_delivered_ratio``: tokens the replay delivered
+      over tokens the recording delivered (higher-better default; a
+      recorder or replayer that starts losing work fails the gate);
+    - ``replay_schedule_skew_ms``: planned-vs-actual arrival skew p95
+      of the open-loop replayer (lower-better via ``_ms`` — a replayer
+      that cannot hold its schedule invalidates every capacity number
+      built on it, observe/capacity.py).
+    """
+    import tempfile
+    import urllib.request
+
+    from veles_tpu.observe.replay import (load_trace, record_trace,
+                                          replay, warp_plan)
+    from veles_tpu.observe.reqledger import RequestLedger
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    from veles_tpu.serving import GenerateAPI
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 32, 64
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(rng.randn(vocab, embed).astype(numpy.float32)
+                        * 0.1)
+
+    def fresh_api():
+        return GenerateAPI(params, table, heads, slots=2, max_len=32,
+                           n_tokens=5, chunk=2, port=0,
+                           ledger=RequestLedger())
+
+    def post(url, tenant, n):
+        req = urllib.request.Request(
+            url, data=json.dumps({"tokens": [1 + i % 7
+                                             for i in range(n)]}
+                                 ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Veles-Tenant": tenant})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+
+    api = fresh_api()
+    api.start()
+    trace_path = os.path.join(tempfile.mkdtemp(prefix="veles-replay-"),
+                              "bench.trace.jsonl")
+    try:
+        url = "http://127.0.0.1:%d/generate" % api.port
+        # the staggered-drain shape: interleaved tenants, ragged
+        # prompt lengths, a deliberate arrival cadence to re-hit
+        for i in range(requests):
+            post(url, "acme" if i % 2 else "globex", 3 + i % 5)
+            time.sleep(0.01 + 0.02 * (i % 3))
+        record_trace(api.ledger, trace_path, source="bench")
+    finally:
+        api.stop()
+    _, rows = load_trace(trace_path)
+    recorded = sum(r["tokens"] for r in rows)
+    api = fresh_api()
+    api.start()
+    try:
+        plan = warp_plan(rows, warp=1.0, seed=0)
+        summary = replay(plan,
+                         url="http://127.0.0.1:%d" % api.port,
+                         vocab=vocab, workers=4)
+    finally:
+        api.stop()
+    return {
+        "replay_fidelity_delivered_ratio":
+            round(summary["delivered_ratio"], 4),
+        "replay_schedule_skew_ms": summary["schedule_skew_ms_p95"],
+        "replay_config": "requests=%d,recorded_tokens=%d,slots=2"
+                         % (len(rows), recorded),
+    }
+
+
 #: same-seed CPU subprocess replica for the elastic bench — identical
 #: weights to its twin so the router's failover stays bit-identical
 #: (the same child tests/test_router.py's chaos acceptance boots).
@@ -2903,6 +2983,14 @@ def serve_main(profile_dir=None, artifact_path=None):
             # wall time under live traffic, with the shed-request
             # count pinned 0 (the zero-downtime contract)
             section = _guarded(deploy_section, fallback={})
+            out.update(section)
+            artifact.update(section)
+            # traffic record-replay round trip
+            # (docs/traffic_replay.md): trace a staggered two-tenant
+            # run off the request ledger, replay it 1x open-loop
+            # against a fresh endpoint — delivered-token ratio and
+            # schedule-skew p95 are the regress-guarded fidelity
+            section = _guarded(replay_section, fallback={})
             out.update(section)
             artifact.update(section)
             # elastic replicated serving (docs/elastic_serving.md):

@@ -259,6 +259,39 @@ class TestCompare:
                   "serve_scope_note_ns": 250.0}
         assert regressions(compare(old, better)) == []
 
+    def test_capacity_and_replay_key_directions(self):
+        """The traffic record-replay + capacity keys (observe/
+        replay.py, observe/capacity.py, bench replay_section —
+        docs/traffic_replay.md): sustained tokens/sec, the cliff warp
+        and round-trip fidelity are HIGHER-better (a config that
+        sustains less, cliffs earlier or loses replayed tokens
+        regressed); the replayer's schedule skew rides the _ms rule."""
+        old = {"capacity_sustained_tokens_per_sec": 1000.0,
+               "capacity_cliff_warp_x": 8.0,
+               "replay_fidelity_delivered_ratio": 1.0,
+               "replay_schedule_skew_ms": 5.0}
+        worse = {"capacity_sustained_tokens_per_sec": 600.0,
+                 "capacity_cliff_warp_x": 3.0,
+                 "replay_fidelity_delivered_ratio": 0.6,
+                 "replay_schedule_skew_ms": 50.0}
+        bad = {f["key"] for f in regressions(compare(old, worse))}
+        assert bad == set(old)
+        better = {"capacity_sustained_tokens_per_sec": 1500.0,
+                  "capacity_cliff_warp_x": 12.0,
+                  "replay_fidelity_delivered_ratio": 1.0,
+                  "replay_schedule_skew_ms": 1.0}
+        assert regressions(compare(old, better)) == []
+
+    def test_fifteen_percent_capacity_loss_regresses(self):
+        """The ISSUE-19 contract: a PR that silently costs 15% of peak
+        throughput must fail the gate (base tolerance is 10%)."""
+        old = {"capacity_sustained_tokens_per_sec": 1000.0}
+        new = {"capacity_sustained_tokens_per_sec": 850.0}
+        bad = regressions(compare(old, new))
+        assert [f["key"] for f in bad] \
+            == ["capacity_sustained_tokens_per_sec"]
+        assert bad[0]["verdict"] == "regressed"
+
     def test_type_change_is_a_regression(self):
         new = dict(self.OLD, decode_step_ms="fast")
         assert regressions(compare(self.OLD, new))[0]["verdict"] \
@@ -288,6 +321,26 @@ class TestSentinelCLI:
         BenchArtifact(new_path).update(seeded)
         assert compare_main(R05, new_path) == 1
         assert "REGRESSED" in capsys.readouterr().out
+
+    def test_seeded_capacity_loss_fixture_exits_one(self, tmp_path,
+                                                    capsys):
+        """The ISSUE-19 acceptance fixture: two artifacts identical
+        but for a 15% capacity_sustained_tokens_per_sec loss — the
+        full CLI path (artifact load, direction lookup, tolerance)
+        exits 1 and names the key."""
+        base = {"capacity_sustained_tokens_per_sec": 1200.0,
+                "capacity_cliff_warp_x": 6.0,
+                "replay_schedule_skew_ms": 4.0,
+                "replay_fidelity_delivered_ratio": 1.0}
+        old_path = str(tmp_path / "main.json")
+        new_path = str(tmp_path / "pr.json")
+        BenchArtifact(old_path).update(base)
+        BenchArtifact(new_path).update(
+            dict(base, capacity_sustained_tokens_per_sec=1020.0))
+        assert compare_main(old_path, new_path) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out
+        assert "capacity_sustained_tokens_per_sec" in out
 
     def test_unreadable_artifact_exits_two(self, tmp_path):
         missing = str(tmp_path / "nope.json")

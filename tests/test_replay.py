@@ -1,0 +1,566 @@
+"""Traffic record-replay + capacity-cliff finder tests
+(docs/traffic_replay.md, ISSUE 19): trace schema round trip + the
+anonymization pins (no prompt text, salted tenant-hash stability),
+lossy-trace stamping from the ledger's loss tallies, deterministic
+warp schedules (same trace + seed => bit-identical arrival plan), the
+open-loop replayer on a scripted poster, the ``veles_reqledger_*``
+metrics bridge, the capacity controller's escalate-then-backoff loop
+on a scripted endpoint, the recorded-traffic chaos profile, and the
+``slow`` e2e acceptance — record a mixed-tenant run off a live
+GenerateAPI, escalate warp until the SLO burn breaches, and the
+capacity report names the first-breaching series plus the dominant
+waste cause. ``make replay`` runs this module standalone."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.observe.capacity import (CapacityFinder,
+                                        render_capacity_report,
+                                        write_capacity_report)
+from veles_tpu.observe.replay import (TRACE_ROW_FIELDS, build_trace,
+                                      hash_tenant, load_trace,
+                                      plan_fingerprint, record_trace,
+                                      replay, tenant_mix, warp_plan,
+                                      write_trace)
+from veles_tpu.observe.reqledger import (RequestLedger,
+                                         publish_request_ledger)
+
+pytestmark = pytest.mark.replay
+
+
+def make_ledger(n=12, chunk_cap=512, capacity=512, tenants=("acme",
+                                                            "globex"),
+                stagger=0.002):
+    """A real ledger driven through its real hooks — rows carry true
+    monotonic cadence, admit kinds and chunk stamps."""
+    ledger = RequestLedger(chunk_cap=chunk_cap, capacity=capacity)
+    for i in range(n):
+        row = ledger.stage(api="generate-api", trace="trace-%d" % i,
+                           tenant=tenants[i % len(tenants)],
+                           prompt_len=4 + i % 3, budget=4, bucket=8,
+                           deadline=9.0)
+        ledger.note_admit(row, "dense" if i % 2 else "cold")
+        for _ in range(4):
+            ledger.note_tokens(row, 1)
+        ledger.resolve(row, "completed")
+        time.sleep(stagger)
+    return ledger
+
+
+class TestTraceSchema:
+    def test_round_trip_preserves_rows_and_header(self, tmp_path):
+        ledger = make_ledger(10)
+        path = str(tmp_path / "t.jsonl")
+        header = record_trace(ledger, path, salt="s1")
+        loaded_header, rows = load_trace(path)
+        assert loaded_header == header
+        assert header["kind"] == "veles-trace"
+        assert header["schema"] == 1
+        assert header["count"] == len(rows) == 10
+        assert header["span_s"] >= 0.0
+        # arrival offsets rebased to the first arrival, ascending
+        assert rows[0]["t"] == 0.0
+        assert [r["t"] for r in rows] == sorted(r["t"] for r in rows)
+        assert {r["admit"] for r in rows} == {"dense", "cold"}
+        assert all(r["budget"] == 4 and r["deadline_s"] == 9.0
+                   for r in rows)
+
+    def test_sidecar_refuses_tampered_trace(self, tmp_path):
+        ledger = make_ledger(4)
+        path = str(tmp_path / "t.jsonl")
+        record_trace(ledger, path)
+        load_trace(path)  # intact passes
+        with open(path, "a") as fout:
+            fout.write(json.dumps({"t": 99.0}) + "\n")
+        with pytest.raises(ValueError, match="sha256 sidecar"):
+            load_trace(path)
+        # an explicitly hand-cut trace (no sidecar) stays loadable
+        bare = str(tmp_path / "bare.jsonl")
+        header, rows = build_trace(ledger.resolved())
+        write_trace(header, rows, bare)
+        import os
+        os.remove(bare + ".sha256")
+        load_trace(bare)
+
+    def test_newer_schema_is_refused(self, tmp_path):
+        path = str(tmp_path / "future.jsonl")
+        write_trace({"kind": "veles-trace", "schema": 99}, [], path)
+        with pytest.raises(ValueError, match="schema"):
+            load_trace(path)
+
+
+class TestAnonymization:
+    def test_rows_carry_only_contract_fields(self, tmp_path):
+        """The whitelist pin: no trace ids, no error strings, no raw
+        tenant names, no prompt text (which never existed upstream)."""
+        ledger = make_ledger(6)
+        path = str(tmp_path / "t.jsonl")
+        record_trace(ledger, path, salt="s1")
+        _, rows = load_trace(path)
+        for row in rows:
+            assert set(row) <= TRACE_ROW_FIELDS
+        raw = open(path).read()
+        assert "trace-" not in raw          # ledger trace ids
+        assert "acme" not in raw            # raw tenant names
+        assert "globex" not in raw
+
+    def test_tenant_hash_stable_within_salt_distinct_across(self):
+        assert hash_tenant("acme", "s1") == hash_tenant("acme", "s1")
+        assert hash_tenant("acme", "s1") != hash_tenant("acme", "s2")
+        assert hash_tenant("acme", "s1") != hash_tenant("globex", "s1")
+        assert len(hash_tenant("acme", "s1")) == 16
+        assert hash_tenant("", "s1") == ""  # anonymous stays empty
+
+    def test_salt_never_written_only_fingerprint(self, tmp_path):
+        ledger = make_ledger(3)
+        path = str(tmp_path / "t.jsonl")
+        header = record_trace(ledger, path, salt="super-secret")
+        assert "super-secret" not in open(path).read()
+        assert len(header["salt_fingerprint"]) == 8
+
+
+class TestLossyStamping:
+    def test_clean_ledger_stamps_not_lossy(self, tmp_path):
+        ledger = make_ledger(4)
+        header = record_trace(ledger, str(tmp_path / "t.jsonl"))
+        assert header["lossy"] is False
+        assert not any(header["loss"].values())
+
+    def test_chunk_cap_drops_stamp_lossy_with_amount(self, tmp_path):
+        ledger = make_ledger(6, chunk_cap=2)
+        # 4 token chunks per request, cap 2 -> 2 dropped per request
+        assert ledger.chunk_stamps_dropped_total == 12
+        header = record_trace(ledger, str(tmp_path / "t.jsonl"))
+        assert header["lossy"] is True
+        assert header["loss"]["chunk_stamps_dropped"] == 12
+
+    def test_ring_overflow_stamps_lossy(self, tmp_path):
+        ledger = make_ledger(7, capacity=4)
+        assert ledger.ring_overflow_total == 3
+        header = record_trace(ledger, str(tmp_path / "t.jsonl"))
+        assert header["lossy"] is True
+        assert header["loss"]["resolved_ring_overflow"] == 3
+
+
+class TestReqledgerMetrics:
+    def test_counters_on_metrics(self):
+        from veles_tpu.observe.metrics import MetricsRegistry
+
+        ledger = make_ledger(5, chunk_cap=2, capacity=3, stagger=0.0)
+        registry = MetricsRegistry(enabled=True)
+        publish_request_ledger(registry, ledger)
+        text = registry.expose()
+        assert "veles_reqledger_staged_total 5" in text
+        assert "veles_reqledger_resolved_total 5" in text
+        assert "veles_reqledger_chunk_stamps_dropped_total 10" in text
+        assert "veles_reqledger_ring_overflow_total 2" in text
+        assert "veles_reqledger_inflight_dropped_total 0" in text
+
+
+class TestWarpDeterminism:
+    def _rows(self):
+        ledger = make_ledger(12)
+        return build_trace(ledger.resolved())[1]
+
+    def test_same_trace_same_seed_bit_identical_plan(self, tmp_path):
+        ledger = make_ledger(12)
+        path = str(tmp_path / "t.jsonl")
+        record_trace(ledger, path)
+        _, rows = load_trace(path)
+        kw = dict(warp=3.0, seed=11, burst_compress=0.4,
+                  long_context_skew=0.5,
+                  tenant_weights={hash_tenant("acme", "veles"): 1.7})
+        one = warp_plan(rows, **kw)
+        two = warp_plan(load_trace(path)[1], **kw)
+        assert json.dumps(one, sort_keys=True) \
+            == json.dumps(two, sort_keys=True)
+        assert plan_fingerprint(one) == plan_fingerprint(two)
+
+    def test_seed_changes_randomized_knobs(self):
+        rows = self._rows()
+        kw = dict(warp=2.0, burst_compress=0.3, long_context_skew=0.5)
+        assert plan_fingerprint(warp_plan(rows, seed=1, **kw)) \
+            != plan_fingerprint(warp_plan(rows, seed=2, **kw))
+
+    def test_rate_warp_compresses_arrivals(self):
+        rows = self._rows()
+        base = warp_plan(rows, warp=1.0)
+        fast = warp_plan(rows, warp=4.0)
+        assert fast[-1]["at"] == pytest.approx(base[-1]["at"] / 4.0,
+                                               abs=1e-6)
+
+    def test_tenant_weight_zero_drops_and_two_doubles(self):
+        rows = self._rows()
+        acme = hash_tenant("acme", "veles")
+        globex = hash_tenant("globex", "veles")
+        plan = warp_plan(rows, tenant_weights={acme: 0.0,
+                                               globex: 2.0})
+        tenants = [e["tenant"] for e in plan]
+        assert acme not in tenants
+        assert len(tenants) == 12  # 6 globex rows, integer-doubled
+
+    def test_burst_compress_squeezes_above_median_gaps(self):
+        rows = [{"t": t, "prompt_len": 4, "budget": 2, "tokens": 2}
+                for t in (0.0, 0.01, 0.02, 1.0, 1.01, 2.0)]
+        plan = warp_plan(rows, burst_compress=0.5)
+        assert plan[-1]["at"] < 2.0  # valleys closed up
+        ats = [e["at"] for e in plan]
+        assert ats == sorted(ats)  # order preserved
+
+    def test_long_context_skew_stretches_prompts(self):
+        rows = [{"t": i * 0.01, "prompt_len": 2 + (i == 9) * 18,
+                 "budget": 2, "tokens": 2} for i in range(10)]
+        plan = warp_plan(rows, seed=3, long_context_skew=1.0)
+        assert all(e["prompt_len"] == 20 for e in plan)
+        plain = warp_plan(rows, seed=3, long_context_skew=0.0)
+        assert sum(e["prompt_len"] == 20 for e in plain) == 1
+
+
+class TestOpenLoopReplay:
+    def test_scripted_poster_full_fidelity(self):
+        rows = [{"t": i * 0.005, "tenant": "aa", "prompt_len": 3,
+                 "budget": 4, "tokens": 4} for i in range(10)]
+        plan = warp_plan(rows)
+        seen = []
+
+        def poster(entry, payload):
+            seen.append((entry["tenant"], len(payload["tokens"]),
+                         payload["n_tokens"]))
+            return 200, payload["n_tokens"]
+
+        summary = replay(plan, poster=poster, workers=4)
+        assert summary["requests"] == summary["completed"] == 10
+        assert summary["delivered_ratio"] == 1.0
+        assert summary["errors"] == 0
+        assert len(seen) == 10
+        assert all(t == "aa" and n == 3 and b == 4 for t, n, b in seen)
+
+    def test_sheds_and_errors_are_booked_separately(self):
+        rows = [{"t": i * 0.002, "prompt_len": 2, "budget": 2,
+                 "tokens": 2} for i in range(9)]
+        plan = warp_plan(rows)
+        statuses = iter([200, 429, 503, 200, 400, -1, 200, 200, 200])
+
+        def poster(entry, payload):
+            status = next(statuses)
+            if status == -1:
+                raise OSError("connection refused")
+            return status, payload["n_tokens"] if status == 200 else 0
+
+        summary = replay(plan, poster=poster, workers=1)
+        assert summary["completed"] == 5
+        assert summary["shed"] == 2
+        assert summary["errors"] == 2
+        assert summary["availability"] == pytest.approx(5 / 9.0)
+
+    def test_arrivals_are_open_loop_not_response_paced(self):
+        """A 60ms-slow endpoint must NOT stretch a ~40ms schedule to
+        ~600ms: arrivals keep releasing on the recorded cadence."""
+        rows = [{"t": i * 0.004, "prompt_len": 2, "budget": 2,
+                 "tokens": 2} for i in range(10)]
+        plan = warp_plan(rows)
+        arrivals = []
+        t0 = time.monotonic()
+
+        def poster(entry, payload):
+            arrivals.append(time.monotonic() - t0)
+            time.sleep(0.06)
+            return 200, 2
+
+        replay(plan, poster=poster, workers=10)
+        assert max(arrivals) - min(arrivals) < 0.3
+
+
+class TestCapacityController:
+    def _rows(self):
+        return [{"t": i * 0.01, "tenant": "aa", "prompt_len": 3,
+                 "budget": 4, "tokens": 4} for i in range(8)]
+
+    def _scripted(self, cliff):
+        """An endpoint that sustains below ``cliff`` and breaches
+        availability at/above it."""
+
+        def runner(warp):
+            return {"requests": 8,
+                    "availability": 1.0 if warp < cliff else 0.5,
+                    "tokens_per_sec": min(warp, cliff) * 100.0,
+                    "schedule_skew_ms_p95": 1.5,
+                    "request_wall_ms_p95": 4.0}
+
+        return runner
+
+    def test_escalates_until_breach_then_backs_off(self):
+        finder = CapacityFinder(self._rows(), start_warp=1.0,
+                                warp_step=2.0, max_warp=32.0,
+                                refine_steps=2,
+                                runner=self._scripted(4.0))
+        doc = finder.run()
+        warps = [e["warp"] for e in finder.escalation]
+        phases = [e["phase"] for e in finder.escalation]
+        assert warps[:3] == [1.0, 2.0, 4.0]
+        assert finder.escalation[2]["breached"]
+        # backoff: every post-breach probe bisects BELOW the breach
+        assert phases[3:] == ["refine"] * len(phases[3:])
+        assert all(2.0 < w < 4.0 for w in warps[3:])
+        assert doc["breached"] is True
+        assert doc["keys"]["capacity_cliff_warp_x"] <= 4.0
+        assert 2.0 <= doc["keys"]["capacity_sustained_warp_x"] < 4.0
+        assert doc["keys"]["capacity_sustained_tokens_per_sec"] > 200.0
+        assert doc["breach"]["detail"]["objective"] == "availability"
+        assert doc["breach"]["first_breaching_series"] \
+            == "replay_availability"
+
+    def test_no_breach_reports_max_warp_sustained(self):
+        finder = CapacityFinder(self._rows(), start_warp=1.0,
+                                warp_step=2.0, max_warp=4.0,
+                                runner=self._scripted(1000.0))
+        doc = finder.run()
+        assert doc["breached"] is False
+        assert doc["breach"] is None
+        assert doc["keys"]["capacity_sustained_warp_x"] == 4.0
+        text = render_capacity_report(doc)
+        assert "no breach up to x4.00" in text
+
+    def test_report_artifact_and_rendering(self, tmp_path):
+        finder = CapacityFinder(self._rows(), start_warp=1.0,
+                                warp_step=2.0, max_warp=16.0,
+                                refine_steps=1,
+                                runner=self._scripted(8.0))
+        doc = finder.run()
+        path = str(tmp_path / "cap.json")
+        write_capacity_report(doc, path)
+        saved = json.loads(open(path).read())
+        assert saved["kind"] == "veles-capacity-report"
+        assert saved["keys"] == doc["keys"]
+        import hashlib
+        digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        assert open(path + ".sha256").read().split()[0] == digest
+        text = render_capacity_report(doc)
+        assert "sustains" in text and "BREACH" in text
+        assert "first-breaching series: replay_availability" in text
+
+    def test_mix_rides_the_report(self):
+        rows = self._rows() + [{"t": 0.09, "tenant": "bb",
+                                "prompt_len": 3, "budget": 4,
+                                "tokens": 4}]
+        finder = CapacityFinder(rows, runner=self._scripted(2.0),
+                                start_warp=1.0, warp_step=2.0,
+                                refine_steps=0)
+        doc = finder.run()
+        assert doc["mix"]["tenants"] == tenant_mix(rows)
+        assert doc["mix"]["requests"] == 9
+
+
+class TestRecordedChaosProfile:
+    def test_trace_becomes_deterministic_chaos_traffic(self, tmp_path):
+        from veles_tpu.serving_chaos import RecordedTrafficProfile
+
+        ledger = make_ledger(10)
+        path = str(tmp_path / "t.jsonl")
+        record_trace(ledger, path)
+        profile = RecordedTrafficProfile(path, warp=4.0, seed=5,
+                                         burst_compress=0.3)
+        again = RecordedTrafficProfile(path, warp=4.0, seed=5,
+                                       burst_compress=0.3)
+        assert profile.fingerprint() == again.fingerprint()
+        mix = profile.expected_mix()
+        assert sum(mix.values()) == pytest.approx(1.0, abs=0.01)
+        hits = []
+        summary = profile.drive(
+            poster=lambda e, p: (hits.append(e["tenant"]) or
+                                 (200, p["n_tokens"])),
+            workers=4)
+        assert summary["completed"] == 10
+        observed = {t: hits.count(t) / float(len(hits))
+                    for t in set(hits)}
+        assert observed == pytest.approx(mix, abs=0.01)
+
+
+# -- the live-endpoint acceptance (slow tier; `make replay` runs it) --------
+
+@pytest.fixture(scope="module")
+def model():
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    heads, embed, vocab = 4, 16, 11
+    params = init_transformer_params(rng, 2, embed, heads, vocab)
+    table = jnp.asarray(
+        rng.randn(vocab, embed).astype(numpy.float32) * 0.3)
+    return params, table, heads, vocab
+
+
+@pytest.fixture
+def registry():
+    from veles_tpu.observe.metrics import get_metrics_registry
+
+    reg = get_metrics_registry()
+    was = reg.enabled
+    reg.reset()
+    reg.enable()
+    yield reg
+    reg.reset()
+    reg.enabled = was
+
+
+@pytest.fixture
+def fast_history(registry, tmp_path):
+    """A fast-sampling process history with ONLY the slo_burn rule, so
+    the incident handoff's first-breaching series is unambiguous."""
+    from veles_tpu.observe.history import (AnomalyRule,
+                                           IncidentRecorder,
+                                           MetricHistory,
+                                           get_metric_history,
+                                           set_metric_history)
+
+    history = MetricHistory(
+        registry=registry, interval_s=0.05, capacity=512,
+        series_cap=128,
+        rules=[AnomalyRule("slo_burn", "veles_slo_burn_rate",
+                           kind="threshold", op=">=", threshold=1.0,
+                           for_samples=1)],
+        incidents=IncidentRecorder(cooldown_s=0.0,
+                                   directory=str(tmp_path)))
+    previous = get_metric_history()
+    set_metric_history(history)
+    try:
+        yield history
+    finally:
+        set_metric_history(previous)
+
+
+def _post(url, payload, tenant=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **({"X-Veles-Tenant": tenant} if tenant else {})))
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read().decode())
+
+
+@pytest.mark.slow
+class TestCapacityE2E:
+    def test_record_replay_capacity_names_breaching_series(
+            self, model, registry, fast_history, tmp_path):
+        """The acceptance: record a mixed-tenant run off a live
+        surface via the CLI, escalate warp until the (deliberately
+        tight) SLO burns, and the report artifact states sustained
+        tokens/sec at the recorded mix AND names the first-breaching
+        series via the incident autopsy."""
+        from veles_tpu.observe.history import start_history_sampler
+        from veles_tpu.observe.reqledger import RequestLedger
+        from veles_tpu.observe.slo import SLOEngine, parse_objectives
+        from veles_tpu.observe.trace_export import main as observe_main
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+        # a ttft objective tight enough that queueing at high warp
+        # (10 arrivals compressed onto 2 slots) is certain to burn it
+        slo = SLOEngine(parse_objectives("ttft_p95_ms=20"))
+        ledger = RequestLedger()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=4, chunk=2, port=0, ledger=ledger,
+                          slo=slo)
+        api.start()
+        start_history_sampler()
+        trace_path = str(tmp_path / "live.trace.jsonl")
+        report_path = str(tmp_path / "live.capacity.json")
+        try:
+            base = "http://127.0.0.1:%d" % api.port
+            url = base + "/generate"
+            for i in range(10):
+                _post(url, {"tokens": [1 + i % 5] * (3 + i % 3),
+                            "n_tokens": 3},
+                      tenant="acme" if i % 2 else "globex")
+                time.sleep(0.05)
+            # the CLI round trip: record --live, then capacity --live
+            assert observe_main(["record", "--live", base,
+                                 "-o", trace_path]) == 0
+            header, rows = load_trace(trace_path)
+            assert header["count"] == 10
+            assert len({r["tenant"] for r in rows}) == 2
+            assert observe_main([
+                "capacity", trace_path, "--live", base,
+                "-o", report_path, "--start-warp", "1",
+                "--warp-step", "4", "--max-warp", "64",
+                "--refine-steps", "0", "--workers", "8",
+                "--availability", "0.999",
+                "--vocab", str(vocab)]) == 0
+            doc = json.loads(open(report_path).read())
+            assert doc["kind"] == "veles-capacity-report"
+            assert doc["escalation"], "controller never probed"
+            assert set(doc["mix"]["tenants"]) \
+                == {r["tenant"] for r in rows}
+            # between the 20ms ttft burn and the 0.999 availability
+            # floor, warp x64 onto 2 slots MUST breach something
+            assert doc["breached"] is True
+            breach = doc["breach"]
+            assert breach["first_breaching_series"] in (
+                "veles_slo_burn_rate", "replay_availability")
+            if breach["first_breaching_rule"]:
+                # the incident autopsy claimed the leading indicator:
+                # the only rule wired into this history is slo_burn
+                assert breach["first_breaching_rule"] == "slo_burn"
+                assert breach["first_breaching_series"] \
+                    == "veles_slo_burn_rate"
+            assert doc["keys"]["capacity_cliff_warp_x"] >= 1.0
+            text = render_capacity_report(doc)
+            assert "first-breaching series:" in text
+            assert "sustains" in text or "no breach" in text
+        finally:
+            api.stop()
+
+    def test_replay_cli_against_live_endpoint(self, model, registry,
+                                              tmp_path):
+        """``observe replay`` at 1x against a fresh surface delivers
+        full fidelity and holds its schedule."""
+        from veles_tpu.observe.reqledger import RequestLedger
+        from veles_tpu.observe.trace_export import main as observe_main
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads, vocab = model
+
+        def serve():
+            return GenerateAPI(params, table, heads, slots=2,
+                               max_len=32, n_tokens=4, chunk=2,
+                               port=0, ledger=RequestLedger())
+
+        api = serve()
+        api.start()
+        trace_path = str(tmp_path / "t.jsonl")
+        try:
+            url = "http://127.0.0.1:%d/generate" % api.port
+            for i in range(6):
+                _post(url, {"tokens": [1, 2, 3], "n_tokens": 3},
+                      tenant="acme")
+                time.sleep(0.03)
+            record_trace(api.ledger, trace_path)
+        finally:
+            api.stop()
+        api = serve()
+        api.start()
+        try:
+            base = "http://127.0.0.1:%d" % api.port
+            assert observe_main(["replay", trace_path, "--live", base,
+                                 "--vocab", str(vocab)]) == 0
+            _, rows = load_trace(trace_path)
+            plan = warp_plan(rows)
+            summary = replay(plan, url=base, vocab=vocab, workers=4)
+            assert summary["completed"] == 6
+            assert summary["delivered_ratio"] == 1.0
+            # the ledger counters the recorder depends on are live on
+            # the endpoint's /metrics (the satellite contract)
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            assert "veles_reqledger_staged_total" in text
+            assert "veles_reqledger_ring_overflow_total" in text
+        finally:
+            api.stop()

@@ -54,7 +54,20 @@ Three coordinated parts (docs/observability.md):
   the dominant waste cause;
 - :mod:`veles_tpu.observe.regress` — the artifact-proof bench sentinel:
   incremental atomic BENCH writes with SHA-256 sidecars, and the
-  ``veles_tpu observe regress`` comparison gate (``make regress``).
+  ``veles_tpu observe regress`` comparison gate (``make regress``);
+- :mod:`veles_tpu.observe.replay` — production traffic record-replay
+  (docs/traffic_replay.md): anonymized versioned JSONL traces exported
+  from the request ledger (salted tenant hashes, loss-stamped headers,
+  sha256 sidecars — ``veles_tpu observe record``) and the open-loop
+  replayer with deterministic seeded time-warps (xN rate, tenant-mix
+  reweighting, long-context skew, burst compression — ``observe
+  replay``);
+- :mod:`veles_tpu.observe.capacity` — the capacity-cliff finder
+  (``veles_tpu observe capacity``): escalate a replayed trace's warp
+  until an SLO objective breaches, back off and bisect the cliff, and
+  emit a report artifact whose incident handoff names the
+  first-breaching series and the dominant servescope waste cause — its
+  keys (``capacity_sustained_tokens_per_sec`` etc.) are regress-gated.
 
 Everything is off by default with a structurally no-op fast path: the
 disabled tracer hands out one shared null span, the disabled registry
@@ -75,8 +88,13 @@ from veles_tpu.observe.metrics import (  # noqa: F401
     DEFAULT_BUCKETS, MetricsRegistry, bridge, get_metrics_registry,
     publish_decoder, publish_fleet, publish_loader,
     publish_serving_health)
+from veles_tpu.observe.capacity import (  # noqa: F401
+    CapacityFinder, render_capacity_report, write_capacity_report)
+from veles_tpu.observe.replay import (  # noqa: F401
+    hash_tenant, load_trace, plan_fingerprint, record_trace, replay,
+    warp_plan, write_trace)
 from veles_tpu.observe.reqledger import (  # noqa: F401
-    RequestLedger, get_request_ledger)
+    RequestLedger, get_request_ledger, publish_request_ledger)
 from veles_tpu.observe.servescope import (  # noqa: F401
     ServeScope, ensure_serve_registered, get_serve_scope,
     publish_serve_scope)

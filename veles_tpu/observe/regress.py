@@ -120,6 +120,20 @@ SCHEMA_VERSION = 1
 #: decode_paged_kernel_speedup (gather/kernel at ragged occupancy)
 #: uses the higher-is-better default via "_speedup", so the
 #: kernel-vs-gather win is itself regress-gated.
+#: The traffic record-replay + capacity keys (observe/replay.py,
+#: observe/capacity.py, bench replay_section —
+#: docs/traffic_replay.md): capacity_sustained_tokens_per_sec (what
+#: the config sustains at the recorded mix before an SLO breach) and
+#: capacity_cliff_warp_x (the warp factor where the cliff sits) use
+#: the higher-is-better default — a PR that silently costs 15% of
+#: peak throughput, or moves the cliff closer, fails the gate;
+#: replay_schedule_skew_ms (planned-vs-actual arrival skew p95 of the
+#: open-loop replayer) rides the "_ms" rule — a replayer that cannot
+#: hold its own schedule invalidates every capacity number downstream;
+#: replay_fidelity_delivered_ratio (delivered/recorded tokens on a 1x
+#: round trip) uses the higher-is-better default — trace round-trip
+#: fidelity decaying is a recorder or replayer bug, gated like any
+#: throughput loss.
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness", "_compiles", "burn_rate", "_transitions",

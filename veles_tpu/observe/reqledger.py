@@ -71,10 +71,18 @@ class RequestLedger:
         self.staged_total = 0
         self.resolved_total = 0
         self.dropped_total = 0
+        # loss truth for the trace recorder (observe/replay.py): every
+        # way a bounded ring under-records is tallied here so an
+        # exported trace can be stamped "lossy" WITH the amount —
+        # chunk stamps past the per-row cap, and resolved rows pushed
+        # off the ring before anyone exported them
+        self.chunk_stamps_dropped_total = 0
+        self.ring_overflow_total = 0
 
     # -- recording (no locks, GIL-atomic container ops only) --------------
     def stage(self, api="", trace=None, tenant="", prompt_len=0,
-              budget=0, bucket=0, quant=None, breaker_gen=0):
+              budget=0, bucket=0, quant=None, breaker_gen=0,
+              deadline=0.0):
         """Open one row at request staging (handler thread); returns
         the row dict to carry alongside the request, or None while
         disabled. One dict/list allocation per REQUEST — never per
@@ -92,6 +100,7 @@ class RequestLedger:
             "bucket": int(bucket),
             "budget": int(budget),
             "quant": quant or "bf16",
+            "deadline_s": float(deadline),
             "breaker_gen": int(breaker_gen),
             "t": time.time(),
             "staged": now,
@@ -170,6 +179,7 @@ class RequestLedger:
             row["chunks"].append([now, int(n), 1 if aot else 0])
         else:
             row["chunks_dropped"] += 1
+            self.chunk_stamps_dropped_total += 1
 
     def resolve(self, row, outcome, error=None):
         """Close a row exactly once: stamp ``resolved``, attach the
@@ -199,6 +209,10 @@ class RequestLedger:
         except Exception:
             pass
         self._inflight.pop(row["id"], None)
+        if len(self._resolved) >= self.capacity:
+            # deque(maxlen) evicts silently; count it so the trace
+            # recorder knows how many resolved rows it never saw
+            self.ring_overflow_total += 1
         self._resolved.append(row)
         self.resolved_total += 1
 
@@ -226,6 +240,23 @@ class RequestLedger:
                       key=lambda r: r.get("wall_ms", 0.0), reverse=True)
         return [self._copy(row) for row in rows[:max(0, int(n))]]
 
+    def resolved(self, n=None):
+        """Copies of the resolved rows in ring order (oldest first) —
+        the trace recorder's export seam (observe/replay.py records
+        arrival cadence from these rows' ``staged`` stamps). ``n``
+        keeps only the newest n."""
+        rows = list(self._resolved)
+        if n is not None:
+            rows = rows[-max(0, int(n)):]
+        return [self._copy(row) for row in rows]
+
+    def loss_tallies(self):
+        """Every way this bounded ledger under-records, as one dict —
+        what the trace recorder stamps into a lossy trace's header."""
+        return {"inflight_dropped": self.dropped_total,
+                "chunk_stamps_dropped": self.chunk_stamps_dropped_total,
+                "resolved_ring_overflow": self.ring_overflow_total}
+
     def debug_snapshot(self, slowest=8):
         """The ``/debug/requests`` payload: live in-flight rows + the N
         slowest resolved, plus the ledger's own tallies."""
@@ -234,6 +265,9 @@ class RequestLedger:
                 "staged_total": self.staged_total,
                 "resolved_total": self.resolved_total,
                 "dropped_total": self.dropped_total,
+                "chunk_stamps_dropped_total":
+                    self.chunk_stamps_dropped_total,
+                "ring_overflow_total": self.ring_overflow_total,
                 "capacity": self.capacity}
 
     def reset(self):
@@ -243,6 +277,8 @@ class RequestLedger:
         self.staged_total = 0
         self.resolved_total = 0
         self.dropped_total = 0
+        self.chunk_stamps_dropped_total = 0
+        self.ring_overflow_total = 0
 
 
 _ledger = RequestLedger()
@@ -250,6 +286,35 @@ _ledger = RequestLedger()
 
 def get_request_ledger():
     return _ledger
+
+
+def publish_request_ledger(registry, ledger):
+    """Scrape-time bridge: the ledger's own tallies as
+    ``veles_reqledger_*`` counters on /metrics (docs/observability.md).
+    The loss counters are the trace recorder's honesty contract made
+    operator-visible — a cadence-capped or ring-overflowed ledger
+    under-records, and these say by how much BEFORE anyone exports a
+    trace from it."""
+    registry.counter_set(
+        "veles_reqledger_staged_total", ledger.staged_total,
+        help="requests that opened a ledger row at staging")
+    registry.counter_set(
+        "veles_reqledger_resolved_total", ledger.resolved_total,
+        help="ledger rows resolved into the bounded ring")
+    registry.counter_set(
+        "veles_reqledger_inflight_dropped_total", ledger.dropped_total,
+        help="unresolved rows dropped past the in-flight cap "
+             "(leaky direct drivers only)")
+    registry.counter_set(
+        "veles_reqledger_chunk_stamps_dropped_total",
+        ledger.chunk_stamps_dropped_total,
+        help="per-request chunk cadence stamps dropped past chunk_cap "
+             "(a trace recorded from this ledger is lossy)")
+    registry.counter_set(
+        "veles_reqledger_ring_overflow_total",
+        ledger.ring_overflow_total,
+        help="resolved rows evicted off the bounded ring "
+             "(a trace recorded from this ledger is lossy)")
 
 
 # -- waterfall formatting (the autopsy view) --------------------------------

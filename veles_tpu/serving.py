@@ -3190,6 +3190,11 @@ class GenerateAPI:
         bridge(registry, self.health, publish_serving_health)
         bridge(registry, self,
                lambda reg, live: publish_decoder(reg, live.decoder))
+        # the request-truth ledger's own tallies (staged/resolved and
+        # the trace-loss counters) are scrapeable beside the health
+        # counters — observe/reqledger.py, docs/traffic_replay.md
+        from veles_tpu.observe.reqledger import publish_request_ledger
+        bridge(registry, self.ledger, publish_request_ledger)
         if self.slo is not None:
             # the SLO gauges ride every scrape of this surface AND the
             # fleet piggyback (registry.snapshot runs collectors)
@@ -3313,7 +3318,8 @@ class GenerateAPI:
                             else decoder.n_tokens),
                     bucket=decoder.bucket_for(len(prompt)),
                     quant=decoder.quantize,
-                    breaker_gen=api.health.counter("rebuilds"))
+                    breaker_gen=api.health.counter("rebuilds"),
+                    deadline=deadline_s)
                 serving_tier = decoder.quantize or "bf16"
                 if serving_tier != api._base_tier:
                     # the governed tier in effect: the demoted

@@ -610,3 +610,63 @@ def tear_file(path, frac=0.5):
     with open(path, "rb+") as fobj:
         fobj.truncate(keep)
     return keep
+
+
+# -- recorded-traffic chaos profiles (observe/replay.py) ---------------------
+
+class RecordedTrafficProfile:
+    """A RECORDED trace as a first-class chaos traffic profile
+    (docs/traffic_replay.md): where the synthetic profiles above fault
+    the server from inside, this one replays a captured adversarial
+    traffic shape — a real burst, a tenant stampede, a long-context
+    wave — against the surface under test, open-loop and seeded, so
+    the same incident is reproducible on demand.
+
+    Deterministic by construction: the arrival plan is fixed by
+    (trace, seed, warp knobs) before a single request is sent
+    (``plan()`` is pure; ``fingerprint()`` pins it), which is what
+    makes a recorded incident a regression test instead of an
+    anecdote. ``drive()`` accepts the replayer's ``poster`` injection,
+    so chaos tests can script the transport with zero sockets."""
+
+    def __init__(self, trace_path, warp=1.0, seed=0,
+                 tenant_weights=None, long_context_skew=0.0,
+                 burst_compress=0.0):
+        from veles_tpu.observe.replay import load_trace
+
+        self.trace_path = str(trace_path)
+        self.header, self.rows = load_trace(trace_path)
+        self.warp = float(warp)
+        self.seed = int(seed)
+        self.warp_kw = {"tenant_weights": dict(tenant_weights or {}),
+                        "long_context_skew": float(long_context_skew),
+                        "burst_compress": float(burst_compress)}
+
+    def plan(self):
+        """The deterministic arrival plan (pure in trace + knobs)."""
+        from veles_tpu.observe.replay import warp_plan
+
+        return warp_plan(self.rows, warp=self.warp, seed=self.seed,
+                         **self.warp_kw)
+
+    def fingerprint(self):
+        """sha256 of the plan — two runs of one profile are THE SAME
+        experiment iff their fingerprints match."""
+        from veles_tpu.observe.replay import plan_fingerprint
+
+        return plan_fingerprint(self.plan())
+
+    def expected_mix(self):
+        """Tenant-hash -> arrival share of the PLANNED traffic (after
+        reweighting) — what an acceptance asserts the replay held."""
+        from veles_tpu.observe.replay import tenant_mix
+
+        return tenant_mix(self.plan())
+
+    def drive(self, url=None, poster=None, **replay_kw):
+        """Replay the profile against ``url`` (or a scripted
+        ``poster``); returns the replay summary dict."""
+        from veles_tpu.observe.replay import replay
+
+        return replay(self.plan(), url=url, poster=poster,
+                      seed=self.seed, **replay_kw)
