@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: check test entry hooks chaos chaos-serve bench-serve metrics \
 	regress mesh paged paged-kernel fleet-mr aot slo governor history \
-	analyze fleetscope servescope deploy elastic replay
+	analyze fleetscope servescope deploy elastic replay memscope
 
 # Full commit gate: whole test suite + both driver entry points.
 check: test entry
@@ -231,6 +231,22 @@ elastic:
 replay:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_replay.py \
 		-m replay -q
+
+# Per-owner HBM attribution suite (docs/memscope.md): weakref'd
+# byte-accountants + GC-as-unregister, the reconciliation contract
+# (exported owner rows cover the device total with owner="untagged"
+# as the published residue), lifecycle-edge leak verdicts + their
+# flight-recorder incident artifacts with the LEAK_EXEMPT carve-outs,
+# the headroom-forecast slope math, the governor's memory-frac CPU
+# fallback + headroom_guard_s actuator, the veles_hbm_* /
+# veles_device_memory_limit_bytes families, /debug/memory, the real
+# serving engine's owner registrations, and the chaos acceptance — a
+# seeded retained-pool injection must land an incident artifact
+# naming kv_pool. (The engine-booting acceptances ride the `slow`
+# marker so tier-1 keeps its timeout margin; this target runs them.)
+memscope:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_memscope.py \
+		-m memscope -q
 
 # AOT compiled-program artifact suite (docs/aot_artifacts.md): bundle
 # build/load bit-identity (dense + paged, bf16 + int8-KV, the 8-device

@@ -1,0 +1,609 @@
+"""ISSUE 20: memscope — per-owner HBM attribution, leak forensics
+and headroom forecasting (docs/memscope.md).
+
+Pins the attribution plane (weakref'd accountants, GC-as-unregister,
+multi-instance stacking, scratch tags), the reconciliation contract
+(sum of exported owners covers the device total with ``untagged``
+exported, never hidden), the lifecycle-edge leak verdicts with their
+flight-recorder incident artifacts + the LEAK_EXEMPT carve-outs, the
+headroom-forecast slope math, the governor guard inputs (the
+memory-frac CPU fallback and ``headroom_guard_s``), the
+``veles_hbm_*`` / ``veles_device_memory_limit_bytes`` metric
+families, the ``/debug/memory`` surface, the real serving engine's
+owner registrations, and the acceptance: the ``serving_chaos``
+retained-pool leak injection must yield an incident artifact naming
+``kv_pool``.
+"""
+
+import gc
+import io
+import json
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.observe.memscope import (MemScope, get_memscope,
+                                        pytree_nbytes, set_memscope)
+from veles_tpu.observe.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.memscope
+
+
+@pytest.fixture
+def fresh_scope():
+    """Install an isolated process scope (restored at teardown) so the
+    serving engine's registrations land where the test can see them."""
+    scope = MemScope(leak_min_bytes=1024, limit_bytes=None)
+    previous = set_memscope(scope)
+    try:
+        yield scope
+    finally:
+        set_memscope(previous)
+
+
+@pytest.fixture
+def run_dir(tmp_path, monkeypatch):
+    """Redirect flight-recorder black boxes under tmp_path."""
+    from veles_tpu.core.config import root
+    monkeypatch.setattr(root.common.dirs, "run", str(tmp_path / "run"))
+    return tmp_path / "run"
+
+
+def _tiny():
+    from veles_tpu.parallel.transformer_step import (
+        init_transformer_params)
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    params = init_transformer_params(rng, 1, 8, 2, 7)
+    table = jnp.asarray(rng.randn(7, 8).astype(numpy.float32))
+    return params, table, 2
+
+
+class _Box:
+    """A registrable owner instance (weakref needs a non-builtin)."""
+
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+
+
+# -- sizing + attribution ----------------------------------------------------
+
+class TestAttribution:
+    def test_pytree_nbytes(self):
+        tree = {"w": numpy.zeros((4, 4), numpy.float32),
+                "b": numpy.zeros(4, numpy.float32),
+                "meta": "not-an-array", "none": None}
+        assert pytree_nbytes(tree) == 64 + 16
+        assert pytree_nbytes(None) == 0
+        assert pytree_nbytes("scalar") == 0
+
+    def test_register_sums_live_instances(self):
+        scope = MemScope(leak_min_bytes=1024)
+        a, b = _Box(100), _Box(200)
+        scope.register("kv_pool", a, lambda box: box.nbytes)
+        scope.register("kv_pool", b, lambda box: box.nbytes)
+        assert scope.attribute()["kv_pool"] == 300
+        # re-registering the SAME instance replaces, never stacks
+        scope.register("kv_pool", a, lambda box: box.nbytes * 2)
+        assert scope.attribute()["kv_pool"] == 400
+
+    def test_gc_is_the_unregister(self):
+        scope = MemScope(leak_min_bytes=1024)
+        a = _Box(100)
+        scope.register("params", a, lambda box: box.nbytes)
+        assert scope.attribute()["params"] == 100
+        del a
+        gc.collect()
+        assert scope.attribute()["params"] == 0
+
+    def test_raising_accountant_contributes_nothing(self):
+        scope = MemScope(leak_min_bytes=1024)
+        a, b = _Box(100), _Box(50)
+        scope.register("params", a,
+                       lambda box: 1 / 0)  # must not take us down
+        scope.register("params", b, lambda box: box.nbytes)
+        assert scope.attribute()["params"] == 50
+
+    def test_scratch_tags_note_and_drop_exactly_once(self):
+        scope = MemScope(leak_min_bytes=1024)
+        scope.scratch_note("r1", 4096)
+        scope.scratch_note("r2", 1000)
+        assert scope.attribute()["admission_scratch"] == 5096
+        scope.scratch_drop("r1")
+        scope.scratch_drop("r1")  # second drop is a no-op
+        scope.scratch_drop(None)  # None key tolerated (resolve path)
+        assert scope.attribute()["admission_scratch"] == 1000
+
+    def test_snapshot_reconciles_and_exports_untagged(self):
+        """The acceptance contract: the exported owner rows cover the
+        device total — owners sum to >= device_bytes because the
+        residue is PUBLISHED as owner="untagged", not hidden."""
+        scope = MemScope(leak_min_bytes=1024)
+        scope.register("params", _keepalive(scope, _Box(1 << 10)),
+                       lambda box: box.nbytes)
+        snap = scope.snapshot()
+        owners = snap["owners"]
+        assert "untagged" in owners
+        assert owners["untagged"] == max(
+            0, snap["device_bytes"] - snap["tagged_bytes"])
+        assert sum(owners.values()) >= snap["device_bytes"]
+        assert 0.0 <= snap["untagged_fraction"] <= 1.0
+
+    def test_device_totals_shape(self):
+        used, limit = MemScope.device_totals()
+        assert isinstance(used, int) and used >= 0
+        assert limit is None or (isinstance(limit, int) and limit > 0)
+
+
+def _keepalive(scope, box):
+    """Park a strong ref on the scope so the box outlives the caller's
+    frame (the weakref must stay live for the snapshot)."""
+    refs = getattr(scope, "_test_refs", None)
+    if refs is None:
+        refs = scope._test_refs = []
+    refs.append(box)
+    return box
+
+
+# -- lifecycle-edge leak forensics -------------------------------------------
+
+class TestLeakForensics:
+    def test_edge_diff_names_the_grown_owner(self, run_dir):
+        scope = MemScope(leak_min_bytes=1024)
+        pool = _Box(10_000)
+        scope.register("kv_pool", pool, lambda box: box.nbytes)
+        scope.edge_begin("breaker_rebuild")
+        zombie = _Box(50_000)  # the retained old pool coexists
+        scope.register("kv_pool", zombie, lambda box: box.nbytes)
+        verdict = scope.edge_end("breaker_rebuild")
+        assert verdict["leak"] is True
+        assert verdict["owner"] == "kv_pool"
+        assert verdict["grew_bytes"] == 50_000
+        assert verdict["edge"] == "breaker_rebuild"
+        assert scope.leaks_total == 1 and scope.edges_total == 1
+        # the incident artifact names the owner in reason AND payload
+        wrote = scope.flush_incidents()
+        assert len(wrote) == 1
+        assert "memscope_leak_kv_pool" in wrote[0]
+        doc = json.load(open(wrote[0]))
+        assert doc["extra"]["memscope_leak"]["owner"] == "kv_pool"
+        assert doc["extra"]["memscope_leak"]["grew_bytes"] == 50_000
+        # flushed verdicts move to incidents with their path
+        assert scope.incidents[-1]["artifact"] == wrote[0]
+        assert scope.flush_incidents() == []  # drained
+
+    def test_growth_below_threshold_is_no_leak(self):
+        scope = MemScope(leak_min_bytes=1 << 20)
+        pool = _Box(10_000)
+        scope.register("kv_pool", pool, lambda box: box.nbytes)
+        scope.edge_begin("swap_params")
+        pool.nbytes += 4096  # < leak_min_bytes
+        verdict = scope.edge_end("swap_params")
+        assert verdict["leak"] is False and verdict["owner"] is None
+        assert scope.leaks_total == 0
+
+    def test_leak_exempt_owners_never_verdict(self):
+        """param_stash grows by DESIGN on every successful hot-swap
+        (the rollback stash); admission scratch tracks the staged
+        queue. Both are exempt — but still visible in ``grown``."""
+        scope = MemScope(leak_min_bytes=1024)
+        stash = _Box(0)
+        scope.register("param_stash", stash, lambda box: box.nbytes)
+        scope.edge_begin("swap_params")
+        stash.nbytes = 1 << 20
+        scope.scratch_note("r1", 1 << 20)
+        verdict = scope.edge_end("swap_params")
+        assert verdict["leak"] is False and verdict["owner"] is None
+        assert verdict["grown"]["param_stash"] == 1 << 20
+        assert scope.leaks_total == 0
+
+    def test_edge_end_without_begin_is_none(self):
+        scope = MemScope(leak_min_bytes=1024)
+        assert scope.edge_end("breaker_rebuild") is None
+        assert scope.edges_total == 0
+
+    def test_retrying_edges_pair_with_the_newest_begin(self):
+        scope = MemScope(leak_min_bytes=1024)
+        pool = _Box(1000)
+        scope.register("kv_pool", pool, lambda box: box.nbytes)
+        scope.edge_begin("breaker_rebuild")   # failed attempt's begin
+        pool.nbytes = 5000
+        scope.edge_begin("breaker_rebuild")   # the retry
+        verdict = scope.edge_end("breaker_rebuild")
+        # diffed against the RETRY's 5000 baseline, not the stale 1000
+        assert verdict["grown"] == {} and verdict["leak"] is False
+        # the stale begin is still open; a second end drains it
+        assert scope.edge_end("breaker_rebuild") is not None
+        assert scope.edge_end("breaker_rebuild") is None
+
+
+# -- headroom forecasting ----------------------------------------------------
+
+class TestHeadroomForecast:
+    def _ramp(self, scope, now, slope=2, points=6, free_last=10):
+        for i in range(points):
+            used = slope * i
+            scope._pool_points.append(
+                (now - (points - 1 - i) * 1.0, used,
+                 free_last + slope * (points - 1 - i)))
+
+    def test_slope_math(self):
+        scope = MemScope(leak_min_bytes=1024)
+        now = time.monotonic()
+        self._ramp(scope, now)  # 2 pages/s net, 10 free at the end
+        assert scope.headroom_forecast_s(now=now) == pytest.approx(5.0)
+
+    def test_flat_or_shrinking_usage_forecasts_none(self):
+        scope = MemScope(leak_min_bytes=1024)
+        now = time.monotonic()
+        for i in range(4):
+            scope._pool_points.append((now - (3 - i), 8, 8))
+        assert scope.headroom_forecast_s(now=now) is None
+        scope._pool_points.clear()
+        for i in range(4):
+            scope._pool_points.append((now - (3 - i), 8 - i, 8 + i))
+        assert scope.headroom_forecast_s(now=now) is None
+
+    def test_needs_two_points_inside_the_window(self):
+        scope = MemScope(leak_min_bytes=1024)
+        now = time.monotonic()
+        assert scope.headroom_forecast_s(now=now) is None
+        scope._pool_points.append((now - 120.0, 0, 20))
+        scope._pool_points.append((now, 10, 10))
+        # the 120s-old point falls outside the 60s window -> 1 point
+        assert scope.headroom_forecast_s(now=now) is None
+        scope._pool_points.clear()
+        scope._pool_points.append((now - 5.0, 0, 20))
+        scope._pool_points.append((now, 10, 10))
+        assert scope.headroom_forecast_s(now=now) == pytest.approx(5.0)
+
+    def test_note_pool_reads_pool_counters(self):
+        class _Pool:
+            used_pages = 3
+            free_pages = 5
+
+        scope = MemScope(leak_min_bytes=1024)
+        scope.note_pool(_Pool())
+        scope.note_pool(None)  # tolerated
+        assert len(scope._pool_points) == 1
+        assert scope._pool_points[0][1:] == (3, 5)
+
+
+# -- publication + governor inputs -------------------------------------------
+
+class TestPublication:
+    def test_publish_hbm_families_and_headroom(self):
+        scope = MemScope(leak_min_bytes=1024)
+        box = _keepalive(scope, _Box(1 << 12))
+        scope.register("params", box, lambda b: b.nbytes)
+        now = time.monotonic()
+        for i in range(4):
+            scope._pool_points.append((now - (3 - i), 2 * i, 12 - 2 * i))
+        registry = MetricsRegistry(enabled=True)
+        scope.publish(registry)
+        text = registry.expose()
+        assert 'veles_hbm_bytes{owner="params"} 4096' in text
+        assert 'veles_hbm_bytes{owner="untagged"}' in text
+        assert "veles_headroom_forecast_s" in text
+        if scope.device_totals()[0]:
+            assert 'veles_hbm_fraction{owner="untagged"}' in text
+
+    def test_gauge_family_retires_dead_owners(self):
+        scope = MemScope(leak_min_bytes=1024)
+        box = _Box(1 << 12)
+        scope.register("aot_executables", box, lambda b: b.nbytes)
+        registry = MetricsRegistry(enabled=True)
+        scope.publish(registry)
+        assert 'owner="aot_executables"' in registry.expose()
+        del box
+        gc.collect()
+        scope.publish(registry)
+        # dead instance -> 0 bytes row (still exported, value 0)
+        assert 'veles_hbm_bytes{owner="aot_executables"} 0' \
+            in registry.expose()
+
+    def test_device_memory_limit_gauge(self, monkeypatch):
+        """Satellite: allocator budgets export as their own gauge."""
+        import veles_tpu.observe.xla_stats as xla_stats
+
+        monkeypatch.setattr(
+            xla_stats, "_sample_device_memory",
+            lambda: {0: {"bytes_in_use": 60, "bytes_limit": 100},
+                     1: {"live_bytes": 30}})
+        registry = MetricsRegistry(enabled=True)
+        xla_stats.publish_device_stats(registry)
+        text = registry.expose()
+        assert 'veles_device_memory_limit_bytes{device="0"} 100' \
+            in text
+        # the CPU-fallback device has no limit -> no phantom row
+        assert 'veles_device_memory_limit_bytes{device="1"}' \
+            not in text
+
+    def test_governor_memory_frac_allocator_path(self, monkeypatch):
+        from veles_tpu.observe.governor import ServingGovernor
+        import veles_tpu.observe.xla_stats as xla_stats
+
+        monkeypatch.setattr(
+            xla_stats, "_sample_device_memory",
+            lambda: {0: {"bytes_in_use": 60, "bytes_limit": 100},
+                     1: {"bytes_in_use": 90, "bytes_limit": 100}})
+        assert ServingGovernor._device_memory_frac() \
+            == pytest.approx(0.9)
+
+    def test_governor_memory_frac_cpu_fallback(self, monkeypatch,
+                                               fresh_scope):
+        """Satellite: the old raw memory_stats() read silently no-op'd
+        on CPU; the guard now falls back to memscope's reconciled
+        total over the configured byte budget."""
+        from veles_tpu.observe.governor import ServingGovernor
+        import veles_tpu.observe.xla_stats as xla_stats
+
+        monkeypatch.setattr(xla_stats, "_sample_device_memory",
+                            lambda: {0: {"live_bytes": 30}})
+        fresh_scope.limit_bytes = None
+        assert ServingGovernor._device_memory_frac() is None
+        fresh_scope.limit_bytes = 120
+        assert ServingGovernor._device_memory_frac() \
+            == pytest.approx(0.25)
+        assert fresh_scope.device_fraction() == pytest.approx(0.25)
+
+    def test_governor_headroom_guard_trips_breaker(self, fresh_scope):
+        from veles_tpu.observe.governor import (GovernorConfig,
+                                                ServingGovernor)
+
+        class _Api:
+            tripped = None
+
+            def request_trip(self, reason):
+                self.tripped = reason
+
+        config = GovernorConfig(headroom_guard_s=30.0)
+        governor = ServingGovernor(config)
+        api = _Api()
+        now = time.monotonic()
+        # 2 pages/s against 10 free -> ~5s, under the 30s guard
+        for i in range(6):
+            fresh_scope._pool_points.append(
+                (now - (5 - i) * 1.0, 2 * i, 20 - 2 * i))
+        governor._guard_breaker(api, now)
+        assert api.tripped is not None
+        assert "pool exhausts" in api.tripped
+        assert governor.counters["guard_trips"] == 1
+        # guard cooldown: an immediate second pass holds fire
+        api.tripped = None
+        governor._guard_breaker(api, now + 0.01)
+        assert api.tripped is None
+
+    def test_headroom_guard_disabled_by_default(self, fresh_scope):
+        from veles_tpu.observe.governor import (GovernorConfig,
+                                                parse_governor_spec)
+
+        assert GovernorConfig().headroom_guard_s == 0.0
+        spec = parse_governor_spec("headroom_guard_s=12")
+        assert spec.headroom_guard_s == 12.0
+        with pytest.raises(ValueError):
+            GovernorConfig(headroom_guard_s=-1)
+
+
+# -- the /debug/memory surface ----------------------------------------------
+
+class _Handler:
+    """Just enough of BaseHTTPRequestHandler for httpd.reply()."""
+
+    def __init__(self, path):
+        self.path = path
+        self.wfile = io.BytesIO()
+
+    def send_response(self, code):
+        self.code = code
+
+    def send_header(self, key, value):
+        pass
+
+    def end_headers(self):
+        pass
+
+    def body(self):
+        return json.loads(self.wfile.getvalue().decode())
+
+
+class TestDebugMemory:
+    def test_route_matches_and_replies(self):
+        from veles_tpu.core.httpd import serve_debug_memory
+
+        scope = MemScope(leak_min_bytes=1024)
+        box = _keepalive(scope, _Box(2048))
+        scope.register("params", box, lambda b: b.nbytes)
+        scope.edge_begin("swap_params")
+        scope.edge_end("swap_params")
+        handler = _Handler("/debug/memory")
+        assert serve_debug_memory(handler, scope=scope) is True
+        doc = handler.body()
+        assert doc["memscope"]["owners"]["params"] == 2048
+        assert "untagged" in doc["memscope"]["owners"]
+        assert doc["edges_total"] == 1 and len(doc["edges"]) == 1
+        assert serve_debug_memory(_Handler("/debug/serve"),
+                                  scope=scope) is False
+
+    def test_edges_query_param_clamped(self):
+        from veles_tpu.core.httpd import serve_debug_memory
+
+        scope = MemScope(leak_min_bytes=1024)
+        for i in range(20):
+            scope.edge_begin("e%d" % i)
+            scope.edge_end("e%d" % i)
+        handler = _Handler("/debug/memory?edges=4")
+        assert serve_debug_memory(handler, scope=scope)
+        assert len(handler.body()["edges"]) == 4
+        handler = _Handler("/debug/memory?edges=garbage")
+        assert serve_debug_memory(handler, scope=scope)
+        assert len(handler.body()["edges"]) == 16  # default kept
+
+    def test_debug_index_lists_memory(self):
+        from veles_tpu.core.httpd import DEBUG_SURFACES
+        assert "/debug/memory" in DEBUG_SURFACES
+
+
+# -- the serving engine's registrations --------------------------------------
+
+class TestServingWiring:
+    def test_decoder_registers_owner_taxonomy(self, fresh_scope):
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads = _tiny()
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=2, paged=True,
+                                page_size=8)
+        owners = fresh_scope.attribute()
+        assert owners["params"] > 0
+        assert owners["kv_pool"] > 0
+        assert owners["decode_state"] >= 0
+        # the pool's geometry was stamped at construction
+        assert dec.pool.page_bytes > 0
+        assert dec.pool.hbm_bytes() \
+            == dec.pool.pages * dec.pool.page_bytes
+        # no double counting: kv_pool bytes come OUT of slot state
+        from veles_tpu.parallel.decode import slot_state_bytes
+        assert owners["decode_state"] \
+            == max(0, slot_state_bytes(dec.state)
+                   - dec.pool.hbm_bytes())
+        del dec
+        gc.collect()
+        owners = fresh_scope.attribute()
+        assert owners["params"] == 0 and owners["kv_pool"] == 0
+
+    def test_dense_decoder_reports_full_slot_state(self, fresh_scope):
+        from veles_tpu.parallel.decode import slot_state_bytes
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads = _tiny()
+        dec = ContinuousDecoder(params, table, heads, slots=1,
+                                max_len=32, n_tokens=2)
+        owners = fresh_scope.attribute()
+        assert owners["decode_state"] == slot_state_bytes(dec.state)
+        assert "kv_pool" not in owners
+
+    def test_paged_kv_bytes_and_pool_sizers(self, fresh_scope):
+        from veles_tpu.parallel.kv_pool import paged_kv_bytes
+        from veles_tpu.serving import ContinuousDecoder
+
+        params, table, heads = _tiny()
+        dec = ContinuousDecoder(params, table, heads, slots=2,
+                                max_len=32, n_tokens=2, paged=True,
+                                page_size=8)
+        total = paged_kv_bytes(dec.state)
+        assert total > 0
+        # stamped page_bytes re-assembles to within one page of the
+        # true paged-KV footprint (integer division remainder)
+        assert 0 <= total - dec.pool.hbm_bytes() < dec.pool.pages
+        assert dec.pool.shadow_bytes() >= 0
+
+
+# -- the chaos leak-injection acceptance -------------------------------------
+
+class TestChaosLeakInjection:
+    def test_config_validation_and_leading_series(self):
+        from veles_tpu.serving_chaos import ServingChaosConfig
+
+        config = ServingChaosConfig(seed=1, leak_retain_pool_at=2)
+        assert config.any_profile
+        assert config.expected_leading_series()["pool_leak"] \
+            == "veles_hbm_bytes"
+        with pytest.raises(ValueError):
+            ServingChaosConfig(leak_retain_pool_at=-1)
+        assert not ServingChaosConfig().any_profile
+
+    @pytest.mark.slow
+    def test_retained_pool_names_kv_pool(self, fresh_scope, run_dir):
+        """The acceptance (ISSUE 20): a seeded chaos run that retains
+        a dead decoder's KV pool across a breaker rebuild must produce
+        an incident artifact naming kv_pool as the grown owner."""
+        from veles_tpu.serving import GenerateAPI
+        from veles_tpu.serving_chaos import (ServingChaosConfig,
+                                             ServingChaosMonkey)
+
+        monkey = ServingChaosMonkey(ServingChaosConfig(
+            seed=1, leak_retain_pool_at=1))
+        params, table, heads = _tiny()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=3, chunk=2, port=0, paged=True,
+                          page_size=8, rebuild_backoff=0.02,
+                          chaos=monkey)
+        api.start()
+        url = "http://127.0.0.1:%d" % api.port
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline \
+                    and not fresh_scope.incidents:
+                request = urllib.request.Request(
+                    url + "/generate",
+                    json.dumps({"tokens": [1, 2, 3]}).encode(),
+                    {"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(request,
+                                                timeout=30) as resp:
+                        resp.read()
+                except Exception:
+                    time.sleep(0.05)  # breaker open mid-rebuild
+            assert monkey.counters["pool_leaks"] == 1
+            assert fresh_scope.leaks_total >= 1
+            verdict = fresh_scope.incidents[-1]
+            assert verdict["owner"] == "kv_pool"
+            assert verdict["edge"] == "breaker_rebuild"
+            assert verdict["grew_bytes"] >= fresh_scope.leak_min_bytes
+            path = verdict["artifact"]
+            assert path and "memscope_leak_kv_pool" in path
+            doc = json.load(open(path))
+            leak = doc["extra"]["memscope_leak"]
+            assert leak["owner"] == "kv_pool"
+            # the serving surfaces carry the attribution too
+            metrics = urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode()
+            assert 'veles_hbm_bytes{owner="kv_pool"}' in metrics
+            assert 'veles_hbm_bytes{owner="untagged"}' in metrics
+            debug = json.load(urllib.request.urlopen(
+                url + "/debug/memory", timeout=10))
+            assert debug["leaks_total"] >= 1
+            assert debug["incidents"]
+            healthz = json.load(urllib.request.urlopen(
+                url + "/healthz", timeout=10))
+            assert healthz["memscope"]["leaks"] >= 1
+            assert healthz["memscope"]["last_leak_owner"] == "kv_pool"
+        finally:
+            monkey.release_leak()
+            api.stop()
+
+    @pytest.mark.slow
+    def test_clean_rebuild_is_no_leak(self, fresh_scope, run_dir):
+        """The negative control: the same trip WITHOUT the retention
+        closes its edge with no leak verdict (the rebuilt pool
+        replaces the collected old one rather than stacking)."""
+        from veles_tpu.serving import GenerateAPI
+
+        params, table, heads = _tiny()
+        api = GenerateAPI(params, table, heads, slots=2, max_len=32,
+                          n_tokens=3, chunk=2, port=0, paged=True,
+                          page_size=8, rebuild_backoff=0.02)
+        api.start()
+        url = "http://127.0.0.1:%d" % api.port
+        try:
+            request = urllib.request.Request(
+                url + "/generate",
+                json.dumps({"tokens": [1, 2, 3]}).encode(),
+                {"Content-Type": "application/json"})
+            json.load(urllib.request.urlopen(request, timeout=30))
+            api.request_trip("test: clean trip")
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline \
+                    and not fresh_scope.edges:
+                time.sleep(0.05)
+            assert fresh_scope.edges, "rebuild edge never closed"
+            verdict = fresh_scope.edges[-1]
+            assert verdict["edge"] == "breaker_rebuild"
+            assert verdict["leak"] is False
+            assert fresh_scope.leaks_total == 0
+        finally:
+            api.stop()

@@ -598,6 +598,46 @@ class TestOverheadGuard:
         assert not any("lock" in attr.lower()
                        for attr in vars(history))
 
+    def test_memscope_record_path_stays_structurally_noop(self):
+        """ISSUE 20: the HBM attribution plane obeys the same guard.
+        The record-path hooks (scratch tags, lifecycle edges, pool
+        points) are flag checks + GIL-atomic container ops: a scope
+        carries no lock attribute anywhere, the hooks never touch a
+        registry, and a disabled scope's hooks mutate nothing."""
+        from veles_tpu.observe.memscope import MemScope
+
+        scope = MemScope(leak_min_bytes=1, limit_bytes=None)
+        assert not any("lock" in attr.lower() for attr in vars(scope))
+        registry = MetricsRegistry(enabled=False)
+        scope.scratch_note("r1", 4096)
+        scope.edge_begin("breaker_rebuild")
+        scope.edge_end("breaker_rebuild")
+        scope.scratch_drop("r1")
+
+        class _Pool:
+            used_pages = 3
+            free_pages = 5
+
+        scope.note_pool(_Pool())
+        # record-path hooks generated zero registry traffic (publish
+        # is the scrape-time seam, and a disabled registry's family
+        # mutators are no-ops anyway)
+        assert registry._families == {}
+        scope.publish(registry)
+        assert registry._families == {}
+        # rings are bounded; tallies recorded the activity
+        assert scope.edges_total == 1
+        assert len(scope._pool_points) == 1
+        # a disabled scope's hooks are structural no-ops
+        scope.enabled = False
+        scope.scratch_note("r2", 1)
+        scope.edge_begin("swap_params")
+        assert scope.edge_end("swap_params") is None
+        scope.note_pool(_Pool())
+        assert "r2" not in scope._scratch
+        assert len(scope._open_edges) == 0
+        assert len(scope._pool_points) == 1
+
     def test_request_ledger_null_and_default_paths(self):
         """ISSUE 10: with NO ledger attached (the default) a decoder
         leaves the process ledger untouched — one attribute check per

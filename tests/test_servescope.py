@@ -555,7 +555,8 @@ class TestHTTPSurfaces:
             index = json.load(urllib.request.urlopen(
                 url + "/debug/", timeout=10))
             assert set(index["surfaces"]) == {
-                "/debug/requests", "/debug/history", "/debug/serve"}
+                "/debug/requests", "/debug/history", "/debug/serve",
+                "/debug/memory"}
             healthz = json.load(urllib.request.urlopen(
                 url + "/healthz", timeout=10))
             assert 0.0 <= healthz["servescope"]["goodput"] <= 1.0
@@ -575,7 +576,8 @@ class TestHTTPSurfaces:
     def test_restful_api_mounts_index(self):
         from veles_tpu.core.httpd import DEBUG_SURFACES
         assert set(DEBUG_SURFACES) == {
-            "/debug/requests", "/debug/history", "/debug/serve"}
+            "/debug/requests", "/debug/history", "/debug/serve",
+            "/debug/memory"}
 
 
 # -- the chaos waste profile ------------------------------------------------

@@ -73,6 +73,19 @@ RECORD_PATH_FUNCTIONS = {
                               "ServeScope.note_slot_admit",
                               "ServeScope.note_slot_first",
                               "ServeScope.note_slot_retire"},
+    # the HBM attribution plane: scratch tags sit on the admission
+    # handler/resolve paths, the lifecycle-edge snapshots on the
+    # driver's rebuild/swap/promote seams, note_pool on the governor
+    # tick — all GIL-atomic container ops. MemScope is deliberately
+    # NOT a shared class (the FlightRecorder doctrine above: its
+    # tallies are best-effort, its containers copy-on-write tuples
+    # and bounded deques); incident writes live in flush_incidents,
+    # NOT declared
+    "observe/memscope.py": {"MemScope.scratch_note",
+                            "MemScope.scratch_drop",
+                            "MemScope.edge_begin",
+                            "MemScope.edge_end",
+                            "MemScope.note_pool"},
 }
 
 #: module-path suffix -> {class name: (exempt method names,)}; every

@@ -174,12 +174,18 @@ class _Entry:
     compilation releases the GIL), so first-token latency is one
     parallel compile, not a queue."""
 
-    __slots__ = ("row", "blob", "mesh", "compiled", "compile_seconds",
-                 "lock", "cache", "cache_key", "from_cache")
+    __slots__ = ("row", "blob", "blob_bytes", "mesh", "compiled",
+                 "compile_seconds", "lock", "cache", "cache_key",
+                 "from_cache")
 
     def __init__(self, row, blob, mesh, cache=None, cache_key=None):
         self.row = row
         self.blob = blob
+        #: serialized program size, recorded before get() clears the
+        #: blob — the footprint proxy memscope's ``aot_executables``
+        #: accountant sums (the compiled executable's device size is
+        #: not introspectable, and the StableHLO bytes track it)
+        self.blob_bytes = len(blob) if blob is not None else 0
         self.mesh = mesh
         self.compiled = None
         self.compile_seconds = 0.0
@@ -239,9 +245,27 @@ class AotPrograms:
         self.misses = {}
         with _LOADED_LOCK:
             _LOADED.add(self)
+        # per-owner HBM attribution (observe/memscope.py): every live
+        # bundle reports its footprint under "aot_executables"; the
+        # weakref registry drops this bundle when it is collected
+        try:
+            from veles_tpu.observe.memscope import get_memscope
+            get_memscope().register(
+                "aot_executables", self,
+                lambda programs: programs.footprint_bytes())
+        except Exception:
+            pass
 
     def __len__(self):
         return len(self._entries)
+
+    def footprint_bytes(self):
+        """Loaded-program footprint: the serialized StableHLO bytes of
+        every entry (recorded at load — the compiled executable's
+        device size is not introspectable, and the blob size tracks
+        it). Lock-free: ``_entries`` is write-once at load time."""
+        return sum(entry.blob_bytes
+                   for entry in self._entries.values())
 
     def _prefetch_order(self):
         """Step/dispatch programs first (every request needs one),

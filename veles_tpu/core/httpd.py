@@ -188,6 +188,10 @@ DEBUG_SURFACES = {
                     "timeline + token-waste decomposition "
                     "(observe/servescope.py; assemble with `veles_tpu "
                     "observe serve-trace`)",
+    "/debug/memory": "per-owner HBM attribution: reconciled owner "
+                     "bytes + untagged residue, lifecycle-edge leak "
+                     "verdicts and the pool headroom forecast "
+                     "(observe/memscope.py)",
 }
 
 
@@ -277,6 +281,32 @@ def serve_debug_history(handler, history=None):
             except ValueError:
                 pass
     reply(handler, history.debug_snapshot(series=series, window=window))
+    return True
+
+
+def serve_debug_memory(handler, scope=None):
+    """Route ``GET /debug/memory``: memscope's reconciled per-owner
+    HBM attribution — owner bytes + the ``untagged`` residue against
+    the device total, the trailing lifecycle-edge leak verdicts (with
+    incident artifact paths) and the pool headroom forecast as JSON
+    (``observe/memscope.py``). Query param: ``edges=`` (trailing edge
+    verdicts to include, default 16, capped 64). Mounted on the
+    serving surfaces beside ``/debug/serve``; returns True when
+    handled."""
+    path, _, query = handler.path.partition("?")
+    if path != "/debug/memory":
+        return False
+    if scope is None:
+        from veles_tpu.observe.memscope import get_memscope
+        scope = get_memscope()
+    edges = 16
+    for part in query.split("&"):
+        if part.startswith("edges="):
+            try:
+                edges = max(1, min(64, int(part[len("edges="):])))
+            except ValueError:
+                pass
+    reply(handler, scope.debug_snapshot(edges=edges))
     return True
 
 

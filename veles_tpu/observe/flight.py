@@ -132,6 +132,17 @@ class FlightRecorder:
                 doc["requests"] = ledger.debug_snapshot(slowest=16)
         except Exception:
             pass
+        try:
+            # the HBM attribution tail (observe/memscope.py): who owned
+            # the bytes when this box dumped — an OOM-adjacent autopsy
+            # starts from the owner decomposition, not the raw total
+            from veles_tpu.observe.memscope import get_memscope
+            scope = get_memscope()
+            summary = scope.summary()
+            if summary.get("tagged_bytes"):
+                doc["memscope"] = summary
+        except Exception:
+            pass
         with self._dump_lock:
             try:
                 if path is None:

@@ -99,13 +99,15 @@ class GovernorConfig:
     KEYS = ("demote_burn", "recover_burn", "cooldown_s", "interval_s",
             "ladder", "min_admit", "admit_factor", "pool_high",
             "prewarm", "prewarm_hot", "breaker_guard",
-            "guard_memory_frac", "deploy_aware", "enabled")
+            "guard_memory_frac", "headroom_guard_s", "deploy_aware",
+            "enabled")
 
     def __init__(self, demote_burn=2.0, recover_burn=1.0,
                  cooldown_s=10.0, interval_s=0.25, ladder=("int8",),
                  min_admit=2, admit_factor=0.5, pool_high=0.85,
                  prewarm=True, prewarm_hot=3, breaker_guard=True,
-                 guard_memory_frac=0.97, deploy_aware=True,
+                 guard_memory_frac=0.97, headroom_guard_s=0.0,
+                 deploy_aware=True,
                  flag="root.common.serve.governor"):
         self.demote_burn = float(demote_burn)
         self.recover_burn = float(recover_burn)
@@ -159,6 +161,14 @@ class GovernorConfig:
         if not 0 < self.guard_memory_frac <= 1:
             raise ValueError("%s: guard_memory_frac must be in (0, 1], "
                              "got %r" % (flag, guard_memory_frac))
+        #: trip the breaker when memscope forecasts the KV pool
+        #: exhausting within this many seconds at the current net
+        #: admission rate (observe/memscope.py headroom forecast);
+        #: 0 disables the guard — the forecast only warns on surfaces
+        self.headroom_guard_s = float(headroom_guard_s)
+        if self.headroom_guard_s < 0:
+            raise ValueError("%s: headroom_guard_s must be >= 0, "
+                             "got %r" % (flag, headroom_guard_s))
         #: suppress tier demotions whose burn is attributable to a
         #: ramping green slice rather than ambient load
         #: (docs/zero_downtime.md): the rollout predicate owns the
@@ -205,7 +215,7 @@ def parse_governor_spec(spec, flag="root.common.serve.governor"):
         return None
     numeric = ("demote_burn", "recover_burn", "cooldown_s",
                "interval_s", "admit_factor", "pool_high",
-               "guard_memory_frac")
+               "guard_memory_frac", "headroom_guard_s")
     for key in numeric:
         if key in spec:
             try:
@@ -366,6 +376,11 @@ class ServingGovernor(Logger):
         self._now = now
         pool = api.decoder.pool
         pool_snap = pool.snapshot() if pool is not None else None
+        if pool is not None:
+            # feed the headroom forecast where the pool is already
+            # being read — one GIL-atomic ring append per tick
+            from veles_tpu.observe.memscope import get_memscope
+            get_memscope().note_pool(pool)
         if self.history is not None:
             if pool_snap is not None:
                 # the pressure reading _resize_admission acts on,
@@ -598,6 +613,13 @@ class ServingGovernor(Logger):
             frac = self._device_memory_frac()
             if frac is not None and frac >= self.config.guard_memory_frac:
                 reason = "device memory %.1f%% of limit" % (frac * 100)
+        if reason is None and self.config.headroom_guard_s > 0:
+            from veles_tpu.observe.memscope import get_memscope
+            headroom = get_memscope().headroom_forecast_s()
+            if headroom is not None \
+                    and headroom <= self.config.headroom_guard_s:
+                reason = ("pool exhausts in ~%.0fs at current admission"
+                          % headroom)
         if reason is None:
             return
         self._last_guard = now
@@ -608,18 +630,29 @@ class ServingGovernor(Logger):
 
     @staticmethod
     def _device_memory_frac():
-        """bytes_in_use / bytes_limit of the first local device, or
-        None when the backend has no allocator report (CPU)."""
+        """Worst ``bytes_in_use / bytes_limit`` across the local
+        devices via the shared sampler
+        (``xla_stats._sample_device_memory``), falling back to
+        memscope's reconciled total over the configured byte budget —
+        so the memory guard applies on EVERY backend, not just the
+        ones whose allocator reports ``memory_stats()`` (the old raw
+        ``jax.local_devices()[0].memory_stats()`` read silently
+        no-op'd on CPU). None only when no limit exists anywhere."""
         try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats()
-            if not stats:
-                return None
-            limit = stats.get("bytes_limit")
-            used = stats.get("bytes_in_use")
-            if not limit or used is None:
-                return None
-            return used / limit
+            from veles_tpu.observe.xla_stats import _sample_device_memory
+            worst = None
+            for stats in _sample_device_memory().values():
+                limit = stats.get("bytes_limit")
+                used = stats.get("bytes_in_use")
+                if not limit or used is None:
+                    continue
+                frac = used / limit
+                if worst is None or frac > worst:
+                    worst = frac
+            if worst is not None:
+                return worst
+            from veles_tpu.observe.memscope import get_memscope
+            return get_memscope().device_fraction()
         except Exception:
             return None
 

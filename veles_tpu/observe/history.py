@@ -89,7 +89,8 @@ FLEET_MAX_POINTS = 32
 SUMMARY_PREFIXES = ("veles_ctrl_", "veles_slo_", "veles_serving_",
                     "veles_serve_", "veles_kv_", "veles_anomaly_",
                     "veles_mfu_ratio", "veles_governor_",
-                    "veles_fleet_goodput", "veles_fleet_straggler")
+                    "veles_fleet_goodput", "veles_fleet_straggler",
+                    "veles_hbm_", "veles_headroom_")
 
 #: rules that stand in for "the user-visible breach" when computing an
 #: incident's leading-indicator lead time: SLO burn for serving,

@@ -134,11 +134,22 @@ SCHEMA_VERSION = 1
 #: round trip) uses the higher-is-better default — trace round-trip
 #: fidelity decaying is a recorder or replayer bug, gated like any
 #: throughput loss.
+#: The memscope keys (observe/memscope.py, bench memscope_section —
+#: docs/memscope.md): the per-owner hbm_owner_*_bytes keys ride
+#: "_bytes" (an owner's footprint quietly growing at fixed geometry is
+#: a regression — the whole point of attribution is making that
+#: visible per cause); "_untagged_fraction" regresses UP and needs its
+#: OWN suffix entry because the bare "_fraction" is deliberately
+#: higher-better (the fleetscope doctrine above) — untagged residue
+#: growing means the accountants stopped explaining the device total,
+#: i.e. attribution coverage decayed; headroom_forecast_s uses the
+#: higher-is-better default (the pool exhausting SOONER at the same
+#: admission profile is a regression).
 _LOWER_BETTER = ("_ms", "_seconds", "_sec_mean", "_overhead_fraction",
                  "_overhead_pct", "_std", "_bytes", "_hit_fraction",
                  "_flatness", "_compiles", "burn_rate", "_transitions",
                  "_ns", "_anomaly_rate", "_waste_share",
-                 "_shed_requests")
+                 "_shed_requests", "_untagged_fraction")
 #: key suffixes that are measurement metadata, never compared
 _SKIP_SUFFIXES = ("_config", "_spread", "_warn", "_spread_warn")
 #: spread-carrying metric suffixes: "<base><suffix>" looks up

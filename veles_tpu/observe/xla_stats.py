@@ -439,6 +439,14 @@ def publish_device_stats(registry):
                 "veles_device_memory_bytes", value,
                 labels={"device": str(dev_id), "kind": kind},
                 help="device allocator stats per local device")
+        # the allocator budget as its own gauge, so dashboards render
+        # headroom fraction without digging bytes_limit out of the
+        # per-kind stats rows
+        if stats.get("bytes_limit"):
+            registry.set(
+                "veles_device_memory_limit_bytes", stats["bytes_limit"],
+                labels={"device": str(dev_id)},
+                help="device allocator byte budget per local device")
     peak = peak_tflops()
     if peak:
         registry.set("veles_device_peak_bf16_tflops", peak,
@@ -473,6 +481,8 @@ def publish_xla_stats(registry):
     publish_reduce_stats(registry)
     from veles_tpu.aot.loader import publish_aot_stats
     publish_aot_stats(registry)
+    from veles_tpu.observe.memscope import publish_memscope
+    publish_memscope(registry)
 
 
 def ensure_registered(registry=None):

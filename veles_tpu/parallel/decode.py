@@ -441,6 +441,23 @@ def init_slot_state(n_blocks, slots, max_len, heads, head_dim, vocab,
     return state
 
 
+def slot_state_bytes(state):
+    """Device bytes of a slot/paged decode state pytree — the
+    ``decode_state`` memscope accountant's sizing primitive. For the
+    paged layout the PAGE leaves are charged to the ``kv_pool`` owner
+    instead (``kv_pool.paged_kv_bytes``), so callers subtract."""
+    from veles_tpu.observe.memscope import pytree_nbytes
+    return pytree_nbytes(state)
+
+
+def param_tree_bytes(params, embed_table=None):
+    """Device bytes of a parameter tree (plus the tied embedding table
+    when it is a separate leaf) — the ``params`` / ``param_stash``
+    memscope accountants' sizing primitive."""
+    from veles_tpu.observe.memscope import pytree_nbytes
+    return pytree_nbytes(params) + pytree_nbytes(embed_table)
+
+
 def _slot_admit_many(params, embed_table, heads, state, slots,
                      prompt_x, req_keys, lengths):
     """Admit a whole same-bucket group in ONE dispatch: prefill

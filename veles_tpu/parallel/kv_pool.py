@@ -128,6 +128,26 @@ def _page_size_of(state):
             else state["k"].shape[2])
 
 
+#: the paged-state leaves whose bytes belong to the PAGE POOL rather
+#: than the decoder's control state — what memscope charges the
+#: ``kv_pool`` owner (observe/memscope.py)
+PAGED_KV_LEAVES = ("k", "v", "k_scale", "v_scale")
+
+
+def paged_kv_bytes(state):
+    """Device bytes of the page arrays inside a paged decode state
+    (both tiers: float K/V, or int8 K/V + f32 scales). The decoder
+    stamps ``pool.page_bytes = paged_kv_bytes(state) // pool.pages``
+    so attribution splits one pytree between the ``kv_pool`` and
+    ``decode_state`` owners without double-counting."""
+    total = 0
+    for leaf in PAGED_KV_LEAVES:
+        arr = state.get(leaf)
+        if arr is not None:
+            total += getattr(arr, "nbytes", 0) or 0
+    return total
+
+
 def _pad_positions(val, t_padded):
     """Zero-pad the positions axis (axis 2 of an (L, B, T, ...) stack)
     up to ``t_padded`` — whole-page scatter granularity."""
@@ -628,6 +648,12 @@ class PagePool:
         #: that prices Retry-After for pool-aware backpressure
         self._freed_events = collections.deque(maxlen=512)
         self.cache = cache if cache is not None else PrefixCache()
+        #: device bytes per page across every KV leaf — the decoder
+        #: stamps this once from its paged state (the page ARRAYS live
+        #: in the decode state pytree; the pool only owns the table),
+        #: so memscope attribution can charge the pool its footprint
+        #: without double-counting the state tree
+        self.page_bytes = 0
 
     # -- accounting -------------------------------------------------------
     @property
@@ -644,6 +670,24 @@ class PagePool:
     def used_pages(self):
         with self._lock:
             return self.capacity - len(self._free)
+
+    def hbm_bytes(self):
+        """Device footprint of the page arrays this pool tables:
+        pages x page_bytes. Lock-free (two write-once ints) — this is
+        a memscope accountant and runs at metrics scrape time."""
+        return self.pages * self.page_bytes
+
+    def shadow_bytes(self):
+        """Host bytes pinned by the prefix cache's page shadows (the
+        re-materialization copies that survive a breaker rebuild).
+        Iterates a point-in-time list copy without the lock — an
+        approximate byte count is fine for attribution, and a memscope
+        accountant must never contend with the admission path."""
+        total = 0
+        for leaves in list(self.cache.page_shadow.values()):
+            for arr in list(leaves.values()):
+                total += getattr(arr, "nbytes", 0) or 0
+        return total
 
     def snapshot(self):
         with self._lock:

@@ -505,6 +505,17 @@ class ServingHealth:
                 snap["slo"] = summary
         if governor is not None:
             snap["governor"] = governor.snapshot()
+        # the HBM attribution cell: the LIGHT summary only (top tagged
+        # owners, headroom forecast, leak tally) — the reconciled
+        # device scan stays on /metrics and /debug/memory, not on
+        # every /healthz poll
+        try:
+            from veles_tpu.observe.memscope import get_memscope
+            memscope = get_memscope().summary()
+            if memscope.get("tagged_bytes"):
+                snap["memscope"] = memscope
+        except Exception:
+            pass
         return snap
 
 
@@ -554,6 +565,7 @@ class RESTfulAPI(Unit):
                                           enable_metrics, read_body,
                                           serve_debug_history,
                                           serve_debug_index,
+                                          serve_debug_memory,
                                           serve_debug_requests,
                                           serve_debug_serve,
                                           serve_health, serve_metrics,
@@ -586,6 +598,8 @@ class RESTfulAPI(Unit):
                 if serve_debug_history(self):
                     return
                 if serve_debug_serve(self):
+                    return
+                if serve_debug_memory(self):
                     return
                 if serve_debug_index(self):
                     return
@@ -1042,6 +1056,42 @@ class ContinuousDecoder:
         #: and that collect's span must still attach to the request's
         #: trace instead of rooting an orphan
         self._done_trace = collections.OrderedDict()
+        #: per-owner HBM attribution (observe/memscope.py): this
+        #: decoder's pytrees report under named owners. The paged KV
+        #: leaves live in ``self.state`` but BELONG to the pool —
+        #: page_bytes is stamped here and decode_state subtracts the
+        #: pool's share, so the two owners split one pytree without
+        #: double-counting. Registration is weakref'd: a decoder the
+        #: breaker replaces drops out when GC takes it — and a RETAINED
+        #: zombie keeps reporting, which is exactly how the lifecycle
+        #: edge diff names the leaked owner.
+        try:
+            from veles_tpu.observe.memscope import get_memscope
+            from veles_tpu.parallel.decode import (param_tree_bytes,
+                                                   slot_state_bytes)
+            scope = get_memscope()
+            scope.register(
+                "params", self,
+                lambda dec: param_tree_bytes(dec.params,
+                                             dec.embed_table))
+            if self.pool is not None:
+                from veles_tpu.parallel.kv_pool import paged_kv_bytes
+                self.pool.page_bytes = (paged_kv_bytes(self.state)
+                                        // self.pool.pages)
+                scope.register("kv_pool", self.pool,
+                               lambda pool: pool.hbm_bytes())
+                scope.register("prefix_shadows", self.pool,
+                               lambda pool: pool.shadow_bytes())
+                scope.register(
+                    "decode_state", self,
+                    lambda dec: max(0, slot_state_bytes(dec.state)
+                                    - dec.pool.hbm_bytes()))
+            else:
+                scope.register(
+                    "decode_state", self,
+                    lambda dec: slot_state_bytes(dec.state))
+        except Exception:
+            pass
 
     def _span(self, name, rids, **attrs):
         """A span parented to the first TRACED request among ``rids``
@@ -2340,6 +2390,20 @@ class GenerateAPI:
         #: collected); discarded — never collected — when the breaker
         #: trips or the server stops
         self._pending = None
+        # the one-slot rollback stash is DELIBERATE retention of a
+        # whole param tree — tag it (memscope's exempt owner) so the
+        # lifecycle-edge diff never mistakes it for a leak, and
+        # dashboards see what rollback readiness costs in bytes
+        try:
+            from veles_tpu.observe.memscope import (get_memscope,
+                                                    pytree_nbytes)
+            get_memscope().register(
+                "param_stash", self,
+                lambda api: (pytree_nbytes(api._param_stash[0])
+                             + pytree_nbytes(api._param_stash[1])
+                             if api._param_stash is not None else 0))
+        except Exception:
+            pass
 
     # -- driver thread (sole owner of the decoder) ------------------------
     def _resolve(self, holder, outcome, **fields):
@@ -2352,6 +2416,11 @@ class GenerateAPI:
         if holder.setdefault("resolved", token) is not token:
             return
         holder.update(fields)
+        # release the request's admission-scratch tag (memscope
+        # attribution) — exactly-once is inherited from the resolved
+        # token; a single GIL-atomic dict pop either way
+        from veles_tpu.observe.memscope import get_memscope
+        get_memscope().scratch_drop(holder.pop("memscope_key", None))
         reserved = holder.pop("pool_reserved", 0)
         if reserved:
             pool = holder.get("pool")
@@ -2590,7 +2659,13 @@ class GenerateAPI:
         """Build a fresh decoder from the held params/embed_table and
         prove the device path end to end with a probe decode
         (:meth:`_build_probed_decoder`); only a probed decoder takes
-        traffic again. Returns True on success."""
+        traffic again. Returns True on success. The whole seam is a
+        memscope lifecycle edge: the per-owner diff across it names
+        anything that survived the trip it should not have (the
+        classic leak — the old pool outliving the rebuild)."""
+        from veles_tpu.observe.memscope import get_memscope
+        memscope = get_memscope()
+        memscope.edge_begin("breaker_rebuild")
         try:
             kwargs, tier = self._governed_kwargs()
             same_tier = tier == (self.decoder.quantize or "bf16")
@@ -2617,8 +2692,20 @@ class GenerateAPI:
         except Exception:
             import traceback
             traceback.print_exc()
+            # close the edge either way: a failed rebuild retries and
+            # re-opens its own edge; leaving one dangling would pair a
+            # later end with a stale baseline
+            memscope.edge_end("breaker_rebuild", gc_collect=True)
             return False
         self._install_decoder(decoder)
+        # the old decoder was just unbound; this seam already pays
+        # seconds of compile, so a GC pass before the diff is free —
+        # any owner still grown across the edge is a real retention,
+        # and the verdict artifact (cold path, not the token loop)
+        # names it
+        verdict = memscope.edge_end("breaker_rebuild", gc_collect=True)
+        if verdict is not None and verdict["leak"]:
+            memscope.flush_incidents()
         return True
 
     # -- governor actuation seams (driver thread) -------------------------
@@ -2747,6 +2834,9 @@ class GenerateAPI:
         is shed on either path — the staged queue held while the
         swap was pending and drains into whichever weights won."""
         flight = get_flight_recorder()
+        from veles_tpu.observe.memscope import get_memscope
+        memscope = get_memscope()
+        memscope.edge_begin("swap_params")
         new_params = holder["params"]
         new_table = holder.get("embed_table")
         if self.chaos is not None:
@@ -2802,6 +2892,7 @@ class GenerateAPI:
             holder["error"] = ("swap refused, old weights serving: %s"
                                % exc)
             holder["event"].set()
+            memscope.edge_end("swap_params", gc_collect=True)
             return False
         # success: the new checkpoint is authoritative for every
         # future breaker rebuild, and the replaced raw params become
@@ -2817,6 +2908,12 @@ class GenerateAPI:
         self.health.incr("param_swaps")
         flight.note("deploy.swap", version=str(self.version))
         holder["event"].set()
+        # the one-slot rollback stash GROWS here by design — it
+        # reports under the exempt "param_stash" owner, so the edge
+        # diff only flags bytes nobody accounts for
+        verdict = memscope.edge_end("swap_params", gc_collect=True)
+        if verdict is not None and verdict["leak"]:
+            memscope.flush_incidents()
         return True
 
     def _start_green(self, holder):
@@ -2908,6 +3005,9 @@ class GenerateAPI:
             if self.decoder.busy or self._pending is not None \
                     or waiting:
                 return
+            from veles_tpu.observe.memscope import get_memscope
+            memscope = get_memscope()
+            memscope.edge_begin("rollout_promote")
             self._param_stash = (self._decoder_kwargs["params"],
                                  self._decoder_kwargs["embed_table"],
                                  self.version)
@@ -2925,6 +3025,12 @@ class GenerateAPI:
             self._green = None
             rollout.finish_promote(api=self)
             self.health.incr("promotes")
+            # the blue decoder was just unbound; the edge diff names
+            # any owner it leaves behind (its pool must die with it)
+            verdict = memscope.edge_end("rollout_promote",
+                                        gc_collect=True)
+            if verdict is not None and verdict["leak"]:
+                memscope.flush_incidents()
 
     def _apply_tier(self, tier):
         """The graceful tier swap: the decoder is idle (the driver
@@ -3169,6 +3275,7 @@ class GenerateAPI:
                                           reply, retry_after_headers,
                                           serve_debug_history,
                                           serve_debug_index,
+                                          serve_debug_memory,
                                           serve_debug_requests,
                                           serve_debug_serve,
                                           serve_health, serve_metrics,
@@ -3216,6 +3323,8 @@ class GenerateAPI:
                 if serve_debug_history(self):
                     return
                 if serve_debug_serve(self, api.scope, api.ledger):
+                    return
+                if serve_debug_memory(self):
                     return
                 if serve_debug_index(self):
                     return
@@ -3408,6 +3517,16 @@ class GenerateAPI:
                 if booked.get("reserved"):
                     holder["pool"] = booked["pool"]
                     holder["pool_reserved"] = booked["need"]
+                # tag the staged request's host-side scratch (prompt
+                # tokens + the token budget it may produce, int32) for
+                # memscope's admission_scratch owner; _resolve drops
+                # the tag exactly once. One GIL-atomic dict set.
+                from veles_tpu.observe.memscope import get_memscope
+                holder["memscope_key"] = id(holder)
+                get_memscope().scratch_note(
+                    id(holder),
+                    (len(prompt) + (budget if budget is not None
+                                    else api.decoder.n_tokens)) * 4)
                 api._staged.put((prompt, budget, holder))
                 api._wake.set()
                 trace_headers = {}

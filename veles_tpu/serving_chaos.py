@@ -103,7 +103,8 @@ class ServingChaosConfig(ChaosConfigBase):
                  pool_flood_steps=0, compile_storm_at=None,
                  waste_cause=None, waste_tokens=0, waste_at=0,
                  waste_steps=0, deploy_green_ramp_ms=0.0,
-                 deploy_green_ramp_steps=0, deploy_poison_nan=False):
+                 deploy_green_ramp_steps=0, deploy_poison_nan=False,
+                 leak_retain_pool_at=None):
         self._set_probabilities(
             step_fail=step_fail, slow_step=slow_step,
             disconnect=disconnect, garbage_body=garbage_body,
@@ -154,6 +155,16 @@ class ServingChaosConfig(ChaosConfigBase):
                 or self.deploy_green_ramp_steps < 0:
             raise ValueError("deploy green ramp knobs must be >= 0")
         self.deploy_poison_nan = bool(deploy_poison_nan)
+        # leak-injection profile (observe/memscope.py): at the given
+        # step, retain a strong reference to the live KV pool and trip
+        # the breaker — the rebuilt decoder's pool then COEXISTS with
+        # the zombie, and memscope's lifecycle-edge diff must name
+        # kv_pool as the grown owner in its incident artifact
+        if leak_retain_pool_at is not None:
+            leak_retain_pool_at = int(leak_retain_pool_at)
+            if leak_retain_pool_at < 0:
+                raise ValueError("leak_retain_pool_at must be >= 0")
+        self.leak_retain_pool_at = leak_retain_pool_at
 
     @property
     def any_profile(self):
@@ -166,7 +177,8 @@ class ServingChaosConfig(ChaosConfigBase):
                         and self.waste_steps)
                     or (self.deploy_green_ramp_ms
                         and self.deploy_green_ramp_steps)
-                    or self.deploy_poison_nan)
+                    or self.deploy_poison_nan
+                    or self.leak_retain_pool_at is not None)
 
     def expected_leading_series(self):
         """The metric series each configured burn profile is expected
@@ -194,6 +206,10 @@ class ServingChaosConfig(ChaosConfigBase):
         if self.deploy_poison_nan:
             from veles_tpu.rollout import SWAP_SERIES
             out["deploy_poison"] = SWAP_SERIES
+        if self.leak_retain_pool_at is not None:
+            # the retained pool doubles the kv_pool owner's bytes —
+            # the per-owner attribution family is where it shows first
+            out["pool_leak"] = "veles_hbm_bytes"
         return out
 
     def expected_leading_cause(self):
@@ -221,7 +237,7 @@ class ServingChaosMonkey(Logger):
                          "disconnects": 0, "garbage_bodies": 0,
                          "oversize_bodies": 0, "ramp_stalls": 0,
                          "pool_floods": 0, "compile_storms": 0,
-                         "waste_injections": 0}
+                         "waste_injections": 0, "pool_leaks": 0}
         #: driver-step index: the burn profiles are step-indexed, so a
         #: (config, workload) pair replays the same fault schedule
         self._step = 0
@@ -238,6 +254,11 @@ class ServingChaosMonkey(Logger):
         self._flood_pages = None
         self._flood_pool = None
         self._flood_done = False
+        #: the leak-injection profile's zombie: a strong reference to
+        #: the pool of the decoder the injected trip killed — held so
+        #: the rebuilt pool coexists with it and memscope's edge diff
+        #: has a real retention to name; release_leak() drops it
+        self._leaked_pool = None
         #: fault-inject / fault-clear instants (monotonic): the bench's
         #: governor_demote_to_recover_ms measures from these
         self.stamps = {}
@@ -271,7 +292,8 @@ class ServingChaosMonkey(Logger):
             deploy_green_ramp_ms=cfg.get("deploy_green_ramp_ms", 0.0),
             deploy_green_ramp_steps=cfg.get("deploy_green_ramp_steps",
                                             0),
-            deploy_poison_nan=cfg.get("deploy_poison_nan", False))
+            deploy_poison_nan=cfg.get("deploy_poison_nan", False),
+            leak_retain_pool_at=cfg.get("leak_retain_pool_at", None))
         if not cfg.get("enabled",
                        config.any_enabled or config.any_profile):
             return None
@@ -381,6 +403,23 @@ class ServingChaosMonkey(Logger):
                     self.stamps["waste_start"] = time.monotonic()
             elif step == cfg.waste_at + cfg.waste_steps:
                 self.stamps.setdefault("waste_clear", time.monotonic())
+        if cfg.leak_retain_pool_at is not None \
+                and self._leaked_pool is None and decoder is not None \
+                and getattr(decoder, "pool", None) is not None \
+                and step >= cfg.leak_retain_pool_at:
+            # >=, not ==: the scheduled step can land on a probe
+            # decode's before_step() (no decoder) — retry until a real
+            # driver step carries the pool. Hold the strong ref FIRST,
+            # then trip: the breaker rebuild replaces the decoder, the
+            # zombie pool keeps reporting under kv_pool, and the edge
+            # diff must name it
+            self._leaked_pool = decoder.pool
+            self.counters["pool_leaks"] += 1
+            self.stamps["leak_at"] = time.monotonic()
+            self.warning("chaos: retaining KV pool across the trip "
+                         "(injected leak)")
+            raise ChaosStepError(
+                "chaos: injected trip with retained KV pool")
         if cfg.compile_storm_at is not None \
                 and step == cfg.compile_storm_at:
             from veles_tpu.observe.xla_stats import get_compile_tracker
@@ -443,6 +482,14 @@ class ServingChaosMonkey(Logger):
             pool.unreserve(reserved)
         finally:
             self.stamps["flood_clear"] = time.monotonic()
+
+    def release_leak(self):
+        """Drop the retained zombie pool (the injected leak clears;
+        safe to call from the harness at teardown — the NEXT lifecycle
+        edge diff then sees kv_pool shrink back)."""
+        if self._leaked_pool is not None:
+            self._leaked_pool = None
+            self.stamps["leak_clear"] = time.monotonic()
 
     # -- client-side faults (rolled by the harness's chaos client) ------------
     def roll_client_fault(self):

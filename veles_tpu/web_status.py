@@ -271,6 +271,25 @@ def format_serving_health(serving):
         rate = pool.get("prefix_hit_rate")
         if isinstance(rate, (int, float)):
             parts.append("prefix hit %d%%" % round(rate * 100))
+    memscope = serving.get("memscope")
+    if isinstance(memscope, dict):
+        # the HBM attribution cell (observe/memscope.py): who owns the
+        # bytes, how long the pool lasts at the current admission
+        # rate, and whether a lifecycle edge leaked — the on-call's
+        # first look before the raw device gauge
+        owner = memscope.get("top_owner")
+        tagged = memscope.get("tagged_bytes")
+        if owner and isinstance(tagged, (int, float)) and tagged:
+            parts.append("hbm %dMB (top %s)"
+                         % (round(tagged / 1e6), owner))
+        headroom = memscope.get("headroom_s")
+        if isinstance(headroom, (int, float)):
+            parts.append("headroom ~%ds" % round(headroom))
+        leaks = memscope.get("leaks")
+        if leaks:
+            parts.append("%d leaks (%s)"
+                         % (leaks,
+                            memscope.get("last_leak_owner", "?")))
     return " · ".join(parts)
 
 
